@@ -1,0 +1,35 @@
+"""bodywork_tpu — a TPU-native ML pipeline lifecycle framework.
+
+A brand-new JAX/XLA-first framework with the capabilities of the Bodywork
+MLOps demo (reference: AlexIoannides/bodywork-mlops-demo): a daily
+train -> serve -> generate-drift-data -> test-the-live-service loop for a
+regression model under concept drift.
+
+Subpackages
+-----------
+- ``store``    — date-versioned artefact store (filesystem / GCS-ready),
+                 replacing the reference's S3 data plane (C7 in SURVEY.md).
+- ``data``     — drift-data generator on ``jax.random`` (reference C4,
+                 ``stage_3_synthetic_data_generation.py``).
+- ``models``   — jitted regressors (closed-form OLS, 3-layer MLP), metrics,
+                 pytree checkpointing (reference C2/C6).
+- ``train``    — training orchestration over the artefact store
+                 (reference ``stage_1_train_model.py``).
+
+Planned (landing incrementally; see SURVEY.md §7 build plan):
+
+- ``ops``      — Pallas TPU kernels for the hot compute paths.
+- ``parallel`` — ``jax.sharding.Mesh`` utilities, data-parallel scoring and
+                 dp+tp training-step sharding (reference has no distributed
+                 backend; this is the TPU-native replacement).
+- ``serve``    — Flask ``/score/v1`` scoring service with params resident in
+                 TPU HBM (reference ``stage_2_serve_model.py``).
+- ``monitor``  — live-service tester + drift metrics + longitudinal
+                 analytics (reference ``stage_4`` + analytics notebook).
+- ``pipeline`` — declarative pipeline spec, local runner, GKE TPU manifest
+                 generation (reference ``bodywork.yaml``).
+"""
+
+from bodywork_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,25 @@
+from bodywork_tpu.data.generator import (
+    DriftConfig,
+    alpha,
+    generate_day,
+    generate_dataframe,
+)
+from bodywork_tpu.data.io import (
+    Dataset,
+    load_all_datasets,
+    load_dataset,
+    load_latest_dataset,
+    persist_dataset,
+)
+
+__all__ = [
+    "DriftConfig",
+    "alpha",
+    "generate_day",
+    "generate_dataframe",
+    "Dataset",
+    "load_all_datasets",
+    "load_dataset",
+    "load_latest_dataset",
+    "persist_dataset",
+]

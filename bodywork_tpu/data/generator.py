@@ -1,0 +1,99 @@
+"""Synthetic drift-data generator (reference C4, the "drift engine").
+
+Behavioral spec reproduced exactly from
+``stage_3_synthetic_data_generation.py:28-43`` (see SURVEY.md §2):
+
+    y = alpha(d) + beta * X + sigma * eps
+    X ~ U(0, 100), eps ~ N(0, 1), n = 24*60 = 1440 rows/day, keep y >= 0
+    alpha(d) = kappa + A * sin(2*pi*f*(d-1)/364)      # d = day of year
+    beta = 0.5, sigma = 10, f = 6, kappa = 1, A = 0.5
+
+Concept drift: the intercept oscillates 6 cycles/year in [0.5, 1.5],
+deliberately degrading any model trained on earlier days — drift as a
+controlled failure mode.
+
+TPU-native design differences from the reference (not bugs — upgrades):
+
+- ``jax.random`` with an explicit per-day PRNG key derived from the simulated
+  date, so every day's dataset is *reproducible* (the reference's seedless
+  ``np.random`` is not).
+- Sampling is a single fused jitted program; the ``y >= 0`` filter runs on
+  device via a mask and the (data-dependent) compaction happens on host,
+  keeping shapes static inside ``jit``.
+- The generator is parameterised by simulated date rather than wall-clock
+  ``date.today()`` (``stage_3:35``), so multi-day simulations can run faster
+  than real time.
+"""
+from __future__ import annotations
+
+import dataclasses
+from datetime import date
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bodywork_tpu.utils.dates import day_of_year
+
+
+@dataclasses.dataclass(frozen=True)
+class DriftConfig:
+    """Generative-model parameters (defaults = reference ``stage_3:19,36-38``)."""
+
+    n_samples: int = 24 * 60          # rows sampled per simulated day
+    beta: float = 0.5                 # slope
+    sigma: float = 10.0               # noise scale
+    freq: float = 6.0                 # intercept cycles per year
+    kappa: float = 1.0                # intercept mean
+    amplitude: float = 0.5            # intercept oscillation amplitude
+    x_low: float = 0.0
+    x_high: float = 100.0
+    seed: int = 42                    # global seed folded with the date
+
+
+def alpha(day: jax.Array | int, cfg: DriftConfig = DriftConfig()) -> jax.Array:
+    """Drifting intercept for a given day-of-year (``stage_3:31-33``)."""
+    day = jnp.asarray(day, dtype=jnp.float32)
+    return cfg.kappa + cfg.amplitude * jnp.sin(
+        2.0 * jnp.pi * cfg.freq * (day - 1.0) / 364.0
+    )
+
+
+def key_for_date(d: date, cfg: DriftConfig = DriftConfig()) -> jax.Array:
+    """Deterministic PRNG key for a simulated date."""
+    return jax.random.fold_in(jax.random.PRNGKey(cfg.seed), d.toordinal())
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _sample_day(key: jax.Array, day: jax.Array, cfg: DriftConfig):
+    """Fused sampler: returns (X, y, valid_mask), all shape (n_samples,)."""
+    kx, ke = jax.random.split(key)
+    x = jax.random.uniform(
+        kx, (cfg.n_samples,), minval=cfg.x_low, maxval=cfg.x_high
+    )
+    eps = jax.random.normal(ke, (cfg.n_samples,))
+    y = alpha(day, cfg) + cfg.beta * x + cfg.sigma * eps
+    return x, y, y >= 0.0
+
+
+def generate_day(
+    d: date, cfg: DriftConfig = DriftConfig()
+) -> tuple[np.ndarray, np.ndarray]:
+    """Generate one simulated day's data: returns host arrays (X, y).
+
+    Rows with ``y < 0`` are dropped, as in the reference's
+    ``dataset.query('y >= 0')`` (``stage_3:43``).
+    """
+    x, y, mask = _sample_day(key_for_date(d, cfg), day_of_year(d), cfg)
+    mask = np.asarray(mask)
+    return np.asarray(x)[mask], np.asarray(y)[mask]
+
+
+def generate_dataframe(d: date, cfg: DriftConfig = DriftConfig()):
+    """One day's data as a DataFrame with the reference's exact column schema
+    ``['date', 'y', 'X']`` (``stage_3:42``)."""
+    import pandas as pd
+
+    x, y = generate_day(d, cfg)
+    return pd.DataFrame({"date": np.full(len(x), str(d)), "y": y, "X": x})

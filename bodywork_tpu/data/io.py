@@ -1,0 +1,96 @@
+"""Dataset I/O against the artefact store.
+
+Replaces the reference's per-stage S3 dataset plumbing:
+
+- persist: ``stage_3_synthetic_data_generation.py:46-61`` (CSV with columns
+  ``date,y,X``, ``header=True, index=False``, key
+  ``datasets/regression-dataset-<date>.csv``).
+- load-all-history (training): ``stage_1_train_model.py:39-76`` — the
+  reference re-downloads *every* day's CSV from S3 on each training run
+  (O(days) round-trips); here history lives on the local/TPU-VM filesystem
+  and is concatenated once.
+- load-latest (live testing): ``stage_4_test_model_scoring_service.py:39-63``.
+"""
+from __future__ import annotations
+
+import io
+from datetime import date
+
+import numpy as np
+import pandas as pd
+
+from bodywork_tpu.store.base import ArtefactStore
+from bodywork_tpu.store.schema import DATASETS_PREFIX, dataset_key
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("data.io")
+
+
+class Dataset:
+    """A (X, y) regression dataset with its artefact date."""
+
+    def __init__(self, X: np.ndarray, y: np.ndarray, data_date: date | None = None):
+        self.X = np.asarray(X, dtype=np.float32)
+        self.y = np.asarray(y, dtype=np.float32)
+        if self.X.ndim == 1:
+            self.X = self.X[:, None]
+        self.date = data_date
+
+    def __len__(self) -> int:
+        return self.X.shape[0]
+
+    def to_dataframe(self) -> pd.DataFrame:
+        d = str(self.date) if self.date else ""
+        cols = {"date": np.full(len(self), d), "y": self.y, "X": self.X[:, 0]}
+        # extra feature columns beyond the reference's single 'X' are
+        # serialised as X2, X3, ... so multi-feature datasets round-trip
+        for i in range(1, self.X.shape[1]):
+            cols[f"X{i + 1}"] = self.X[:, i]
+        return pd.DataFrame(cols)
+
+    @classmethod
+    def from_dataframe(cls, df: pd.DataFrame, data_date: date | None = None) -> "Dataset":
+        x_cols = ["X"] + sorted(
+            (c for c in df.columns if c.startswith("X") and c[1:].isdigit()),
+            key=lambda c: int(c[1:]),
+        )
+        return cls(df[x_cols].values, df["y"].values, data_date)
+
+
+def persist_dataset(store: ArtefactStore, ds: Dataset) -> str:
+    """Write a day's dataset as CSV under ``datasets/`` (``stage_3:46-61``)."""
+    assert ds.date is not None, "dataset must carry its simulated date"
+    key = dataset_key(ds.date)
+    buf = io.StringIO()
+    ds.to_dataframe().to_csv(buf, header=True, index=False)
+    store.put_text(key, buf.getvalue())
+    log.info(f"persisted {len(ds)} rows to {key}")
+    return key
+
+
+def load_dataset(store: ArtefactStore, key: str) -> Dataset:
+    from bodywork_tpu.utils.dates import date_from_key
+
+    df = pd.read_csv(io.BytesIO(store.get_bytes(key)))
+    return Dataset.from_dataframe(df, date_from_key(key))
+
+
+def load_latest_dataset(store: ArtefactStore) -> Dataset:
+    """Latest day's dataset (``stage_4:39-63``)."""
+    key, _ = store.latest(DATASETS_PREFIX)
+    return load_dataset(store, key)
+
+
+def load_all_datasets(store: ArtefactStore) -> Dataset:
+    """All available history, oldest first, concatenated (``stage_1:39-76``)."""
+    hist = store.history(DATASETS_PREFIX)
+    if not hist:
+        from bodywork_tpu.store.base import ArtefactNotFound
+
+        raise ArtefactNotFound(f"no datasets under '{DATASETS_PREFIX}'")
+    parts = [load_dataset(store, key) for key, _ in hist]
+    X = np.concatenate([p.X for p in parts])
+    y = np.concatenate([p.y for p in parts])
+    most_recent = hist[-1][1]
+    log.info(f"loaded {len(parts)} day(s), {len(y)} rows, most recent {most_recent}")
+    return Dataset(X, y, most_recent)

@@ -1,0 +1,27 @@
+from bodywork_tpu.models.base import Regressor, TrainSplit, train_test_split
+from bodywork_tpu.models.linear import LinearRegressor, LinearConfig
+from bodywork_tpu.models.mlp import MLPRegressor, MLPConfig
+from bodywork_tpu.models.metrics import regression_metrics
+from bodywork_tpu.models.checkpoint import (
+    MODEL_REGISTRY,
+    load_model,
+    load_model_bytes,
+    save_model,
+    save_model_bytes,
+)
+
+__all__ = [
+    "Regressor",
+    "TrainSplit",
+    "train_test_split",
+    "LinearRegressor",
+    "LinearConfig",
+    "MLPRegressor",
+    "MLPConfig",
+    "regression_metrics",
+    "MODEL_REGISTRY",
+    "load_model",
+    "load_model_bytes",
+    "save_model",
+    "save_model_bytes",
+]

@@ -1,0 +1,108 @@
+"""Regressor protocol and train/test split.
+
+The reference's model layer is the sklearn estimator protocol
+(``fit(X, y)`` / ``predict(X)`` — ``stage_1_train_model.py:105-107``,
+``stage_2_serve_model.py:78``). Here the protocol is functional-style:
+models are thin wrappers around a JAX pytree of parameters plus a static
+config; ``fit`` returns a *new* fitted model, ``predict`` routes through a
+jitted apply function that is cached per model class (so repeated instances
+never recompile).
+"""
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+
+def _bucket_rows(n: int, minimum: int = 1024) -> int:
+    """Next power-of-two row count >= n (>= minimum).
+
+    Padding datasets to bucketed static shapes keeps the number of distinct
+    XLA compilations logarithmic in dataset size as the simulated-day history
+    grows — the TPU answer to SURVEY.md's "hard part (2)".
+    """
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def pad_rows(X: np.ndarray, y: np.ndarray, minimum: int = 1024):
+    """Zero-pad (X, y) to a bucketed row count; returns (Xp, yp, weights)."""
+    n = X.shape[0]
+    b = _bucket_rows(n, minimum)
+    Xp = np.zeros((b,) + X.shape[1:], dtype=X.dtype)
+    yp = np.zeros((b,), dtype=y.dtype)
+    w = np.zeros((b,), dtype=np.float32)
+    Xp[:n] = X
+    yp[:n] = y
+    w[:n] = 1.0
+    return Xp, yp, w
+
+
+@dataclasses.dataclass
+class TrainSplit:
+    X_train: np.ndarray
+    y_train: np.ndarray
+    X_test: np.ndarray
+    y_test: np.ndarray
+
+
+def train_test_split(
+    X: np.ndarray, y: np.ndarray, test_size: float = 0.2, seed: int = 42
+) -> TrainSplit:
+    """Random 80/20 split with a fixed seed (reference ``stage_1:98-103``,
+    ``test_size=0.2, random_state=42``)."""
+    n = X.shape[0]
+    perm = np.random.default_rng(seed).permutation(n)
+    n_test = int(round(n * test_size))
+    test_idx, train_idx = perm[:n_test], perm[n_test:]
+    return TrainSplit(X[train_idx], y[train_idx], X[test_idx], y[test_idx])
+
+
+class Regressor(abc.ABC):
+    """Fitted-or-unfitted regression model over a JAX pytree of params."""
+
+    #: short registry name, e.g. "linear" / "mlp" (used in checkpoints)
+    model_type: str = "base"
+
+    def __init__(self, config: Any = None, params: Any = None):
+        self.config = config
+        self.params = params
+
+    # -- estimator protocol ------------------------------------------------
+    @abc.abstractmethod
+    def fit(
+        self, X: np.ndarray, y: np.ndarray, seed: int | None = None
+    ) -> "Regressor":
+        """Return a fitted copy of this model.
+
+        ``seed`` overrides the model config's own seed when given; None
+        defers to the config (deterministic models ignore it entirely).
+        """
+
+    @abc.abstractmethod
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """Predict targets; accepts (n, d) or (n,) arrays."""
+
+    # -- serving metadata --------------------------------------------------
+    @property
+    def info(self) -> str:
+        """The ``model_info`` string in the scoring response — the analogue
+        of the reference's ``str(model)`` == "LinearRegression()"
+        (``stage_2_serve_model.py:79``)."""
+        return f"{type(self).__name__}()"
+
+    def __repr__(self) -> str:
+        return self.info
+
+    # -- checkpoint hooks (see checkpoint.py) ------------------------------
+    def config_dict(self) -> dict:
+        return dataclasses.asdict(self.config) if self.config else {}
+
+    @classmethod
+    @abc.abstractmethod
+    def from_config_dict(cls, cfg: dict, params: Any) -> "Regressor": ...

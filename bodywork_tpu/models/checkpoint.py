@@ -1,0 +1,117 @@
+"""Model checkpointing: JAX pytrees as date-versioned artefacts.
+
+Replaces the reference's ``joblib.dump``/``joblib.load`` model serialization
+(C6 — ``stage_1_train_model.py:114``, ``stage_2_serve_model.py:65``). Format:
+a single ``.npz`` holding the flattened params pytree (one entry per leaf,
+keyed by its tree path) plus a JSON metadata blob (model type, config,
+framework version, artefact date). Self-describing, dependency-free, and
+loadable without executing pickled code (unlike joblib).
+"""
+from __future__ import annotations
+
+import io
+import json
+from datetime import date
+
+import jax
+import numpy as np
+
+from bodywork_tpu.store.base import ArtefactStore
+from bodywork_tpu.store.schema import MODELS_PREFIX, model_key
+from bodywork_tpu.utils.logging import get_logger
+from bodywork_tpu.version import __version__
+
+log = get_logger("models.checkpoint")
+
+_META_KEY = "__meta__"
+
+
+def _leaf_path(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def save_model_bytes(model) -> bytes:
+    """Serialise a fitted Regressor to npz bytes."""
+    assert model.params is not None, "cannot checkpoint an unfitted model"
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(model.params)[0]
+    arrays = {_leaf_path(p): np.asarray(v) for p, v in leaves_with_paths}
+    meta = {
+        "model_type": model.model_type,
+        "config": model.config_dict(),
+        "framework_version": __version__,
+    }
+    buf = io.BytesIO()
+    np.savez(buf, **arrays, **{_META_KEY: np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)})
+    return buf.getvalue()
+
+
+def _listify(node):
+    """Convert dict nodes whose keys are 0..n-1 back into lists."""
+    if isinstance(node, dict):
+        if node and all(k.isdigit() for k in node) and sorted(
+            int(k) for k in node
+        ) == list(range(len(node))):
+            return [_listify(node[str(i)]) for i in range(len(node))]
+        return {k: _listify(v) for k, v in node.items()}
+    return node
+
+
+def _unflatten_paths(arrays: dict[str, np.ndarray]):
+    root: dict = {}
+    for path, arr in arrays.items():
+        parts = path.split("/")
+        node = root
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = arr
+    return _listify(root)
+
+
+def load_model_bytes(data: bytes):
+    """Reconstruct a fitted Regressor from npz bytes."""
+    with np.load(io.BytesIO(data)) as npz:
+        meta = json.loads(bytes(npz[_META_KEY]).decode())
+        arrays = {k: npz[k] for k in npz.files if k != _META_KEY}
+    cls = MODEL_REGISTRY[meta["model_type"]]
+    params = _unflatten_paths(arrays)
+    return cls.from_config_dict(meta["config"], jax.device_put(params))
+
+
+def save_model(store: ArtefactStore, model, artefact_date: date) -> str:
+    """Persist a fitted model under ``models/regressor-<date>.npz``
+    (reference ``stage_1:111-125``)."""
+    key = model_key(artefact_date)
+    store.put_bytes(key, save_model_bytes(model))
+    log.info(f"persisted {model.info} to {key}")
+    return key
+
+
+def load_model(store: ArtefactStore, key: str | None = None):
+    """Load a model by key, or the latest under ``models/`` if key is None
+    (reference ``stage_2:46-70``). Returns (model, artefact_date)."""
+    from bodywork_tpu.utils.dates import date_from_key
+
+    if key is None:
+        key, d = store.latest(MODELS_PREFIX)
+    else:
+        d = date_from_key(key)
+    model = load_model_bytes(store.get_bytes(key))
+    log.info(f"loaded {model.info} from {key} (trained {d})")
+    return model, d
+
+
+from bodywork_tpu.models.linear import LinearRegressor as _Linear
+from bodywork_tpu.models.mlp import MLPRegressor as _MLP
+
+MODEL_REGISTRY = {
+    _Linear.model_type: _Linear,
+    _MLP.model_type: _MLP,
+}

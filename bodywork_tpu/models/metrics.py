@@ -1,0 +1,37 @@
+"""Regression metrics in jnp (reference ``stage_1_train_model.py:79-90``).
+
+The reference computes sklearn ``mean_absolute_percentage_error``,
+``r2_score`` and ``max_error`` on the held-out split. Same definitions here,
+as a single jitted fused reduction.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# sklearn's MAPE guards the denominator with float64 machine epsilon.
+_MAPE_EPS = 2.220446049250313e-16
+
+
+@jax.jit
+def _metrics(y_true: jax.Array, y_pred: jax.Array):
+    resid = y_true - y_pred
+    mape = jnp.mean(jnp.abs(resid) / jnp.maximum(jnp.abs(y_true), _MAPE_EPS))
+    ss_res = jnp.sum(resid**2)
+    ss_tot = jnp.sum((y_true - jnp.mean(y_true)) ** 2)
+    r_squared = 1.0 - ss_res / ss_tot
+    max_residual = jnp.max(jnp.abs(resid))
+    return mape, r_squared, max_residual
+
+
+def regression_metrics(y_true, y_pred) -> dict[str, float]:
+    """MAPE / R^2 / max-abs-residual, matching the reference's metric record
+    columns (``stage_1:85-89``)."""
+    y_true = jnp.asarray(y_true, dtype=jnp.float32).ravel()
+    y_pred = jnp.asarray(y_pred, dtype=jnp.float32).ravel()
+    mape, r2, max_resid = _metrics(y_true, y_pred)
+    return {
+        "MAPE": float(mape),
+        "r_squared": float(r2),
+        "max_residual": float(max_resid),
+    }

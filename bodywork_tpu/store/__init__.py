@@ -1,0 +1,43 @@
+from bodywork_tpu.store.base import ArtefactStore, ArtefactNotFound
+from bodywork_tpu.store.filesystem import FilesystemStore
+from bodywork_tpu.store import schema
+from bodywork_tpu.store.schema import (
+    DATASETS_PREFIX,
+    MODELS_PREFIX,
+    MODEL_METRICS_PREFIX,
+    TEST_METRICS_PREFIX,
+    dataset_key,
+    model_key,
+    model_metrics_key,
+    test_metrics_key,
+)
+
+__all__ = [
+    "ArtefactStore",
+    "ArtefactNotFound",
+    "FilesystemStore",
+    "schema",
+    "DATASETS_PREFIX",
+    "MODELS_PREFIX",
+    "MODEL_METRICS_PREFIX",
+    "TEST_METRICS_PREFIX",
+    "dataset_key",
+    "model_key",
+    "model_metrics_key",
+    "test_metrics_key",
+]
+
+
+def open_store(url: str) -> ArtefactStore:
+    """Open an artefact store from a URL-ish spec.
+
+    - ``/path/to/dir`` or ``file:///path`` -> :class:`FilesystemStore`
+    - ``gs://bucket/prefix``               -> :class:`~bodywork_tpu.store.gcs.GCSStore`
+    """
+    if url.startswith("gs://"):
+        from bodywork_tpu.store.gcs import GCSStore
+
+        return GCSStore.from_url(url)
+    if url.startswith("file://"):
+        url = url[len("file://"):]
+    return FilesystemStore(url)

@@ -1,0 +1,81 @@
+"""Artefact store interface (replaces reference C7, the S3 data plane).
+
+The reference uses a single S3 bucket with four key prefixes as the
+inter-stage data plane, duplicating the client code in every stage
+(``stage_1_train_model.py:39-76``, ``stage_2_serve_model.py:46-70``,
+``stage_3_synthetic_data_generation.py:46-61``,
+``stage_4_test_model_scoring_service.py:39-63``). Versioning is by a date
+embedded in the object key; "latest" = max embedded date.
+
+This module defines that contract *once* as an abstract byte store plus the
+date-key versioning helpers (``latest``/``history``). Backends: local/TPU-VM
+host filesystem (the BASELINE.json north-star transport) and GCS.
+"""
+from __future__ import annotations
+
+import abc
+from datetime import date
+
+from bodywork_tpu.utils.dates import date_from_key
+
+
+class ArtefactNotFound(KeyError):
+    """No artefact exists at the requested key/prefix."""
+
+
+class ArtefactStore(abc.ABC):
+    """Flat byte store with ``/``-separated keys and date-key versioning."""
+
+    # -- raw byte plane ----------------------------------------------------
+    @abc.abstractmethod
+    def put_bytes(self, key: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def get_bytes(self, key: str) -> bytes: ...
+
+    @abc.abstractmethod
+    def list_keys(self, prefix: str = "") -> list[str]:
+        """All keys under ``prefix``, sorted lexicographically."""
+
+    @abc.abstractmethod
+    def delete(self, key: str) -> None: ...
+
+    def exists(self, key: str) -> bool:
+        try:
+            self.get_bytes(key)
+            return True
+        except ArtefactNotFound:
+            return False
+
+    # -- text convenience --------------------------------------------------
+    def put_text(self, key: str, text: str) -> None:
+        self.put_bytes(key, text.encode("utf-8"))
+
+    def get_text(self, key: str) -> str:
+        return self.get_bytes(key).decode("utf-8")
+
+    # -- date-key versioning protocol -------------------------------------
+    def history(self, prefix: str) -> list[tuple[str, date]]:
+        """All date-keyed artefacts under ``prefix``, oldest first.
+
+        Mirrors the reference's list-objects + regex-parse + sort-by-date
+        pattern (``stage_1_train_model.py:61-67``). Keys without an embedded
+        date are ignored.
+        """
+        keyed = []
+        for key in self.list_keys(prefix):
+            d = date_from_key(key)
+            if d is not None:
+                keyed.append((key, d))
+        keyed.sort(key=lambda e: (e[1], e[0]))
+        return keyed
+
+    def latest(self, prefix: str) -> tuple[str, date]:
+        """Key and date of the most recent artefact under ``prefix``.
+
+        Mirrors ``stage_2_serve_model.py:57-62`` / ``stage_4:49-56``.
+        """
+        hist = self.history(prefix)
+        if not hist:
+            raise ArtefactNotFound(f"no date-keyed artefacts under '{prefix}'")
+        return hist[-1]

@@ -1,0 +1,70 @@
+"""Filesystem artefact-store backend.
+
+Per ``BASELINE.json``'s north star, artefacts pass between stages via the TPU
+VM host filesystem (a shared volume on a GKE TPU node) rather than S3. Keys
+map to paths under a root directory; writes are atomic (tmp file + rename) so
+a concurrently-reading service stage never sees a torn artefact.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+from pathlib import Path
+
+from bodywork_tpu.store.base import ArtefactNotFound, ArtefactStore
+
+
+class FilesystemStore(ArtefactStore):
+    def __init__(self, root: str | os.PathLike):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, key: str) -> Path:
+        if not key or key.startswith(("/", "..")) or ".." in key.split("/"):
+            raise ValueError(f"invalid artefact key: {key!r}")
+        return self.root / key
+
+    def put_bytes(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=".tmp-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def exists(self, key: str) -> bool:
+        return self._path(key).is_file()
+
+    def get_bytes(self, key: str) -> bytes:
+        path = self._path(key)
+        try:
+            return path.read_bytes()
+        except FileNotFoundError:
+            raise ArtefactNotFound(key) from None
+
+    def list_keys(self, prefix: str = "") -> list[str]:
+        keys = []
+        for dirpath, _dirnames, filenames in os.walk(self.root):
+            for name in filenames:
+                if name.startswith(".tmp-"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, name), self.root)
+                key = rel.replace(os.sep, "/")
+                if key.startswith(prefix):
+                    keys.append(key)
+        return sorted(keys)
+
+    def delete(self, key: str) -> None:
+        path = self._path(key)
+        try:
+            path.unlink()
+        except FileNotFoundError:
+            raise ArtefactNotFound(key) from None
+
+    def __repr__(self) -> str:
+        return f"FilesystemStore(root={str(self.root)!r})"

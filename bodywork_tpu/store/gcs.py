@@ -1,0 +1,56 @@
+"""GCS artefact-store backend (optional).
+
+The GKE-deployed pipeline can use a GCS bucket exactly as the reference uses
+S3 (SURVEY.md C7). Requires ``google-cloud-storage``, which is not a hard
+dependency — the backend raises a clear error at construction if missing, and
+the rest of the framework runs on :class:`FilesystemStore`.
+"""
+from __future__ import annotations
+
+from bodywork_tpu.store.base import ArtefactNotFound, ArtefactStore
+
+
+class GCSStore(ArtefactStore):
+    def __init__(self, bucket: str, prefix: str = ""):
+        try:
+            from google.cloud import storage  # type: ignore
+        except ImportError as e:
+            raise RuntimeError(
+                "GCSStore requires the 'google-cloud-storage' package; "
+                "use FilesystemStore (the default) or install it"
+            ) from e
+        self._client = storage.Client()
+        self._bucket = self._client.bucket(bucket)
+        self._prefix = prefix.strip("/")
+
+    @classmethod
+    def from_url(cls, url: str) -> "GCSStore":
+        assert url.startswith("gs://"), url
+        bucket, _, prefix = url[len("gs://"):].partition("/")
+        return cls(bucket, prefix)
+
+    def _blob_name(self, key: str) -> str:
+        return f"{self._prefix}/{key}" if self._prefix else key
+
+    def exists(self, key: str) -> bool:
+        return self._bucket.blob(self._blob_name(key)).exists()
+
+    def put_bytes(self, key: str, data: bytes) -> None:
+        self._bucket.blob(self._blob_name(key)).upload_from_string(data)
+
+    def get_bytes(self, key: str) -> bytes:
+        blob = self._bucket.blob(self._blob_name(key))
+        if not blob.exists():
+            raise ArtefactNotFound(key)
+        return blob.download_as_bytes()
+
+    def list_keys(self, prefix: str = "") -> list[str]:
+        full = self._blob_name(prefix)
+        strip = len(self._prefix) + 1 if self._prefix else 0
+        return sorted(b.name[strip:] for b in self._client.list_blobs(self._bucket, prefix=full))
+
+    def delete(self, key: str) -> None:
+        blob = self._bucket.blob(self._blob_name(key))
+        if not blob.exists():
+            raise ArtefactNotFound(key)
+        blob.delete()

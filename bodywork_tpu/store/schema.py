@@ -1,0 +1,41 @@
+"""The four-prefix artefact schema shared by all pipeline stages.
+
+Same layout as the reference bucket ``bodywork-mlops-project`` (SURVEY.md L2):
+
+- ``datasets/regression-dataset-<date>.csv``       (``stage_3:49,56``)
+- ``models/regressor-<date>.npz``                   (``stage_1:113-121``;
+  reference uses ``.joblib`` — here models are JAX pytree checkpoints)
+- ``model-metrics/regressor-<date>.csv``            (``stage_1:130-138``)
+- ``test-metrics/regressor-test-results-<date>.csv``(``stage_4:122-130``)
+"""
+from __future__ import annotations
+
+from datetime import date
+
+DATASETS_PREFIX = "datasets/"
+MODELS_PREFIX = "models/"
+MODEL_METRICS_PREFIX = "model-metrics/"
+TEST_METRICS_PREFIX = "test-metrics/"
+
+ALL_PREFIXES = (
+    DATASETS_PREFIX,
+    MODELS_PREFIX,
+    MODEL_METRICS_PREFIX,
+    TEST_METRICS_PREFIX,
+)
+
+
+def dataset_key(d: date) -> str:
+    return f"{DATASETS_PREFIX}regression-dataset-{d}.csv"
+
+
+def model_key(d: date, suffix: str = "npz") -> str:
+    return f"{MODELS_PREFIX}regressor-{d}.{suffix}"
+
+
+def model_metrics_key(d: date) -> str:
+    return f"{MODEL_METRICS_PREFIX}regressor-{d}.csv"
+
+
+def test_metrics_key(d: date) -> str:
+    return f"{TEST_METRICS_PREFIX}regressor-test-results-{d}.csv"

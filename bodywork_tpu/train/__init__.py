@@ -1,0 +1,3 @@
+from bodywork_tpu.train.trainer import TrainResult, persist_metrics, train_on_history
+
+__all__ = ["TrainResult", "persist_metrics", "train_on_history"]

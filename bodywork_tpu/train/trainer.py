@@ -1,0 +1,93 @@
+"""Training orchestration over the artefact store (reference C2,
+``stage_1_train_model.py:31-36``).
+
+Flow (same contract as the reference's ``main()``):
+load all dataset history -> 80/20 split (seed 42) -> fit regressor (jitted on
+TPU) -> metrics on held-out split -> persist date-keyed model checkpoint +
+metrics CSV.
+"""
+from __future__ import annotations
+
+import dataclasses
+import io
+from datetime import date
+
+import pandas as pd
+
+from bodywork_tpu.data.io import load_all_datasets
+from bodywork_tpu.models import (
+    LinearRegressor,
+    MLPRegressor,
+    Regressor,
+    regression_metrics,
+    save_model,
+    train_test_split,
+)
+from bodywork_tpu.store.base import ArtefactStore
+from bodywork_tpu.store.schema import model_metrics_key
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("train")
+
+
+@dataclasses.dataclass
+class TrainResult:
+    model: Regressor
+    metrics: dict[str, float]
+    data_date: date
+    model_artefact_key: str
+    metrics_artefact_key: str
+    n_rows: int
+
+
+def make_model(model_type: str, **kwargs) -> Regressor:
+    if model_type == "linear":
+        return LinearRegressor(**kwargs)
+    if model_type == "mlp":
+        return MLPRegressor(**kwargs)
+    raise ValueError(f"unknown model type: {model_type!r}")
+
+
+def persist_metrics(
+    store: ArtefactStore, metrics: dict[str, float], data_date: date
+) -> str:
+    """Write a one-row metrics CSV with the reference's exact column schema
+    ``date,MAPE,r_squared,max_residual`` (``stage_1:84-89,128-142``)."""
+    record = pd.DataFrame(
+        {
+            "date": [data_date],
+            "MAPE": [metrics["MAPE"]],
+            "r_squared": [metrics["r_squared"]],
+            "max_residual": [metrics["max_residual"]],
+        }
+    )
+    key = model_metrics_key(data_date)
+    buf = io.StringIO()
+    record.to_csv(buf, header=True, index=False)
+    store.put_text(key, buf.getvalue())
+    log.info(f"persisted train metrics to {key}")
+    return key
+
+
+def train_on_history(
+    store: ArtefactStore,
+    model_type: str = "linear",
+    test_size: float = 0.2,
+    split_seed: int = 42,
+    fit_seed: int | None = None,
+    model_kwargs: dict | None = None,
+) -> TrainResult:
+    """Run the full train stage against an artefact store."""
+    ds = load_all_datasets(store)
+    split = train_test_split(ds.X, ds.y, test_size=test_size, seed=split_seed)
+    model = make_model(model_type, **(model_kwargs or {}))
+    fitted = model.fit(split.X_train, split.y_train, seed=fit_seed)
+    metrics = regression_metrics(split.y_test, fitted.predict(split.X_test))
+    log.info(
+        f"trained {fitted.info} on {len(ds)} rows to {ds.date}: "
+        f"MAPE={metrics['MAPE']:.4f} r2={metrics['r_squared']:.4f} "
+        f"max_resid={metrics['max_residual']:.2f}"
+    )
+    model_key_ = save_model(store, fitted, ds.date)
+    metrics_key = persist_metrics(store, metrics, ds.date)
+    return TrainResult(fitted, metrics, ds.date, model_key_, metrics_key, len(ds))

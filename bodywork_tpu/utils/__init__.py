@@ -1,0 +1,18 @@
+from bodywork_tpu.utils.logging import configure_logger
+from bodywork_tpu.utils.dates import (
+    DATE_PATTERN,
+    date_from_key,
+    day_of_year,
+    parse_date,
+)
+from bodywork_tpu.utils.errors import init_error_monitoring, StageError
+
+__all__ = [
+    "configure_logger",
+    "DATE_PATTERN",
+    "date_from_key",
+    "day_of_year",
+    "parse_date",
+    "init_error_monitoring",
+    "StageError",
+]

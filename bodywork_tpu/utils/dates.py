@@ -1,0 +1,46 @@
+"""Date-key utilities for artefact versioning.
+
+The reference versions every artefact by a date embedded in the object key and
+re-derives it in every stage with the same regex
+(``stage_1_train_model.py:47``, ``stage_2_serve_model.py:50``,
+``stage_4_test_model_scoring_service.py:43``). That protocol is centralised
+here once.
+"""
+from __future__ import annotations
+
+import re
+from datetime import date, datetime, timedelta
+
+# Same date grammar as the reference's regex: years 2020-2099.
+DATE_PATTERN = re.compile(r"20[2-9][0-9]-[0-1][0-9]-[0-3][0-9]")
+
+
+def parse_date(date_string: str) -> date:
+    return datetime.strptime(date_string, "%Y-%m-%d").date()
+
+
+def date_from_key(key: str) -> date | None:
+    """Extract the (first) embedded date from an artefact key, if any.
+
+    Returns None both when no date-shaped substring exists and when the
+    match is not a real calendar date (the regex admits e.g. month 15) —
+    such keys are ignored by the versioning protocol rather than crashing
+    every store consumer.
+    """
+    match = DATE_PATTERN.search(key)
+    if match is None:
+        return None
+    try:
+        return parse_date(match.group(0))
+    except ValueError:
+        return None
+
+
+def day_of_year(d: date) -> int:
+    """1-based day-of-year, as used by the drift sinusoid (``stage_3:38``)."""
+    return d.timetuple().tm_yday
+
+
+def date_range(start: date, days: int) -> list[date]:
+    """``days`` consecutive dates starting at ``start`` (simulated days)."""
+    return [start + timedelta(days=i) for i in range(days)]

@@ -1,0 +1,45 @@
+"""Error monitoring hook (reference C9) and stage failure types.
+
+The reference initialises Sentry in every stage entrypoint
+(``sentry_sdk.init(dsn, traces_sample_rate=1.0)`` +
+``set_tag('stage', ...)`` — ``stage_1_train_model.py:171-172`` and clones).
+Here error monitoring is a *pluggable, optional* hook: if ``sentry_sdk`` is
+importable and ``SENTRY_DSN`` is set, it is enabled; otherwise it is a no-op.
+This fixes the reference behaviour of hard-failing when ``SENTRY_DSN`` is
+unset (``get_sentry_dsn`` raises — ``stage_1:161-167``), and the copy-paste
+bug where stage 4 tags itself ``'stage-4-generate-next-dataset'``
+(``stage_4:164``).
+"""
+from __future__ import annotations
+
+import os
+
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("errors")
+
+
+class StageError(RuntimeError):
+    """A pipeline stage failed; carries the stage name for the orchestrator."""
+
+    def __init__(self, stage: str, message: str):
+        super().__init__(f"stage '{stage}' failed: {message}")
+        self.stage = stage
+
+
+def init_error_monitoring(stage: str, traces_sample_rate: float = 1.0) -> bool:
+    """Initialise the optional Sentry integration for a stage.
+
+    Returns True if monitoring was enabled, False if running without it.
+    """
+    dsn = os.environ.get("SENTRY_DSN")
+    if not dsn:
+        return False
+    try:
+        import sentry_sdk  # type: ignore
+    except ImportError:
+        log.warning("SENTRY_DSN set but sentry_sdk not installed; continuing")
+        return False
+    sentry_sdk.init(dsn, traces_sample_rate=traces_sample_rate)
+    sentry_sdk.set_tag("stage", stage)
+    return True

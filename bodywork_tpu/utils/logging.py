@@ -1,0 +1,50 @@
+"""Shared logging (reference C8).
+
+The reference duplicates an identical ``configure_logger`` in all four stage
+scripts (e.g. ``stage_1_train_model.py:145-158``); here it is a single shared
+module. The log format is kept identical so operators see the same lines:
+``asctime - levelname - module.funcName - message`` to stdout.
+
+The reference also creates the logger only under ``__main__`` and references
+the module-global ``log`` from library functions (a known bug — importing a
+stage module breaks). Here loggers are real module-level loggers obtained via
+``get_logger``.
+"""
+from __future__ import annotations
+
+import logging
+import sys
+
+LOG_FORMAT = (
+    "%(asctime)s - "
+    "%(levelname)s - "
+    "%(module)s.%(funcName)s - "
+    "%(message)s"
+)
+
+_ROOT_NAME = "bodywork_tpu"
+
+
+def configure_logger(level: str | int = logging.INFO) -> logging.Logger:
+    """Configure the framework's root logger to write to stdout.
+
+    Idempotent: repeated calls do not stack handlers.
+    """
+    logger = logging.getLogger(_ROOT_NAME)
+    if not any(
+        isinstance(h, logging.StreamHandler) and getattr(h, "stream", None) is sys.stdout
+        for h in logger.handlers
+    ):
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(logging.Formatter(LOG_FORMAT))
+        logger.addHandler(handler)
+    if isinstance(level, str):
+        level = getattr(logging, level.upper())
+    logger.setLevel(level)
+    return logger
+
+
+def get_logger(name: str) -> logging.Logger:
+    """Return a child logger under the framework root (e.g. ``store``)."""
+    configure_logger()
+    return logging.getLogger(f"{_ROOT_NAME}.{name}")

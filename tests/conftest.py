@@ -1,0 +1,29 @@
+"""Test env: force JAX onto a virtual 8-device CPU mesh.
+
+Per SURVEY.md §4, multi-device tests fake a v5e-4/v5e-8 slice with
+``xla_force_host_platform_device_count`` — the standard JAX analogue of
+multi-node tests without hardware. Must run before jax is imported anywhere.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def store(tmp_path):
+    from bodywork_tpu.store import FilesystemStore
+
+    return FilesystemStore(tmp_path / "artefacts")
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

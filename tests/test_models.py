@@ -1,0 +1,150 @@
+"""Models: closed-form OLS vs analytic solution, MLP convergence, metrics
+parity with sklearn definitions, checkpoint round-trips."""
+from datetime import date
+
+import numpy as np
+import pytest
+
+from bodywork_tpu.models import (
+    LinearRegressor,
+    MLPConfig,
+    MLPRegressor,
+    load_model,
+    load_model_bytes,
+    regression_metrics,
+    save_model,
+    save_model_bytes,
+    train_test_split,
+)
+
+
+@pytest.fixture
+def linear_data(rng):
+    n = 500
+    X = rng.uniform(0, 100, n).astype(np.float32)
+    y = (1.2 + 0.5 * X + rng.normal(0, 1, n)).astype(np.float32)
+    return X, y
+
+
+def test_ols_recovers_coefficients(linear_data):
+    X, y = linear_data
+    model = LinearRegressor().fit(X, y)
+    w = float(np.asarray(model.params["w"]).ravel()[0])
+    b = float(model.params["b"])
+    assert w == pytest.approx(0.5, abs=0.01)
+    assert b == pytest.approx(1.2, abs=0.5)
+
+
+def test_ols_matches_numpy_lstsq(linear_data):
+    X, y = linear_data
+    model = LinearRegressor().fit(X, y)
+    A = np.stack([X, np.ones_like(X)], axis=1)
+    theta, *_ = np.linalg.lstsq(A.astype(np.float64), y.astype(np.float64), rcond=None)
+    assert float(np.asarray(model.params["w"]).ravel()[0]) == pytest.approx(
+        theta[0], abs=1e-3
+    )
+    assert float(model.params["b"]) == pytest.approx(theta[1], abs=0.05)
+
+
+def test_ols_predict_shapes(linear_data):
+    X, y = linear_data
+    model = LinearRegressor().fit(X, y)
+    assert model.predict(np.array([50.0])).shape == (1,)
+    assert model.predict(np.array([[50.0], [60.0]])).shape == (2,)
+
+
+def test_ols_exact_on_noiseless_data():
+    X = np.linspace(0, 10, 300).astype(np.float32)
+    y = 3.0 + 2.0 * X
+    model = LinearRegressor().fit(X, y)
+    pred = model.predict(X)
+    np.testing.assert_allclose(pred, y, atol=1e-2)
+
+
+def test_padding_does_not_change_fit(linear_data):
+    # fits at different bucket sizes (n=500 pads to 1024; n=1500 to 2048)
+    X, y = linear_data
+    m1 = LinearRegressor().fit(X, y)
+    m2 = LinearRegressor().fit(np.tile(X, 3), np.tile(y, 3))
+    assert float(m2.params["b"]) == pytest.approx(float(m1.params["b"]), abs=0.1)
+
+
+def test_metrics_match_sklearn(linear_data):
+    from sklearn.metrics import (
+        max_error,
+        mean_absolute_percentage_error,
+        r2_score,
+    )
+
+    X, y = linear_data
+    pred = LinearRegressor().fit(X, y).predict(X)
+    m = regression_metrics(y, pred)
+    assert m["MAPE"] == pytest.approx(mean_absolute_percentage_error(y, pred), rel=1e-3)
+    assert m["r_squared"] == pytest.approx(r2_score(y, pred), rel=1e-3)
+    assert m["max_residual"] == pytest.approx(max_error(y, pred), rel=1e-3)
+
+
+def test_train_test_split_deterministic(linear_data):
+    X, y = linear_data
+    s1 = train_test_split(X, y)
+    s2 = train_test_split(X, y)
+    np.testing.assert_array_equal(s1.X_test, s2.X_test)
+    assert len(s1.y_test) == round(0.2 * len(y))
+    assert len(s1.y_train) + len(s1.y_test) == len(y)
+
+
+def test_mlp_fits_linear_function(linear_data):
+    X, y = linear_data
+    cfg = MLPConfig(hidden=(32, 32), n_steps=800, learning_rate=1e-2, batch_size=128)
+    model = MLPRegressor(cfg).fit(X, y)
+    pred = model.predict(X)
+    m = regression_metrics(y, pred)
+    assert m["r_squared"] > 0.99
+
+
+def test_mlp_learns_nonlinear_structure(rng):
+    n = 2000
+    X = rng.uniform(-3, 3, n).astype(np.float32)
+    y = (np.sin(X) * 2 + 0.5 * X**2).astype(np.float32)
+    cfg = MLPConfig(hidden=(64, 64), n_steps=1500, learning_rate=5e-3, batch_size=256)
+    model = MLPRegressor(cfg).fit(X, y)
+    m = regression_metrics(y, model.predict(X))
+    assert m["r_squared"] > 0.97  # far beyond any linear fit (~0.5)
+
+
+def test_linear_checkpoint_roundtrip(linear_data):
+    X, y = linear_data
+    model = LinearRegressor().fit(X, y)
+    clone = load_model_bytes(save_model_bytes(model))
+    np.testing.assert_allclose(clone.predict(X), model.predict(X), rtol=1e-6)
+    assert clone.info == model.info
+
+
+def test_mlp_checkpoint_roundtrip(linear_data):
+    X, y = linear_data
+    cfg = MLPConfig(hidden=(16, 16), n_steps=200)
+    model = MLPRegressor(cfg).fit(X, y)
+    clone = load_model_bytes(save_model_bytes(model))
+    np.testing.assert_allclose(clone.predict(X), model.predict(X), rtol=1e-5)
+    assert clone.config.hidden == (16, 16)
+
+
+def test_checkpoint_store_roundtrip(store, linear_data):
+    X, y = linear_data
+    model = LinearRegressor().fit(X, y)
+    d = date(2026, 7, 1)
+    save_model(store, model, d)
+    loaded, loaded_date = load_model(store)
+    assert loaded_date == d
+    np.testing.assert_allclose(loaded.predict(X), model.predict(X), rtol=1e-6)
+
+
+def test_load_model_picks_latest(store, linear_data):
+    X, y = linear_data
+    m_old = LinearRegressor().fit(X, y)
+    m_new = LinearRegressor().fit(X, y + 100.0)
+    save_model(store, m_old, date(2026, 7, 1))
+    save_model(store, m_new, date(2026, 7, 2))
+    loaded, d = load_model(store)
+    assert d == date(2026, 7, 2)
+    np.testing.assert_allclose(loaded.predict(X), m_new.predict(X), rtol=1e-6)

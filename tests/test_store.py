@@ -1,0 +1,84 @@
+"""Artefact store: byte plane, schema keys, date-key versioning."""
+from datetime import date
+
+import pytest
+
+from bodywork_tpu.store import (
+    ArtefactNotFound,
+    FilesystemStore,
+    dataset_key,
+    model_key,
+    model_metrics_key,
+)
+from bodywork_tpu.store import test_metrics_key as tm_key
+
+
+def test_put_get_roundtrip(store):
+    store.put_bytes("datasets/x.csv", b"hello")
+    assert store.get_bytes("datasets/x.csv") == b"hello"
+    assert store.exists("datasets/x.csv")
+    assert not store.exists("datasets/y.csv")
+
+
+def test_get_missing_raises(store):
+    with pytest.raises(ArtefactNotFound):
+        store.get_bytes("nope")
+
+
+def test_overwrite(store):
+    store.put_text("k", "one")
+    store.put_text("k", "two")
+    assert store.get_text("k") == "two"
+
+
+def test_list_keys_prefix_filter(store):
+    store.put_text("datasets/a.csv", "x")
+    store.put_text("models/b.npz", "x")
+    store.put_text("datasets/sub/c.csv", "x")
+    assert store.list_keys("datasets/") == ["datasets/a.csv", "datasets/sub/c.csv"]
+    assert store.list_keys() == ["datasets/a.csv", "datasets/sub/c.csv", "models/b.npz"]
+
+
+def test_delete(store):
+    store.put_text("k", "v")
+    store.delete("k")
+    assert not store.exists("k")
+    with pytest.raises(ArtefactNotFound):
+        store.delete("k")
+
+
+def test_invalid_keys_rejected(store):
+    for bad in ["", "/abs", "../escape", "a/../b"]:
+        with pytest.raises(ValueError):
+            store.put_bytes(bad, b"x")
+
+
+def test_schema_keys_match_reference_naming():
+    # Exact naming parity with the reference S3 schema (SURVEY.md L2).
+    d = date(2026, 7, 29)
+    assert dataset_key(d) == "datasets/regression-dataset-2026-07-29.csv"
+    assert model_key(d) == "models/regressor-2026-07-29.npz"
+    assert model_metrics_key(d) == "model-metrics/regressor-2026-07-29.csv"
+    assert tm_key(d) == "test-metrics/regressor-test-results-2026-07-29.csv"
+
+
+def test_history_and_latest(store):
+    for day in [3, 1, 2]:
+        store.put_text(dataset_key(date(2026, 7, day)), "x")
+    store.put_text("datasets/undated.csv", "x")  # ignored by versioning
+    hist = store.history("datasets/")
+    assert [d.day for _, d in hist] == [1, 2, 3]
+    key, d = store.latest("datasets/")
+    assert d == date(2026, 7, 3)
+    assert key == dataset_key(d)
+
+
+def test_latest_empty_raises(store):
+    with pytest.raises(ArtefactNotFound):
+        store.latest("models/")
+
+
+def test_atomic_write_leaves_no_tmp_files(store, tmp_path):
+    store.put_bytes("a/b.bin", b"x" * 1024)
+    leftover = [p for p in (store.root / "a").iterdir() if p.name.startswith(".tmp-")]
+    assert leftover == []
