@@ -15,6 +15,15 @@ Subpackages
                  pytree checkpointing (reference C2/C6).
 - ``train``    — training orchestration over the artefact store
                  (reference ``stage_1_train_model.py``).
+- ``serve``    — HTTP ``/score/v1`` scoring service with params resident in
+                 TPU HBM, shape-bucketed batch scoring
+                 (reference ``stage_2_serve_model.py``).
+- ``monitor``  — live-service tester + drift metrics + longitudinal
+                 analytics (reference ``stage_4`` + analytics notebook).
+- ``pipeline`` — declarative pipeline spec, local day-loop runner, GKE TPU
+                 manifest generation (reference ``bodywork.yaml``).
+- ``cli``      — ``python -m bodywork_tpu.cli`` driver for every stage and
+                 the multi-day simulation.
 
 Planned (landing incrementally; see SURVEY.md §7 build plan):
 
@@ -22,12 +31,6 @@ Planned (landing incrementally; see SURVEY.md §7 build plan):
 - ``parallel`` — ``jax.sharding.Mesh`` utilities, data-parallel scoring and
                  dp+tp training-step sharding (reference has no distributed
                  backend; this is the TPU-native replacement).
-- ``serve``    — Flask ``/score/v1`` scoring service with params resident in
-                 TPU HBM (reference ``stage_2_serve_model.py``).
-- ``monitor``  — live-service tester + drift metrics + longitudinal
-                 analytics (reference ``stage_4`` + analytics notebook).
-- ``pipeline`` — declarative pipeline spec, local runner, GKE TPU manifest
-                 generation (reference ``bodywork.yaml``).
 """
 
 from bodywork_tpu.version import __version__

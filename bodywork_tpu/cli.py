@@ -1,0 +1,254 @@
+"""Command-line interface to the framework (SURVEY.md §7 step 7).
+
+The reference has no CLI — each stage is ``python <script>.py`` and the
+pipeline is driven by the external ``bodywork`` tool. Here the framework is
+its own driver:
+
+    python -m bodywork_tpu.cli generate  --store DIR [--date D]
+    python -m bodywork_tpu.cli train     --store DIR [--model linear|mlp]
+    python -m bodywork_tpu.cli serve     --store DIR [--port P]
+    python -m bodywork_tpu.cli test      --store DIR --scoring-url URL
+    python -m bodywork_tpu.cli run-day   --store DIR [--date D]
+    python -m bodywork_tpu.cli run-sim   --store DIR --days N [--model ...]
+    python -m bodywork_tpu.cli run-stage --store DIR --stage NAME ...
+    python -m bodywork_tpu.cli report    --store DIR
+    python -m bodywork_tpu.cli deploy    --out DIR [--store-path P] [--image I]
+
+Every command exits 0 on success and 1 with a logged error otherwise — the
+exit-code contract the reference implements per-script
+(``stage_1_train_model.py:170-178``) and the orchestrator relies on.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from datetime import date
+
+from bodywork_tpu.utils.dates import parse_date
+from bodywork_tpu.utils.errors import init_error_monitoring
+from bodywork_tpu.utils.logging import configure_logger, get_logger
+
+log = get_logger("cli")
+
+
+def _store(args):
+    from bodywork_tpu.store import open_store
+
+    return open_store(args.store)
+
+
+def _date(args) -> date:
+    return parse_date(args.date) if args.date else date.today()
+
+
+def _pipeline_spec(args):
+    """The pipeline spec for orchestration commands: an explicit ``--spec``
+    YAML wins (this is how in-cluster pods receive the deploy-time spec via
+    ConfigMap); otherwise the default pipeline built from CLI options."""
+    from bodywork_tpu.pipeline import PipelineSpec, default_pipeline
+
+    if getattr(args, "spec", None):
+        from pathlib import Path
+
+        return PipelineSpec.from_yaml(Path(args.spec).read_text())
+    return default_pipeline(args.model, args.mode)
+
+
+def cmd_generate(args) -> int:
+    from bodywork_tpu.data import Dataset, generate_day, persist_dataset
+
+    d = _date(args)
+    X, y = generate_day(d)
+    key = persist_dataset(_store(args), Dataset(X, y, d))
+    print(key)
+    return 0
+
+
+def cmd_train(args) -> int:
+    from bodywork_tpu.train import train_on_history
+
+    result = train_on_history(_store(args), args.model)
+    print(
+        f"{result.model_artefact_key} MAPE={result.metrics['MAPE']:.4f} "
+        f"r2={result.metrics['r_squared']:.4f}"
+    )
+    return 0
+
+
+def cmd_serve(args) -> int:
+    from bodywork_tpu.serve import serve_latest_model
+
+    serve_latest_model(_store(args), host=args.host, port=args.port, block=True)
+    return 0
+
+
+def cmd_test(args) -> int:
+    from bodywork_tpu.monitor import (
+        HttpScoringClient,
+        run_service_test,
+        scoring_endpoint,
+    )
+
+    client = HttpScoringClient(scoring_endpoint(args.scoring_url, args.mode))
+    metrics = run_service_test(_store(args), client, mode=args.mode)
+    print(metrics.to_string(index=False))
+    return 0
+
+
+def cmd_run_day(args) -> int:
+    from bodywork_tpu.pipeline import LocalRunner
+
+    runner = LocalRunner(_pipeline_spec(args), _store(args))
+    d = _date(args)
+    runner.bootstrap(d)
+    result = runner.run_day(d)
+    print(f"day {d}: {result.wall_clock_s:.3f}s")
+    for name, secs in result.stage_seconds.items():
+        print(f"  {name}: {secs:.3f}s")
+    return 0
+
+
+def cmd_run_sim(args) -> int:
+    from bodywork_tpu.pipeline import LocalRunner
+
+    runner = LocalRunner(_pipeline_spec(args), _store(args))
+    results = runner.run_simulation(_date(args), args.days)
+    total = sum(r.wall_clock_s for r in results)
+    for r in results:
+        print(f"day {r.day}: {r.wall_clock_s:.3f}s")
+    print(f"total {total:.3f}s over {args.days} day(s), "
+          f"mean {total / max(args.days, 1):.3f}s/day")
+    return 0
+
+
+def cmd_run_stage(args) -> int:
+    """Run one named stage from the default pipeline — the per-pod entrypoint
+    the k8s manifests use."""
+    from bodywork_tpu.pipeline.runner import resolve_executable
+    from bodywork_tpu.pipeline.stages import StageContext
+
+    spec = _pipeline_spec(args)
+    if args.stage not in spec.stages:
+        log.error(f"unknown stage {args.stage!r}; have {sorted(spec.stages)}")
+        return 1
+    stage = spec.stages[args.stage]
+    ctx = StageContext(
+        store=_store(args), today=_date(args), scoring_url=args.scoring_url
+    )
+    fn = resolve_executable(stage.executable)
+    if stage.kind == "service":
+        # run the stage's declared executable and block for the pod's
+        # lifetime, exposed on the declared port
+        handle = fn(ctx, host="0.0.0.0", port=stage.port or 5000, **stage.args)
+        handle.wait()
+    else:
+        fn(ctx, **stage.args)
+    return 0
+
+
+def cmd_report(args) -> int:
+    from bodywork_tpu.monitor import drift_report
+
+    report = drift_report(_store(args))
+    if report.empty:
+        print("no metric history yet")
+    else:
+        print(report.to_string(index=False))
+    return 0
+
+
+def cmd_deploy(args) -> int:
+    from bodywork_tpu.pipeline import write_manifests
+
+    spec = _pipeline_spec(args)
+    written = write_manifests(
+        spec, args.out, store_path=args.store_path, image=args.image
+    )
+    for path in written:
+        print(path)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="bodywork_tpu", description="TPU-native ML pipeline framework"
+    )
+    parser.add_argument("--log-level", default="INFO")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    def add(name, fn, **kwargs):
+        p = sub.add_parser(name, **kwargs)
+        p.set_defaults(fn=fn)
+        return p
+
+    common_store = {"required": True, "help": "artefact store dir or gs:// URL"}
+
+    p = add("generate", cmd_generate, help="generate one day's drift data")
+    p.add_argument("--store", **common_store)
+    p.add_argument("--date", default=None)
+
+    p = add("train", cmd_train, help="train on all history, persist model")
+    p.add_argument("--store", **common_store)
+    p.add_argument("--model", default="linear", choices=["linear", "mlp"])
+
+    p = add("serve", cmd_serve, help="serve the latest model over HTTP")
+    p.add_argument("--store", **common_store)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=5000)
+
+    p = add("test", cmd_test, help="test a live scoring service")
+    p.add_argument("--store", **common_store)
+    p.add_argument("--scoring-url", required=True)
+    p.add_argument("--mode", default="batch", choices=["single", "batch"])
+
+    p = add("run-day", cmd_run_day, help="run one simulated day in-process")
+    p.add_argument("--spec", default=None, help="pipeline spec YAML (overrides --model/--mode)")
+    p.add_argument("--store", **common_store)
+    p.add_argument("--date", default=None)
+    p.add_argument("--model", default="linear", choices=["linear", "mlp"])
+    p.add_argument("--mode", default="batch", choices=["single", "batch"])
+
+    p = add("run-sim", cmd_run_sim, help="run an N-day drift simulation")
+    p.add_argument("--spec", default=None, help="pipeline spec YAML (overrides --model/--mode)")
+    p.add_argument("--store", **common_store)
+    p.add_argument("--days", type=int, required=True)
+    p.add_argument("--date", default=None, help="start date (YYYY-MM-DD)")
+    p.add_argument("--model", default="linear", choices=["linear", "mlp"])
+    p.add_argument("--mode", default="batch", choices=["single", "batch"])
+
+    p = add("run-stage", cmd_run_stage, help="run one pipeline stage (pod entrypoint)")
+    p.add_argument("--spec", default=None, help="pipeline spec YAML (overrides --model/--mode)")
+    p.add_argument("--store", **common_store)
+    p.add_argument("--stage", required=True)
+    p.add_argument("--date", default=None)
+    p.add_argument("--model", default="linear", choices=["linear", "mlp"])
+    p.add_argument("--mode", default="batch", choices=["single", "batch"])
+    p.add_argument("--scoring-url", default=None)
+
+    p = add("report", cmd_report, help="longitudinal train-vs-live drift report")
+    p.add_argument("--store", **common_store)
+
+    p = add("deploy", cmd_deploy, help="write GKE TPU manifests")
+    p.add_argument("--spec", default=None, help="pipeline spec YAML (overrides --model/--mode)")
+    p.add_argument("--out", required=True)
+    p.add_argument("--store-path", default="/mnt/artefact-store")
+    p.add_argument("--image", default="bodywork-tpu/runtime:latest")
+    p.add_argument("--model", default="linear", choices=["linear", "mlp"])
+    p.add_argument("--mode", default="batch", choices=["single", "batch"])
+
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    configure_logger(args.log_level)
+    init_error_monitoring(f"cli-{args.command}")
+    try:
+        return args.fn(args)
+    except Exception as exc:
+        log.error(exc)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -90,6 +90,14 @@ class Regressor(abc.ABC):
 
     # -- serving metadata --------------------------------------------------
     @property
+    def n_features(self) -> int | None:
+        """Input feature dimension of a fitted model (None if unfitted).
+
+        Serving warmup uses this to pre-compile the right bucket shapes.
+        """
+        return None
+
+    @property
     def info(self) -> str:
         """The ``model_info`` string in the scoring response — the analogue
         of the reference's ``str(model)`` == "LinearRegression()"

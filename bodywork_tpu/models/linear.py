@@ -79,6 +79,12 @@ class LinearRegressor(Regressor):
         return np.asarray(linear_apply(self.params, X))
 
     @property
+    def n_features(self) -> int | None:
+        if self.params is None:
+            return None
+        return int(np.asarray(self.params["w"]).shape[0])
+
+    @property
     def info(self) -> str:
         return "LinearRegressor(closed_form_ols)"
 

@@ -149,6 +149,12 @@ class MLPRegressor(Regressor):
         return np.asarray(_predict_jit(self.params, X))
 
     @property
+    def n_features(self) -> int | None:
+        if self.params is None:
+            return None
+        return int(np.asarray(self.params["net"]["layers"][0]["w"]).shape[0])
+
+    @property
     def info(self) -> str:
         return f"MLPRegressor(hidden={list(self.config.hidden)})"
 

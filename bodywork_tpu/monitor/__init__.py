@@ -1,0 +1,22 @@
+from bodywork_tpu.monitor.tester import (
+    HttpScoringClient,
+    InProcessScoringClient,
+    compute_test_metrics,
+    persist_test_metrics,
+    run_service_test,
+    score_dataset,
+    scoring_endpoint,
+)
+from bodywork_tpu.monitor.analytics import drift_report, load_metric_history
+
+__all__ = [
+    "HttpScoringClient",
+    "InProcessScoringClient",
+    "compute_test_metrics",
+    "persist_test_metrics",
+    "run_service_test",
+    "score_dataset",
+    "scoring_endpoint",
+    "drift_report",
+    "load_metric_history",
+]

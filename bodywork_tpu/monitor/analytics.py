@@ -1,0 +1,61 @@
+"""Longitudinal model-performance analytics (reference C12,
+``model-performance-analytics.ipynb``).
+
+The reference notebook concatenates every CSV under ``model-metrics/`` and
+``test-metrics/`` into two DataFrames (cell-4) and eyeballs per-day tables
+for drift. Here that is a library function plus a joined drift report, so
+dashboards and alerting can be built on it (and the CLI can print it).
+"""
+from __future__ import annotations
+
+import io
+
+import pandas as pd
+
+from bodywork_tpu.store.base import ArtefactStore
+from bodywork_tpu.store.schema import MODEL_METRICS_PREFIX, TEST_METRICS_PREFIX
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("monitor.analytics")
+
+
+def _load_history_frame(store: ArtefactStore, prefix: str) -> pd.DataFrame:
+    frames = []
+    for key, _d in store.history(prefix):
+        frames.append(pd.read_csv(io.BytesIO(store.get_bytes(key))))
+    if not frames:
+        return pd.DataFrame()
+    df = pd.concat(frames, ignore_index=True)
+    df["date"] = pd.to_datetime(df["date"]).dt.date
+    return df.sort_values("date").reset_index(drop=True)
+
+
+def load_metric_history(store: ArtefactStore) -> tuple[pd.DataFrame, pd.DataFrame]:
+    """(train_metrics, test_metrics) histories, oldest first."""
+    return (
+        _load_history_frame(store, MODEL_METRICS_PREFIX),
+        _load_history_frame(store, TEST_METRICS_PREFIX),
+    )
+
+
+def drift_report(store: ArtefactStore) -> pd.DataFrame:
+    """Join train-time vs live-test metrics by date.
+
+    Columns are suffixed ``_train`` / ``_live``; the gap between
+    ``MAPE_train`` and ``MAPE_live`` over days is the concept-drift signal
+    the whole simulation exists to surface.
+    """
+    train_df, test_df = load_metric_history(store)
+    if train_df.empty and test_df.empty:
+        return pd.DataFrame()
+    if train_df.empty:
+        return test_df.add_suffix("_live").rename(columns={"date_live": "date"})
+    if test_df.empty:
+        return train_df.add_suffix("_train").rename(columns={"date_train": "date"})
+    report = pd.merge(
+        train_df.add_suffix("_train").rename(columns={"date_train": "date"}),
+        test_df.add_suffix("_live").rename(columns={"date_live": "date"}),
+        on="date",
+        how="outer",
+    ).sort_values("date")
+    return report.reset_index(drop=True)

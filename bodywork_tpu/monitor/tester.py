@@ -1,0 +1,219 @@
+"""Live-service tester / drift monitor (reference C5,
+``stage_4_test_model_scoring_service.py``).
+
+Black-box tests the deployed scoring service over its HTTP contract with the
+latest day's labeled data, computes drift metrics, and persists them as
+date-keyed artefacts — "testing in production" as a pipeline stage.
+
+Reference parity, with its known bugs fixed idiomatically (SURVEY.md §2):
+
+- Failed scores are NOT recorded as ``-1`` and averaged into metrics
+  (``stage_4:82,85``); failures are counted separately and excluded.
+- The APE denominator is guarded against label ~ 0 (the reference divides by
+  raw label — ``stage_4:90``).
+- The connection-error handler actually logs the exception (the reference
+  references an unbound name and would NameError — ``stage_4:84``).
+
+Metric definitions preserved exactly (``stage_4:101-113``): MAPE = mean APE,
+``r_squared`` = Pearson correlation of score vs label (the reference's —
+arguably mislabeled — definition), ``max_residual`` = max APE, plus
+``mean_response_time`` of the HTTP round-trip.
+
+TPU-native addition: ``mode="batch"`` drives ``/score/v1/batch`` so the whole
+day's test set is scored in a handful of padded device calls instead of ~1.4k
+serial single-row HTTP requests (the reference's hot loop, ``stage_4:97``).
+"""
+from __future__ import annotations
+
+import io
+from datetime import date
+from time import perf_counter
+
+import numpy as np
+import pandas as pd
+
+from bodywork_tpu.data.io import Dataset, load_latest_dataset
+from bodywork_tpu.store.base import ArtefactStore
+from bodywork_tpu.store.schema import test_metrics_key
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("monitor.tester")
+
+_APE_EPS = 2.220446049250313e-16
+
+
+def scoring_endpoint(base_url: str, mode: str = "single") -> str:
+    """Normalise a scoring-service URL to the endpoint for ``mode``.
+
+    Accepts a bare base (``http://svc:5000``) or a URL already carrying the
+    scoring path (``http://svc:5000/score/v1[/batch]``) — the k8s manifests
+    pass the latter — and returns the correct endpoint either way.
+    """
+    url = base_url.rstrip("/")
+    for suffix in ("/score/v1/batch", "/score/v1"):
+        if url.endswith(suffix):
+            url = url[: -len(suffix)]
+            break
+    return url + ("/score/v1/batch" if mode == "batch" else "/score/v1")
+
+
+class HttpScoringClient:
+    """Scores over real HTTP with per-request retries
+    (reference ``stage_4:68-85``: ``HTTPAdapter(max_retries=3)``)."""
+
+    def __init__(self, url: str, max_retries: int = 3, timeout_s: float = 10.0):
+        import requests
+
+        self.url = url
+        self.timeout_s = timeout_s
+        self._session = requests.Session()
+        self._session.mount(url, requests.adapters.HTTPAdapter(max_retries=max_retries))
+
+    def score(self, payload: dict) -> tuple[bool, list[float], float]:
+        """POST a payload; returns (ok, predictions, seconds)."""
+        import requests
+
+        start = perf_counter()
+        try:
+            response = self._session.post(self.url, json=payload, timeout=self.timeout_s)
+            elapsed = perf_counter() - start
+            if response.ok:
+                body = response.json()
+                preds = (
+                    body["predictions"] if "predictions" in body else [body["prediction"]]
+                )
+                return True, [float(p) for p in preds], elapsed
+            log.error(f"scoring request failed: HTTP {response.status_code}")
+            return False, [], elapsed
+        except (requests.ConnectionError, requests.Timeout) as exc:
+            log.error(f"scoring request failed: {exc!r}")
+            return False, [], perf_counter() - start
+
+
+class InProcessScoringClient:
+    """Scores through a Flask test client — lets integration tests and the
+    local runner exercise the exact HTTP contract without sockets."""
+
+    def __init__(self, app, path: str = "/score/v1"):
+        self._client = app.test_client()
+        self.path = path
+
+    def score(self, payload: dict) -> tuple[bool, list[float], float]:
+        start = perf_counter()
+        response = self._client.post(self.path, json=payload)
+        elapsed = perf_counter() - start
+        if response.status_code == 200:
+            body = response.get_json()
+            preds = (
+                body["predictions"] if "predictions" in body else [body["prediction"]]
+            )
+            return True, [float(p) for p in preds], elapsed
+        log.error(f"scoring request failed: HTTP {response.status_code}")
+        return False, [], elapsed
+
+    def batch_sibling(self) -> "InProcessScoringClient":
+        clone = InProcessScoringClient.__new__(InProcessScoringClient)
+        clone._client = self._client
+        clone.path = "/score/v1/batch"
+        return clone
+
+
+def _ape(score: float, label: float) -> float:
+    return abs(score - label) / max(abs(label), _APE_EPS)
+
+
+def score_dataset(
+    client, ds: Dataset, mode: str = "single", batch_size: int = 512
+) -> pd.DataFrame:
+    """Score every labeled row via the live service.
+
+    Returns a results frame with the reference's columns
+    ``score,label,APE,response_time`` (``stage_4:98``) plus ``ok``.
+    """
+    rows = []
+    X = ds.X[:, 0]
+    if mode == "single":
+        for x, label in zip(X, ds.y):
+            ok, preds, elapsed = client.score({"X": float(x)})
+            score = preds[0] if ok else np.nan
+            ape = _ape(score, float(label)) if ok else np.nan
+            rows.append((score, float(label), ape, elapsed, ok))
+    elif mode == "batch":
+        for i in range(0, len(X), batch_size):
+            xb, yb = X[i : i + batch_size], ds.y[i : i + batch_size]
+            ok, preds, elapsed = client.score({"X": [float(v) for v in xb]})
+            per_row_time = elapsed / max(len(xb), 1)
+            if ok and len(preds) == len(xb):
+                for p, label in zip(preds, yb):
+                    rows.append((p, float(label), _ape(p, float(label)), per_row_time, True))
+            else:
+                rows.extend(
+                    (np.nan, float(label), np.nan, per_row_time, False) for label in yb
+                )
+    else:
+        raise ValueError(f"unknown scoring mode: {mode!r}")
+    return pd.DataFrame(rows, columns=["score", "label", "APE", "response_time", "ok"])
+
+
+def compute_test_metrics(results: pd.DataFrame, results_date: date) -> pd.DataFrame:
+    """One-row metrics record; columns extend the reference schema
+    (``stage_4:101-113``) with an explicit ``n_failures`` count."""
+    ok = results[results["ok"]]
+    n_failures = int((~results["ok"]).sum())
+    if len(ok) == 0:
+        mape = r_squared = max_residual = float("nan")
+    else:
+        mape = float(ok["APE"].mean())
+        r_squared = float(ok["score"].corr(ok["label"]))
+        max_residual = float(ok["APE"].max())
+    mean_response_time = float(results["response_time"].mean())
+    return pd.DataFrame(
+        {
+            "date": [results_date],
+            "MAPE": [mape],
+            "r_squared": [r_squared],
+            "max_residual": [max_residual],
+            "mean_response_time": [mean_response_time],
+            "n_failures": [n_failures],
+        }
+    )
+
+
+def persist_test_metrics(
+    store: ArtefactStore, metrics: pd.DataFrame, results_date: date
+) -> str:
+    """Write ``test-metrics/regressor-test-results-<date>.csv``
+    (``stage_4:116-134``)."""
+    key = test_metrics_key(results_date)
+    buf = io.StringIO()
+    metrics.to_csv(buf, header=True, index=False)
+    store.put_text(key, buf.getvalue())
+    log.info(f"persisted test metrics to {key}")
+    return key
+
+
+def run_service_test(
+    store: ArtefactStore, client, mode: str = "single", max_rows: int | None = None
+) -> pd.DataFrame:
+    """Full stage-4 flow: latest dataset -> score via live service ->
+    metrics -> persist. Returns the metrics record.
+
+    ``max_rows`` caps the number of scored rows (head of the day's data) for
+    cheap smoke tests; None (default) scores the full day as the reference
+    does.
+    """
+    ds = load_latest_dataset(store)
+    if max_rows is not None and len(ds) > max_rows:
+        ds = Dataset(ds.X[:max_rows], ds.y[:max_rows], ds.date)
+    if mode == "batch" and isinstance(client, InProcessScoringClient):
+        client = client.batch_sibling()
+    results = score_dataset(client, ds, mode=mode)
+    metrics = compute_test_metrics(results, ds.date)
+    persist_test_metrics(store, metrics, ds.date)
+    rec = metrics.iloc[0]
+    log.info(
+        f"live test on {len(results)} rows ({ds.date}): MAPE={rec.MAPE:.4f} "
+        f"corr={rec.r_squared:.4f} maxAPE={rec.max_residual:.2f} "
+        f"mean_rt={rec.mean_response_time * 1000:.2f}ms failures={rec.n_failures}"
+    )
+    return metrics

@@ -1,0 +1,22 @@
+from bodywork_tpu.pipeline.spec import (
+    PipelineSpec,
+    ResourceSpec,
+    StageSpec,
+    default_pipeline,
+    parse_dag,
+)
+from bodywork_tpu.pipeline.runner import DayResult, LocalRunner, StageFailure
+from bodywork_tpu.pipeline.k8s import generate_manifests, write_manifests
+
+__all__ = [
+    "PipelineSpec",
+    "ResourceSpec",
+    "StageSpec",
+    "default_pipeline",
+    "parse_dag",
+    "DayResult",
+    "LocalRunner",
+    "StageFailure",
+    "generate_manifests",
+    "write_manifests",
+]

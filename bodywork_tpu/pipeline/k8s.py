@@ -1,0 +1,254 @@
+"""GKE/Kubernetes manifest generation for TPU node pools (reference C1's
+deployment half).
+
+The reference delegates materialisation to the Bodywork controller: batch
+stages become Jobs (retries/timeout — ``bodywork.yaml:19-21``), the service
+stage a 2-replica Deployment + cluster Service on port 5000
+(``bodywork.yaml:38-42``), secrets injected as env (``bodywork.yaml:22-26``).
+
+Here the framework emits those manifests itself, targeting GKE TPU node
+pools: stages with TPU resources get the standard GKE nodeSelectors
+(``cloud.google.com/gke-tpu-accelerator`` / ``gke-tpu-topology``) and a
+``google.com/tpu`` resource request, per Google's TPU-on-GKE scheduling
+model. Artefacts flow over a shared volume (TPU-VM host filesystem /
+Filestore) mounted at the store path — the BASELINE.json north star — or GCS
+if the store URL says so.
+
+The daily loop is a CronJob running ``run-day`` (the reference re-runs the
+whole Bodywork deployment daily — README.md:5).
+"""
+from __future__ import annotations
+
+import io
+from pathlib import Path
+
+import yaml
+
+from bodywork_tpu.pipeline.spec import PipelineSpec, StageSpec
+
+_STORE_VOLUME = "artefact-store"
+_SPEC_VOLUME = "pipeline-spec"
+_SPEC_MOUNT = "/etc/bodywork"
+_SPEC_FILE = "pipeline.yaml"
+_DEFAULT_IMAGE = "bodywork-tpu/runtime:latest"
+
+
+def _store_volume(store_path: str) -> tuple[dict, dict]:
+    volume = {
+        "name": _STORE_VOLUME,
+        "hostPath": {"path": store_path, "type": "DirectoryOrCreate"},
+    }
+    mount = {"name": _STORE_VOLUME, "mountPath": store_path}
+    return volume, mount
+
+
+def _spec_volume(spec: PipelineSpec) -> tuple[dict, dict]:
+    """The deploy-time pipeline spec rides into every pod as a ConfigMap, so
+    in-cluster entrypoints run exactly the deployed configuration (stage
+    args, model/mode choices) rather than rebuilding defaults."""
+    volume = {
+        "name": _SPEC_VOLUME,
+        "configMap": {"name": f"{spec.name}--spec"},
+    }
+    mount = {"name": _SPEC_VOLUME, "mountPath": _SPEC_MOUNT, "readOnly": True}
+    return volume, mount
+
+
+def _container(
+    spec: PipelineSpec,
+    stage: StageSpec,
+    store_path: str,
+    image: str,
+    command: list[str],
+) -> dict:
+    _, mount = _store_volume(store_path)
+    _, spec_mount = _spec_volume(spec)
+    resources: dict = {
+        "requests": {
+            "cpu": str(stage.resources.cpu_request),
+            "memory": f"{stage.resources.memory_mb}Mi",
+        }
+    }
+    if stage.resources.tpu_chips:
+        resources["limits"] = {"google.com/tpu": stage.resources.tpu_chips}
+    env = [{"name": k, "value": str(v)} for k, v in stage.env.items()]
+    env_from = [{"secretRef": {"name": s}} for s in stage.secrets]
+    container = {
+        "name": stage.name,
+        "image": image,
+        "command": command,
+        "volumeMounts": [mount, spec_mount],
+        "resources": resources,
+    }
+    if env:
+        container["env"] = env
+    if env_from:
+        container["envFrom"] = env_from
+    if stage.kind == "service" and stage.port:
+        container["ports"] = [{"containerPort": stage.port}]
+        container["readinessProbe"] = {
+            "httpGet": {"path": "/healthz", "port": stage.port},
+            "initialDelaySeconds": 2,
+            "periodSeconds": 3,
+            "failureThreshold": int(stage.max_startup_time_s // 3) or 1,
+        }
+    return container
+
+
+def _pod_spec(spec: PipelineSpec, stage: StageSpec, store_path: str,
+              image: str, command: list[str], restart_policy: str) -> dict:
+    volume, _ = _store_volume(store_path)
+    spec_volume, _ = _spec_volume(spec)
+    pod: dict = {
+        "containers": [_container(spec, stage, store_path, image, command)],
+        "volumes": [volume, spec_volume],
+        "restartPolicy": restart_policy,
+    }
+    r = stage.resources
+    if r.tpu_accelerator:
+        pod["nodeSelector"] = {
+            "cloud.google.com/gke-tpu-accelerator": r.tpu_accelerator,
+            **({"cloud.google.com/gke-tpu-topology": r.tpu_topology}
+               if r.tpu_topology else {}),
+        }
+    return pod
+
+
+def _stage_command(spec: PipelineSpec, stage: StageSpec, store_path: str) -> list[str]:
+    cmd = [
+        "python", "-m", "bodywork_tpu.cli", "run-stage",
+        "--stage", stage.name,
+        "--store", store_path,
+        "--spec", f"{_SPEC_MOUNT}/{_SPEC_FILE}",
+    ]
+    service_stages = [s for s in spec.stages.values() if s.kind == "service"]
+    if stage.kind == "batch" and service_stages:
+        svc = service_stages[0]
+        cmd += [
+            "--scoring-url",
+            f"http://{spec.service_dns(svc.name)}:{svc.port}/score/v1",
+        ]
+    return cmd
+
+
+def generate_manifests(
+    spec: PipelineSpec,
+    store_path: str = "/mnt/artefact-store",
+    image: str = _DEFAULT_IMAGE,
+    namespace: str = "bodywork-tpu",
+    daily_schedule: str | None = "0 6 * * *",
+) -> dict[str, dict]:
+    """Emit all k8s objects for the pipeline, keyed by filename."""
+    docs: dict[str, dict] = {
+        "00-namespace.yaml": {
+            "apiVersion": "v1",
+            "kind": "Namespace",
+            "metadata": {"name": namespace},
+        },
+        "00-pipeline-spec-configmap.yaml": {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": f"{spec.name}--spec", "namespace": namespace},
+            "data": {_SPEC_FILE: spec.to_yaml()},
+        },
+    }
+    labels_base = {"app.kubernetes.io/part-of": spec.name}
+    for i, step in enumerate(spec.dag, start=1):
+        for stage_name in step:
+            stage = spec.stages[stage_name]
+            labels = {**labels_base, "app": spec.service_dns(stage.name)}
+            command = _stage_command(spec, stage, store_path)
+            meta = {
+                "name": spec.service_dns(stage.name),
+                "namespace": namespace,
+                "labels": labels,
+            }
+            if stage.kind == "batch":
+                docs[f"{i:02d}-{stage.name}-job.yaml"] = {
+                    "apiVersion": "batch/v1",
+                    "kind": "Job",
+                    "metadata": meta,
+                    "spec": {
+                        "backoffLimit": stage.retries,
+                        "activeDeadlineSeconds": int(stage.max_completion_time_s),
+                        "template": {
+                            "metadata": {"labels": labels},
+                            "spec": _pod_spec(
+                                spec, stage, store_path, image, command, "Never"
+                            ),
+                        },
+                    },
+                }
+            else:
+                docs[f"{i:02d}-{stage.name}-deployment.yaml"] = {
+                    "apiVersion": "apps/v1",
+                    "kind": "Deployment",
+                    "metadata": meta,
+                    "spec": {
+                        "replicas": stage.replicas,
+                        "selector": {"matchLabels": {"app": labels["app"]}},
+                        "template": {
+                            "metadata": {"labels": labels},
+                            "spec": _pod_spec(
+                                spec, stage, store_path, image, command,
+                                "Always",
+                            ),
+                        },
+                    },
+                }
+                docs[f"{i:02d}-{stage.name}-service.yaml"] = {
+                    "apiVersion": "v1",
+                    "kind": "Service",
+                    "metadata": meta,
+                    "spec": {
+                        "selector": {"app": labels["app"]},
+                        "ports": [{"port": stage.port, "targetPort": stage.port}],
+                        "type": "ClusterIP",
+                    },
+                }
+    if daily_schedule:
+        docs["99-daily-loop-cronjob.yaml"] = {
+            "apiVersion": "batch/v1",
+            "kind": "CronJob",
+            "metadata": {
+                "name": f"{spec.name}--daily-loop",
+                "namespace": namespace,
+                "labels": labels_base,
+            },
+            "spec": {
+                "schedule": daily_schedule,
+                "concurrencyPolicy": "Forbid",
+                "jobTemplate": {
+                    "spec": {
+                        "template": {
+                            "spec": _pod_spec(
+                                spec,
+                                next(iter(spec.stages.values())),
+                                store_path,
+                                image,
+                                ["python", "-m", "bodywork_tpu.cli", "run-day",
+                                 "--store", store_path,
+                                 "--spec", f"{_SPEC_MOUNT}/{_SPEC_FILE}"],
+                                "Never",
+                            )
+                        }
+                    }
+                },
+            },
+        }
+    return docs
+
+
+def write_manifests(
+    spec: PipelineSpec, out_dir: str | Path, **kwargs
+) -> list[Path]:
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    written = []
+    for filename, doc in generate_manifests(spec, **kwargs).items():
+        buf = io.StringIO()
+        yaml.safe_dump(doc, buf, sort_keys=False)
+        path = out / filename
+        path.write_text(buf.getvalue())
+        written.append(path)
+    return written

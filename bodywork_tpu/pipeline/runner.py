@@ -1,0 +1,200 @@
+"""Local in-process pipeline runner (reference C1/C10 behavior).
+
+Executes a :class:`PipelineSpec`'s DAG for one simulated day per run — the
+in-process equivalent of Bodywork materialising the DAG as k8s Jobs and
+Deployments. Orchestrator guarantees preserved from the reference:
+
+- batch stages get ``retries`` attempts (``bodywork.yaml:21``) and a
+  completion deadline (``max_completion_time_seconds`` — ``bodywork.yaml:20``);
+- service stages get a startup deadline and a health check before the DAG
+  proceeds (``bodywork.yaml:39`` + k8s probes);
+- a failed stage (exit-code contract, ``stage_1:170-178``) aborts the day
+  with a :class:`StageFailure` naming the stage.
+
+``run_simulation`` loops the daily DAG over N simulated days — the
+reference's "re-run the deployment every day" (README.md:5) without needing
+a day to take a day.
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import threading
+import time
+from datetime import date, timedelta
+
+from bodywork_tpu.data.generator import DriftConfig
+from bodywork_tpu.pipeline.spec import PipelineSpec, StageSpec
+from bodywork_tpu.pipeline.stages import StageContext
+from bodywork_tpu.data import Dataset, generate_day, persist_dataset
+from bodywork_tpu.store.base import ArtefactStore
+from bodywork_tpu.store.schema import DATASETS_PREFIX
+from bodywork_tpu.utils.errors import StageError
+from bodywork_tpu.utils.logging import configure_logger, get_logger
+
+log = get_logger("pipeline.runner")
+
+
+class StageFailure(StageError):
+    """A stage exhausted its retries."""
+
+
+@dataclasses.dataclass
+class DayResult:
+    day: date
+    wall_clock_s: float
+    stage_seconds: dict[str, float]
+    stage_results: dict[str, object]
+
+
+def resolve_executable(path: str):
+    """``"pkg.mod:fn"`` -> the callable."""
+    module_name, _, fn_name = path.partition(":")
+    if not fn_name:
+        raise ValueError(f"executable must be 'module:function', got {path!r}")
+    module = importlib.import_module(module_name)
+    return getattr(module, fn_name)
+
+
+class LocalRunner:
+    def __init__(self, spec: PipelineSpec, store: ArtefactStore,
+                 drift: DriftConfig | None = None):
+        self.spec = spec
+        self.store = store
+        self.drift = drift or DriftConfig()
+        configure_logger(spec.log_level)
+
+    # -- single stages -----------------------------------------------------
+    def _run_batch_stage(self, stage: StageSpec, ctx: StageContext):
+        fn = resolve_executable(stage.executable)
+        last_exc: BaseException | None = None
+        for attempt in range(1 + stage.retries):
+            if attempt:
+                log.warning(f"retrying {stage.name} (attempt {attempt + 1})")
+            # A daemon thread (not an executor) so a stage hung past its
+            # deadline is truly abandoned — like a k8s Job past
+            # activeDeadlineSeconds — and cannot block interpreter exit via
+            # concurrent.futures' atexit join.
+            box: dict[str, object] = {}
+
+            def _target():
+                try:
+                    box["result"] = fn(ctx, **stage.args)
+                except BaseException as exc:  # noqa: BLE001 — reported below
+                    box["exc"] = exc
+
+            worker = threading.Thread(
+                target=_target, name=f"stage-{stage.name}", daemon=True
+            )
+            worker.start()
+            worker.join(timeout=stage.max_completion_time_s)
+            if worker.is_alive():
+                last_exc = TimeoutError(
+                    f"exceeded max_completion_time_seconds="
+                    f"{stage.max_completion_time_s}"
+                )
+                log.error(f"{stage.name}: {last_exc}")
+            elif "exc" in box:
+                last_exc = box["exc"]  # type: ignore[assignment]
+                log.error(f"{stage.name} failed: {last_exc!r}")
+            else:
+                return box.get("result")
+        raise StageFailure(stage.name, repr(last_exc))
+
+    def _run_service_stage(self, stage: StageSpec, ctx: StageContext):
+        """Start + health-gate a service stage, honouring ``retries`` and the
+        stage-failure contract (every failure surfaces as StageFailure)."""
+        last_exc: Exception | None = None
+        for attempt in range(1 + stage.retries):
+            if attempt:
+                log.warning(f"retrying {stage.name} (attempt {attempt + 1})")
+            try:
+                return self._start_and_health_gate(stage, ctx)
+            except Exception as exc:
+                last_exc = exc
+                log.error(f"{stage.name} failed to start: {exc!r}")
+        if isinstance(last_exc, StageFailure):
+            raise last_exc
+        raise StageFailure(stage.name, repr(last_exc))
+
+    def _start_and_health_gate(self, stage: StageSpec, ctx: StageContext):
+        fn = resolve_executable(stage.executable)
+        deadline = time.monotonic() + stage.max_startup_time_s
+        handle = fn(ctx, **stage.args)
+        # health-check before the DAG proceeds (k8s readiness probe analogue)
+        import requests
+
+        health_url = handle.url.replace("/score/v1", "/healthz")
+        while True:
+            try:
+                if requests.get(health_url, timeout=2).ok:
+                    break
+            except requests.ConnectionError:
+                pass
+            if time.monotonic() > deadline:
+                handle.stop()
+                raise StageFailure(
+                    stage.name,
+                    f"not healthy within max_startup_time_seconds="
+                    f"{stage.max_startup_time_s}",
+                )
+            time.sleep(0.05)
+        ctx.services[stage.name] = handle
+        return handle
+
+    # -- DAG execution -----------------------------------------------------
+    def run_day(self, today: date, scoring_url: str | None = None) -> DayResult:
+        ctx = StageContext(
+            store=self.store, today=today, drift=self.drift, scoring_url=scoring_url
+        )
+        stage_seconds: dict[str, float] = {}
+        stage_results: dict[str, object] = {}
+        day_start = time.perf_counter()
+        try:
+            for step in self.spec.dag:
+                # stages within a step are independent; executed in order
+                # here (concurrent pods in the k8s materialisation)
+                for stage_name in step:
+                    stage = self.spec.stages[stage_name]
+                    t0 = time.perf_counter()
+                    if stage.kind == "service":
+                        result = self._run_service_stage(stage, ctx)
+                    else:
+                        result = self._run_batch_stage(stage, ctx)
+                    stage_seconds[stage_name] = time.perf_counter() - t0
+                    stage_results[stage_name] = result
+                    log.info(
+                        f"[{today}] {stage_name} done in "
+                        f"{stage_seconds[stage_name]:.3f}s"
+                    )
+        finally:
+            for name, handle in ctx.services.items():
+                handle.stop()
+        return DayResult(
+            day=today,
+            wall_clock_s=time.perf_counter() - day_start,
+            stage_seconds=stage_seconds,
+            stage_results=stage_results,
+        )
+
+    # -- multi-day simulation ----------------------------------------------
+    def bootstrap(self, start: date) -> None:
+        """Seed day-0 data if the store has none (the reference bootstraps by
+        hand-running the stage-3 notebook before the first deployment)."""
+        if not self.store.history(DATASETS_PREFIX):
+            X, y = generate_day(start, self.drift)
+            persist_dataset(self.store, Dataset(X, y, start))
+            log.info(f"bootstrapped day-0 dataset for {start}")
+
+    def run_simulation(self, start: date, days: int) -> list[DayResult]:
+        """The daily MLOps loop over N simulated days: each day trains on
+        history to date, deploys, generates the next (drifted) day, and
+        tests the live service against it."""
+        self.bootstrap(start)
+        results = []
+        for i in range(days):
+            today = start + timedelta(days=i)
+            result = self.run_day(today)
+            results.append(result)
+            log.info(f"simulated day {today}: {result.wall_clock_s:.2f}s wall-clock")
+        return results

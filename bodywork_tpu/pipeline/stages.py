@@ -1,0 +1,100 @@
+"""The four canonical stage callables (reference C2-C5 entrypoints).
+
+Each stage is a function ``stage(ctx, **args)`` over a shared
+:class:`StageContext` — the framework's replacement for the reference's
+convention that a stage is "a python script with a ``main()``"
+(``bodywork.yaml:9,28,49,66``). Batch stages return when done; service
+stages return a handle the runner owns for the rest of the day.
+
+Stage semantics (and their reference call stacks, SURVEY.md §3):
+
+- ``train_stage``    <- ``stage_1_train_model.main`` (§3.1)
+- ``serve_stage``    <- ``stage_2_serve_model`` ``__main__`` (§3.2)
+- ``generate_stage`` <- ``stage_3_synthetic_data_generation.main`` (§3.3)
+- ``test_stage``     <- ``stage_4_test_model_scoring_service.main`` (§3.4)
+"""
+from __future__ import annotations
+
+import dataclasses
+from datetime import date, timedelta
+
+from bodywork_tpu.data import Dataset, generate_day, persist_dataset
+from bodywork_tpu.data.generator import DriftConfig
+from bodywork_tpu.monitor import (
+    HttpScoringClient,
+    InProcessScoringClient,
+    run_service_test,
+    scoring_endpoint,
+)
+from bodywork_tpu.serve import ServiceHandle, create_app
+from bodywork_tpu.models.checkpoint import load_model
+from bodywork_tpu.store.base import ArtefactStore
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("pipeline.stages")
+
+
+@dataclasses.dataclass
+class StageContext:
+    """Everything a stage needs from the orchestrator."""
+
+    store: ArtefactStore
+    #: the simulated "today" (the reference uses wall-clock ``date.today()``;
+    #: parameterising it lets simulations run faster than real time)
+    today: date
+    drift: DriftConfig = dataclasses.field(default_factory=DriftConfig)
+    #: service handles started earlier in the DAG, keyed by stage name
+    services: dict = dataclasses.field(default_factory=dict)
+    #: URL of the scoring service for cross-process testing (cluster DNS in
+    #: k8s — ``stage_4:28``); None means test in-process via the app object
+    scoring_url: str | None = None
+
+
+def generate_stage(ctx: StageContext, offset_days: int = 1) -> str:
+    """Generate the *next* simulated day's drifting data
+    (reference stage 3: tomorrow's dataset appears today)."""
+    target = ctx.today + timedelta(days=offset_days)
+    X, y = generate_day(target, ctx.drift)
+    key = persist_dataset(ctx.store, Dataset(X, y, target))
+    return key
+
+
+def train_stage(ctx: StageContext, model_type: str = "linear", **model_kwargs):
+    """Train on all data to date, persist model + metrics (reference stage 1)."""
+    from bodywork_tpu.train import train_on_history
+
+    return train_on_history(ctx.store, model_type, model_kwargs=model_kwargs or None)
+
+
+def serve_stage(
+    ctx: StageContext, host: str = "127.0.0.1", port: int = 0
+) -> ServiceHandle:
+    """Load the latest model into device HBM and start the scoring service
+    on a background thread (reference stage 2). Returns the handle; the
+    runner keeps it alive for the rest of the day and tears it down at
+    day end (the k8s deployment path instead keeps it up until re-deploy)."""
+    model, model_date = load_model(ctx.store)
+    app = create_app(model, model_date)
+    handle = ServiceHandle(app, host=host, port=port).start()
+    handle.app = app
+    return handle
+
+
+def test_stage(
+    ctx: StageContext,
+    mode: str = "batch",
+    service_stage: str = "stage-2-serve-model",
+    max_rows: int | None = None,
+):
+    """Score the latest dataset through the live service and persist drift
+    metrics (reference stage 4)."""
+    if ctx.scoring_url is not None:
+        client = HttpScoringClient(scoring_endpoint(ctx.scoring_url, mode))
+    elif service_stage in ctx.services:
+        client = InProcessScoringClient(ctx.services[service_stage].app)
+    else:
+        raise RuntimeError(
+            f"test_stage needs a scoring_url or a running service "
+            f"{service_stage!r} in the context"
+        )
+    return run_service_test(ctx.store, client, mode=mode, max_rows=max_rows)

@@ -1,0 +1,163 @@
+"""HTTP scoring service (reference C3, ``stage_2_serve_model.py``).
+
+The public HTTP contract is frozen to the reference's API:
+
+    POST /score/v1   {"X": 50}  ->  {"prediction": 54.57..., "model_info": "..."}
+
+(``stage_2_serve_model.py:11-21,73-80``). The input is coerced with
+``np.array(features, ndmin=2)`` semantics exactly as the reference does, so a
+scalar scores one instance — but the response additionally carries
+``model_date`` (the artefact version being served), fixing the reference's
+inability to tell *which* model answered.
+
+Implementation: a self-contained WSGI application on werkzeug primitives
+(the reference uses the Flask dev server; this framework owns its serving
+layer — the same app object runs under the threaded dev server, a test
+client, or any production WSGI container).
+
+TPU-native additions beyond parity:
+
+- ``POST /score/v1/batch`` — score many rows in one request through the
+  shape-bucketed predictor (BASELINE.json config 4: 1k-row predict requests).
+- ``GET /healthz`` — readiness probe for the orchestrator (the reference
+  relies on k8s TCP probes only).
+
+Params live in TPU HBM from model load; per-request work is one padded
+device call.
+"""
+from __future__ import annotations
+
+import json
+from datetime import date
+
+import numpy as np
+from werkzeug.exceptions import HTTPException, MethodNotAllowed, NotFound
+from werkzeug.wrappers import Request, Response
+
+from bodywork_tpu.models.base import Regressor
+from bodywork_tpu.serve.predictor import PaddedPredictor
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("serve.app")
+
+
+def _json_response(payload: dict, status: int = 200) -> Response:
+    return Response(
+        json.dumps(payload), status=status, mimetype="application/json"
+    )
+
+
+class ScoringApp:
+    """WSGI scoring application over a shape-bucketed predictor."""
+
+    def __init__(
+        self,
+        model: Regressor,
+        model_date: date | None = None,
+        buckets: tuple[int, ...] | None = None,
+    ):
+        self.predictor = (
+            PaddedPredictor(model, buckets) if buckets else PaddedPredictor(model)
+        )
+        self.model_info = model.info
+        self.model_date = str(model_date) if model_date else None
+        self._routes = {
+            ("POST", "/score/v1"): self.score_data_instance,
+            ("POST", "/score/v1/batch"): self.score_batch,
+            ("GET", "/healthz"): self.healthz,
+        }
+
+    # -- WSGI plumbing -----------------------------------------------------
+    def __call__(self, environ, start_response):
+        request = Request(environ)
+        try:
+            handler = self._routes.get((request.method, request.path))
+            if handler is None:
+                if any(path == request.path for _m, path in self._routes):
+                    raise MethodNotAllowed()
+                raise NotFound()
+            response = handler(request)
+        except HTTPException as exc:
+            response = _json_response({"error": exc.description}, exc.code)
+        except Exception as exc:  # don't leak tracebacks to clients
+            log.error(f"unhandled error serving {request.path}: {exc!r}")
+            response = _json_response({"error": "internal server error"}, 500)
+        return response(environ, start_response)
+
+    def test_client(self):
+        from werkzeug.test import Client
+
+        return Client(self)
+
+    # -- shared parsing ----------------------------------------------------
+    def _features_from(self, request: Request):
+        payload = request.get_json(silent=True)
+        if not isinstance(payload, dict) or "X" not in payload:
+            return None, _json_response(
+                {"error": "request body must be a JSON object with an 'X' field"},
+                400,
+            )
+        try:
+            X = np.asarray(payload["X"], dtype=np.float32)
+        except (TypeError, ValueError):
+            return None, _json_response({"error": "'X' must be numeric"}, 400)
+        if X.size == 0:
+            return None, _json_response({"error": "'X' must be non-empty"}, 400)
+        if not np.all(np.isfinite(X)):
+            return None, _json_response({"error": "'X' must be finite"}, 400)
+        return X, None
+
+    # -- routes ------------------------------------------------------------
+    def score_data_instance(self, request: Request) -> Response:
+        """Single-instance scoring; reference-parity contract
+        (``stage_2:73-80``)."""
+        X, err = self._features_from(request)
+        if err is not None:
+            return err
+        X = np.array(X, ndmin=2)  # scalar -> (1, 1), as the reference
+        prediction = self.predictor.predict(X)
+        return _json_response(
+            {
+                "prediction": float(prediction[0]),
+                "model_info": self.model_info,
+                "model_date": self.model_date,
+            }
+        )
+
+    def score_batch(self, request: Request) -> Response:
+        """Batched scoring: one padded device call for up to bucket-size rows."""
+        X, err = self._features_from(request)
+        if err is not None:
+            return err
+        if X.ndim == 0:
+            X = X[None]
+        predictions = self.predictor.predict(X)
+        return _json_response(
+            {
+                "predictions": [float(p) for p in predictions],
+                "n": int(len(predictions)),
+                "model_info": self.model_info,
+                "model_date": self.model_date,
+            }
+        )
+
+    def healthz(self, request: Request) -> Response:
+        return _json_response(
+            {
+                "status": "ok",
+                "model_info": self.model_info,
+                "model_date": self.model_date,
+            }
+        )
+
+
+def create_app(
+    model: Regressor,
+    model_date: date | None = None,
+    buckets: tuple[int, ...] | None = None,
+    warmup: bool = True,
+) -> ScoringApp:
+    app = ScoringApp(model, model_date, buckets)
+    if warmup:
+        app.predictor.warmup()
+    return app

@@ -1,0 +1,71 @@
+"""Shape-bucketed prediction wrapper for serving.
+
+SURVEY.md "hard part (1)": keep host<->device transfers and *recompilation*
+out of the per-request path. Under jit, every distinct input shape is a new
+XLA compilation; a scoring service seeing arbitrary request sizes would
+compile on the request path. This wrapper pads each request's row count up to
+a fixed bucket (powers of two), so the set of compiled executables is small,
+pre-warmable at startup, and shared across requests. Oversized requests are
+chunked through the largest bucket.
+
+The reference has no analogue (sklearn predict is shape-agnostic); this is
+pure TPU-serving design.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from bodywork_tpu.models.base import Regressor
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("serve.predictor")
+
+DEFAULT_BUCKETS = (1, 8, 64, 512, 4096)
+
+
+class PaddedPredictor:
+    def __init__(self, model: Regressor, buckets: tuple[int, ...] = DEFAULT_BUCKETS):
+        assert model.params is not None, "cannot serve an unfitted model"
+        self.model = model
+        self.buckets = tuple(sorted(buckets))
+
+    def warmup(self, n_features: int | None = None) -> None:
+        """Compile every bucket shape before taking traffic (startup cost,
+        analogous to the reference's load-model-at-boot — ``stage_2:113``).
+
+        The feature dimension defaults to the fitted model's own, so the
+        shapes compiled here are exactly the request-path shapes.
+        """
+        if n_features is None:
+            n_features = self.model.n_features or 1
+        for b in self.buckets:
+            self.model.predict(np.zeros((b, n_features), dtype=np.float32))
+        log.info(
+            f"warmed up predict buckets {self.buckets} (n_features={n_features})"
+        )
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        X = np.asarray(X, dtype=np.float32)
+        if X.ndim == 1:
+            X = X[:, None]
+        n = X.shape[0]
+        max_bucket = self.buckets[-1]
+        if n > max_bucket:
+            # chunk through the largest compiled bucket
+            parts = [
+                self.predict(X[i : i + max_bucket]) for i in range(0, n, max_bucket)
+            ]
+            return np.concatenate(parts)
+        b = self._bucket_for(n)
+        if b != n:
+            Xp = np.zeros((b, X.shape[1]), dtype=np.float32)
+            Xp[:n] = X
+        else:
+            Xp = X
+        return np.asarray(self.model.predict(Xp))[:n]

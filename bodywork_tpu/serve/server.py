@@ -1,0 +1,81 @@
+"""Scoring-service lifecycle.
+
+Two run modes replace the reference's bare ``app.run`` (``stage_2:108-116``):
+
+- :func:`serve_latest_model` — blocking production entrypoint: load the
+  latest checkpoint from the store into TPU HBM, warm up the compiled
+  buckets, serve.
+- :class:`ServiceHandle` — in-process threaded server (werkzeug
+  ``make_server``) with clean startup/shutdown, used by the local pipeline
+  runner and the live-service tester so the whole daily loop can run in one
+  process (the reference needs a k8s cluster for this).
+"""
+from __future__ import annotations
+
+import threading
+
+from werkzeug.serving import make_server
+
+from bodywork_tpu.models.checkpoint import load_model
+from bodywork_tpu.serve.app import create_app
+from bodywork_tpu.store.base import ArtefactStore
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("serve.server")
+
+
+class ServiceHandle:
+    """A scoring service running on a background thread."""
+
+    def __init__(self, app, host: str = "127.0.0.1", port: int = 5000):
+        # port=0 lets the OS pick a free port (tests / concurrent pipelines)
+        self._server = make_server(host, port, app, threaded=True)
+        self.host = host
+        self.port = self._server.server_port
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="scoring-service", daemon=True
+        )
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/score/v1"
+
+    def start(self) -> "ServiceHandle":
+        self._thread.start()
+        log.info(f"scoring service listening on {self.url}")
+        return self
+
+    def wait(self) -> None:
+        """Block until the server thread exits (pod-entrypoint mode)."""
+        self._thread.join()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._thread.join(timeout=10)
+        log.info("scoring service stopped")
+
+    def __enter__(self) -> "ServiceHandle":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def serve_latest_model(
+    store: ArtefactStore,
+    host: str = "0.0.0.0",
+    port: int = 5000,
+    block: bool = True,
+):
+    """Load latest model -> HBM, warm up, serve (reference ``stage_2`` main).
+
+    With ``block=False`` returns a started :class:`ServiceHandle`.
+    """
+    model, model_date = load_model(store)
+    app = create_app(model, model_date)
+    handle = ServiceHandle(app, host, port)
+    if block:
+        log.info(f"starting API server on {host}:{port}")
+        handle._server.serve_forever()
+        return None
+    return handle.start()

@@ -1,0 +1,33 @@
+"""CLI: subcommand wiring, exit-code contract, day-loop smoke."""
+from bodywork_tpu.cli import main
+
+
+def test_generate_then_train_then_report(tmp_path, capsys):
+    store = str(tmp_path / "artefacts")
+    assert main(["generate", "--store", store, "--date", "2026-01-01"]) == 0
+    assert main(["train", "--store", store]) == 0
+    out = capsys.readouterr().out
+    assert "regression-dataset-2026-01-01.csv" in out
+    assert "models/regressor-2026-01-01.npz" in out
+    assert main(["report", "--store", store]) == 0
+    assert "MAPE" in capsys.readouterr().out
+
+
+def test_run_day_smoke(tmp_path, capsys):
+    store = str(tmp_path / "artefacts")
+    assert main(["run-day", "--store", store, "--date", "2026-01-01"]) == 0
+    out = capsys.readouterr().out
+    assert "stage-4-test-model-scoring-service" in out
+
+
+def test_exit_code_contract_on_failure(tmp_path, capsys):
+    # train with no data must exit 1 with a logged error (stage_1:170-178)
+    assert main(["train", "--store", str(tmp_path / "empty")]) == 1
+
+
+def test_deploy_writes_manifests(tmp_path, capsys):
+    out_dir = tmp_path / "k8s"
+    assert main(["deploy", "--out", str(out_dir)]) == 0
+    files = sorted(p.name for p in out_dir.iterdir())
+    assert "00-namespace.yaml" in files
+    assert any("cronjob" in f for f in files)

@@ -1,0 +1,158 @@
+"""Live-service tester + analytics: metric parity, failure accounting,
+batched scoring path, longitudinal drift report."""
+from datetime import date
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from bodywork_tpu.data import Dataset, generate_day, persist_dataset
+from bodywork_tpu.models import LinearRegressor
+from bodywork_tpu.monitor import (
+    InProcessScoringClient,
+    compute_test_metrics,
+    drift_report,
+    load_metric_history,
+    run_service_test,
+    score_dataset,
+)
+from bodywork_tpu.serve import create_app
+from bodywork_tpu.store.schema import test_metrics_key as tm_key
+from bodywork_tpu.train import train_on_history
+from bodywork_tpu.utils.dates import date_range
+
+
+@pytest.fixture(scope="module")
+def served_store(tmp_path_factory):
+    """Store with 2 days of data + a trained model; returns (store, app)."""
+    from bodywork_tpu.store import FilesystemStore
+
+    store = FilesystemStore(tmp_path_factory.mktemp("artefacts"))
+    for d in date_range(date(2026, 1, 1), 2):
+        X, y = generate_day(d)
+        persist_dataset(store, Dataset(X, y, d))
+    result = train_on_history(store, "linear")
+    app = create_app(result.model, result.data_date, buckets=(1, 64, 512), warmup=False)
+    return store, app
+
+
+def test_run_service_test_single_mode(served_store):
+    store, app = served_store
+    metrics = run_service_test(
+        store, InProcessScoringClient(app), mode="single", max_rows=300
+    )
+    rec = metrics.iloc[0]
+    # live-test baseline regime (BASELINE.md): MAPE ~0.8, corr ~0.8
+    assert 0.2 < rec.MAPE < 3.0
+    assert rec.r_squared > 0.7
+    assert rec.n_failures == 0
+    assert store.exists(tm_key(date(2026, 1, 2)))
+
+
+def test_batch_mode_matches_single_mode_metrics(served_store):
+    store, app = served_store
+    m_single = run_service_test(
+        store, InProcessScoringClient(app), mode="single", max_rows=300
+    )
+    m_batch = run_service_test(
+        store, InProcessScoringClient(app), mode="batch", max_rows=300
+    )
+    for col in ["MAPE", "r_squared", "max_residual"]:
+        assert m_batch.iloc[0][col] == pytest.approx(
+            m_single.iloc[0][col], rel=1e-4
+        ), col
+    # batched scoring must be much faster per row than per-row HTTP calls
+    assert (
+        m_batch.iloc[0].mean_response_time < m_single.iloc[0].mean_response_time
+    )
+
+
+def test_metrics_csv_schema_extends_reference(served_store):
+    store, app = served_store
+    run_service_test(store, InProcessScoringClient(app), mode="batch")
+    import io
+
+    df = pd.read_csv(
+        io.BytesIO(store.get_bytes(tm_key(date(2026, 1, 2))))
+    )
+    # reference columns (stage_4:106-112) preserved, + n_failures
+    assert list(df.columns) == [
+        "date", "MAPE", "r_squared", "max_residual", "mean_response_time",
+        "n_failures",
+    ]
+
+
+class _FailingClient:
+    """Fails every 3rd request — exercises failure accounting."""
+
+    def __init__(self, app):
+        self._inner = InProcessScoringClient(app)
+        self._count = 0
+
+    def score(self, payload):
+        self._count += 1
+        if self._count % 3 == 0:
+            return False, [], 0.001
+        return self._inner.score(payload)
+
+
+def test_failures_excluded_from_metrics(served_store):
+    # the reference averaged -1 sentinels into MAPE/corr (stage_4:82,85);
+    # here failures must be counted but not pollute the metrics
+    store, app = served_store
+    X, y = generate_day(date(2026, 1, 2))
+    ds = Dataset(X[:30], y[:30], date(2026, 1, 2))
+    results = score_dataset(_FailingClient(app), ds, mode="single")
+    assert (~results["ok"]).sum() == 10
+    metrics = compute_test_metrics(results, ds.date)
+    rec = metrics.iloc[0]
+    assert rec.n_failures == 10
+    assert rec.MAPE < 3.0  # no -1 pollution
+    assert not np.isnan(rec.r_squared)
+
+
+def test_all_failures_gives_nan_metrics():
+    results = pd.DataFrame(
+        {
+            "score": [np.nan, np.nan],
+            "label": [1.0, 2.0],
+            "APE": [np.nan, np.nan],
+            "response_time": [0.001, 0.001],
+            "ok": [False, False],
+        }
+    )
+    rec = compute_test_metrics(results, date(2026, 1, 1)).iloc[0]
+    assert rec.n_failures == 2
+    assert np.isnan(rec.MAPE)
+
+
+def test_ape_guards_zero_label(served_store):
+    _store, app = served_store
+    ds = Dataset(np.array([50.0]), np.array([0.0]), date(2026, 1, 2))
+    results = score_dataset(InProcessScoringClient(app), ds, mode="single")
+    assert np.isfinite(results["APE"][0])  # no inf/div-by-zero
+
+
+def test_drift_report_joins_histories(served_store):
+    store, app = served_store
+    run_service_test(store, InProcessScoringClient(app), mode="batch")
+    report = drift_report(store)
+    assert "MAPE_train" in report.columns and "MAPE_live" in report.columns
+    # train metrics exist for day 2 (trained on 2-day history)
+    assert date(2026, 1, 2) in list(report["date"])
+    train_df, test_df = load_metric_history(store)
+    assert len(train_df) == 1 and len(test_df) == 1
+
+
+def test_scoring_endpoint_normalisation():
+    from bodywork_tpu.monitor import scoring_endpoint
+
+    # bare base, trailing slash, or already-suffixed URLs all normalise
+    for base in [
+        "http://svc:5000",
+        "http://svc:5000/",
+        "http://svc:5000/score/v1",
+        "http://svc:5000/score/v1/batch",
+    ]:
+        assert scoring_endpoint(base, "single") == "http://svc:5000/score/v1"
+        assert scoring_endpoint(base, "batch") == "http://svc:5000/score/v1/batch"

@@ -1,0 +1,236 @@
+"""Pipeline layer: spec round-trip, DAG parsing, local day-loop runner,
+retry/timeout semantics, manifest golden properties."""
+from datetime import date
+
+import pytest
+
+from bodywork_tpu.pipeline import (
+    LocalRunner,
+    PipelineSpec,
+    StageFailure,
+    StageSpec,
+    default_pipeline,
+    generate_manifests,
+    parse_dag,
+    write_manifests,
+)
+from bodywork_tpu.store.schema import (
+    DATASETS_PREFIX,
+    MODEL_METRICS_PREFIX,
+    MODELS_PREFIX,
+    TEST_METRICS_PREFIX,
+)
+
+
+def test_parse_dag_reference_grammar():
+    # same grammar as bodywork.yaml:5
+    assert parse_dag("a >> b >> c >> d") == [["a"], ["b"], ["c"], ["d"]]
+    assert parse_dag("a >> b,c >> d") == [["a"], ["b", "c"], ["d"]]
+    assert parse_dag(" a ") == [["a"]]
+
+
+def test_spec_yaml_roundtrip():
+    spec = default_pipeline()
+    clone = PipelineSpec.from_yaml(spec.to_yaml())
+    assert clone.name == spec.name
+    assert clone.dag == spec.dag
+    assert set(clone.stages) == set(spec.stages)
+    s = clone.stages["stage-2-serve-model"]
+    assert s.kind == "service" and s.replicas == 2 and s.port == 5000
+    assert clone.stages["stage-1-train-model"].resources.tpu_topology == "1x1"
+
+
+def test_spec_rejects_undeclared_dag_stage():
+    with pytest.raises(ValueError, match="undeclared"):
+        PipelineSpec(name="p", dag=[["ghost"]], stages={})
+
+
+def test_service_dns_convention():
+    # reference convention <project>--<stage> (stage_4:28)
+    spec = default_pipeline()
+    assert (
+        spec.service_dns("stage-2-serve-model")
+        == "bodywork-tpu-pipeline--stage-2-serve-model"
+    )
+
+
+def test_run_day_end_to_end(store):
+    runner = LocalRunner(default_pipeline(scoring_mode="batch"), store)
+    start = date(2026, 1, 1)
+    runner.bootstrap(start)
+    result = runner.run_day(start)
+    # all four stages ran
+    assert set(result.stage_seconds) == set(default_pipeline().stages)
+    # artefacts of every kind exist
+    assert store.history(DATASETS_PREFIX)  # day 0 + generated day 1
+    assert store.history(MODELS_PREFIX)
+    assert store.history(MODEL_METRICS_PREFIX)
+    assert store.history(TEST_METRICS_PREFIX)
+    # stage 3 generated *tomorrow's* data; stage 4 tested against it
+    assert store.history(DATASETS_PREFIX)[-1][1] == date(2026, 1, 2)
+    assert store.history(TEST_METRICS_PREFIX)[-1][1] == date(2026, 1, 2)
+    # the service was torn down at day end
+    import requests
+
+    handle = result.stage_results["stage-2-serve-model"]
+    with pytest.raises(requests.ConnectionError):
+        requests.get(handle.url.replace("/score/v1", "/healthz"), timeout=2)
+
+
+def test_run_simulation_three_days_shows_drift_history(store):
+    runner = LocalRunner(default_pipeline(scoring_mode="batch"), store)
+    results = runner.run_simulation(date(2026, 1, 1), 3)
+    assert len(results) == 3
+    # 3 train runs + 3 test runs persisted
+    assert len(store.history(MODEL_METRICS_PREFIX)) == 3
+    assert len(store.history(TEST_METRICS_PREFIX)) == 3
+    # datasets: day0 bootstrap + one generated per day
+    assert len(store.history(DATASETS_PREFIX)) == 4
+    from bodywork_tpu.monitor import drift_report
+
+    report = drift_report(store)
+    assert len(report) >= 3
+    assert {"MAPE_train", "MAPE_live"} <= set(report.columns)
+
+
+def _failing_stage(ctx, **kwargs):
+    raise RuntimeError("boom")
+
+
+def _flaky_stage(ctx, **kwargs):
+    # counts attempts via the store: resolve_executable imports this module
+    # under its own instance, so in-memory globals would not be shared
+    n = int(ctx.store.get_text("flaky-count")) if ctx.store.exists("flaky-count") else 0
+    n += 1
+    ctx.store.put_text("flaky-count", str(n))
+    if n < 3:
+        raise RuntimeError("flaky")
+    return "ok"
+
+
+def _slow_stage(ctx, **kwargs):
+    import time
+
+    time.sleep(5)
+
+
+def _make_single_stage_spec(executable, **stage_kwargs):
+    stage = StageSpec(
+        name="s", kind="batch", executable=executable, **stage_kwargs
+    )
+    return PipelineSpec(name="t", dag=[["s"]], stages={"s": stage})
+
+
+def test_batch_stage_retries_then_fails(store):
+    spec = _make_single_stage_spec("tests.test_pipeline:_failing_stage", retries=2)
+    runner = LocalRunner(spec, store)
+    with pytest.raises(StageFailure, match="'s' failed"):
+        runner.run_day(date(2026, 1, 1))
+
+
+def test_batch_stage_retry_eventually_succeeds(store):
+    spec = _make_single_stage_spec("tests.test_pipeline:_flaky_stage", retries=2)
+    result = LocalRunner(spec, store).run_day(date(2026, 1, 1))
+    assert result.stage_results["s"] == "ok"
+    assert store.get_text("flaky-count") == "3"
+
+
+def test_batch_stage_timeout_enforced(store):
+    spec = _make_single_stage_spec(
+        "tests.test_pipeline:_slow_stage", retries=0, max_completion_time_s=0.3
+    )
+    with pytest.raises(StageFailure, match="max_completion_time"):
+        LocalRunner(spec, store).run_day(date(2026, 1, 1))
+
+
+def test_manifests_structure(tmp_path):
+    spec = default_pipeline()
+    docs = generate_manifests(spec, store_path="/mnt/store")
+    kinds = {}
+    for doc in docs.values():
+        kinds.setdefault(doc["kind"], 0)
+        kinds[doc["kind"]] += 1
+    assert kinds == {
+        "Namespace": 1, "ConfigMap": 1, "Job": 3, "Deployment": 1,
+        "Service": 1, "CronJob": 1,
+    }
+    # the deploy-time spec rides into pods as a ConfigMap, and every stage
+    # command loads it — so non-default model/mode choices round-trip
+    cm = docs["00-pipeline-spec-configmap.yaml"]
+    assert PipelineSpec.from_yaml(cm["data"]["pipeline.yaml"]).dag == spec.dag
+    for name, doc in docs.items():
+        if doc["kind"] == "Job":
+            cmd = doc["spec"]["template"]["spec"]["containers"][0]["command"]
+            assert "--spec" in cmd and "/etc/bodywork/pipeline.yaml" in cmd
+    # TPU scheduling: train stage pod targets a v5e node pool
+    job = docs["01-stage-1-train-model-job.yaml"]
+    pod = job["spec"]["template"]["spec"]
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"] == (
+        "tpu-v5-lite-podslice"
+    )
+    assert pod["containers"][0]["resources"]["limits"]["google.com/tpu"] == 1
+    # Job-level retry/timeout mirror the spec (bodywork.yaml:19-21)
+    assert job["spec"]["backoffLimit"] == 2
+    assert job["spec"]["activeDeadlineSeconds"] == 30
+    # service: 2 replicas, readiness probe on /healthz
+    dep = docs["02-stage-2-serve-model-deployment.yaml"]
+    assert dep["spec"]["replicas"] == 2
+    probe = dep["spec"]["template"]["spec"]["containers"][0]["readinessProbe"]
+    assert probe["httpGet"]["path"] == "/healthz"
+    # files are valid yaml on disk
+    written = write_manifests(spec, tmp_path / "k8s")
+    assert len(written) == len(docs)
+    import yaml
+
+    for path in written:
+        assert yaml.safe_load(path.read_text())["kind"]
+
+
+def test_batch_stage_timeout_does_not_block_on_worker(store):
+    # the deadline must fire at ~the configured timeout even though the
+    # worker thread sleeps much longer (executor must not join it)
+    import time
+
+    spec = _make_single_stage_spec(
+        "tests.test_pipeline:_slow_stage", retries=0, max_completion_time_s=0.3
+    )
+    t0 = time.perf_counter()
+    with pytest.raises(StageFailure):
+        LocalRunner(spec, store).run_day(date(2026, 1, 1))
+    assert time.perf_counter() - t0 < 3.0  # _slow_stage sleeps 5s
+
+
+def test_spec_file_round_trips_nondefault_choices(tmp_path):
+    # deploy --model mlp --mode single must reach in-cluster entrypoints
+    from bodywork_tpu.cli import main
+
+    out = tmp_path / "k8s"
+    assert main(["deploy", "--out", str(out), "--model", "mlp",
+                 "--mode", "single"]) == 0
+    import yaml as _yaml
+
+    cm = _yaml.safe_load((out / "00-pipeline-spec-configmap.yaml").read_text())
+    loaded = PipelineSpec.from_yaml(cm["data"]["pipeline.yaml"])
+    assert loaded.stages["stage-1-train-model"].args["model_type"] == "mlp"
+    assert (
+        loaded.stages["stage-4-test-model-scoring-service"].args["mode"]
+        == "single"
+    )
+    # and a local runner accepts the same spec file via --spec
+    spec_file = tmp_path / "pipeline.yaml"
+    spec_file.write_text(cm["data"]["pipeline.yaml"])
+    store = str(tmp_path / "artefacts")
+    from bodywork_tpu.pipeline.spec import default_pipeline as _dp
+
+    # cheap sanity: run-stage with --spec resolves the mlp train stage
+    from bodywork_tpu.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["run-stage", "--store", store, "--stage", "stage-1-train-model",
+         "--spec", str(spec_file)]
+    )
+    from bodywork_tpu.cli import _pipeline_spec
+
+    assert _pipeline_spec(args).stages["stage-1-train-model"].args[
+        "model_type"
+    ] == "mlp"

@@ -1,0 +1,120 @@
+"""Scoring service: exact reference HTTP contract, batch path, padding."""
+from datetime import date
+
+import numpy as np
+import pytest
+
+from bodywork_tpu.models import LinearRegressor
+from bodywork_tpu.serve import PaddedPredictor, ServiceHandle, create_app
+
+
+@pytest.fixture(scope="module")
+def fitted_model():
+    rng = np.random.default_rng(1)
+    X = rng.uniform(0, 100, 600).astype(np.float32)
+    y = (1.0 + 0.5 * X + rng.normal(0, 1, 600)).astype(np.float32)
+    return LinearRegressor().fit(X, y)
+
+
+@pytest.fixture(scope="module")
+def app(fitted_model):
+    return create_app(
+        fitted_model, date(2026, 7, 1), buckets=(1, 8, 64), warmup=True
+    )
+
+
+@pytest.fixture(scope="module")
+def client(app):
+    return app.test_client()
+
+
+def test_score_v1_reference_contract(client):
+    # the frozen reference request/response schema (stage_2:11-21,73-80)
+    response = client.post("/score/v1", json={"X": 50})
+    assert response.status_code == 200
+    body = response.get_json()
+    assert set(body) >= {"prediction", "model_info"}
+    assert body["prediction"] == pytest.approx(26.0, abs=2.0)  # ~1 + 0.5*50
+    assert body["model_info"] == "LinearRegressor(closed_form_ols)"
+    assert body["model_date"] == "2026-07-01"
+
+
+def test_score_v1_accepts_nested_list(client):
+    # np.array(ndmin=2) semantics: [[60]] scores one instance (stage_2:77)
+    response = client.post("/score/v1", json={"X": [[60.0]]})
+    assert response.status_code == 200
+    assert response.get_json()["prediction"] == pytest.approx(31.0, abs=2.0)
+
+
+def test_score_v1_missing_field_is_400(client):
+    assert client.post("/score/v1", json={"Y": 1}).status_code == 400
+    assert client.post("/score/v1", data="not json").status_code == 400
+
+
+def test_score_v1_non_numeric_is_400(client):
+    assert client.post("/score/v1", json={"X": "fifty"}).status_code == 400
+
+
+def test_batch_endpoint(client, fitted_model):
+    xs = list(np.linspace(0, 100, 100))
+    response = client.post("/score/v1/batch", json={"X": xs})
+    assert response.status_code == 200
+    body = response.get_json()
+    assert body["n"] == 100
+    direct = fitted_model.predict(np.array(xs, dtype=np.float32))
+    np.testing.assert_allclose(body["predictions"], direct, rtol=1e-4)
+
+
+def test_healthz(client):
+    body = client.get("/healthz").get_json()
+    assert body["status"] == "ok"
+    assert body["model_date"] == "2026-07-01"
+
+
+def test_padded_predictor_matches_direct(fitted_model):
+    pred = PaddedPredictor(fitted_model, buckets=(1, 8, 64))
+    for n in [1, 3, 8, 9, 64, 200]:  # 200 > max bucket => chunked
+        X = np.linspace(0, 100, n).astype(np.float32)
+        np.testing.assert_allclose(
+            pred.predict(X), fitted_model.predict(X[:, None]), rtol=1e-5,
+            err_msg=f"n={n}",
+        )
+
+
+def test_service_handle_over_real_http(app):
+    import requests
+
+    with ServiceHandle(app, port=0) as handle:
+        response = requests.post(handle.url, json={"X": 50}, timeout=10)
+        assert response.status_code == 200
+        assert "prediction" in response.json()
+    # after stop, the port is closed
+    with pytest.raises(requests.ConnectionError):
+        requests.post(handle.url, json={"X": 50}, timeout=2)
+
+
+def test_non_dict_payload_is_400(client):
+    assert client.post("/score/v1", json=42).status_code == 400
+    assert client.post("/score/v1", json=[1, 2]).status_code == 400
+
+
+def test_empty_x_is_400(client):
+    assert client.post("/score/v1", json={"X": []}).status_code == 400
+    assert client.post("/score/v1/batch", json={"X": []}).status_code == 400
+
+
+def test_wrong_method_is_405_unknown_route_404(client):
+    assert client.get("/score/v1").status_code == 405
+    assert client.get("/nope").status_code == 404
+
+
+def test_warmup_uses_model_feature_dim():
+    rng = np.random.default_rng(2)
+    X = rng.uniform(0, 1, (200, 3)).astype(np.float32)
+    y = X.sum(axis=1).astype(np.float32)
+    model = LinearRegressor().fit(X, y)
+    assert model.n_features == 3
+    pred = PaddedPredictor(model, buckets=(1, 8))
+    pred.warmup()  # must compile (b, 3) shapes without error
+    out = pred.predict(X[:5])
+    np.testing.assert_allclose(out, model.predict(X[:5]), rtol=1e-5)
