@@ -1,0 +1,65 @@
+"""Benchmark: end-to-end pipeline wall-clock per simulated day.
+
+North-star metric from BASELINE.json: the daily train -> serve -> generate ->
+test loop, run in-process on the TPU. The reference publishes no end-to-end
+number; the only defensible baseline quantity is its recorded live-scoring
+cost — 8.22 ms/request x 1317 rows = 10.83 s for the stage-4 loop alone
+(``notebooks/4-test-model-scoring-service.ipynb`` cell-10; BASELINE.md) —
+which *understates* the reference's full day (it excludes train/generate/
+deploy overhead), so ``vs_baseline`` = baseline_s / ours_s is conservative.
+
+Protocol: bootstrap a fresh store, run a multi-day simulation with the
+jitted linear regressor and batched scoring, report the mean wall-clock of
+the steady-state days (day 1 pays one-time XLA compiles and is excluded).
+
+Prints ONE JSON line to stdout; progress goes to stderr.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+from datetime import date
+
+BASELINE_DAY_S = 1317 * 0.00822  # reference stage-4 scoring loop, see above
+SIM_DAYS = 5
+
+
+def main() -> int:
+    import jax
+
+    from bodywork_tpu.utils.logging import configure_logger
+
+    configure_logger(stream=sys.stderr)  # keep stdout = the one JSON line
+    print(f"bench devices: {jax.devices()}", file=sys.stderr)
+
+    from bodywork_tpu.pipeline import LocalRunner, default_pipeline
+    from bodywork_tpu.store import FilesystemStore
+
+    store = FilesystemStore(tempfile.mkdtemp(prefix="bench-store-"))
+    runner = LocalRunner(
+        default_pipeline(model_type="linear", scoring_mode="batch"), store
+    )
+    results = runner.run_simulation(date(2026, 1, 1), SIM_DAYS)
+    for r in results:
+        print(f"  day {r.day}: {r.wall_clock_s:.3f}s", file=sys.stderr)
+
+    steady = [r.wall_clock_s for r in results[1:]] or [
+        results[0].wall_clock_s
+    ]
+    value = sum(steady) / len(steady)
+    print(
+        json.dumps(
+            {
+                "metric": "e2e_day_wallclock",
+                "value": round(value, 4),
+                "unit": "s/day",
+                "vs_baseline": round(BASELINE_DAY_S / value, 2),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -78,7 +78,13 @@ def cmd_train(args) -> int:
 def cmd_serve(args) -> int:
     from bodywork_tpu.serve import serve_latest_model
 
-    serve_latest_model(_store(args), host=args.host, port=args.port, block=True)
+    serve_latest_model(
+        _store(args),
+        host=args.host,
+        port=args.port,
+        block=True,
+        mesh_data=args.mesh_data,
+    )
     return 0
 
 
@@ -146,6 +152,48 @@ def cmd_run_stage(args) -> int:
     return 0
 
 
+def cmd_wait_for(args) -> int:
+    """Block until pipeline preconditions hold — the DAG-ordering gate for
+    the k8s materialisation (used as Job initContainers, replacing the
+    Bodywork controller's step sequencing)."""
+    import time as _time
+
+    from bodywork_tpu.store.schema import DATASETS_PREFIX, MODELS_PREFIX
+
+    store = _store(args)
+    deadline = _time.monotonic() + args.timeout
+
+    def _conditions_met() -> bool:
+        if args.dataset and not store.history(DATASETS_PREFIX):
+            return False
+        if args.model and not store.history(MODELS_PREFIX):
+            return False
+        if args.dataset_newer_than_model:
+            datasets = store.history(DATASETS_PREFIX)
+            models = store.history(MODELS_PREFIX)
+            if not datasets or not models:
+                return False
+            if datasets[-1][1] <= models[-1][1]:
+                return False
+        if args.service_url:
+            import requests
+
+            try:
+                if not requests.get(args.service_url, timeout=2).ok:
+                    return False
+            except requests.RequestException:
+                return False
+        return True
+
+    while not _conditions_met():
+        if _time.monotonic() > deadline:
+            log.error(f"wait-for conditions not met within {args.timeout}s")
+            return 1
+        _time.sleep(args.poll_interval)
+    print("conditions met")
+    return 0
+
+
 def cmd_report(args) -> int:
     from bodywork_tpu.monitor import drift_report
 
@@ -195,6 +243,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--store", **common_store)
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=5000)
+    p.add_argument(
+        "--mesh-data", type=int, default=None,
+        help="shard batches over this many devices (data-parallel serving)",
+    )
 
     p = add("test", cmd_test, help="test a live scoring service")
     p.add_argument("--store", **common_store)
@@ -224,6 +276,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model", default="linear", choices=["linear", "mlp"])
     p.add_argument("--mode", default="batch", choices=["single", "batch"])
     p.add_argument("--scoring-url", default=None)
+
+    p = add("wait-for", cmd_wait_for, help="block until pipeline preconditions hold")
+    p.add_argument("--store", **common_store)
+    p.add_argument("--dataset", action="store_true",
+                   help="wait until any dataset exists")
+    p.add_argument("--model", action="store_true",
+                   help="wait until any model checkpoint exists")
+    p.add_argument("--dataset-newer-than-model", action="store_true",
+                   help="wait until the latest dataset postdates the latest model")
+    p.add_argument("--service-url", default=None,
+                   help="wait until this health URL returns 200")
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--poll-interval", type=float, default=2.0)
 
     p = add("report", cmd_report, help="longitudinal train-vs-live drift report")
     p.add_argument("--store", **common_store)

@@ -131,17 +131,33 @@ def score_dataset(
     ``score,label,APE,response_time`` (``stage_4:98``) plus ``ok``.
     """
     rows = []
-    X = ds.X[:, 0]
+    multi = ds.X.shape[1] > 1
+
+    def _payload_row(i: int):
+        # scalar for 1-feature parity with the reference payloads
+        # (``stage_4:91``); a full row list for multi-feature models (the
+        # endpoint's np.array(ndmin=2) coerces it to one (1, d) instance)
+        if multi:
+            return [float(v) for v in ds.X[i]]
+        return float(ds.X[i, 0])
+
     if mode == "single":
-        for x, label in zip(X, ds.y):
-            ok, preds, elapsed = client.score({"X": float(x)})
+        for i, label in enumerate(ds.y):
+            ok, preds, elapsed = client.score({"X": _payload_row(i)})
             score = preds[0] if ok else np.nan
             ape = _ape(score, float(label)) if ok else np.nan
             rows.append((score, float(label), ape, elapsed, ok))
     elif mode == "batch":
-        for i in range(0, len(X), batch_size):
-            xb, yb = X[i : i + batch_size], ds.y[i : i + batch_size]
-            ok, preds, elapsed = client.score({"X": [float(v) for v in xb]})
+        for i in range(0, len(ds.y), batch_size):
+            yb = ds.y[i : i + batch_size]
+            if multi:
+                xb_payload = [
+                    [float(v) for v in row] for row in ds.X[i : i + batch_size]
+                ]
+            else:
+                xb_payload = [float(v) for v in ds.X[i : i + batch_size, 0]]
+            xb = ds.X[i : i + batch_size]
+            ok, preds, elapsed = client.score({"X": xb_payload})
             per_row_time = elapsed / max(len(xb), 1)
             if ok and len(preds) == len(xb):
                 for p, label in zip(preds, yb):

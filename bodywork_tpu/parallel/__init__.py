@@ -1,0 +1,27 @@
+from bodywork_tpu.parallel.mesh import (
+    make_mesh,
+    multihost_init,
+    split_devices,
+)
+from bodywork_tpu.parallel.sharding import (
+    DataParallelPredictor,
+    make_data_parallel_predict,
+    mlp_param_sharding,
+)
+from bodywork_tpu.parallel.train_step import (
+    ShardedTrainState,
+    make_sharded_train_step,
+    train_mlp_sharded,
+)
+
+__all__ = [
+    "make_mesh",
+    "multihost_init",
+    "split_devices",
+    "DataParallelPredictor",
+    "make_data_parallel_predict",
+    "mlp_param_sharding",
+    "ShardedTrainState",
+    "make_sharded_train_step",
+    "train_mlp_sharded",
+]

@@ -1,0 +1,81 @@
+"""Device-mesh utilities — the framework's distributed-communication layer.
+
+The reference has **no** distributed backend (SURVEY.md §5: no NCCL/MPI/
+Gloo; inter-stage transport is S3 + HTTP). The TPU-native replacement is
+``jax.sharding.Mesh`` over a v5e slice: computations are jitted with named
+shardings and XLA compiles the collectives (all-reduce/all-gather/…) onto
+ICI. Multi-host pools extend the same mesh over DCN via
+``jax.distributed.initialize`` — no hand-written communication anywhere.
+
+Axis convention: ``data`` (batch parallel) × ``model`` (tensor parallel).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+from jax.sharding import Mesh
+
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("parallel.mesh")
+
+
+def make_mesh(
+    data: int | None = None,
+    model: int = 1,
+    devices=None,
+) -> Mesh:
+    """A ``(data, model)`` mesh over the available devices.
+
+    Defaults: all devices on the ``data`` axis (pure DP) — the right shape
+    for batched scoring on a v5e-4 (BASELINE.json config 4). ``model > 1``
+    splits off a tensor-parallel axis (e.g. ``data=4, model=2`` on v5e-8).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if data is None:
+        data = n // model
+    if data * model != n:
+        raise ValueError(
+            f"mesh {data}x{model} needs {data * model} devices, have {n}"
+        )
+    import numpy as np
+
+    dev_array = np.asarray(devices).reshape(data, model)
+    mesh = Mesh(dev_array, axis_names=("data", "model"))
+    log.info(f"mesh data={data} model={model} over {n} {devices[0].platform} device(s)")
+    return mesh
+
+
+def split_devices(n_groups: int, devices=None) -> list[list]:
+    """Partition devices into disjoint equal groups.
+
+    Device-level isolation for concurrent pipelines sharing one pool —
+    BASELINE.json config 5 (two A/B train+serve pipelines on a v5e-8).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % n_groups != 0:
+        raise ValueError(f"cannot split {n} devices into {n_groups} equal groups")
+    per = n // n_groups
+    return [devices[i * per : (i + 1) * per] for i in range(n_groups)]
+
+
+def multihost_init() -> bool:
+    """Join a multi-host JAX cluster if the standard coordinator env vars are
+    present (GKE TPU pod slices set these); no-op on a single host.
+
+    After this, ``jax.devices()`` spans all hosts and meshes built on it
+    compile collectives over ICI within a slice and DCN across slices.
+    """
+    if os.environ.get("COORDINATOR_ADDRESS") or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    ):
+        jax.distributed.initialize()
+        log.info(
+            f"joined distributed cluster: process {jax.process_index()} / "
+            f"{jax.process_count()}, {jax.device_count()} global devices"
+        )
+        return True
+    return False

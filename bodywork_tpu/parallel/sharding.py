@@ -1,0 +1,117 @@
+"""Sharded inference: data-parallel batched scoring over a device mesh.
+
+BASELINE.json config 4: "batched scoring service: 1k-row predict() requests,
+over v5e-4". The reference scales scoring with 2 HTTP replicas
+(``bodywork.yaml:40``); here a single service process shards each batch
+across the mesh's ``data`` axis — params replicated in every chip's HBM,
+rows split by NamedSharding, XLA compiling any cross-chip traffic onto ICI.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bodywork_tpu.models.base import Regressor
+from bodywork_tpu.serve.predictor import PaddedPredictor
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("parallel.sharding")
+
+
+def mlp_param_sharding(mesh: Mesh, params: dict) -> dict:
+    """Tensor-parallel PartitionSpecs for the MLP params pytree.
+
+    Megatron-style dense sharding: first hidden layer column-parallel
+    (``P(None, "model")``), middle layers' inputs row-parallel so XLA
+    inserts one all-reduce per boundary, output layer replicated (its width
+    is 1). The scaler is replicated.
+    """
+
+    def spec_for_layer(i: int, n_layers: int, leaf: str):
+        if i == 0:
+            # column parallel: out-features split
+            return P(None, "model") if leaf == "w" else P("model")
+        if i < n_layers - 1:
+            # row parallel on input dim; output replicated via psum
+            return P("model", None) if leaf == "w" else P()
+        return P()  # final (tiny) layer replicated
+
+    n_layers = len(params["net"]["layers"])
+    layer_specs = [
+        {"w": spec_for_layer(i, n_layers, "w"), "b": spec_for_layer(i, n_layers, "b")}
+        for i in range(n_layers)
+    ]
+    scaler_specs = {k: P() for k in params["scaler"]}
+    return {"net": {"layers": layer_specs}, "scaler": scaler_specs}
+
+
+def _named(mesh: Mesh, tree):
+    return jax.tree.map(lambda spec: NamedSharding(mesh, spec), tree,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def make_data_parallel_predict(model: Regressor, mesh: Mesh):
+    """A predict fn sharding rows over the mesh ``data`` axis.
+
+    Params are replicated into each device's HBM once, at closure build
+    time; each call pads the batch to a multiple of the data-axis size and
+    runs one pjit'ed program.
+    """
+    from bodywork_tpu.models.linear import LinearRegressor, linear_apply
+    from bodywork_tpu.models.mlp import MLPRegressor, mlp_apply
+
+    if isinstance(model, LinearRegressor):
+        apply_fn = linear_apply
+    elif isinstance(model, MLPRegressor):
+        apply_fn = mlp_apply
+    else:
+        raise TypeError(f"unsupported model type: {type(model).__name__}")
+
+    replicated = NamedSharding(mesh, P())
+    row_sharded = NamedSharding(mesh, P("data", None))
+    out_sharded = NamedSharding(mesh, P("data"))
+    params = jax.device_put(model.params, jax.tree.map(lambda _: replicated, model.params))
+
+    sharded_apply = jax.jit(
+        apply_fn,
+        in_shardings=(jax.tree.map(lambda _: replicated, model.params), row_sharded),
+        out_shardings=out_sharded,
+    )
+    n_data = mesh.shape["data"]
+
+    def predict(X: np.ndarray) -> np.ndarray:
+        X = np.asarray(X, dtype=np.float32)
+        if X.ndim == 1:
+            X = X[:, None]
+        n = X.shape[0]
+        pad = (-n) % n_data
+        if pad:
+            X = np.concatenate([X, np.zeros((pad, X.shape[1]), X.dtype)])
+        return np.asarray(sharded_apply(params, X))[:n]
+
+    return predict
+
+
+class DataParallelPredictor(PaddedPredictor):
+    """A :class:`PaddedPredictor` whose bucket execution shards rows across
+    the mesh ``data`` axis — the serving path for BASELINE.json config 4.
+    Reuses the bucket/pad/chunk logic from the base class; only the
+    padded-batch execution differs."""
+
+    def __init__(self, model: Regressor, mesh: Mesh,
+                 buckets: tuple[int, ...] = (64, 512, 4096)):
+        n_data = mesh.shape["data"]
+        # every bucket must divide evenly over the data axis
+        buckets = tuple(sorted({max(b, n_data) for b in buckets}))
+        for b in buckets:
+            if b % n_data:
+                raise ValueError(
+                    f"bucket {b} not divisible by data-axis size {n_data}"
+                )
+        super().__init__(model, buckets)
+        self.mesh = mesh
+        self._sharded_predict = make_data_parallel_predict(model, mesh)
+
+    def _predict_padded(self, Xp: np.ndarray) -> np.ndarray:
+        return self._sharded_predict(Xp)

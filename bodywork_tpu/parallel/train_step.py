@@ -1,0 +1,183 @@
+"""Sharded (dp x tp) MLP training over a device mesh.
+
+The multi-chip training path: the minibatch is sharded over the ``data``
+axis, hidden-layer weights over the ``model`` axis (Megatron-style column/
+row parallel — see :func:`~bodywork_tpu.parallel.sharding.mlp_param_sharding`),
+and the whole optimisation run is one jitted ``lax.scan``. Gradients are
+combined by the collectives XLA derives from the shardings (a psum over
+``data`` for the batch dimension, a psum over ``model`` at the row-parallel
+boundary) — nothing is hand-scheduled, per the scaling-book recipe: pick a
+mesh, annotate shardings, let XLA insert collectives.
+
+The reference trains sklearn OLS on one CPU (``stage_1:105-106``); this
+module is the no-parity-constraint TPU growth path (BASELINE.json configs
+3-5).
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bodywork_tpu.models.mlp import (
+    MLPConfig,
+    MLPRegressor,
+    _loss,
+    _masked_stats,
+    init_mlp_params,
+)
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("parallel.train_step")
+
+
+@dataclasses.dataclass
+class ShardedTrainState:
+    params: dict
+    opt_state: object
+    mesh: Mesh
+
+
+def make_sharded_train_step(cfg: MLPConfig, mesh: Mesh):
+    """Build (init_fn, step_fn) for dp x tp training.
+
+    - ``init_fn(key, n_features, scaler) -> ShardedTrainState`` places params
+      according to the tp sharding.
+    - ``step_fn(state, xb, yb, wb) -> (state, loss)`` runs one optimiser step;
+      batches must arrive sharded over ``data``.
+    """
+    from bodywork_tpu.parallel.sharding import mlp_param_sharding
+
+    opt = optax.adam(cfg.learning_rate)
+    batch_sharding = NamedSharding(mesh, P("data", None))
+    batch1_sharding = NamedSharding(mesh, P("data"))
+
+    def init_fn(key: jax.Array, n_features: int) -> ShardedTrainState:
+        sizes = (n_features,) + cfg.hidden + (1,)
+        net = init_mlp_params(key, sizes)
+        specs = mlp_param_sharding(mesh, {"net": net, "scaler": {}})["net"]
+        shardings = jax.tree.map(
+            lambda spec: NamedSharding(mesh, spec), specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        net = jax.device_put(net, shardings)
+        opt_state = opt.init(net)
+        return ShardedTrainState(net, opt_state, mesh)
+
+    @jax.jit
+    def step_fn(net, opt_state, xb, yb, wb):
+        loss, grads = jax.value_and_grad(_loss)(net, xb, yb, wb)
+        updates, opt_state = opt.update(grads, opt_state, net)
+        net = optax.apply_updates(net, updates)
+        return net, opt_state, loss
+
+    def step(state: ShardedTrainState, xb, yb, wb):
+        xb = jax.device_put(jnp.asarray(xb), batch_sharding)
+        yb = jax.device_put(jnp.asarray(yb), batch1_sharding)
+        wb = jax.device_put(jnp.asarray(wb), batch1_sharding)
+        net, opt_state, loss = step_fn(state.params, state.opt_state, xb, yb, wb)
+        return ShardedTrainState(net, opt_state, state.mesh), float(loss)
+
+    return init_fn, step
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg",),
+    donate_argnums=(0, 1),
+)
+def _scan_train(net, opt_state, batches_x, batches_y, batches_w, cfg: MLPConfig):
+    opt = optax.adam(cfg.learning_rate)
+
+    def body(carry, batch):
+        net, opt_state = carry
+        xb, yb, wb = batch
+        loss, grads = jax.value_and_grad(_loss)(net, xb, yb, wb)
+        updates, opt_state = opt.update(grads, opt_state, net)
+        net = optax.apply_updates(net, updates)
+        return (net, opt_state), loss
+
+    (net, opt_state), losses = jax.lax.scan(
+        body, (net, opt_state), (batches_x, batches_y, batches_w)
+    )
+    return net, opt_state, losses
+
+
+def train_mlp_sharded(
+    X: np.ndarray,
+    y: np.ndarray,
+    cfg: MLPConfig,
+    mesh: Mesh,
+    seed: int | None = None,
+) -> MLPRegressor:
+    """Full dp x tp training run compiled as ONE XLA program.
+
+    Pre-samples the whole batch schedule host-side (with-replacement, same
+    scheme as the single-device path), shards it ``P(None, "data", None)``
+    (steps x rows x features), and scans over steps on-device. Returns a
+    fitted :class:`MLPRegressor` whose params can be checkpointed/served
+    exactly like the single-device model.
+    """
+    X = np.asarray(X, dtype=np.float32)
+    if X.ndim == 1:
+        X = X[:, None]
+    y = np.asarray(y, dtype=np.float32).ravel()
+    n = X.shape[0]
+
+    key = jax.random.PRNGKey(cfg.seed if seed is None else seed)
+    k_init, k_batch = jax.random.split(key)
+
+    # standardise (full data, no padding needed here — stats on host)
+    w_all = np.ones(n, dtype=np.float32)
+    x_mean, x_std = jax.vmap(_masked_stats, in_axes=(1, None), out_axes=0)(
+        jnp.asarray(X), jnp.asarray(w_all)
+    )
+    y_mean, y_std = _masked_stats(jnp.asarray(y), jnp.asarray(w_all))
+    Xs = (X - np.asarray(x_mean)) / np.asarray(x_std)
+    ys = (y - float(y_mean)) / float(y_std)
+
+    # batch schedule: (steps, batch) indices sampled with replacement
+    idx = jax.random.randint(k_batch, (cfg.n_steps, cfg.batch_size), 0, n)
+    idx = np.asarray(idx)
+    bx = Xs[idx]                      # (steps, batch, d)
+    by = ys[idx]                      # (steps, batch)
+    bw = np.ones_like(by)
+
+    from bodywork_tpu.parallel.sharding import mlp_param_sharding
+
+    sizes = (X.shape[1],) + cfg.hidden + (1,)
+    net = init_mlp_params(k_init, sizes)
+    specs = mlp_param_sharding(mesh, {"net": net, "scaler": {}})["net"]
+    net = jax.device_put(
+        net,
+        jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                     is_leaf=lambda x: isinstance(x, P)),
+    )
+    opt_state = optax.adam(cfg.learning_rate).init(net)
+
+    batch_shard = NamedSharding(mesh, P(None, "data", None))
+    batch1_shard = NamedSharding(mesh, P(None, "data"))
+    bx = jax.device_put(jnp.asarray(bx), batch_shard)
+    by = jax.device_put(jnp.asarray(by), batch1_shard)
+    bw = jax.device_put(jnp.asarray(bw), batch1_shard)
+
+    net, opt_state, losses = _scan_train(net, opt_state, bx, by, bw, cfg)
+    log.info(
+        f"sharded train: {cfg.n_steps} steps over mesh "
+        f"{dict(mesh.shape)}; final loss {float(losses[-1]):.5f}"
+    )
+
+    params = {
+        "net": net,
+        "scaler": {
+            "x_mean": x_mean, "x_std": x_std, "y_mean": y_mean, "y_std": y_std
+        },
+    }
+    fitted = MLPRegressor(cfg, params)
+    fitted.final_loss = float(losses[-1])
+    return fitted

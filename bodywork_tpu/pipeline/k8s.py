@@ -96,7 +96,8 @@ def _container(
 
 
 def _pod_spec(spec: PipelineSpec, stage: StageSpec, store_path: str,
-              image: str, command: list[str], restart_policy: str) -> dict:
+              image: str, command: list[str], restart_policy: str,
+              gate_on_deps: bool = True) -> dict:
     volume, _ = _store_volume(store_path)
     spec_volume, _ = _spec_volume(spec)
     pod: dict = {
@@ -104,6 +105,10 @@ def _pod_spec(spec: PipelineSpec, stage: StageSpec, store_path: str,
         "volumes": [volume, spec_volume],
         "restartPolicy": restart_policy,
     }
+    if gate_on_deps:
+        init_containers = _init_containers(spec, stage, store_path, image)
+        if init_containers:
+            pod["initContainers"] = init_containers
     r = stage.resources
     if r.tpu_accelerator:
         pod["nodeSelector"] = {
@@ -112,6 +117,60 @@ def _pod_spec(spec: PipelineSpec, stage: StageSpec, store_path: str,
                if r.tpu_topology else {}),
         }
     return pod
+
+
+def _init_containers(
+    spec: PipelineSpec, stage: StageSpec, store_path: str, image: str
+) -> list[dict]:
+    """DAG-ordering gates as initContainers.
+
+    ``kubectl apply -f`` creates all Jobs at once; the reference relied on
+    the Bodywork controller to sequence ``>>`` steps. Here each pod gates
+    itself on the *observable effects* of its DAG predecessors via
+    ``cli wait-for``: a produced artefact for batch predecessors, a healthy
+    endpoint for service predecessors — no controller or RBAC needed.
+    """
+    conditions: list[str] = []
+    # input precondition: training needs data to exist at all
+    if stage.executable.endswith(":train_stage"):
+        conditions += ["--dataset"]
+    if stage.executable.endswith(":serve_stage"):
+        conditions += ["--model"]
+    # DAG predecessors, by the effect each one produces
+    seen_self = False
+    for step in reversed(spec.dag):
+        if stage.name in step:
+            seen_self = True
+            continue
+        if not seen_self:
+            continue
+        for pred_name in step:
+            pred = spec.stages[pred_name]
+            if pred.kind == "service" and pred.port:
+                conditions += [
+                    "--service-url",
+                    f"http://{spec.service_dns(pred.name)}:{pred.port}/healthz",
+                ]
+            elif pred.executable.endswith(":generate_stage"):
+                conditions += ["--dataset-newer-than-model"]
+            elif pred.executable.endswith(":train_stage"):
+                conditions += ["--model"]
+        break  # only the immediately preceding step gates this stage
+    if not conditions:
+        return []
+    _, mount = _store_volume(store_path)
+    _, spec_mount = _spec_volume(spec)
+    return [
+        {
+            "name": "wait-for-deps",
+            "image": image,
+            "command": [
+                "python", "-m", "bodywork_tpu.cli", "wait-for",
+                "--store", store_path, *conditions,
+            ],
+            "volumeMounts": [mount, spec_mount],
+        }
+    ]
 
 
 def _stage_command(spec: PipelineSpec, stage: StageSpec, store_path: str) -> list[str]:
@@ -230,6 +289,9 @@ def generate_manifests(
                                  "--store", store_path,
                                  "--spec", f"{_SPEC_MOUNT}/{_SPEC_FILE}"],
                                 "Never",
+                                gate_on_deps=False,  # run-day sequences and
+                                # bootstraps internally; a dataset gate here
+                                # would deadlock a fresh store
                             )
                         }
                     }

@@ -89,12 +89,17 @@ class LocalRunner:
             worker.start()
             worker.join(timeout=stage.max_completion_time_s)
             if worker.is_alive():
+                # A timed-out worker cannot be killed and may still be
+                # writing to the shared store; retrying alongside it would
+                # run two attempts concurrently. Fail the stage immediately
+                # (the k8s materialisation kills the whole pod instead).
                 last_exc = TimeoutError(
                     f"exceeded max_completion_time_seconds="
                     f"{stage.max_completion_time_s}"
                 )
                 log.error(f"{stage.name}: {last_exc}")
-            elif "exc" in box:
+                break
+            if "exc" in box:
                 last_exc = box["exc"]  # type: ignore[assignment]
                 log.error(f"{stage.name} failed: {last_exc!r}")
             else:

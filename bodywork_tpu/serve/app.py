@@ -55,8 +55,11 @@ class ScoringApp:
         model: Regressor,
         model_date: date | None = None,
         buckets: tuple[int, ...] | None = None,
+        predictor=None,
     ):
-        self.predictor = (
+        # a custom predictor (e.g. parallel.DataParallelPredictor over a
+        # device mesh) replaces the single-device bucketed default
+        self.predictor = predictor or (
             PaddedPredictor(model, buckets) if buckets else PaddedPredictor(model)
         )
         self.model_info = model.info
@@ -156,8 +159,9 @@ def create_app(
     model_date: date | None = None,
     buckets: tuple[int, ...] | None = None,
     warmup: bool = True,
+    predictor=None,
 ) -> ScoringApp:
-    app = ScoringApp(model, model_date, buckets)
+    app = ScoringApp(model, model_date, buckets, predictor=predictor)
     if warmup:
         app.predictor.warmup()
     return app

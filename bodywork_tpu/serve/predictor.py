@@ -24,10 +24,21 @@ DEFAULT_BUCKETS = (1, 8, 64, 512, 4096)
 
 
 class PaddedPredictor:
+    """Bucket-padding predictor over ``model.predict``.
+
+    Subclasses may override :meth:`_predict_padded` to change the execution
+    backend (e.g. sharded over a mesh) while reusing the bucket/pad/chunk
+    logic here.
+    """
+
     def __init__(self, model: Regressor, buckets: tuple[int, ...] = DEFAULT_BUCKETS):
         assert model.params is not None, "cannot serve an unfitted model"
         self.model = model
         self.buckets = tuple(sorted(buckets))
+
+    def _predict_padded(self, Xp: np.ndarray) -> np.ndarray:
+        """Run the model on an exactly-bucket-sized batch."""
+        return np.asarray(self.model.predict(Xp))
 
     def warmup(self, n_features: int | None = None) -> None:
         """Compile every bucket shape before taking traffic (startup cost,
@@ -39,7 +50,7 @@ class PaddedPredictor:
         if n_features is None:
             n_features = self.model.n_features or 1
         for b in self.buckets:
-            self.model.predict(np.zeros((b, n_features), dtype=np.float32))
+            self._predict_padded(np.zeros((b, n_features), dtype=np.float32))
         log.info(
             f"warmed up predict buckets {self.buckets} (n_features={n_features})"
         )
@@ -68,4 +79,4 @@ class PaddedPredictor:
             Xp[:n] = X
         else:
             Xp = X
-        return np.asarray(self.model.predict(Xp))[:n]
+        return self._predict_padded(Xp)[:n]

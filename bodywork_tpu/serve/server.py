@@ -66,16 +66,33 @@ def serve_latest_model(
     host: str = "0.0.0.0",
     port: int = 5000,
     block: bool = True,
+    mesh_data: int | None = None,
 ):
     """Load latest model -> HBM, warm up, serve (reference ``stage_2`` main).
 
+    ``mesh_data > 1`` serves through a data-parallel predictor sharding each
+    batch over a ``(mesh_data, 1)`` device mesh (BASELINE.json config 4).
     With ``block=False`` returns a started :class:`ServiceHandle`.
     """
     model, model_date = load_model(store)
-    app = create_app(model, model_date)
-    handle = ServiceHandle(app, host, port)
+    predictor = None
+    if mesh_data and mesh_data > 1:
+        import jax
+
+        from bodywork_tpu.parallel import DataParallelPredictor, make_mesh
+
+        devices = jax.devices()
+        if mesh_data > len(devices):
+            raise ValueError(
+                f"--mesh-data {mesh_data} exceeds the {len(devices)} "
+                f"available device(s)"
+            )
+        mesh = make_mesh(data=mesh_data, devices=devices[:mesh_data])
+        predictor = DataParallelPredictor(model, mesh)
+    app = create_app(model, model_date, predictor=predictor)
+    handle = ServiceHandle(app, host, port).start()
+    log.info(f"API server listening on {host}:{handle.port}")
     if block:
-        log.info(f"starting API server on {host}:{port}")
-        handle._server.serve_forever()
+        handle.wait()
         return None
-    return handle.start()
+    return handle

@@ -25,17 +25,26 @@ LOG_FORMAT = (
 _ROOT_NAME = "bodywork_tpu"
 
 
-def configure_logger(level: str | int = logging.INFO) -> logging.Logger:
-    """Configure the framework's root logger to write to stdout.
+def configure_logger(
+    level: str | int = logging.INFO, stream=None
+) -> logging.Logger:
+    """Configure the framework's root logger (stdout by default, like the
+    reference's ``configure_logger``; pass ``stream=sys.stderr`` when stdout
+    must stay machine-readable, e.g. bench output).
 
-    Idempotent: repeated calls do not stack handlers.
+    Idempotent: repeated calls do not stack handlers; passing a different
+    ``stream`` re-points the existing handler.
     """
     logger = logging.getLogger(_ROOT_NAME)
-    if not any(
-        isinstance(h, logging.StreamHandler) and getattr(h, "stream", None) is sys.stdout
-        for h in logger.handlers
-    ):
-        handler = logging.StreamHandler(sys.stdout)
+    # exact type check: FileHandler etc. subclass StreamHandler and must not
+    # have their streams hijacked
+    handlers = [h for h in logger.handlers if type(h) is logging.StreamHandler]
+    if handlers:
+        if stream is not None:  # only an explicit stream re-points
+            for h in handlers:
+                h.setStream(stream)
+    else:
+        handler = logging.StreamHandler(stream if stream is not None else sys.stdout)
         handler.setFormatter(logging.Formatter(LOG_FORMAT))
         logger.addHandler(handler)
     if isinstance(level, str):

@@ -156,3 +156,25 @@ def test_scoring_endpoint_normalisation():
     ]:
         assert scoring_endpoint(base, "single") == "http://svc:5000/score/v1"
         assert scoring_endpoint(base, "batch") == "http://svc:5000/score/v1/batch"
+
+
+def test_multi_feature_dataset_served_and_tested(tmp_path):
+    """Multi-feature models flow through store -> train -> serve -> test."""
+    from bodywork_tpu.store import FilesystemStore
+    from bodywork_tpu.train import train_on_history
+
+    rng = np.random.default_rng(7)
+    store = FilesystemStore(tmp_path / "mf")
+    X = rng.uniform(0, 10, (800, 3)).astype(np.float32)
+    y = (X @ np.array([1.0, 2.0, 3.0]) + 5).astype(np.float32)
+    persist_dataset(store, Dataset(X, y, date(2026, 1, 1)))
+    result = train_on_history(store, "linear")
+    assert result.model.n_features == 3
+    app = create_app(result.model, result.data_date, buckets=(1, 64, 512))
+    for mode in ["single", "batch"]:
+        metrics = run_service_test(
+            store, InProcessScoringClient(app), mode=mode, max_rows=50
+        )
+        rec = metrics.iloc[0]
+        assert rec.n_failures == 0, mode
+        assert rec.MAPE < 0.01, mode  # noiseless linear data

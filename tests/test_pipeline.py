@@ -234,3 +234,43 @@ def test_spec_file_round_trips_nondefault_choices(tmp_path):
     assert _pipeline_spec(args).stages["stage-1-train-model"].args[
         "model_type"
     ] == "mlp"
+
+
+def test_manifests_enforce_dag_order_via_init_containers():
+    docs = generate_manifests(default_pipeline(), store_path="/mnt/store")
+    # stage-1 gates on data existing; stage-2 pods gate on a model;
+    # stage-3 gates on the service being healthy; stage-4 on service +
+    # fresh (post-train) dataset
+    def init_cmd(doc):
+        pod = doc["spec"]["template"]["spec"]
+        return " ".join(pod["initContainers"][0]["command"]) if "initContainers" in pod else ""
+
+    assert "--dataset" in init_cmd(docs["01-stage-1-train-model-job.yaml"])
+    assert "--model" in init_cmd(docs["02-stage-2-serve-model-deployment.yaml"])
+    assert "/healthz" in init_cmd(docs["03-stage-3-generate-next-dataset-job.yaml"])
+    s4 = init_cmd(docs["04-stage-4-test-model-scoring-service-job.yaml"])
+    assert "--dataset-newer-than-model" in s4
+    # the daily CronJob must NOT gate (run-day bootstraps fresh stores)
+    cron_pod = docs["99-daily-loop-cronjob.yaml"]["spec"]["jobTemplate"]["spec"][
+        "template"]["spec"]
+    assert "initContainers" not in cron_pod
+
+
+def test_wait_for_cli_gates(tmp_path):
+    from bodywork_tpu.cli import main
+
+    store = str(tmp_path / "s")
+    # unmet condition -> exit 1 after (tiny) timeout
+    assert main(["wait-for", "--store", store, "--model",
+                 "--timeout", "0.2", "--poll-interval", "0.05"]) == 1
+    # satisfy it, then the gate opens
+    assert main(["generate", "--store", store, "--date", "2026-01-01"]) == 0
+    assert main(["train", "--store", store]) == 0
+    assert main(["wait-for", "--store", store, "--model", "--dataset",
+                 "--timeout", "5"]) == 0
+    # dataset-newer-than-model: false now (same date), true after generating
+    assert main(["wait-for", "--store", store, "--dataset-newer-than-model",
+                 "--timeout", "0.2", "--poll-interval", "0.05"]) == 1
+    assert main(["generate", "--store", store, "--date", "2026-01-02"]) == 0
+    assert main(["wait-for", "--store", store, "--dataset-newer-than-model",
+                 "--timeout", "5"]) == 0
