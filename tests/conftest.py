@@ -15,6 +15,20 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The env var alone is not enough: an accelerator plugin registered from
+# sitecustomize may have already called jax.config.update("jax_platforms",
+# ...), which takes precedence over JAX_PLATFORMS. Pin the config itself
+# (reads XLA_FLAGS above because no backend has been initialized yet).
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge as _xb
+
+if _xb.backends_are_initialized():  # pragma: no cover
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+
 import numpy as np
 import pytest
 
