@@ -8,11 +8,7 @@ from bodywork_tpu.parallel.sharding import (
     make_data_parallel_predict,
     mlp_param_sharding,
 )
-from bodywork_tpu.parallel.train_step import (
-    ShardedTrainState,
-    make_sharded_train_step,
-    train_mlp_sharded,
-)
+from bodywork_tpu.parallel.train_step import train_mlp_sharded
 
 __all__ = [
     "make_mesh",
@@ -21,7 +17,5 @@ __all__ = [
     "DataParallelPredictor",
     "make_data_parallel_predict",
     "mlp_param_sharding",
-    "ShardedTrainState",
-    "make_sharded_train_step",
     "train_mlp_sharded",
 ]

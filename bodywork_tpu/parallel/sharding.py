@@ -102,13 +102,9 @@ class DataParallelPredictor(PaddedPredictor):
     def __init__(self, model: Regressor, mesh: Mesh,
                  buckets: tuple[int, ...] = (64, 512, 4096)):
         n_data = mesh.shape["data"]
-        # every bucket must divide evenly over the data axis
-        buckets = tuple(sorted({max(b, n_data) for b in buckets}))
-        for b in buckets:
-            if b % n_data:
-                raise ValueError(
-                    f"bucket {b} not divisible by data-axis size {n_data}"
-                )
+        # round each bucket up to a multiple of the data-axis size so every
+        # padded batch splits evenly across the mesh (stable XLA shapes)
+        buckets = tuple(sorted({b + (-b) % n_data for b in buckets}))
         super().__init__(model, buckets)
         self.mesh = mesh
         self._sharded_predict = make_data_parallel_predict(model, mesh)

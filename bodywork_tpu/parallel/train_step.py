@@ -15,7 +15,6 @@ module is the no-parity-constraint TPU growth path (BASELINE.json configs
 """
 from __future__ import annotations
 
-import dataclasses
 from functools import partial
 
 import jax
@@ -34,56 +33,6 @@ from bodywork_tpu.models.mlp import (
 from bodywork_tpu.utils.logging import get_logger
 
 log = get_logger("parallel.train_step")
-
-
-@dataclasses.dataclass
-class ShardedTrainState:
-    params: dict
-    opt_state: object
-    mesh: Mesh
-
-
-def make_sharded_train_step(cfg: MLPConfig, mesh: Mesh):
-    """Build (init_fn, step_fn) for dp x tp training.
-
-    - ``init_fn(key, n_features, scaler) -> ShardedTrainState`` places params
-      according to the tp sharding.
-    - ``step_fn(state, xb, yb, wb) -> (state, loss)`` runs one optimiser step;
-      batches must arrive sharded over ``data``.
-    """
-    from bodywork_tpu.parallel.sharding import mlp_param_sharding
-
-    opt = optax.adam(cfg.learning_rate)
-    batch_sharding = NamedSharding(mesh, P("data", None))
-    batch1_sharding = NamedSharding(mesh, P("data"))
-
-    def init_fn(key: jax.Array, n_features: int) -> ShardedTrainState:
-        sizes = (n_features,) + cfg.hidden + (1,)
-        net = init_mlp_params(key, sizes)
-        specs = mlp_param_sharding(mesh, {"net": net, "scaler": {}})["net"]
-        shardings = jax.tree.map(
-            lambda spec: NamedSharding(mesh, spec), specs,
-            is_leaf=lambda x: isinstance(x, P),
-        )
-        net = jax.device_put(net, shardings)
-        opt_state = opt.init(net)
-        return ShardedTrainState(net, opt_state, mesh)
-
-    @jax.jit
-    def step_fn(net, opt_state, xb, yb, wb):
-        loss, grads = jax.value_and_grad(_loss)(net, xb, yb, wb)
-        updates, opt_state = opt.update(grads, opt_state, net)
-        net = optax.apply_updates(net, updates)
-        return net, opt_state, loss
-
-    def step(state: ShardedTrainState, xb, yb, wb):
-        xb = jax.device_put(jnp.asarray(xb), batch_sharding)
-        yb = jax.device_put(jnp.asarray(yb), batch1_sharding)
-        wb = jax.device_put(jnp.asarray(wb), batch1_sharding)
-        net, opt_state, loss = step_fn(state.params, state.opt_state, xb, yb, wb)
-        return ShardedTrainState(net, opt_state, state.mesh), float(loss)
-
-    return init_fn, step
 
 
 @partial(

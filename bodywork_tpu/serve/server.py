@@ -45,13 +45,23 @@ class ServiceHandle:
         log.info(f"scoring service listening on {self.url}")
         return self
 
+    def serve_forever(self) -> None:
+        """Serve in the calling thread (pod-entrypoint mode): an unhandled
+        error in the serve loop propagates, so a crashed service exits
+        non-zero instead of reporting success to its supervisor."""
+        log.info(f"scoring service listening on {self.url}")
+        self._server.serve_forever()
+
     def wait(self) -> None:
-        """Block until the server thread exits (pod-entrypoint mode)."""
-        self._thread.join()
+        """Block until the server thread exits."""
+        if self._thread.ident is not None:
+            self._thread.join()
 
     def stop(self) -> None:
         self._server.shutdown()
-        self._thread.join(timeout=10)
+        # in serve_forever mode the background thread was never started
+        if self._thread.ident is not None:
+            self._thread.join(timeout=10)
         log.info("scoring service stopped")
 
     def __enter__(self) -> "ServiceHandle":
@@ -90,9 +100,8 @@ def serve_latest_model(
         mesh = make_mesh(data=mesh_data, devices=devices[:mesh_data])
         predictor = DataParallelPredictor(model, mesh)
     app = create_app(model, model_date, predictor=predictor)
-    handle = ServiceHandle(app, host, port).start()
-    log.info(f"API server listening on {host}:{handle.port}")
+    handle = ServiceHandle(app, host, port)
     if block:
-        handle.wait()
+        handle.serve_forever()
         return None
-    return handle
+    return handle.start()

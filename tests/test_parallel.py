@@ -61,6 +61,17 @@ def test_data_parallel_predictor_buckets(linear_model):
     np.testing.assert_allclose(out, model.predict(X[:, None]), rtol=1e-5)
 
 
+def test_data_parallel_predictor_nondivisible_axis(linear_model):
+    """Buckets that don't divide the data axis are rounded up, not rejected
+    (serving must work for any valid device count, e.g. data=5)."""
+    model, X, _y = linear_model
+    mesh = make_mesh(data=5, devices=jax.devices()[:5])
+    pred = DataParallelPredictor(model, mesh, buckets=(64, 512))
+    assert all(b % 5 == 0 for b in pred.buckets)
+    out = pred.predict(X[:100])
+    np.testing.assert_allclose(out, model.predict(X[:100, None]), rtol=1e-5)
+
+
 def test_dp_predict_output_is_sharded(linear_model):
     model, _X, _y = linear_model
     mesh = make_mesh(data=8)
