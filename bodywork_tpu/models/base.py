@@ -88,6 +88,25 @@ class Regressor(abc.ABC):
     def predict(self, X: np.ndarray) -> np.ndarray:
         """Predict targets; accepts (n, d) or (n,) arrays."""
 
+    def predict_padded(self, X: np.ndarray, minimum: int = 256) -> np.ndarray:
+        """Predict through a power-of-two row bucket.
+
+        Evaluation on arbitrary-sized arrays (e.g. a growing held-out split)
+        would trigger one XLA recompile per distinct shape; padding keeps the
+        compile count logarithmic. Serving uses the richer
+        :class:`~bodywork_tpu.serve.predictor.PaddedPredictor`; this is the
+        lightweight equivalent for in-process evaluation."""
+        X = np.asarray(X, dtype=np.float32)
+        if X.ndim == 1:
+            X = X[:, None]
+        n = X.shape[0]
+        b = _bucket_rows(n, minimum)
+        if b == n:
+            return np.asarray(self.predict(X))
+        Xp = np.zeros((b, X.shape[1]), dtype=X.dtype)
+        Xp[:n] = X
+        return np.asarray(self.predict(Xp))[:n]
+
     # -- serving metadata --------------------------------------------------
     @property
     def n_features(self) -> int | None:

@@ -2,23 +2,35 @@
 
 The reference computes sklearn ``mean_absolute_percentage_error``,
 ``r2_score`` and ``max_error`` on the held-out split. Same definitions here,
-as a single jitted fused reduction.
+as a single jitted fused reduction. Inputs are zero-padded to power-of-two
+row buckets with a 0/1 weight mask, so computing metrics on a growing
+held-out split (the daily retrain loop) reuses a logarithmic number of
+compiled executables instead of recompiling every day.
 """
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+from bodywork_tpu.models.base import pad_rows
 
 # sklearn's MAPE guards the denominator with float64 machine epsilon.
 _MAPE_EPS = 2.220446049250313e-16
 
 
 @jax.jit
-def _metrics(y_true: jax.Array, y_pred: jax.Array):
-    resid = y_true - y_pred
-    mape = jnp.mean(jnp.abs(resid) / jnp.maximum(jnp.abs(y_true), _MAPE_EPS))
+def _metrics(y_true: jax.Array, y_pred: jax.Array, w: jax.Array):
+    """Masked MAPE / R^2 / max-abs-residual; padding rows carry weight 0."""
+    n = jnp.maximum(jnp.sum(w), 1.0)
+    resid = (y_true - y_pred) * w
+    mape = (
+        jnp.sum(w * jnp.abs(y_true - y_pred) / jnp.maximum(jnp.abs(y_true), _MAPE_EPS))
+        / n
+    )
+    mean_y = jnp.sum(w * y_true) / n
     ss_res = jnp.sum(resid**2)
-    ss_tot = jnp.sum((y_true - jnp.mean(y_true)) ** 2)
+    ss_tot = jnp.sum(w * (y_true - mean_y) ** 2)
     r_squared = 1.0 - ss_res / ss_tot
     max_residual = jnp.max(jnp.abs(resid))
     return mape, r_squared, max_residual
@@ -27,9 +39,10 @@ def _metrics(y_true: jax.Array, y_pred: jax.Array):
 def regression_metrics(y_true, y_pred) -> dict[str, float]:
     """MAPE / R^2 / max-abs-residual, matching the reference's metric record
     columns (``stage_1:85-89``)."""
-    y_true = jnp.asarray(y_true, dtype=jnp.float32).ravel()
-    y_pred = jnp.asarray(y_pred, dtype=jnp.float32).ravel()
-    mape, r2, max_resid = _metrics(y_true, y_pred)
+    y_true = np.asarray(y_true, dtype=np.float32).ravel()
+    y_pred = np.asarray(y_pred, dtype=np.float32).ravel()
+    yt, yp, w = pad_rows(y_true, y_pred, minimum=256)
+    mape, r2, max_resid = _metrics(jnp.asarray(yt), jnp.asarray(yp), jnp.asarray(w))
     return {
         "MAPE": float(mape),
         "r_squared": float(r2),

@@ -54,8 +54,7 @@ class ServiceHandle:
 
     def wait(self) -> None:
         """Block until the server thread exits."""
-        if self._thread.ident is not None:
-            self._thread.join()
+        self._thread.join()
 
     def stop(self) -> None:
         self._server.shutdown()
