@@ -67,14 +67,15 @@ def key_for_date(d: date, cfg: DriftConfig = DriftConfig()) -> jax.Array:
 
 @partial(jax.jit, static_argnums=(2,))
 def _sample_day(key: jax.Array, day: jax.Array, cfg: DriftConfig):
-    """Fused sampler: returns (X, y, valid_mask), all shape (n_samples,)."""
+    """Fused sampler: one (3, n_samples) array stacking (X, y, valid_mask) —
+    a single device->host transfer instead of three."""
     kx, ke = jax.random.split(key)
     x = jax.random.uniform(
         kx, (cfg.n_samples,), minval=cfg.x_low, maxval=cfg.x_high
     )
     eps = jax.random.normal(ke, (cfg.n_samples,))
     y = alpha(day, cfg) + cfg.beta * x + cfg.sigma * eps
-    return x, y, y >= 0.0
+    return jnp.stack([x, y, (y >= 0.0).astype(x.dtype)])
 
 
 def generate_day(
@@ -85,9 +86,9 @@ def generate_day(
     Rows with ``y < 0`` are dropped, as in the reference's
     ``dataset.query('y >= 0')`` (``stage_3:43``).
     """
-    x, y, mask = _sample_day(key_for_date(d, cfg), day_of_year(d), cfg)
-    mask = np.asarray(mask)
-    return np.asarray(x)[mask], np.asarray(y)[mask]
+    stacked = np.asarray(_sample_day(key_for_date(d, cfg), day_of_year(d), cfg))
+    x, y, mask = stacked[0], stacked[1], stacked[2] > 0.0
+    return x[mask], y[mask]
 
 
 def generate_dataframe(d: date, cfg: DriftConfig = DriftConfig()):

@@ -63,11 +63,19 @@ def train_test_split(
     return TrainSplit(X[train_idx], y[train_idx], X[test_idx], y[test_idx])
 
 
+#: per-class cache of fused evaluate programs (see Regressor.evaluate)
+_EVAL_FNS: dict[type, Any] = {}
+
+
 class Regressor(abc.ABC):
     """Fitted-or-unfitted regression model over a JAX pytree of params."""
 
     #: short registry name, e.g. "linear" / "mlp" (used in checkpoints)
     model_type: str = "base"
+
+    #: the pure apply function ``(params, X(n,d)) -> y(n,)`` backing
+    #: ``predict`` — set per subclass; used to build fused programs
+    apply = None
 
     def __init__(self, config: Any = None, params: Any = None):
         self.config = config
@@ -87,6 +95,31 @@ class Regressor(abc.ABC):
     @abc.abstractmethod
     def predict(self, X: np.ndarray) -> np.ndarray:
         """Predict targets; accepts (n, d) or (n,) arrays."""
+
+    def evaluate(self, X: np.ndarray, y: np.ndarray) -> dict[str, float]:
+        """MAPE / R^2 / max-residual of this model on (X, y), computed as a
+        single fused device program over padded shapes (predict + metrics in
+        one dispatch; see :func:`~bodywork_tpu.models.metrics.make_eval_fn`)."""
+        from bodywork_tpu.models.metrics import make_eval_fn
+
+        assert self.params is not None, "model is not fitted"
+        assert type(self).apply is not None, (
+            f"{type(self).__name__} does not define an apply function"
+        )
+        fn = _EVAL_FNS.get(type(self))
+        if fn is None:
+            fn = _EVAL_FNS[type(self)] = make_eval_fn(type(self).apply)
+        X = np.asarray(X, dtype=np.float32)
+        if X.ndim == 1:
+            X = X[:, None]
+        y = np.asarray(y, dtype=np.float32).ravel()
+        Xp, yp, w = pad_rows(X, y, minimum=256)
+        mape, r2, max_resid = fn(self.params, Xp, yp, w)
+        return {
+            "MAPE": float(mape),
+            "r_squared": float(r2),
+            "max_residual": float(max_resid),
+        }
 
     def predict_padded(self, X: np.ndarray, minimum: int = 256) -> np.ndarray:
         """Predict through a power-of-two row bucket.

@@ -50,6 +50,7 @@ def linear_apply(params, X: jax.Array) -> jax.Array:
 
 class LinearRegressor(Regressor):
     model_type = "linear"
+    apply = staticmethod(linear_apply)
 
     def __init__(self, config: LinearConfig | None = None, params=None):
         super().__init__(config or LinearConfig(), params)

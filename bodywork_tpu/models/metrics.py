@@ -36,6 +36,21 @@ def _metrics(y_true: jax.Array, y_pred: jax.Array, w: jax.Array):
     return mape, r_squared, max_residual
 
 
+def make_eval_fn(apply_fn):
+    """Fuse model apply + metrics into ONE jitted program.
+
+    Evaluating a fitted model as predict-then-metrics costs two device
+    dispatches; on a remote-attached TPU each dispatch pays the host
+    round-trip. The fused program runs both on device and returns three
+    scalars."""
+
+    @jax.jit
+    def eval_fn(params, Xp: jax.Array, yp: jax.Array, w: jax.Array):
+        return _metrics(yp, apply_fn(params, Xp), w)
+
+    return eval_fn
+
+
 def regression_metrics(y_true, y_pred) -> dict[str, float]:
     """MAPE / R^2 / max-abs-residual, matching the reference's metric record
     columns (``stage_1:85-89``)."""

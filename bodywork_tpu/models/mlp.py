@@ -104,6 +104,7 @@ def _masked_stats(v: jax.Array, w: jax.Array):
 
 class MLPRegressor(Regressor):
     model_type = "mlp"
+    apply = staticmethod(mlp_apply)
 
     def __init__(self, config: MLPConfig | None = None, params=None):
         super().__init__(config or MLPConfig(), params)

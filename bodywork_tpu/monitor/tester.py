@@ -209,7 +209,11 @@ def persist_test_metrics(
 
 
 def run_service_test(
-    store: ArtefactStore, client, mode: str = "single", max_rows: int | None = None
+    store: ArtefactStore,
+    client,
+    mode: str = "single",
+    max_rows: int | None = None,
+    batch_size: int = 512,
 ) -> pd.DataFrame:
     """Full stage-4 flow: latest dataset -> score via live service ->
     metrics -> persist. Returns the metrics record.
@@ -223,7 +227,7 @@ def run_service_test(
         ds = Dataset(ds.X[:max_rows], ds.y[:max_rows], ds.date)
     if mode == "batch" and isinstance(client, InProcessScoringClient):
         client = client.batch_sibling()
-    results = score_dataset(client, ds, mode=mode)
+    results = score_dataset(client, ds, mode=mode, batch_size=batch_size)
     metrics = compute_test_metrics(results, ds.date)
     persist_test_metrics(store, metrics, ds.date)
     rec = metrics.iloc[0]

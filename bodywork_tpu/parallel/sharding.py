@@ -58,15 +58,11 @@ def make_data_parallel_predict(model: Regressor, mesh: Mesh):
     time; each call pads the batch to a multiple of the data-axis size and
     runs one pjit'ed program.
     """
-    from bodywork_tpu.models.linear import LinearRegressor, linear_apply
-    from bodywork_tpu.models.mlp import MLPRegressor, mlp_apply
-
-    if isinstance(model, LinearRegressor):
-        apply_fn = linear_apply
-    elif isinstance(model, MLPRegressor):
-        apply_fn = mlp_apply
-    else:
-        raise TypeError(f"unsupported model type: {type(model).__name__}")
+    apply_fn = type(model).apply
+    if apply_fn is None:
+        raise TypeError(
+            f"{type(model).__name__} does not define an apply function"
+        )
 
     replicated = NamedSharding(mesh, P())
     row_sharded = NamedSharding(mesh, P("data", None))

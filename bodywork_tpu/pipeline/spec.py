@@ -198,6 +198,9 @@ def default_pipeline(
             name="stage-2-serve-model",
             kind="service",
             executable="bodywork_tpu.pipeline.stages:serve_stage",
+            # compile only the buckets the tester's request sizes need
+            # (each warmed bucket is one device dispatch at startup)
+            args={"buckets": [2048] if scoring_mode == "batch" else [1, 2048]},
             replicas=2,
             port=port,
             ingress=False,
@@ -213,7 +216,9 @@ def default_pipeline(
             name="stage-4-test-model-scoring-service",
             kind="batch",
             executable="bodywork_tpu.pipeline.stages:test_stage",
-            args={"mode": scoring_mode},
+            # one full simulated day (<=1440 rows) scores in a single padded
+            # device call in batch mode
+            args={"mode": scoring_mode, "batch_size": 2048},
             resources=ResourceSpec(cpu_request=0.5, memory_mb=256),
         ),
     }

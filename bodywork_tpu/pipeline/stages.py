@@ -67,14 +67,21 @@ def train_stage(ctx: StageContext, model_type: str = "linear", **model_kwargs):
 
 
 def serve_stage(
-    ctx: StageContext, host: str = "127.0.0.1", port: int = 0
+    ctx: StageContext,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    buckets: tuple[int, ...] | None = None,
 ) -> ServiceHandle:
     """Load the latest model into device HBM and start the scoring service
     on a background thread (reference stage 2). Returns the handle; the
     runner keeps it alive for the rest of the day and tears it down at
-    day end (the k8s deployment path instead keeps it up until re-deploy)."""
+    day end (the k8s deployment path instead keeps it up until re-deploy).
+
+    ``buckets`` narrows the predictor's compiled shape set (each warmed
+    bucket costs one device dispatch at startup) — the pipeline spec sets it
+    to match the tester's request sizes."""
     model, model_date = load_model(ctx.store)
-    app = create_app(model, model_date)
+    app = create_app(model, model_date, buckets=tuple(buckets) if buckets else None)
     handle = ServiceHandle(app, host=host, port=port).start()
     handle.app = app
     return handle
@@ -85,6 +92,7 @@ def test_stage(
     mode: str = "batch",
     service_stage: str = "stage-2-serve-model",
     max_rows: int | None = None,
+    batch_size: int = 512,
 ):
     """Score the latest dataset through the live service and persist drift
     metrics (reference stage 4)."""
@@ -97,4 +105,6 @@ def test_stage(
             f"test_stage needs a scoring_url or a running service "
             f"{service_stage!r} in the context"
         )
-    return run_service_test(ctx.store, client, mode=mode, max_rows=max_rows)
+    return run_service_test(
+        ctx.store, client, mode=mode, max_rows=max_rows, batch_size=batch_size
+    )

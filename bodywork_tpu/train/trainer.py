@@ -19,7 +19,6 @@ from bodywork_tpu.models import (
     LinearRegressor,
     MLPRegressor,
     Regressor,
-    regression_metrics,
     save_model,
     train_test_split,
 )
@@ -82,9 +81,8 @@ def train_on_history(
     split = train_test_split(ds.X, ds.y, test_size=test_size, seed=split_seed)
     model = make_model(model_type, **(model_kwargs or {}))
     fitted = model.fit(split.X_train, split.y_train, seed=fit_seed)
-    metrics = regression_metrics(
-        split.y_test, fitted.predict_padded(split.X_test)
-    )
+    # fused predict+metrics: one device dispatch on padded shapes
+    metrics = fitted.evaluate(split.X_test, split.y_test)
     log.info(
         f"trained {fitted.info} on {len(ds)} rows to {ds.date}: "
         f"MAPE={metrics['MAPE']:.4f} r2={metrics['r_squared']:.4f} "

@@ -84,6 +84,27 @@ def test_metrics_match_sklearn(linear_data):
     assert m["max_residual"] == pytest.approx(max_error(y, pred), rel=1e-3)
 
 
+def test_fused_evaluate_matches_predict_then_metrics(linear_data):
+    """model.evaluate (one fused device program over padded shapes) must
+    equal the two-dispatch predict -> regression_metrics path exactly."""
+    X, y = linear_data
+    for model in (
+        LinearRegressor().fit(X, y),
+        MLPRegressor(MLPConfig(hidden=(16,), n_steps=50)).fit(X, y),
+    ):
+        # odd row count so padding rows (masked, weight 0) are exercised
+        fused = model.evaluate(X[:777], y[:777])
+        reference = regression_metrics(y[:777], model.predict(X[:777, None]))
+        for k in ("MAPE", "r_squared", "max_residual"):
+            assert fused[k] == pytest.approx(reference[k], rel=1e-5), k
+
+
+def test_evaluate_unfitted_raises(linear_data):
+    X, y = linear_data
+    with pytest.raises(AssertionError, match="not fitted"):
+        LinearRegressor().evaluate(X, y)
+
+
 def test_train_test_split_deterministic(linear_data):
     X, y = linear_data
     s1 = train_test_split(X, y)
