@@ -23,10 +23,11 @@ _MAPE_EPS = 2.220446049250313e-16
 def _metrics(y_true: jax.Array, y_pred: jax.Array, w: jax.Array):
     """Masked MAPE / R^2 / max-abs-residual; padding rows carry weight 0."""
     n = jnp.maximum(jnp.sum(w), 1.0)
-    resid = (y_true - y_pred) * w
+    # Mask with where, not multiplication: a non-finite prediction on a
+    # padding row would turn 0 * inf into NaN and poison every reduction.
+    resid = jnp.where(w > 0, y_true - y_pred, 0.0)
     mape = (
-        jnp.sum(w * jnp.abs(y_true - y_pred) / jnp.maximum(jnp.abs(y_true), _MAPE_EPS))
-        / n
+        jnp.sum(jnp.abs(resid) / jnp.maximum(jnp.abs(y_true), _MAPE_EPS)) / n
     )
     mean_y = jnp.sum(w * y_true) / n
     ss_res = jnp.sum(resid**2)

@@ -200,7 +200,7 @@ def default_pipeline(
             executable="bodywork_tpu.pipeline.stages:serve_stage",
             # compile only the buckets the tester's request sizes need
             # (each warmed bucket is one device dispatch at startup)
-            args={"buckets": [2048] if scoring_mode == "batch" else [1, 2048]},
+            args={"buckets": [2048] if scoring_mode == "batch" else [1]},
             replicas=2,
             port=port,
             ingress=False,
@@ -218,7 +218,11 @@ def default_pipeline(
             executable="bodywork_tpu.pipeline.stages:test_stage",
             # one full simulated day (<=1440 rows) scores in a single padded
             # device call in batch mode
-            args={"mode": scoring_mode, "batch_size": 2048},
+            args=(
+                {"mode": scoring_mode, "batch_size": 2048}
+                if scoring_mode == "batch"
+                else {"mode": scoring_mode}
+            ),
             resources=ResourceSpec(cpu_request=0.5, memory_mb=256),
         ),
     }
