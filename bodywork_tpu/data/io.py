@@ -82,15 +82,38 @@ def load_latest_dataset(store: ArtefactStore) -> Dataset:
 
 
 def load_all_datasets(store: ArtefactStore) -> Dataset:
-    """All available history, oldest first, concatenated (``stage_1:39-76``)."""
+    """All available history, oldest first, concatenated (``stage_1:39-76``).
+
+    The reference re-downloads and re-parses every day's CSV on each
+    training run — O(days) round-trips on a monotonically growing history
+    (``stage_1:68-71``; SURVEY.md hard part 2). Here each day's parsed
+    arrays are cached on the store instance keyed by the backend's
+    ``version_token``, so a daily retrain only parses the one new day.
+    """
     hist = store.history(DATASETS_PREFIX)
     if not hist:
         from bodywork_tpu.store.base import ArtefactNotFound
 
         raise ArtefactNotFound(f"no datasets under '{DATASETS_PREFIX}'")
-    parts = [load_dataset(store, key) for key, _ in hist]
+    cache: dict = store.__dict__.setdefault("_parsed_dataset_cache", {})
+    tokens = store.version_tokens([key for key, _ in hist])
+    parts, n_parsed = [], 0
+    for key, _ in hist:
+        token = tokens.get(key)
+        hit = cache.get(key) if token is not None else None
+        if hit is not None and hit[0] == token:
+            parts.append(hit[1])
+            continue
+        ds = load_dataset(store, key)
+        n_parsed += 1
+        if token is not None:
+            cache[key] = (token, ds)
+        parts.append(ds)
     X = np.concatenate([p.X for p in parts])
     y = np.concatenate([p.y for p in parts])
     most_recent = hist[-1][1]
-    log.info(f"loaded {len(parts)} day(s), {len(y)} rows, most recent {most_recent}")
+    log.info(
+        f"loaded {len(parts)} day(s) ({n_parsed} parsed, rest cached), "
+        f"{len(y)} rows, most recent {most_recent}"
+    )
     return Dataset(X, y, most_recent)

@@ -150,7 +150,11 @@ class LocalRunner:
     # -- DAG execution -----------------------------------------------------
     def run_day(self, today: date, scoring_url: str | None = None) -> DayResult:
         ctx = StageContext(
-            store=self.store, today=today, drift=self.drift, scoring_url=scoring_url
+            store=self.store,
+            today=today,
+            drift=self.drift,
+            scoring_url=scoring_url,
+            persistent_process=True,
         )
         stage_seconds: dict[str, float] = {}
         stage_results: dict[str, object] = {}

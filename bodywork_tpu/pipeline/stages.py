@@ -48,6 +48,10 @@ class StageContext:
     #: URL of the scoring service for cross-process testing (cluster DNS in
     #: k8s — ``stage_4:28``); None means test in-process via the app object
     scoring_url: str | None = None
+    #: True when the orchestrator runs many days in one process (the local
+    #: day-loop runner): enables cross-day warm-ahead optimisations that
+    #: would be dead weight in a one-shot per-day pod
+    persistent_process: bool = False
 
 
 def generate_stage(ctx: StageContext, offset_days: int = 1) -> str:
@@ -63,7 +67,13 @@ def train_stage(ctx: StageContext, model_type: str = "linear", **model_kwargs):
     """Train on all data to date, persist model + metrics (reference stage 1)."""
     from bodywork_tpu.train import train_on_history
 
-    return train_on_history(ctx.store, model_type, model_kwargs=model_kwargs or None)
+    return train_on_history(
+        ctx.store,
+        model_type,
+        model_kwargs=model_kwargs or None,
+        prewarm_next=ctx.persistent_process,
+        rows_per_day=ctx.drift.n_samples,
+    )
 
 
 def serve_stage(

@@ -32,8 +32,12 @@ class ServiceHandle:
         self._server = make_server(host, port, app, threaded=True)
         self.host = host
         self.port = self._server.server_port
+        # poll_interval bounds how long shutdown() blocks (socketserver's
+        # serve_forever only notices the shutdown flag between polls)
         self._thread = threading.Thread(
-            target=self._server.serve_forever, name="scoring-service", daemon=True
+            target=lambda: self._server.serve_forever(poll_interval=0.02),
+            name="scoring-service",
+            daemon=True,
         )
 
     @property

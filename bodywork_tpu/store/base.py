@@ -47,6 +47,33 @@ class ArtefactStore(abc.ABC):
         except ArtefactNotFound:
             return False
 
+    def version_token(self, key: str):
+        """Opaque token identifying the current content of ``key``, or None.
+
+        Two reads of a key with equal non-None tokens are guaranteed to see
+        identical bytes, which lets readers (e.g. the training history
+        loader) cache parsed artefacts across the daily loop instead of
+        re-reading O(days) objects — the reference's re-download-everything
+        pattern (``stage_1_train_model.py:68-71``). Backends without a cheap
+        validity check return None (no caching).
+        """
+        return None
+
+    def version_tokens(self, keys: list[str]) -> dict[str, object]:
+        """Version tokens for many keys at once (None values omitted).
+
+        Backends with a batched metadata listing (e.g. GCS) override this
+        to avoid one round-trip per key — otherwise a cached reader of N
+        artefacts would still pay the O(N) metadata calls the cache exists
+        to eliminate.
+        """
+        out = {}
+        for key in keys:
+            token = self.version_token(key)
+            if token is not None:
+                out[key] = token
+        return out
+
     # -- text convenience --------------------------------------------------
     def put_text(self, key: str, text: str) -> None:
         self.put_bytes(key, text.encode("utf-8"))

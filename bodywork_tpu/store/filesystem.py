@@ -66,5 +66,15 @@ class FilesystemStore(ArtefactStore):
         except FileNotFoundError:
             raise ArtefactNotFound(key) from None
 
+    def version_token(self, key: str):
+        # Every put_bytes is tmp-file + rename, i.e. a fresh inode, so
+        # (ino, size, mtime_ns) changes on every overwrite even when the
+        # filesystem's mtime granularity is coarse and the size is equal.
+        try:
+            st = self._path(key).stat()
+        except (FileNotFoundError, ValueError):
+            return None
+        return (st.st_ino, st.st_size, st.st_mtime_ns)
+
     def __repr__(self) -> str:
         return f"FilesystemStore(root={str(self.root)!r})"

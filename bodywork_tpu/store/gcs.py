@@ -54,3 +54,22 @@ class GCSStore(ArtefactStore):
         if not blob.exists():
             raise ArtefactNotFound(key)
         blob.delete()
+
+    def version_token(self, key: str):
+        # GCS object generation changes on every overwrite
+        blob = self._bucket.get_blob(self._blob_name(key))
+        return None if blob is None else blob.generation
+
+    def version_tokens(self, keys: list[str]) -> dict[str, object]:
+        # one paged listing returns every blob's generation — O(1) requests
+        # instead of one get_blob round-trip per key
+        wanted = {self._blob_name(k): k for k in keys}
+        import os.path
+
+        common = os.path.commonprefix(list(wanted)) if wanted else ""
+        out = {}
+        for blob in self._client.list_blobs(self._bucket, prefix=common):
+            key = wanted.get(blob.name)
+            if key is not None and blob.generation is not None:
+                out[key] = blob.generation
+        return out

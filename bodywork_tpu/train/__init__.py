@@ -1,3 +1,4 @@
+from bodywork_tpu.train.prewarm import prewarm_async
 from bodywork_tpu.train.trainer import TrainResult, persist_metrics, train_on_history
 
-__all__ = ["TrainResult", "persist_metrics", "train_on_history"]
+__all__ = ["TrainResult", "persist_metrics", "prewarm_async", "train_on_history"]

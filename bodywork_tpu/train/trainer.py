@@ -75,8 +75,21 @@ def train_on_history(
     split_seed: int = 42,
     fit_seed: int | None = None,
     model_kwargs: dict | None = None,
+    prewarm_next: bool = False,
+    rows_per_day: int | None = None,
 ) -> TrainResult:
-    """Run the full train stage against an artefact store."""
+    """Run the full train stage against an artefact store.
+
+    With ``prewarm_next``, tomorrow's padded-row buckets are compiled on a
+    background thread after training, so the days whose grown history first
+    crosses into a larger bucket don't pay the XLA compile on the critical
+    path (see :mod:`bodywork_tpu.train.prewarm`). Only useful to callers
+    that retrain repeatedly in one process (the local day-loop runner);
+    one-shot processes (CLI, per-day k8s jobs) gain nothing and would
+    block at exit joining the warm thread, so it defaults off.
+    ``rows_per_day`` bounds tomorrow's history growth (defaults to the
+    standard generator's daily sample count).
+    """
     ds = load_all_datasets(store)
     split = train_test_split(ds.X, ds.y, test_size=test_size, seed=split_seed)
     model = make_model(model_type, **(model_kwargs or {}))
@@ -90,4 +103,24 @@ def train_on_history(
     )
     model_key_ = save_model(store, fitted, ds.date)
     metrics_key = persist_metrics(store, metrics, ds.date)
+    if prewarm_next:
+        from bodywork_tpu.data.generator import DriftConfig
+        from bodywork_tpu.train.prewarm import prewarm_async
+
+        # Warm the buckets for tomorrow AND the day after: a bucket compile
+        # (~2 s) can outlast the rest of today's loop, so warming only one
+        # day ahead still races the next train. Two days' lead hides the
+        # whole compile off the critical path; the dedupe cache makes the
+        # extra call free when no new bucket is crossed.
+        per_day = (
+            rows_per_day if rows_per_day is not None else DriftConfig().n_samples
+        )
+        for days_ahead in (1, 2):
+            prewarm_async(
+                model_type,
+                model_kwargs,
+                len(ds) + days_ahead * per_day,
+                test_size,
+                n_features=ds.X.shape[1],
+            )
     return TrainResult(fitted, metrics, ds.date, model_key_, metrics_key, len(ds))

@@ -82,3 +82,14 @@ def test_atomic_write_leaves_no_tmp_files(store, tmp_path):
     store.put_bytes("a/b.bin", b"x" * 1024)
     leftover = [p for p in (store.root / "a").iterdir() if p.name.startswith(".tmp-")]
     assert leftover == []
+
+
+def test_version_token_tracks_content(store):
+    key = dataset_key(date(2026, 7, 1))
+    assert store.version_token(key) is None  # missing key
+    store.put_text(key, "date,y,X\n2026-07-01,1.0,2.0\n")
+    t1 = store.version_token(key)
+    assert t1 is not None
+    assert store.version_token(key) == t1  # stable across reads
+    store.put_text(key, "date,y,X\n2026-07-01,9.0,2.0\n")
+    assert store.version_token(key) != t1  # overwrite changes the token

@@ -60,3 +60,60 @@ def test_train_mlp_on_history(store):
     assert result.metrics["r_squared"] > 0.5
     assert store.list_keys(MODELS_PREFIX)
     assert store.list_keys(MODEL_METRICS_PREFIX)
+
+
+def test_history_loader_caches_parsed_days(store):
+    """Daily retrains must not re-parse O(days) history (SURVEY hard part 2)."""
+    from unittest.mock import patch
+
+    import bodywork_tpu.data.io as dio
+
+    _seed_days(store, days=3)
+    dio.load_all_datasets(store)  # warm the parse cache
+    with patch.object(dio, "load_dataset", wraps=dio.load_dataset) as spy:
+        ds = dio.load_all_datasets(store)
+        assert spy.call_count == 0  # all 3 days served from cache
+        d4 = date(2026, 1, 4)  # one new day appears
+        X, y = generate_day(d4)
+        persist_dataset(store, Dataset(X, y, d4))
+        ds2 = dio.load_all_datasets(store)
+        assert spy.call_count == 1  # only the new day parsed
+    assert len(ds2) > len(ds)
+
+
+def test_history_loader_cache_invalidates_on_overwrite(store):
+    import bodywork_tpu.data.io as dio
+    from bodywork_tpu.data import Dataset, persist_dataset
+
+    _seed_days(store, days=1)
+    before = dio.load_all_datasets(store)
+    X = np.full(10, 5.0, np.float32)
+    y = np.full(10, 7.0, np.float32)
+    persist_dataset(store, Dataset(X, y, date(2026, 1, 1)))  # overwrite day 1
+    after = dio.load_all_datasets(store)
+    assert len(after) == 10 and len(before) != 10
+
+
+def test_prewarm_bucket_math_matches_trainer():
+    """next_buckets must mirror train_test_split + pad_rows exactly, or the
+    background compile warms the wrong program."""
+    from bodywork_tpu.models.base import _bucket_rows, train_test_split
+    from bodywork_tpu.train.prewarm import next_buckets
+
+    for n in [100, 1024, 1281, 4096, 5000, 12800]:
+        X = np.zeros((n, 1), np.float32)
+        y = np.zeros(n, np.float32)
+        split = train_test_split(X, y, test_size=0.2, seed=42)
+        fit_b, eval_b = next_buckets(n, 0.2)
+        assert fit_b == _bucket_rows(len(split.X_train), 1024), n
+        assert eval_b == _bucket_rows(len(split.X_test), 256), n
+
+
+def test_prewarm_async_dedupes():
+    from bodywork_tpu.train.prewarm import prewarm_async
+
+    t1 = prewarm_async("linear", None, 700)
+    t2 = prewarm_async("linear", None, 700)  # same buckets -> deduped
+    if t1 is not None:
+        t1.join()
+    assert t2 is None
