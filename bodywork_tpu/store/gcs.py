@@ -61,15 +61,17 @@ class GCSStore(ArtefactStore):
         return None if blob is None else blob.generation
 
     def version_tokens(self, keys: list[str]) -> dict[str, object]:
-        # one paged listing returns every blob's generation — O(1) requests
-        # instead of one get_blob round-trip per key
+        # One paged listing per key *directory* returns every blob's
+        # generation — O(#directories) requests instead of one get_blob
+        # round-trip per key, without ever listing unrelated bucket
+        # contents (keys from different prefixes must not degrade to a
+        # whole-bucket listing).
         wanted = {self._blob_name(k): k for k in keys}
-        import os.path
-
-        common = os.path.commonprefix(list(wanted)) if wanted else ""
+        dirs = {name.rsplit("/", 1)[0] + "/" if "/" in name else "" for name in wanted}
         out = {}
-        for blob in self._client.list_blobs(self._bucket, prefix=common):
-            key = wanted.get(blob.name)
-            if key is not None and blob.generation is not None:
-                out[key] = blob.generation
+        for d in sorted(dirs):
+            for blob in self._client.list_blobs(self._bucket, prefix=d):
+                key = wanted.get(blob.name)
+                if key is not None and blob.generation is not None:
+                    out[key] = blob.generation
         return out

@@ -30,18 +30,23 @@ log = get_logger("train.prewarm")
 _warmed: set[tuple] = set()
 _lock = threading.Lock()
 _live: list[threading.Thread] = []
+_cancelled = threading.Event()
 
 
 @atexit.register
 def _drain() -> None:
     """Join in-flight warm threads before interpreter teardown: killing a
     daemon thread mid-XLA-compile aborts the whole process (pthread
-    cancellation unwinds through C++ noexcept frames -> std::terminate)."""
+    cancellation unwinds through C++ noexcept frames -> std::terminate).
+    The cancel flag stops threads that haven't started their fit yet, so
+    exit blocks on at most the one in-flight XLA call — not on dummy
+    trainings for buckets no future day will use."""
     import logging
 
     # log streams (e.g. pytest capture) may already be closed at exit;
     # don't let the warm thread's completion log print handler diagnostics
     logging.raiseExceptions = False
+    _cancelled.set()
     for t in list(_live):
         t.join()
 
@@ -63,6 +68,21 @@ def next_buckets(n_total_next: int, test_size: float) -> tuple[int, int]:
     n_test = int(round(n_total_next * test_size))
     n_train = n_total_next - n_test
     return _bucket_rows(n_train, 1024), _bucket_rows(max(n_test, 1), 256)
+
+
+def register_compiled(
+    model_type: str,
+    model_kwargs: dict | None,
+    n_total: int,
+    test_size: float = 0.2,
+    n_features: int = 1,
+) -> None:
+    """Record that a real fit just compiled the buckets for ``n_total``
+    rows, so ``prewarm_async`` never re-runs a dummy fit of a bucket the
+    jit cache already holds."""
+    fit_b, eval_b = next_buckets(n_total, test_size)
+    with _lock:
+        _warmed.add(_key(model_type, model_kwargs, fit_b, eval_b, n_features))
 
 
 def prewarm_async(
@@ -91,6 +111,8 @@ def prewarm_async(
 
     def _work():
         try:
+            if _cancelled.is_set():  # process is exiting; skip the fit
+                return
             from bodywork_tpu.train.trainer import make_model
 
             model = make_model(model_type, **(model_kwargs or {}))
@@ -103,6 +125,8 @@ def prewarm_async(
             X = np.tile(x1[:, None], (1, n_features))
             y = (1.0 + 0.5 * x1).astype(np.float32)
             fitted = model.fit(X, y)
+            if _cancelled.is_set():
+                return
             xe1 = np.linspace(0.0, 100.0, eval_b, dtype=np.float32)
             Xe = np.tile(xe1[:, None], (1, n_features))
             ye = (1.0 + 0.5 * xe1).astype(np.float32)

@@ -105,7 +105,17 @@ def train_on_history(
     metrics_key = persist_metrics(store, metrics, ds.date)
     if prewarm_next:
         from bodywork_tpu.data.generator import DriftConfig
-        from bodywork_tpu.train.prewarm import prewarm_async
+        from bodywork_tpu.train.prewarm import prewarm_async, register_compiled
+
+        # today's fit already compiled today's buckets — seed the dedupe so
+        # a no-boundary-crossing day never spawns a redundant dummy fit
+        register_compiled(
+            model_type,
+            model_kwargs,
+            len(ds),
+            test_size,
+            n_features=ds.X.shape[1],
+        )
 
         # Warm the buckets for tomorrow AND the day after: a bucket compile
         # (~2 s) can outlast the rest of today's loop, so warming only one

@@ -1,0 +1,42 @@
+"""Black-box test the live scoring service with the latest labeled data
+(reference ``notebooks/4-test-model-scoring-service.ipynb`` / ``stage_4``).
+
+Scores the newest day's dataset through the service's HTTP API, computes the
+live drift metrics (MAPE, score/label correlation, max APE, mean response
+time) and persists them under ``test-metrics/``. Failed requests are
+*counted* (``n_failures`` column) rather than averaged in as the reference's
+``-1`` sentinel was (SURVEY.md known-bug list).
+
+Single mode posts one row per request like the reference's per-row loop;
+batch mode posts 512-row chunks that the service pads into pre-compiled
+row buckets on the TPU.
+"""
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo-root run
+
+
+from bodywork_tpu.monitor import HttpScoringClient, run_service_test, scoring_endpoint
+from bodywork_tpu.store import open_store
+from bodywork_tpu.utils.logging import configure_logger
+
+DEFAULT_STORE = "/tmp/bodywork-tpu-example-store"
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--store", default=DEFAULT_STORE)
+    p.add_argument("--url", default="http://localhost:5000")
+    p.add_argument("--mode", default="batch", choices=["single", "batch"])
+    args = p.parse_args()
+
+    configure_logger()
+    client = HttpScoringClient(scoring_endpoint(args.url, args.mode))
+    metrics = run_service_test(open_store(args.store), client, mode=args.mode)
+    print(metrics.to_string(index=False))
+
+
+if __name__ == "__main__":
+    main()

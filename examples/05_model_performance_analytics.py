@@ -1,0 +1,43 @@
+"""Longitudinal model-performance analytics (reference
+``notebooks/model-performance-analytics.ipynb``).
+
+Joins the full ``model-metrics/`` (train-time) and ``test-metrics/``
+(live-service) histories by date. The widening gap between ``MAPE_train``
+and ``MAPE_live`` across simulated days is the concept-drift signal the
+whole pipeline exists to surface: the deployed model was trained through
+yesterday, the live data keeps drifting.
+"""
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo-root run
+
+
+from bodywork_tpu.monitor import drift_report
+from bodywork_tpu.store import open_store
+from bodywork_tpu.utils.logging import configure_logger
+
+DEFAULT_STORE = "/tmp/bodywork-tpu-example-store"
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--store", default=DEFAULT_STORE)
+    args = p.parse_args()
+
+    configure_logger()
+    report = drift_report(open_store(args.store))
+    if report.empty:
+        print("no metric history yet - run the pipeline first")
+        return
+    cols = [c for c in report.columns if c == "date" or c.startswith(("MAPE", "r_squared", "mean_response"))]
+    print(report[cols].to_string(index=False))
+    if {"MAPE_train", "MAPE_live"} <= set(report.columns):
+        gap = (report["MAPE_live"] - report["MAPE_train"]).dropna()
+        if len(gap):
+            print(f"\nmean live-vs-train MAPE gap over {len(gap)} day(s): {gap.mean():+.4f}")
+
+
+if __name__ == "__main__":
+    main()
