@@ -38,7 +38,10 @@ def main() -> int:
 
     store = FilesystemStore(tempfile.mkdtemp(prefix="bench-store-"))
     runner = LocalRunner(
-        default_pipeline(model_type="linear", scoring_mode="batch"), store
+        default_pipeline(
+            model_type="linear", scoring_mode="batch", overlap_generate=True
+        ),
+        store,
     )
     results = runner.run_simulation(date(2026, 1, 1), SIM_DAYS)
     for r in results:

@@ -14,6 +14,7 @@ import abc
 import dataclasses
 from typing import Any
 
+import jax
 import numpy as np
 
 
@@ -66,6 +67,9 @@ def train_test_split(
 #: per-class cache of fused evaluate programs (see Regressor.evaluate)
 _EVAL_FNS: dict[type, Any] = {}
 
+#: per-class cache of jitted apply functions (see Regressor.predict_device)
+_APPLY_FNS: dict[type, Any] = {}
+
 
 class Regressor(abc.ABC):
     """Fitted-or-unfitted regression model over a JAX pytree of params."""
@@ -80,6 +84,10 @@ class Regressor(abc.ABC):
     def __init__(self, config: Any = None, params: Any = None):
         self.config = config
         self.params = params
+        #: host (numpy) copy of params, populated by the fused fit+eval path
+        #: so checkpointing never re-fetches from the device (each fetch is a
+        #: full tunnel round-trip on a remote-attached TPU)
+        self._host_params: Any = None
 
     # -- estimator protocol ------------------------------------------------
     @abc.abstractmethod
@@ -92,9 +100,79 @@ class Regressor(abc.ABC):
         defers to the config (deterministic models ignore it entirely).
         """
 
-    @abc.abstractmethod
     def predict(self, X: np.ndarray) -> np.ndarray:
-        """Predict targets; accepts (n, d) or (n,) arrays."""
+        """Predict targets; accepts (n, d) or (n,) arrays. Routes through
+        the per-class jitted apply cache (:meth:`predict_device`), so there
+        is exactly ONE compiled apply program per class per shape."""
+        return np.asarray(self.predict_device(X))
+
+    def fit_and_evaluate(
+        self,
+        X_train: np.ndarray,
+        y_train: np.ndarray,
+        X_test: np.ndarray,
+        y_test: np.ndarray,
+        seed: int | None = None,
+    ) -> tuple["Regressor", dict[str, float]]:
+        """Fit on the train split and score the held-out split.
+
+        Default implementation is fit-then-evaluate (several device
+        round-trips); Linear/MLP override it with a single fused XLA
+        program whose result comes back in ONE device->host transfer
+        (see :mod:`bodywork_tpu.models.fused`).
+        """
+        fitted = self.fit(X_train, y_train, seed=seed)
+        return fitted, fitted.evaluate(X_test, y_test)
+
+    @staticmethod
+    def _pad_splits(
+        X_train: np.ndarray,
+        y_train: np.ndarray,
+        X_test: np.ndarray,
+        y_test: np.ndarray,
+    ):
+        """Shared input coercion + bucket padding for the fused fit+eval
+        paths: float32, (n, d) features, ravelled targets, train padded to
+        the fit bucket and test to the eval bucket (min 256)."""
+
+        def _coerce(X, y):
+            X = np.asarray(X, dtype=np.float32)
+            if X.ndim == 1:
+                X = X[:, None]
+            return X, np.asarray(y, dtype=np.float32).ravel()
+
+        X_train, y_train = _coerce(X_train, y_train)
+        X_test, y_test = _coerce(X_test, y_test)
+        return pad_rows(X_train, y_train) + pad_rows(
+            X_test, y_test, minimum=256
+        )
+
+    def host_params(self):
+        """Params as host numpy arrays, fetching from device only if the
+        fused fit path didn't already deliver a host copy."""
+        assert self.params is not None, "model is not fitted"
+        if self._host_params is not None:
+            return self._host_params
+        self._host_params = jax.tree_util.tree_map(
+            np.asarray, jax.device_get(self.params)
+        )
+        return self._host_params
+
+    def predict_device(self, X: np.ndarray):
+        """Dispatch the jitted apply WITHOUT materialising the result on the
+        host (no device->host transfer; returns the device array). Used by
+        serving warmup, where only the compile + dispatch matter."""
+        assert self.params is not None, "model is not fitted"
+        assert type(self).apply is not None, (
+            f"{type(self).__name__} does not define an apply function"
+        )
+        fn = _APPLY_FNS.get(type(self))
+        if fn is None:
+            fn = _APPLY_FNS[type(self)] = jax.jit(type(self).apply)
+        X = np.asarray(X, dtype=np.float32)
+        if X.ndim == 1:
+            X = X[:, None]
+        return fn(self.params, X)
 
     def evaluate(self, X: np.ndarray, y: np.ndarray) -> dict[str, float]:
         """MAPE / R^2 / max-residual of this model on (X, y), computed as a

@@ -41,7 +41,9 @@ def _leaf_path(path) -> str:
 def save_model_bytes(model) -> bytes:
     """Serialise a fitted Regressor to npz bytes."""
     assert model.params is not None, "cannot checkpoint an unfitted model"
-    leaves_with_paths = jax.tree_util.tree_flatten_with_path(model.params)[0]
+    # host_params() is free when the fused fit path already delivered a host
+    # copy; otherwise it fetches from device once
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(model.host_params())[0]
     arrays = {_leaf_path(p): np.asarray(v) for p, v in leaves_with_paths}
     meta = {
         "model_type": model.model_type,

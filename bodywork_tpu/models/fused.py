@@ -1,0 +1,57 @@
+"""Pack/unpack helpers for single-transfer fused train programs.
+
+On a remote-attached TPU every device->host fetch pays the tunnel
+round-trip (~70-200 ms measured), so a train stage that fetches three
+metric scalars and each param leaf separately spends ~0.4 s/day on
+transfers alone. The fused fit+eval programs (``linear._ols_fit_eval``,
+``mlp._mlp_fit_eval``) instead return the params pytree (kept on device
+for serving) *plus* one flat ``float32`` vector holding every param leaf
+ravelled followed by the metrics — so the whole train stage costs exactly
+ONE device->host transfer.
+
+The reference has no analogue (sklearn is host-resident, transfers are
+free — ``stage_1_train_model.py:105-107``); this is remote-accelerator
+design.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def pack_tree_with_tail(params, tail_scalars) -> jax.Array:
+    """Concatenate every leaf of ``params`` (ravelled, f32) and the given
+    scalars into one flat device vector. Runs inside jit."""
+    leaves = jax.tree_util.tree_leaves(params)
+    flat = [jnp.ravel(leaf).astype(jnp.float32) for leaf in leaves]
+    tail = jnp.stack([jnp.asarray(s, jnp.float32) for s in tail_scalars])
+    return jnp.concatenate(flat + [tail])
+
+
+def unpack_tree_with_tail(packed_host: np.ndarray, params_like, n_tail: int):
+    """Split a fetched flat vector back into (host params pytree, tail).
+
+    ``params_like`` supplies the tree structure and leaf shapes (its device
+    leaves are never transferred — only ``.shape`` is read).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(params_like)
+    out, offset = [], 0
+    for leaf in leaves:
+        size = int(np.prod(leaf.shape)) if leaf.shape else 1
+        arr = np.asarray(
+            packed_host[offset : offset + size], dtype=np.float32
+        ).reshape(leaf.shape)
+        out.append(arr)
+        offset += size
+    tail = np.asarray(packed_host[offset : offset + n_tail], dtype=np.float32)
+    return jax.tree_util.tree_unflatten(treedef, out), tail
+
+
+def metrics_dict(tail: np.ndarray) -> dict[str, float]:
+    """First three tail entries are always (MAPE, r_squared, max_residual)."""
+    return {
+        "MAPE": float(tail[0]),
+        "r_squared": float(tail[1]),
+        "max_residual": float(tail[2]),
+    }

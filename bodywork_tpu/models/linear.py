@@ -16,12 +16,19 @@ matmul is MXU work; the O(d^3) solve is negligible (d = 2 here).
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from bodywork_tpu.models.base import Regressor, pad_rows
+from bodywork_tpu.models.fused import (
+    metrics_dict,
+    pack_tree_with_tail,
+    unpack_tree_with_tail,
+)
+from bodywork_tpu.models.metrics import _metrics
 
 
 @dataclasses.dataclass
@@ -32,8 +39,7 @@ class LinearConfig:
     l2: float = 0.0
 
 
-@jax.jit
-def _ols_fit(X: jax.Array, y: jax.Array, w: jax.Array, l2: jax.Array):
+def _ols_core(X: jax.Array, y: jax.Array, w: jax.Array, l2: jax.Array):
     ones = jnp.ones((X.shape[0], 1), X.dtype)
     A = jnp.concatenate([X, ones], axis=1)
     Aw = A * w[:, None]
@@ -43,8 +49,35 @@ def _ols_fit(X: jax.Array, y: jax.Array, w: jax.Array, l2: jax.Array):
     return {"w": theta[:-1], "b": theta[-1]}
 
 
-@jax.jit
+_ols_fit = jax.jit(_ols_core)
+
+
+def _ols_no_intercept_core(X: jax.Array, y: jax.Array, w: jax.Array, l2: jax.Array):
+    Xw = X * w[:, None]
+    G = Xw.T @ X + l2 * jnp.eye(X.shape[1], dtype=X.dtype)
+    c = Xw.T @ y
+    theta = jnp.linalg.solve(G, c)
+    return {"w": theta, "b": jnp.zeros((), X.dtype)}
+
+
+@partial(jax.jit, static_argnames=("fit_intercept",))
+def _ols_fit_eval(Xtr, ytr, wtr, Xte, yte, wte, l2, fit_intercept: bool = True):
+    """Fused fit + held-out metrics; returns (device params, packed vector).
+
+    The packed vector is [w..., b, MAPE, r2, max_residual] — the train
+    stage's entire device->host traffic in one transfer (see
+    :mod:`bodywork_tpu.models.fused`).
+    """
+    core = _ols_core if fit_intercept else _ols_no_intercept_core
+    params = core(Xtr, ytr, wtr, l2)
+    m = _metrics(yte, linear_apply(params, Xte), wte)
+    return params, pack_tree_with_tail(params, m)
+
+
 def linear_apply(params, X: jax.Array) -> jax.Array:
+    # plain (unjitted) pure function: the per-class jitted version lives in
+    # base._APPLY_FNS (one compiled apply per class), and fused programs
+    # inline it
     return X @ params["w"] + params["b"]
 
 
@@ -72,12 +105,29 @@ class LinearRegressor(Regressor):
         params = jax.device_put(params)
         return LinearRegressor(self.config, params)
 
-    def predict(self, X: np.ndarray) -> np.ndarray:
-        assert self.params is not None, "model is not fitted"
-        X = jnp.asarray(X, dtype=jnp.float32)
-        if X.ndim == 1:
-            X = X[:, None]
-        return np.asarray(linear_apply(self.params, X))
+    def fit_and_evaluate(
+        self,
+        X_train: np.ndarray,
+        y_train: np.ndarray,
+        X_test: np.ndarray,
+        y_test: np.ndarray,
+        seed: int | None = None,
+    ) -> tuple["LinearRegressor", dict[str, float]]:
+        """Fused fit + held-out metrics: one XLA program, ONE device->host
+        transfer for params and metrics together (vs fit/eval/fetch costing
+        ~5 tunnel round-trips — see models/fused.py)."""
+        Xtr, ytr, wtr, Xte, yte, wte = self._pad_splits(
+            X_train, y_train, X_test, y_test
+        )
+        params, packed = _ols_fit_eval(
+            Xtr, ytr, wtr, Xte, yte, wte,
+            jnp.float32(self.config.l2),
+            fit_intercept=self.config.fit_intercept,
+        )
+        host_params, tail = unpack_tree_with_tail(np.asarray(packed), params, 3)
+        fitted = LinearRegressor(self.config, params)
+        fitted._host_params = host_params
+        return fitted, metrics_dict(tail)
 
     @property
     def n_features(self) -> int | None:
@@ -94,10 +144,4 @@ class LinearRegressor(Regressor):
         return cls(LinearConfig(**cfg), params)
 
 
-@jax.jit
-def _ols_fit_no_intercept(X: jax.Array, y: jax.Array, w: jax.Array, l2: jax.Array):
-    Xw = X * w[:, None]
-    G = Xw.T @ X + l2 * jnp.eye(X.shape[1], dtype=X.dtype)
-    c = Xw.T @ y
-    theta = jnp.linalg.solve(G, c)
-    return {"w": theta, "b": jnp.zeros((), X.dtype)}
+_ols_fit_no_intercept = jax.jit(_ols_no_intercept_core)

@@ -73,8 +73,19 @@ def _loss(net_params, xb, yb, wb):
     return jnp.sum(wb * (pred - yb) ** 2) / jnp.maximum(jnp.sum(wb), 1.0)
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
-def _train(net_params, X, y, w, key, cfg: MLPConfig):
+
+
+def _scaled_splits(Xp, yp, w):
+    """Masked standardisation stats + standardised train arrays."""
+    x_mean, x_std = jax.vmap(_masked_stats, in_axes=(1, None), out_axes=0)(Xp, w)
+    y_mean, y_std = _masked_stats(yp, w)
+    Xs = (Xp - x_mean) / x_std
+    ys = (yp - y_mean) / y_std
+    scaler = {"x_mean": x_mean, "x_std": x_std, "y_mean": y_mean, "y_std": y_std}
+    return Xs, ys, scaler
+
+
+def _train_core(net_params, X, y, w, key, cfg: MLPConfig):
     opt = optax.adam(cfg.learning_rate)
     opt_state = opt.init(net_params)
 
@@ -92,6 +103,31 @@ def _train(net_params, X, y, w, key, cfg: MLPConfig):
         step, (net_params, opt_state, key), None, length=cfg.n_steps
     )
     return net_params, losses
+
+
+#: standalone jitted train loop (used by ``fit``; the fused path inlines
+#: ``_train_core`` into one program instead)
+_train = partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))(_train_core)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _mlp_fit_eval(Xp, yp, w, Xe, ye, we, key, cfg: MLPConfig):
+    """Whole MLP train stage as ONE XLA program: scaler stats, init, the
+    ``lax.scan`` optimisation loop, and held-out metrics. Returns the device
+    params plus a packed [leaves..., MAPE, r2, max_resid, final_loss] vector
+    so the host fetches everything in a single transfer."""
+    from bodywork_tpu.models.fused import pack_tree_with_tail
+    from bodywork_tpu.models.metrics import _metrics
+
+    k_init, k_train = jax.random.split(key)
+    Xs, ys, scaler = _scaled_splits(Xp, yp, w)
+    sizes = (Xp.shape[1],) + cfg.hidden + (1,)
+    net = init_mlp_params(k_init, sizes)
+    net, losses = _train_core(net, Xs, ys, w, k_train, cfg)
+    params = {"net": net, "scaler": scaler}
+    m = _metrics(ye, mlp_apply(params, Xe), we)
+    packed = pack_tree_with_tail(params, tuple(m) + (losses[-1],))
+    return params, packed
 
 
 @jax.jit
@@ -121,33 +157,39 @@ class MLPRegressor(Regressor):
         k_init, k_train = jax.random.split(key)
 
         Xp, yp, w = jnp.asarray(Xp), jnp.asarray(yp), jnp.asarray(w)
-        x_mean, x_std = jax.vmap(_masked_stats, in_axes=(1, None), out_axes=0)(Xp, w)
-        y_mean, y_std = _masked_stats(yp, w)
-        Xs = (Xp - x_mean) / x_std
-        ys = (yp - y_mean) / y_std
+        Xs, ys, scaler = _scaled_splits(Xp, yp, w)
 
         sizes = (X.shape[1],) + cfg.hidden + (1,)
         net = init_mlp_params(k_init, sizes)
         net, losses = _train(net, Xs, ys, w, k_train, cfg)
-        params = {
-            "net": net,
-            "scaler": {
-                "x_mean": x_mean,
-                "x_std": x_std,
-                "y_mean": y_mean,
-                "y_std": y_std,
-            },
-        }
+        params = {"net": net, "scaler": scaler}
         fitted = MLPRegressor(cfg, jax.device_put(params))
         fitted.final_loss = float(losses[-1])
         return fitted
 
-    def predict(self, X: np.ndarray) -> np.ndarray:
-        assert self.params is not None, "model is not fitted"
-        X = jnp.asarray(X, dtype=jnp.float32)
-        if X.ndim == 1:
-            X = X[:, None]
-        return np.asarray(_predict_jit(self.params, X))
+    def fit_and_evaluate(
+        self,
+        X_train: np.ndarray,
+        y_train: np.ndarray,
+        X_test: np.ndarray,
+        y_test: np.ndarray,
+        seed: int | None = None,
+    ) -> tuple["MLPRegressor", dict[str, float]]:
+        """Fused scaler+init+scan-train+metrics in one XLA program; host
+        receives params, metrics, and the final loss in ONE transfer."""
+        from bodywork_tpu.models.fused import metrics_dict, unpack_tree_with_tail
+
+        cfg = self.config
+        Xp, yp, w, Xe, ye, we = self._pad_splits(
+            X_train, y_train, X_test, y_test
+        )
+        key = jax.random.PRNGKey(cfg.seed if seed is None else seed)
+        params, packed = _mlp_fit_eval(Xp, yp, w, Xe, ye, we, key, cfg)
+        host_params, tail = unpack_tree_with_tail(np.asarray(packed), params, 4)
+        fitted = MLPRegressor(cfg, params)
+        fitted._host_params = host_params
+        fitted.final_loss = float(tail[3])
+        return fitted, metrics_dict(tail)
 
     @property
     def n_features(self) -> int | None:
@@ -164,6 +206,3 @@ class MLPRegressor(Regressor):
         cfg = dict(cfg)
         cfg["hidden"] = tuple(cfg.get("hidden", (64, 64)))
         return cls(MLPConfig(**cfg), params)
-
-
-_predict_jit = jax.jit(mlp_apply)

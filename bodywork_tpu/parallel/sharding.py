@@ -51,13 +51,12 @@ def _named(mesh: Mesh, tree):
                         is_leaf=lambda x: isinstance(x, P))
 
 
-def make_data_parallel_predict(model: Regressor, mesh: Mesh):
-    """A predict fn sharding rows over the mesh ``data`` axis.
-
-    Params are replicated into each device's HBM once, at closure build
-    time; each call pads the batch to a multiple of the data-axis size and
-    runs one pjit'ed program.
-    """
+def make_data_parallel_apply(model: Regressor, mesh: Mesh):
+    """Build the sharded apply: params replicated into each device's HBM
+    once, rows split over the mesh ``data`` axis by NamedSharding. Returns
+    ``(dispatch, n_data)`` where ``dispatch(X)`` pads the batch to a
+    multiple of the data-axis size and returns the UN-materialised device
+    result (no device->host transfer)."""
     apply_fn = type(model).apply
     if apply_fn is None:
         raise TypeError(
@@ -76,15 +75,29 @@ def make_data_parallel_predict(model: Regressor, mesh: Mesh):
     )
     n_data = mesh.shape["data"]
 
+    def dispatch(X: np.ndarray):
+        X = np.asarray(X, dtype=np.float32)
+        if X.ndim == 1:
+            X = X[:, None]
+        pad = (-X.shape[0]) % n_data
+        if pad:
+            X = np.concatenate([X, np.zeros((pad, X.shape[1]), X.dtype)])
+        return sharded_apply(params, X)
+
+    return dispatch, n_data
+
+
+def make_data_parallel_predict(model: Regressor, mesh: Mesh):
+    """A predict fn sharding rows over the mesh ``data`` axis (materialises
+    the result on host; see :func:`make_data_parallel_apply` for the
+    dispatch-only path)."""
+    dispatch, _ = make_data_parallel_apply(model, mesh)
+
     def predict(X: np.ndarray) -> np.ndarray:
         X = np.asarray(X, dtype=np.float32)
         if X.ndim == 1:
             X = X[:, None]
-        n = X.shape[0]
-        pad = (-n) % n_data
-        if pad:
-            X = np.concatenate([X, np.zeros((pad, X.shape[1]), X.dtype)])
-        return np.asarray(sharded_apply(params, X))[:n]
+        return np.asarray(dispatch(X))[: X.shape[0]]
 
     return predict
 
@@ -103,7 +116,10 @@ class DataParallelPredictor(PaddedPredictor):
         buckets = tuple(sorted({b + (-b) % n_data for b in buckets}))
         super().__init__(model, buckets)
         self.mesh = mesh
-        self._sharded_predict = make_data_parallel_predict(model, mesh)
+        self._sharded_dispatch, _ = make_data_parallel_apply(model, mesh)
 
-    def _predict_padded(self, Xp: np.ndarray) -> np.ndarray:
-        return self._sharded_predict(Xp)
+    def _dispatch_padded(self, Xp: np.ndarray):
+        # the *sharded* program, un-materialised: warmup compiles and
+        # enqueues without paying a device->host transfer; the base
+        # _predict_padded materialises this result for real requests
+        return self._sharded_dispatch(Xp)

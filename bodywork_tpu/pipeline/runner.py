@@ -130,22 +130,60 @@ class LocalRunner:
         import requests
 
         health_url = handle.url.replace("/score/v1", "/healthz")
-        while True:
-            try:
-                if requests.get(health_url, timeout=2).ok:
-                    break
-            except requests.ConnectionError:
-                pass
-            if time.monotonic() > deadline:
-                handle.stop()
-                raise StageFailure(
-                    stage.name,
-                    f"not healthy within max_startup_time_seconds="
-                    f"{stage.max_startup_time_s}",
-                )
-            time.sleep(0.05)
+        poll_s = 0.002  # werkzeug's thread is typically up in <10 ms
+        try:
+            while True:
+                try:
+                    if requests.get(health_url, timeout=2).ok:
+                        break
+                except requests.RequestException:
+                    # not just ConnectionError: a slow-to-wake server can
+                    # also ReadTimeout; both mean "poll again"
+                    pass
+                if time.monotonic() > deadline:
+                    raise StageFailure(
+                        stage.name,
+                        f"not healthy within max_startup_time_seconds="
+                        f"{stage.max_startup_time_s}",
+                    )
+                time.sleep(poll_s)
+                poll_s = min(poll_s * 2, 0.05)
+        except BaseException:
+            # never leak a started-but-not-registered server (a leaked
+            # thread+socket per retry otherwise)
+            handle.stop()
+            raise
         ctx.services[stage.name] = handle
         return handle
+
+    def _run_stage_timed(self, stage_name: str, ctx: StageContext,
+                         stage_seconds: dict, stage_results: dict,
+                         today: date, concurrent: bool = False) -> None:
+        """Run one stage, recording wall-clock and result. With
+        ``concurrent=True`` (stage is on a step thread) ANY failure is
+        parked in ``ctx.failures`` for the step barrier to re-raise — so
+        sibling stages finish cleanly, as independent k8s pods would —
+        instead of dying silently in the thread's excepthook."""
+        stage = self.spec.stages[stage_name]
+        t0 = time.perf_counter()
+        try:
+            if stage.kind == "service":
+                result = self._run_service_stage(stage, ctx)
+            else:
+                result = self._run_batch_stage(stage, ctx)
+        except BaseException as exc:
+            stage_seconds[stage_name] = time.perf_counter() - t0
+            if not concurrent:
+                raise
+            if not isinstance(exc, StageFailure):
+                exc = StageFailure(stage.name, repr(exc))
+            ctx.failures[stage_name] = exc
+            return
+        stage_seconds[stage_name] = time.perf_counter() - t0
+        stage_results[stage_name] = result
+        log.info(
+            f"[{today}] {stage_name} done in {stage_seconds[stage_name]:.3f}s"
+        )
 
     # -- DAG execution -----------------------------------------------------
     def run_day(self, today: date, scoring_url: str | None = None) -> DayResult:
@@ -161,21 +199,29 @@ class LocalRunner:
         day_start = time.perf_counter()
         try:
             for step in self.spec.dag:
-                # stages within a step are independent; executed in order
-                # here (concurrent pods in the k8s materialisation)
-                for stage_name in step:
-                    stage = self.spec.stages[stage_name]
-                    t0 = time.perf_counter()
-                    if stage.kind == "service":
-                        result = self._run_service_stage(stage, ctx)
-                    else:
-                        result = self._run_batch_stage(stage, ctx)
-                    stage_seconds[stage_name] = time.perf_counter() - t0
-                    stage_results[stage_name] = result
-                    log.info(
-                        f"[{today}] {stage_name} done in "
-                        f"{stage_seconds[stage_name]:.3f}s"
-                    )
+                # stages within a step are independent and run CONCURRENTLY
+                # (concurrent pods in the k8s materialisation); steps are
+                # barriers
+                if len(step) == 1:
+                    self._run_stage_timed(step[0], ctx, stage_seconds,
+                                          stage_results, today)
+                else:
+                    threads = [
+                        threading.Thread(
+                            target=self._run_stage_timed,
+                            args=(name, ctx, stage_seconds, stage_results,
+                                  today, True),
+                            name=f"step-{name}",
+                        )
+                        for name in step
+                    ]
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join()
+                    failed = [n for n in step if n in ctx.failures]
+                    if failed:
+                        raise ctx.failures[failed[0]]
         finally:
             for name, handle in ctx.services.items():
                 handle.stop()
@@ -195,11 +241,51 @@ class LocalRunner:
             persist_dataset(self.store, Dataset(X, y, start))
             log.info(f"bootstrapped day-0 dataset for {start}")
 
+    def _prewarm_horizon(self, days: int) -> None:
+        """Start background compiles of every train/eval row bucket the
+        whole simulation horizon will need. Day lengths shrank below XLA
+        compile time, so warming only 1-2 days ahead (the trainer's own
+        lookahead) can lose the race on bucket-crossing days; the runner
+        knows the full horizon up front and warms it all at day 0."""
+        stage = next(
+            (
+                s
+                for s in self.spec.stages.values()
+                if s.executable.endswith(":train_stage")
+            ),
+            None,
+        )
+        if stage is None:
+            return
+        from bodywork_tpu.train.prewarm import prewarm_async
+
+        model_type = stage.args.get("model_type", "linear")
+        model_kwargs = {
+            k: v for k, v in stage.args.items() if k != "model_type"
+        } or None
+        # Base the estimate on the ACTUAL persisted history size (the y>=0
+        # filter drops a sigma-dependent fraction of n_samples, so counting
+        # days * n_samples would overshoot and can warm the wrong bucket on
+        # a crossing day). load_all_datasets is cached, so this prepays
+        # stage-1's parse rather than adding work. Future days still need an
+        # estimate; warm both ends of the plausible filter-drop range so the
+        # bucket actually crossed is covered either way.
+        from bodywork_tpu.data.io import load_all_datasets
+
+        n_now = len(load_all_datasets(self.store))
+        per_day = self.drift.n_samples
+        for i in range(days):
+            prewarm_async(model_type, model_kwargs, n_now + i * per_day)
+            prewarm_async(
+                model_type, model_kwargs, n_now + int(i * per_day * 0.85)
+            )
+
     def run_simulation(self, start: date, days: int) -> list[DayResult]:
         """The daily MLOps loop over N simulated days: each day trains on
         history to date, deploys, generates the next (drifted) day, and
         tests the live service against it."""
         self.bootstrap(start)
+        self._prewarm_horizon(days)
         results = []
         for i in range(days):
             today = start + timedelta(days=i)

@@ -52,6 +52,9 @@ class StageContext:
     #: day-loop runner): enables cross-day warm-ahead optimisations that
     #: would be dead weight in a one-shot per-day pod
     persistent_process: bool = False
+    #: failures from stages run on concurrent-step threads, keyed by stage
+    #: name (the step barrier re-raises the first one)
+    failures: dict = dataclasses.field(default_factory=dict)
 
 
 def generate_stage(ctx: StageContext, offset_days: int = 1) -> str:
@@ -91,7 +94,15 @@ def serve_stage(
     bucket costs one device dispatch at startup) — the pipeline spec sets it
     to match the tester's request sizes."""
     model, model_date = load_model(ctx.store)
-    app = create_app(model, model_date, buckets=tuple(buckets) if buckets else None)
+    # in the persistent day-loop these exact bucket shapes executed on
+    # previous days, so skip warmup's error-surfacing device sync; a
+    # one-shot pod keeps it (device faults fail startup, not requests)
+    app = create_app(
+        model,
+        model_date,
+        buckets=tuple(buckets) if buckets else None,
+        warmup_sync=not ctx.persistent_process,
+    )
     handle = ServiceHandle(app, host=host, port=port).start()
     handle.app = app
     return handle

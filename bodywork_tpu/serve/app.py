@@ -159,9 +159,10 @@ def create_app(
     model_date: date | None = None,
     buckets: tuple[int, ...] | None = None,
     warmup: bool = True,
+    warmup_sync: bool = True,
     predictor=None,
 ) -> ScoringApp:
     app = ScoringApp(model, model_date, buckets, predictor=predictor)
     if warmup:
-        app.predictor.warmup()
+        app.predictor.warmup(sync=warmup_sync)
     return app

@@ -38,19 +38,38 @@ class PaddedPredictor:
 
     def _predict_padded(self, Xp: np.ndarray) -> np.ndarray:
         """Run the model on an exactly-bucket-sized batch."""
-        return np.asarray(self.model.predict(Xp))
+        return np.asarray(self._dispatch_padded(Xp))
 
-    def warmup(self, n_features: int | None = None) -> None:
+    def _dispatch_padded(self, Xp: np.ndarray):
+        """Dispatch the padded batch without materialising on the host
+        (compile + enqueue only — no device->host transfer)."""
+        return self.model.predict_device(Xp)
+
+    def warmup(self, n_features: int | None = None, sync: bool = True) -> None:
         """Compile every bucket shape before taking traffic (startup cost,
         analogous to the reference's load-model-at-boot — ``stage_2:113``).
 
         The feature dimension defaults to the fitted model's own, so the
-        shapes compiled here are exactly the request-path shapes.
+        shapes compiled here are exactly the request-path shapes. All
+        buckets are dispatched first (XLA compiles synchronously at
+        dispatch; execution drains asynchronously), then with ``sync`` one
+        ``block_until_ready`` surfaces any device-side execution error
+        (e.g. HBM OOM on the largest bucket) HERE — before the health gate
+        reports ready — at the cost of a single device sync, with no
+        device->host data transfer. ``sync=False`` is for callers that
+        already executed these exact shapes in this process (the local
+        day-loop re-serving each day).
         """
         if n_features is None:
             n_features = self.model.n_features or 1
-        for b in self.buckets:
-            self._predict_padded(np.zeros((b, n_features), dtype=np.float32))
+        results = [
+            self._dispatch_padded(np.zeros((b, n_features), dtype=np.float32))
+            for b in self.buckets
+        ]
+        if sync:
+            import jax
+
+            jax.block_until_ready(results)
         log.info(
             f"warmed up predict buckets {self.buckets} (n_features={n_features})"
         )

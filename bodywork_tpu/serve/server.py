@@ -35,7 +35,7 @@ class ServiceHandle:
         # poll_interval bounds how long shutdown() blocks (socketserver's
         # serve_forever only notices the shutdown flag between polls)
         self._thread = threading.Thread(
-            target=lambda: self._server.serve_forever(poll_interval=0.02),
+            target=lambda: self._server.serve_forever(poll_interval=0.005),
             name="scoring-service",
             daemon=True,
         )

@@ -124,13 +124,12 @@ def prewarm_async(
             x1 = np.linspace(0.0, 100.0, fit_b, dtype=np.float32)
             X = np.tile(x1[:, None], (1, n_features))
             y = (1.0 + 0.5 * x1).astype(np.float32)
-            fitted = model.fit(X, y)
-            if _cancelled.is_set():
-                return
             xe1 = np.linspace(0.0, 100.0, eval_b, dtype=np.float32)
             Xe = np.tile(xe1[:, None], (1, n_features))
             ye = (1.0 + 0.5 * xe1).astype(np.float32)
-            fitted.evaluate(Xe, ye)
+            # compile exactly the program the trainer runs: the fused
+            # single-transfer fit+eval (models/fused.py)
+            model.fit_and_evaluate(X, y, Xe, ye)
             log.info(
                 f"pre-warmed {model_type} buckets fit={fit_b} eval={eval_b}"
             )

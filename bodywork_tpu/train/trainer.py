@@ -16,7 +16,9 @@ import pandas as pd
 
 from bodywork_tpu.data.io import load_all_datasets
 from bodywork_tpu.models import (
+    LinearConfig,
     LinearRegressor,
+    MLPConfig,
     MLPRegressor,
     Regressor,
     save_model,
@@ -40,11 +42,22 @@ class TrainResult:
 
 
 def make_model(model_type: str, **kwargs) -> Regressor:
+    """Build a model from a registry name plus either a ``config=`` object
+    or flat config fields (``make_model("mlp", n_steps=300)``) — the flat
+    form is what YAML pipeline specs can express (``StageSpec.args``)."""
     if model_type == "linear":
-        return LinearRegressor(**kwargs)
-    if model_type == "mlp":
-        return MLPRegressor(**kwargs)
-    raise ValueError(f"unknown model type: {model_type!r}")
+        cls, cfg_cls = LinearRegressor, LinearConfig
+    elif model_type == "mlp":
+        cls, cfg_cls = MLPRegressor, MLPConfig
+    else:
+        raise ValueError(f"unknown model type: {model_type!r}")
+    if "config" in kwargs:
+        return cls(**kwargs)
+    if kwargs:
+        if cfg_cls is MLPConfig and "hidden" in kwargs:
+            kwargs["hidden"] = tuple(kwargs["hidden"])
+        return cls(cfg_cls(**kwargs))
+    return cls()
 
 
 def persist_metrics(
@@ -93,9 +106,11 @@ def train_on_history(
     ds = load_all_datasets(store)
     split = train_test_split(ds.X, ds.y, test_size=test_size, seed=split_seed)
     model = make_model(model_type, **(model_kwargs or {}))
-    fitted = model.fit(split.X_train, split.y_train, seed=fit_seed)
-    # fused predict+metrics: one device dispatch on padded shapes
-    metrics = fitted.evaluate(split.X_test, split.y_test)
+    # fused fit+eval: one XLA program, one device->host transfer for params
+    # and metrics together (models/fused.py)
+    fitted, metrics = model.fit_and_evaluate(
+        split.X_train, split.y_train, split.X_test, split.y_test, seed=fit_seed
+    )
     log.info(
         f"trained {fitted.info} on {len(ds)} rows to {ds.date}: "
         f"MAPE={metrics['MAPE']:.4f} r2={metrics['r_squared']:.4f} "

@@ -169,3 +169,41 @@ def test_load_model_picks_latest(store, linear_data):
     loaded, d = load_model(store)
     assert d == date(2026, 7, 2)
     np.testing.assert_allclose(loaded.predict(X), m_new.predict(X), rtol=1e-6)
+
+
+def test_linear_fused_fit_eval_matches_separate(linear_data):
+    X, y = linear_data
+    split = train_test_split(X, y, test_size=0.2, seed=42)
+    sep = LinearRegressor().fit(split.X_train, split.y_train)
+    sep_metrics = sep.evaluate(split.X_test, split.y_test)
+    fused, fused_metrics = LinearRegressor().fit_and_evaluate(
+        split.X_train, split.y_train, split.X_test, split.y_test
+    )
+    np.testing.assert_allclose(fused.predict(X), sep.predict(X), rtol=1e-5)
+    for k in ("MAPE", "r_squared", "max_residual"):
+        np.testing.assert_allclose(fused_metrics[k], sep_metrics[k], rtol=1e-4)
+    # the fused path delivers a host param copy: checkpointing must not
+    # need a device fetch, and must round-trip identically
+    assert fused._host_params is not None
+    clone = load_model_bytes(save_model_bytes(fused))
+    np.testing.assert_allclose(clone.predict(X), fused.predict(X), rtol=1e-6)
+
+
+def test_mlp_fused_fit_eval_matches_separate(linear_data):
+    X, y = linear_data
+    split = train_test_split(X, y, test_size=0.2, seed=42)
+    cfg = MLPConfig(hidden=(16, 16), n_steps=200)
+    sep = MLPRegressor(cfg).fit(split.X_train, split.y_train)
+    fused, fused_metrics = MLPRegressor(cfg).fit_and_evaluate(
+        split.X_train, split.y_train, split.X_test, split.y_test
+    )
+    # same seed + same program structure => same fit
+    np.testing.assert_allclose(fused.predict(X), sep.predict(X), rtol=1e-4)
+    sep_metrics = sep.evaluate(split.X_test, split.y_test)
+    for k in ("MAPE", "r_squared", "max_residual"):
+        np.testing.assert_allclose(
+            fused_metrics[k], sep_metrics[k], rtol=1e-3, atol=1e-4
+        )
+    assert np.isfinite(fused.final_loss)
+    clone = load_model_bytes(save_model_bytes(fused))
+    np.testing.assert_allclose(clone.predict(X), fused.predict(X), rtol=1e-5)

@@ -117,3 +117,12 @@ def test_prewarm_async_dedupes():
     if t1 is not None:
         t1.join()
     assert t2 is None
+
+
+def test_make_model_flat_kwargs():
+    from bodywork_tpu.train.trainer import make_model
+
+    m = make_model("mlp", hidden=[8, 8], n_steps=50)
+    assert m.config.hidden == (8, 8) and m.config.n_steps == 50
+    m2 = make_model("linear", l2=0.5)
+    assert m2.config.l2 == 0.5
