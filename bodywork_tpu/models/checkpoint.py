@@ -77,14 +77,25 @@ def _unflatten_paths(arrays: dict[str, np.ndarray]):
     return _listify(root)
 
 
-def load_model_bytes(data: bytes):
-    """Reconstruct a fitted Regressor from npz bytes."""
+def load_model_bytes(data: bytes, device: bool = True):
+    """Reconstruct a fitted Regressor from npz bytes.
+
+    ``device=False`` keeps params as host numpy arrays — for callers that
+    may substitute an already-device-resident copy (see
+    ``pipeline.stages.serve_stage``) before paying the host->device
+    transfer.
+    """
     with np.load(io.BytesIO(data)) as npz:
         meta = json.loads(bytes(npz[_META_KEY]).decode())
         arrays = {k: npz[k] for k in npz.files if k != _META_KEY}
     cls = MODEL_REGISTRY[meta["model_type"]]
     params = _unflatten_paths(arrays)
-    return cls.from_config_dict(meta["config"], jax.device_put(params))
+    model = cls.from_config_dict(
+        meta["config"], jax.device_put(params) if device else params
+    )
+    if not device:
+        model._host_params = params
+    return model
 
 
 def save_model(store: ArtefactStore, model, artefact_date: date) -> str:
@@ -96,7 +107,7 @@ def save_model(store: ArtefactStore, model, artefact_date: date) -> str:
     return key
 
 
-def load_model(store: ArtefactStore, key: str | None = None):
+def load_model(store: ArtefactStore, key: str | None = None, device: bool = True):
     """Load a model by key, or the latest under ``models/`` if key is None
     (reference ``stage_2:46-70``). Returns (model, artefact_date)."""
     from bodywork_tpu.utils.dates import date_from_key
@@ -105,7 +116,7 @@ def load_model(store: ArtefactStore, key: str | None = None):
         key, d = store.latest(MODELS_PREFIX)
     else:
         d = date_from_key(key)
-    model = load_model_bytes(store.get_bytes(key))
+    model = load_model_bytes(store.get_bytes(key), device=device)
     log.info(f"loaded {model.info} from {key} (trained {d})")
     return model, d
 

@@ -133,7 +133,8 @@ class LinearRegressor(Regressor):
     def n_features(self) -> int | None:
         if self.params is None:
             return None
-        return int(np.asarray(self.params["w"]).shape[0])
+        # .shape only — np.asarray here would be a device->host fetch
+        return int(self.params["w"].shape[0])
 
     @property
     def info(self) -> str:

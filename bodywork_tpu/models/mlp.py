@@ -195,7 +195,8 @@ class MLPRegressor(Regressor):
     def n_features(self) -> int | None:
         if self.params is None:
             return None
-        return int(np.asarray(self.params["net"]["layers"][0]["w"]).shape[0])
+        # .shape only — np.asarray here would be a device->host fetch
+        return int(self.params["net"]["layers"][0]["w"].shape[0])
 
     @property
     def info(self) -> str:

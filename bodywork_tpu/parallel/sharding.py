@@ -123,3 +123,9 @@ class DataParallelPredictor(PaddedPredictor):
         # enqueues without paying a device->host transfer; the base
         # _predict_padded materialises this result for real requests
         return self._sharded_dispatch(Xp)
+
+    def _warm_key_extra(self) -> tuple:
+        return (
+            tuple(self.mesh.shape.items()),
+            tuple(d.id for d in self.mesh.devices.flat),
+        )

@@ -62,6 +62,14 @@ class LocalRunner:
         self.spec = spec
         self.store = store
         self.drift = drift or DriftConfig()
+        #: (date, box) handoff from a lookahead train to the next run_day
+        self._pending_train: tuple | None = None
+        #: dataset prefetch state: date -> {"ready": Event, "X", "y"},
+        #: filled by a single background worker (see _enqueue_generate)
+        self._dataset_boxes: dict[date, dict] = {}
+        self._gen_queue: list[tuple[date, dict]] = []
+        self._gen_worker: threading.Thread | None = None
+        self._gen_lock = threading.Lock()
         configure_logger(spec.log_level)
 
     # -- single stages -----------------------------------------------------
@@ -185,8 +193,101 @@ class LocalRunner:
             f"[{today}] {stage_name} done in {stage_seconds[stage_name]:.3f}s"
         )
 
+    def _generate_offsets(self) -> list[int]:
+        return [
+            s.args.get("offset_days", 1)
+            for s in self.spec.stages.values()
+            if s.executable.endswith(":generate_stage")
+        ]
+
+    def _enqueue_generate(self, targets: list[date]) -> None:
+        """Queue the generator's device sampling for the given dates on the
+        single background prefetch worker. The generator is a pure function
+        of (date, drift), so its device round-trips can run any time before
+        each date's generate stage; that stage waits on the box's ``ready``
+        event and only persists (at its proper DAG position, so stage-1
+        never sees tomorrow's file early). A multi-day simulation enqueues
+        its WHOLE horizon at day 0, keeping every sampling round-trip off
+        the critical path (a day is now shorter than one round-trip)."""
+        with self._gen_lock:
+            fresh = [t for t in targets if t not in self._dataset_boxes]
+            for t in fresh:
+                box = {"ready": threading.Event()}
+                self._dataset_boxes[t] = box
+                # queue carries the box itself: a stage popping its entry
+                # from _dataset_boxes must not break the worker
+                self._gen_queue.append((t, box))
+            if fresh and self._gen_worker is None:
+                self._gen_worker = threading.Thread(
+                    target=self._generate_worker,
+                    name="dataset-prefetch",
+                    daemon=True,
+                )
+                self._gen_worker.start()
+
+    def _generate_worker(self) -> None:
+        while True:
+            with self._gen_lock:
+                if not self._gen_queue:
+                    self._gen_worker = None
+                    return
+                target, box = self._gen_queue.pop(0)
+            try:
+                X, y = generate_day(target, self.drift)
+                box["X"], box["y"] = X, y
+            except Exception as exc:  # stage falls back to inline
+                log.warning(f"dataset prefetch failed (non-fatal): {exc!r}")
+            finally:
+                box["ready"].set()
+
+    def _start_lookahead_train(self, tomorrow: date) -> None:
+        """Train tomorrow's model NOW, on a background thread — tomorrow's
+        training set is complete the moment today's generate stage persists
+        its dataset, so the train overlaps today's test stage. Tomorrow's
+        ``train_stage`` collects the result (``ctx.prefetched_train``)."""
+        train_spec = next(
+            (
+                s
+                for s in self.spec.stages.values()
+                if s.executable.endswith(":train_stage")
+            ),
+            None,
+        )
+        if train_spec is None:
+            return
+        ctx_next = StageContext(
+            store=self.store,
+            today=tomorrow,
+            drift=self.drift,
+            persistent_process=True,
+            # compute only: artefacts are written when tomorrow's train
+            # stage collects the result, so an aborted day never leaves a
+            # future-dated model in the store
+            defer_artefacts=True,
+        )
+        fn = resolve_executable(train_spec.executable)
+        box: dict = {}
+
+        def _work():
+            try:
+                box["result"] = fn(ctx_next, **train_spec.args)
+            except BaseException as exc:  # tomorrow's stage retrains inline
+                box["exc"] = exc
+
+        t = threading.Thread(
+            target=_work, name=f"lookahead-train-{tomorrow}", daemon=True
+        )
+        box["thread"] = t
+        t.start()
+        self._pending_train = (tomorrow, box)
+
     # -- DAG execution -----------------------------------------------------
-    def run_day(self, today: date, scoring_url: str | None = None) -> DayResult:
+    def run_day(
+        self,
+        today: date,
+        scoring_url: str | None = None,
+        lookahead_train: bool = False,
+    ) -> DayResult:
         ctx = StageContext(
             store=self.store,
             today=today,
@@ -194,8 +295,21 @@ class LocalRunner:
             scoring_url=scoring_url,
             persistent_process=True,
         )
+        pending = getattr(self, "_pending_train", None)
+        if pending is not None and pending[0] == today:
+            ctx.prefetched_train = pending[1]
+        self._pending_train = None
+        self._enqueue_generate(
+            [today + timedelta(days=o) for o in self._generate_offsets()]
+        )
+        ctx.prefetched_datasets = self._dataset_boxes
+        gen_stages = {
+            name
+            for name, s in self.spec.stages.items()
+            if s.executable.endswith(":generate_stage")
+        }
         stage_seconds: dict[str, float] = {}
-        stage_results: dict[str, object] = {}
+        stage_results = ctx.stage_results
         day_start = time.perf_counter()
         try:
             for step in self.spec.dag:
@@ -222,6 +336,16 @@ class LocalRunner:
                     failed = [n for n in step if n in ctx.failures]
                     if failed:
                         raise ctx.failures[failed[0]]
+                # tomorrow's training set is complete once every generate
+                # stage has persisted: overlap tomorrow's train with the
+                # rest of today (typically the test stage)
+                if (
+                    lookahead_train
+                    and gen_stages
+                    and gen_stages <= set(stage_results)
+                ):
+                    self._start_lookahead_train(today + timedelta(days=1))
+                    lookahead_train = False
         finally:
             for name, handle in ctx.services.items():
                 handle.stop()
@@ -286,10 +410,18 @@ class LocalRunner:
         tests the live service against it."""
         self.bootstrap(start)
         self._prewarm_horizon(days)
+        # queue every sampling round-trip of the horizon off-path now
+        self._enqueue_generate(
+            [
+                start + timedelta(days=i + o)
+                for i in range(days)
+                for o in self._generate_offsets()
+            ]
+        )
         results = []
         for i in range(days):
             today = start + timedelta(days=i)
-            result = self.run_day(today)
+            result = self.run_day(today, lookahead_train=(i < days - 1))
             results.append(result)
             log.info(f"simulated day {today}: {result.wall_clock_s:.2f}s wall-clock")
         return results

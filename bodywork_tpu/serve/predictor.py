@@ -22,6 +22,12 @@ log = get_logger("serve.predictor")
 
 DEFAULT_BUCKETS = (1, 8, 64, 512, 4096)
 
+#: (predictor class, model class, n_features, bucket, extra) shapes already
+#: dispatched this process — the jit cache holds their executables, so
+#: re-warming them (e.g. the day-loop re-serving daily) would only pay a
+#: pointless host->device transfer per bucket
+_WARMED_SHAPES: set[tuple] = set()
+
 
 class PaddedPredictor:
     """Bucket-padding predictor over ``model.predict``.
@@ -60,19 +66,47 @@ class PaddedPredictor:
         already executed these exact shapes in this process (the local
         day-loop re-serving each day).
         """
+        import jax
+
         if n_features is None:
             n_features = self.model.n_features or 1
-        results = [
-            self._dispatch_padded(np.zeros((b, n_features), dtype=np.float32))
-            for b in self.buckets
-        ]
-        if sync:
-            import jax
-
-            jax.block_until_ready(results)
-        log.info(
-            f"warmed up predict buckets {self.buckets} (n_features={n_features})"
+        # the compiled program depends on every param leaf's shape (two
+        # same-class models with different widths compile differently), so
+        # fingerprint them into the dedup key
+        shapes = tuple(
+            tuple(leaf.shape)
+            for leaf in jax.tree_util.tree_leaves(self.model.params)
         )
+        extra = self._warm_key_extra()
+        results, added = [], []
+        try:
+            for b in self.buckets:
+                key = (type(self), type(self.model), shapes, n_features, b, extra)
+                if key in _WARMED_SHAPES:
+                    continue
+                results.append(
+                    self._dispatch_padded(
+                        np.zeros((b, n_features), dtype=np.float32)
+                    )
+                )
+                # only a successful dispatch counts as warmed
+                _WARMED_SHAPES.add(key)
+                added.append(key)
+            if sync and results:
+                jax.block_until_ready(results)
+        except BaseException:
+            # a failed warm must be retryable, not silently skipped forever
+            _WARMED_SHAPES.difference_update(added)
+            raise
+        log.info(
+            f"warmed up predict buckets {self.buckets} (n_features={n_features},"
+            f" {len(results)} new)"
+        )
+
+    def _warm_key_extra(self) -> tuple:
+        """Extra warm-cache key material for subclasses whose compiled
+        program depends on more than (model class, shape) — e.g. the mesh."""
+        return ()
 
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:

@@ -1,4 +1,15 @@
 from bodywork_tpu.train.prewarm import prewarm_async
-from bodywork_tpu.train.trainer import TrainResult, persist_metrics, train_on_history
+from bodywork_tpu.train.trainer import (
+    TrainResult,
+    persist_metrics,
+    persist_train_result,
+    train_on_history,
+)
 
-__all__ = ["TrainResult", "persist_metrics", "prewarm_async", "train_on_history"]
+__all__ = [
+    "TrainResult",
+    "persist_metrics",
+    "persist_train_result",
+    "prewarm_async",
+    "train_on_history",
+]

@@ -36,9 +36,23 @@ class TrainResult:
     model: Regressor
     metrics: dict[str, float]
     data_date: date
-    model_artefact_key: str
-    metrics_artefact_key: str
+    #: None until the artefacts are persisted (see ``persist_train_result``
+    #: — a lookahead train defers persistence to its stage's DAG position)
+    model_artefact_key: str | None
+    metrics_artefact_key: str | None
     n_rows: int
+
+
+def persist_train_result(store: ArtefactStore, result: TrainResult) -> TrainResult:
+    """Write a computed-but-unpersisted TrainResult's model + metrics
+    artefacts and return the result with its keys filled in."""
+    model_key_ = save_model(store, result.model, result.data_date)
+    metrics_key = persist_metrics(store, result.metrics, result.data_date)
+    return dataclasses.replace(
+        result,
+        model_artefact_key=model_key_,
+        metrics_artefact_key=metrics_key,
+    )
 
 
 def make_model(model_type: str, **kwargs) -> Regressor:
@@ -90,6 +104,7 @@ def train_on_history(
     model_kwargs: dict | None = None,
     prewarm_next: bool = False,
     rows_per_day: int | None = None,
+    persist: bool = True,
 ) -> TrainResult:
     """Run the full train stage against an artefact store.
 
@@ -116,8 +131,14 @@ def train_on_history(
         f"MAPE={metrics['MAPE']:.4f} r2={metrics['r_squared']:.4f} "
         f"max_resid={metrics['max_residual']:.2f}"
     )
-    model_key_ = save_model(store, fitted, ds.date)
-    metrics_key = persist_metrics(store, metrics, ds.date)
+    # persist=False defers the artefact writes to the caller (a lookahead
+    # train must not mutate the store before its stage's DAG position —
+    # an aborted day would otherwise leave a future-dated model behind)
+    if persist:
+        model_key_ = save_model(store, fitted, ds.date)
+        metrics_key = persist_metrics(store, metrics, ds.date)
+    else:
+        model_key_ = metrics_key = None
     if prewarm_next:
         from bodywork_tpu.data.generator import DriftConfig
         from bodywork_tpu.train.prewarm import prewarm_async, register_compiled
