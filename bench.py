@@ -8,24 +8,151 @@ cost — 8.22 ms/request x 1317 rows = 10.83 s for the stage-4 loop alone
 which *understates* the reference's full day (it excludes train/generate/
 deploy overhead), so ``vs_baseline`` = baseline_s / ours_s is conservative.
 
-Protocol: bootstrap a fresh store, run a multi-day simulation with the
-jitted linear regressor and batched scoring, report the mean wall-clock of
-the steady-state days (day 1 pays one-time XLA compiles and is excluded).
+``--config N`` selects a BASELINE.json config (default 2):
+
+1. single simulated day, in-process train+serve (includes first-compile)
+2. jitted linear regressor, 7-day drift loop with daily retrain (default)
+3. 3-layer MLP, 30-day drift loop with daily retrain + test
+4. batched scoring: 1k-row requests through the data-parallel service
+5. two concurrent A/B pipelines (linear vs MLP) sharing the pool
+
+Protocol (configs 2/3/5): bootstrap a fresh store, run the multi-day
+simulation, report the mean wall-clock of the steady-state days (day 1
+pays one-time XLA compiles and is excluded). Config 4 reports mean seconds
+per 1k-row scoring request; config 1 reports the single day.
 
 Prints ONE JSON line to stdout; progress goes to stderr.
 """
 from __future__ import annotations
 
+import argparse
 import json
 import sys
 import tempfile
+import time
 from datetime import date
 
 BASELINE_DAY_S = 1317 * 0.00822  # reference stage-4 scoring loop, see above
-SIM_DAYS = 5
+BASELINE_REQUEST_S = 0.00822  # reference per-request scoring latency
+
+
+def _steady_mean(results) -> float:
+    steady = [r.wall_clock_s for r in results[1:]] or [results[0].wall_clock_s]
+    return sum(steady) / len(steady)
+
+
+def _run_sim(model_type: str, days: int, model_kwargs=None):
+    from bodywork_tpu.pipeline import LocalRunner, default_pipeline
+    from bodywork_tpu.store import FilesystemStore
+
+    store = FilesystemStore(tempfile.mkdtemp(prefix="bench-store-"))
+    spec = default_pipeline(
+        model_type=model_type, scoring_mode="batch", overlap_generate=True
+    )
+    if model_kwargs:
+        spec.stages["stage-1-train-model"].args.update(model_kwargs)
+    runner = LocalRunner(spec, store)
+    results = runner.run_simulation(date(2026, 1, 1), days)
+    for r in results:
+        print(f"  day {r.day}: {r.wall_clock_s:.3f}s", file=sys.stderr)
+    return results
+
+
+def bench_day_loop(model_type: str, days: int, model_kwargs=None) -> dict:
+    value = _steady_mean(_run_sim(model_type, days, model_kwargs))
+    return {
+        "metric": "e2e_day_wallclock",
+        "value": round(value, 4),
+        "unit": "s/day",
+        "vs_baseline": round(BASELINE_DAY_S / value, 2),
+    }
+
+
+def bench_single_day() -> dict:
+    results = _run_sim("linear", 1)
+    value = results[0].wall_clock_s
+    return {
+        "metric": "e2e_single_day_wallclock",
+        "value": round(value, 4),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_DAY_S / value, 2),
+    }
+
+
+def bench_batched_scoring(rows: int = 1000, requests: int = 20) -> dict:
+    """Config 4: 1k-row predict requests through the (data-parallel when
+    the pool allows) scoring service."""
+    import jax
+    import numpy as np
+
+    from bodywork_tpu.data import Dataset, generate_day, persist_dataset
+    from bodywork_tpu.serve import serve_latest_model
+    from bodywork_tpu.store import FilesystemStore
+    from bodywork_tpu.train import train_on_history
+
+    store = FilesystemStore(tempfile.mkdtemp(prefix="bench-score-"))
+    d = date(2026, 1, 1)
+    X, y = generate_day(d)
+    persist_dataset(store, Dataset(X, y, d))
+    train_on_history(store, "linear")
+    n_dev = len(jax.devices())
+    handle = serve_latest_model(
+        store,
+        host="127.0.0.1",
+        port=0,
+        block=False,
+        mesh_data=n_dev if n_dev > 1 else None,
+    )
+    try:
+        import requests as rq
+
+        url = handle.url + "/batch"
+        rng = np.random.default_rng(0)
+        payload = {"X": [float(v) for v in rng.uniform(0, 100, rows)]}
+        rq.post(url, json=payload, timeout=30)  # warm
+        t0 = time.perf_counter()
+        for _ in range(requests):
+            resp = rq.post(url, json=payload, timeout=30)
+            assert resp.ok and len(resp.json()["predictions"]) == rows
+        value = (time.perf_counter() - t0) / requests
+    finally:
+        handle.stop()
+    return {
+        "metric": "batched_1k_request_latency",
+        "value": round(value, 5),
+        "unit": "s/request",
+        # reference scores serially at 8.22 ms/row => 1k rows = 8.22 s
+        "vs_baseline": round(rows * BASELINE_REQUEST_S / value, 2),
+    }
+
+
+def bench_ab(days: int = 5) -> dict:
+    from bodywork_tpu.pipeline import run_ab_simulation, variants_from_model_types
+
+    root = tempfile.mkdtemp(prefix="bench-ab-")
+    variants = variants_from_model_types(["linear", "mlp"])
+    t0 = time.perf_counter()
+    results = run_ab_simulation(variants, root, date(2026, 1, 1), days)
+    total = time.perf_counter() - t0
+    for name, vr in results.items():
+        if vr.error is not None:
+            raise SystemExit(f"variant {name} failed: {vr.error!r}")
+        print(f"  {name}: {_steady_mean(vr.results):.3f}s/day steady", file=sys.stderr)
+    # N pipelines' days delivered per wall-clock second vs one reference day
+    value = total / (len(variants) * days)
+    return {
+        "metric": "ab_day_wallclock_per_pipeline_day",
+        "value": round(value, 4),
+        "unit": "s/pipeline-day",
+        "vs_baseline": round(BASELINE_DAY_S / value, 2),
+    }
 
 
 def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", type=int, default=2, choices=[1, 2, 3, 4, 5])
+    args = parser.parse_args()
+
     import jax
 
     from bodywork_tpu.utils.logging import configure_logger
@@ -33,34 +160,20 @@ def main() -> int:
     configure_logger(stream=sys.stderr)  # keep stdout = the one JSON line
     print(f"bench devices: {jax.devices()}", file=sys.stderr)
 
-    from bodywork_tpu.pipeline import LocalRunner, default_pipeline
-    from bodywork_tpu.store import FilesystemStore
-
-    store = FilesystemStore(tempfile.mkdtemp(prefix="bench-store-"))
-    runner = LocalRunner(
-        default_pipeline(
-            model_type="linear", scoring_mode="batch", overlap_generate=True
-        ),
-        store,
-    )
-    results = runner.run_simulation(date(2026, 1, 1), SIM_DAYS)
-    for r in results:
-        print(f"  day {r.day}: {r.wall_clock_s:.3f}s", file=sys.stderr)
-
-    steady = [r.wall_clock_s for r in results[1:]] or [
-        results[0].wall_clock_s
-    ]
-    value = sum(steady) / len(steady)
-    print(
-        json.dumps(
-            {
-                "metric": "e2e_day_wallclock",
-                "value": round(value, 4),
-                "unit": "s/day",
-                "vs_baseline": round(BASELINE_DAY_S / value, 2),
-            }
+    if args.config == 1:
+        record = bench_single_day()
+    elif args.config == 2:
+        record = bench_day_loop("linear", days=7)
+    elif args.config == 3:
+        record = bench_day_loop(
+            "mlp", days=30, model_kwargs={"hidden": [64, 64, 64]}
         )
-    )
+    elif args.config == 4:
+        record = bench_batched_scoring()
+    else:
+        record = bench_ab()
+    record["config"] = args.config
+    print(json.dumps(record))
     return 0
 
 

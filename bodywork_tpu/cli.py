@@ -10,6 +10,7 @@ its own driver:
     python -m bodywork_tpu.cli test      --store DIR --scoring-url URL
     python -m bodywork_tpu.cli run-day   --store DIR [--date D]
     python -m bodywork_tpu.cli run-sim   --store DIR --days N [--model ...]
+    python -m bodywork_tpu.cli run-ab    --store DIR --days N [--models a,b]
     python -m bodywork_tpu.cli run-stage --store DIR --stage NAME ...
     python -m bodywork_tpu.cli report    --store DIR
     python -m bodywork_tpu.cli deploy    --out DIR [--store-path P] [--image I]
@@ -125,6 +126,26 @@ def cmd_run_sim(args) -> int:
     print(f"total {total:.3f}s over {args.days} day(s), "
           f"mean {total / max(args.days, 1):.3f}s/day")
     return 0
+
+
+def cmd_run_ab(args) -> int:
+    """Run N model variants as concurrent isolated pipelines sharing the
+    device pool (BASELINE.json config 5) and print the comparison."""
+    from bodywork_tpu.pipeline import (
+        compare_report,
+        run_ab_simulation,
+        variants_from_model_types,
+    )
+
+    variants = variants_from_model_types(args.models.split(","))
+    results = run_ab_simulation(variants, args.store, _date(args), args.days)
+    failed = [v for v in results.values() if v.error is not None]
+    report = compare_report(results)
+    if not report.empty:
+        print(report.to_string(index=False))
+    for v in failed:
+        print(f"variant {v.name} FAILED: {v.error!r}")
+    return 1 if failed else 0
 
 
 def cmd_run_stage(args) -> int:
@@ -267,6 +288,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--date", default=None, help="start date (YYYY-MM-DD)")
     p.add_argument("--model", default="linear", choices=["linear", "mlp"])
     p.add_argument("--mode", default="batch", choices=["single", "batch"])
+
+    p = add("run-ab", cmd_run_ab,
+            help="concurrent A/B model pipelines on one device pool")
+    p.add_argument("--store", **common_store)
+    p.add_argument("--days", type=int, required=True)
+    p.add_argument("--date", default=None, help="start date (YYYY-MM-DD)")
+    p.add_argument("--models", default="linear,mlp",
+                   help="comma-separated model types, one pipeline each")
 
     p = add("run-stage", cmd_run_stage, help="run one pipeline stage (pod entrypoint)")
     p.add_argument("--spec", default=None, help="pipeline spec YAML (overrides --model/--mode)")

@@ -7,8 +7,20 @@ from bodywork_tpu.pipeline.spec import (
 )
 from bodywork_tpu.pipeline.runner import DayResult, LocalRunner, StageFailure
 from bodywork_tpu.pipeline.k8s import generate_manifests, write_manifests
+from bodywork_tpu.pipeline.ab import (
+    PipelineVariant,
+    VariantResult,
+    compare_report,
+    run_ab_simulation,
+    variants_from_model_types,
+)
 
 __all__ = [
+    "PipelineVariant",
+    "VariantResult",
+    "compare_report",
+    "run_ab_simulation",
+    "variants_from_model_types",
     "PipelineSpec",
     "ResourceSpec",
     "StageSpec",

@@ -1,0 +1,159 @@
+"""Concurrent A/B pipelines sharing one TPU pool (BASELINE.json config 5).
+
+The reference can only run one pipeline per Bodywork deployment; comparing
+two models means two cluster deployments. Here N variants (e.g. an A/B
+model comparison) run concurrently in one process against one device pool:
+
+- **store isolation**: each variant gets its own namespace directory, so
+  the four-prefix artefact schema never collides;
+- **device isolation**: the pool is partitioned into disjoint device
+  groups (``parallel.split_devices``) and each variant's runner pins ALL
+  its computations — including its own worker threads (prefetch, lookahead
+  train, concurrent DAG steps) — to its group's lead chip via the runner's
+  ``device`` knob. On a v5e-8 with two variants, each owns a 4-chip group
+  (serving can additionally shard over the group via ``mesh_data``). With
+  fewer devices than variants the pool is shared (single-chip dev boxes
+  still work, just without isolation).
+
+The per-variant loop is the standard :class:`LocalRunner` daily
+simulation, so every overlap optimisation (lookahead train, prefetch)
+applies per variant, and variants additionally overlap each other.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from datetime import date
+from pathlib import Path
+
+from bodywork_tpu.data.generator import DriftConfig
+from bodywork_tpu.pipeline.runner import DayResult, LocalRunner
+from bodywork_tpu.pipeline.spec import PipelineSpec, default_pipeline
+from bodywork_tpu.store import ArtefactStore, FilesystemStore
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("pipeline.ab")
+
+
+@dataclasses.dataclass
+class PipelineVariant:
+    """One arm of a concurrent comparison."""
+
+    name: str
+    spec: PipelineSpec
+    drift: DriftConfig | None = None
+
+
+@dataclasses.dataclass
+class VariantResult:
+    name: str
+    results: list[DayResult]
+    store: "ArtefactStore"
+    error: BaseException | None = None
+
+
+def variants_from_model_types(model_types: list[str]) -> list[PipelineVariant]:
+    """Shorthand: one variant per model type, e.g. ``["linear", "mlp"]``."""
+    return [
+        PipelineVariant(
+            name=f"{chr(ord('a') + i)}-{mt}",
+            spec=default_pipeline(model_type=mt, scoring_mode="batch",
+                                  overlap_generate=True),
+        )
+        for i, mt in enumerate(model_types)
+    ]
+
+
+def run_ab_simulation(
+    variants: list[PipelineVariant],
+    root: str | Path,
+    start: date,
+    days: int,
+    devices=None,
+) -> dict[str, VariantResult]:
+    """Run every variant's N-day simulation concurrently.
+
+    Each variant writes to ``<root>/<variant.name>/`` (``root`` may be a
+    local path or a ``gs://`` URL) and, when the pool divides evenly,
+    computes only on its own disjoint device group. Returns per-variant
+    results; a failed variant carries its error rather than killing its
+    siblings (they are independent deployments).
+    """
+    import jax
+
+    from bodywork_tpu.parallel.mesh import split_devices
+
+    names = [v.name for v in variants]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate variant names: {names}")
+
+    pool = list(devices if devices is not None else jax.devices())
+    if len(variants) > 1 and len(pool) % len(variants) == 0 and len(pool) >= len(variants):
+        groups = split_devices(len(variants), pool)
+    else:
+        if len(variants) > 1:
+            log.warning(
+                f"{len(pool)} device(s) not partitionable into "
+                f"{len(variants)} groups; variants share the pool"
+            )
+        groups = [None] * len(variants)
+
+    out: dict[str, VariantResult] = {}
+
+    def _variant_store(name: str):
+        from bodywork_tpu.store import open_store
+
+        if isinstance(root, str) and "://" in root:
+            return open_store(root.rstrip("/") + "/" + name)
+        return FilesystemStore(Path(root) / name)
+
+    def _run(variant: PipelineVariant, group) -> None:
+        store = _variant_store(variant.name)
+        # the runner's device knob pins every thread it spawns (DAG step
+        # threads, prefetch worker, lookahead train) — a bare
+        # jax.default_device() here would be thread-local and miss them
+        runner = LocalRunner(
+            variant.spec,
+            store,
+            drift=variant.drift,
+            device=group[0] if group else None,
+        )
+        try:
+            results = runner.run_simulation(start, days)
+            out[variant.name] = VariantResult(variant.name, results, store)
+        except BaseException as exc:
+            log.error(f"variant {variant.name} failed: {exc!r}")
+            out[variant.name] = VariantResult(variant.name, [], store, exc)
+
+    threads = [
+        threading.Thread(
+            target=_run, args=(v, g), name=f"pipeline-{v.name}"
+        )
+        for v, g in zip(variants, groups)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return out
+
+
+def compare_report(results: dict[str, VariantResult]):
+    """Side-by-side drift report: one row per (day, variant) with the
+    train/live metric gap — the A/B deliverable."""
+    import pandas as pd
+
+    from bodywork_tpu.monitor.analytics import drift_report
+
+    frames = []
+    for name, vr in results.items():
+        if vr.error is not None:
+            continue
+        rep = drift_report(vr.store)
+        rep.insert(0, "variant", name)
+        frames.append(rep)
+    if not frames:
+        return pd.DataFrame()
+    return pd.concat(frames, ignore_index=True).sort_values(
+        ["date", "variant"]
+    ).reset_index(drop=True)

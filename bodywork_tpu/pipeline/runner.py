@@ -39,6 +39,17 @@ class StageFailure(StageError):
     """A stage exhausted its retries."""
 
 
+def _device_ctx(device):
+    """jax.default_device(device), or a no-op when device is None."""
+    if device is None:
+        import contextlib
+
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.default_device(device)
+
+
 @dataclasses.dataclass
 class DayResult:
     day: date
@@ -58,10 +69,15 @@ def resolve_executable(path: str):
 
 class LocalRunner:
     def __init__(self, spec: PipelineSpec, store: ArtefactStore,
-                 drift: DriftConfig | None = None):
+                 drift: DriftConfig | None = None, device=None):
         self.spec = spec
         self.store = store
         self.drift = drift or DriftConfig()
+        #: pin ALL this runner's computations — including its own worker
+        #: threads — to one jax device (device isolation for concurrent
+        #: pipelines sharing a pool; jax.default_device alone is
+        #: thread-local and would miss the spawned threads)
+        self.device = device
         #: (date, box) handoff from a lookahead train to the next run_day
         self._pending_train: tuple | None = None
         #: dataset prefetch state: date -> {"ready": Event, "X", "y"},
@@ -87,7 +103,8 @@ class LocalRunner:
 
             def _target():
                 try:
-                    box["result"] = fn(ctx, **stage.args)
+                    with _device_ctx(self.device):
+                        box["result"] = fn(ctx, **stage.args)
                 except BaseException as exc:  # noqa: BLE001 — reported below
                     box["exc"] = exc
 
@@ -133,7 +150,8 @@ class LocalRunner:
     def _start_and_health_gate(self, stage: StageSpec, ctx: StageContext):
         fn = resolve_executable(stage.executable)
         deadline = time.monotonic() + stage.max_startup_time_s
-        handle = fn(ctx, **stage.args)
+        with _device_ctx(self.device):
+            handle = fn(ctx, **stage.args)
         # health-check before the DAG proceeds (k8s readiness probe analogue)
         import requests
 
@@ -233,7 +251,8 @@ class LocalRunner:
                     return
                 target, box = self._gen_queue.pop(0)
             try:
-                X, y = generate_day(target, self.drift)
+                with _device_ctx(self.device):
+                    X, y = generate_day(target, self.drift)
                 box["X"], box["y"] = X, y
             except Exception as exc:  # stage falls back to inline
                 log.warning(f"dataset prefetch failed (non-fatal): {exc!r}")
@@ -270,7 +289,8 @@ class LocalRunner:
 
         def _work():
             try:
-                box["result"] = fn(ctx_next, **train_spec.args)
+                with _device_ctx(self.device):
+                    box["result"] = fn(ctx_next, **train_spec.args)
             except BaseException as exc:  # tomorrow's stage retrains inline
                 box["exc"] = exc
 
@@ -361,7 +381,8 @@ class LocalRunner:
         """Seed day-0 data if the store has none (the reference bootstraps by
         hand-running the stage-3 notebook before the first deployment)."""
         if not self.store.history(DATASETS_PREFIX):
-            X, y = generate_day(start, self.drift)
+            with _device_ctx(self.device):
+                X, y = generate_day(start, self.drift)
             persist_dataset(self.store, Dataset(X, y, start))
             log.info(f"bootstrapped day-0 dataset for {start}")
 
