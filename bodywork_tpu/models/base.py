@@ -113,15 +113,22 @@ class Regressor(abc.ABC):
         X_test: np.ndarray,
         y_test: np.ndarray,
         seed: int | None = None,
-    ) -> tuple["Regressor", dict[str, float]]:
+        materialize: bool = True,
+    ) -> tuple["Regressor", dict[str, float]] | tuple[None, None]:
         """Fit on the train split and score the held-out split.
 
         Default implementation is fit-then-evaluate (several device
         round-trips); Linear/MLP override it with a single fused XLA
         program whose result comes back in ONE device->host transfer
-        (see :mod:`bodywork_tpu.models.fused`).
+        (see :mod:`bodywork_tpu.models.fused`). ``materialize=False`` is
+        the prewarming mode: compile both programs and return
+        ``(None, None)`` — the fused overrides additionally skip the host
+        fetch entirely; this generic fallback still blocks on the fit.
         """
         fitted = self.fit(X_train, y_train, seed=seed)
+        if not materialize:
+            fitted.evaluate(X_test, y_test)  # compile the eval program too
+            return None, None
         return fitted, fitted.evaluate(X_test, y_test)
 
     @staticmethod

@@ -112,10 +112,14 @@ class LinearRegressor(Regressor):
         X_test: np.ndarray,
         y_test: np.ndarray,
         seed: int | None = None,
-    ) -> tuple["LinearRegressor", dict[str, float]]:
+        materialize: bool = True,
+    ) -> tuple["LinearRegressor", dict[str, float]] | tuple[None, None]:
         """Fused fit + held-out metrics: one XLA program, ONE device->host
         transfer for params and metrics together (vs fit/eval/fetch costing
-        ~5 tunnel round-trips — see models/fused.py)."""
+        ~5 tunnel round-trips — see models/fused.py).
+
+        ``materialize=False`` only compiles + dispatches (for bucket
+        prewarming): no host fetch, no blocking, returns ``(None, None)``."""
         Xtr, ytr, wtr, Xte, yte, wte = self._pad_splits(
             X_train, y_train, X_test, y_test
         )
@@ -124,6 +128,8 @@ class LinearRegressor(Regressor):
             jnp.float32(self.config.l2),
             fit_intercept=self.config.fit_intercept,
         )
+        if not materialize:
+            return None, None
         host_params, tail = unpack_tree_with_tail(np.asarray(packed), params, 3)
         fitted = LinearRegressor(self.config, params)
         fitted._host_params = host_params

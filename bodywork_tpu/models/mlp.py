@@ -174,9 +174,13 @@ class MLPRegressor(Regressor):
         X_test: np.ndarray,
         y_test: np.ndarray,
         seed: int | None = None,
-    ) -> tuple["MLPRegressor", dict[str, float]]:
+        materialize: bool = True,
+    ) -> tuple["MLPRegressor", dict[str, float]] | tuple[None, None]:
         """Fused scaler+init+scan-train+metrics in one XLA program; host
-        receives params, metrics, and the final loss in ONE transfer."""
+        receives params, metrics, and the final loss in ONE transfer.
+
+        ``materialize=False`` only compiles + dispatches (for bucket
+        prewarming): no host fetch, no blocking, returns ``(None, None)``."""
         from bodywork_tpu.models.fused import metrics_dict, unpack_tree_with_tail
 
         cfg = self.config
@@ -185,6 +189,8 @@ class MLPRegressor(Regressor):
         )
         key = jax.random.PRNGKey(cfg.seed if seed is None else seed)
         params, packed = _mlp_fit_eval(Xp, yp, w, Xe, ye, we, key, cfg)
+        if not materialize:
+            return None, None
         host_params, tail = unpack_tree_with_tail(np.asarray(packed), params, 4)
         fitted = MLPRegressor(cfg, params)
         fitted._host_params = host_params

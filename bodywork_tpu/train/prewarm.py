@@ -1,17 +1,27 @@
-"""Background pre-compilation of the next day's train/eval row buckets.
+"""Background pre-compilation of the next days' train/eval row buckets.
 
 The daily retrain pads the growing dataset history into power-of-two row
 buckets (``models.base.pad_rows``) so the number of distinct XLA programs
 stays logarithmic in history size — but the first day whose history crosses
 into a new bucket still pays that bucket's compile on the critical path
-(~1.3 s measured on v5e). Tomorrow's row count is bounded by today's plus
-the generator's per-day sample count, and buckets are monotone in row
-count, so tomorrow's buckets are knowable *today*: compile them now, on a
-daemon thread, overlapped with the serve/generate/test stages.
+(~1.3 s for the linear program, several seconds for the MLP scan). Bucket
+row counts are knowable ahead of time (monotone in history size), so they
+are compiled early, off the critical path.
+
+Two design constraints learned the hard way:
+
+- Warm by **dispatch only** (``fit_and_evaluate(materialize=False)``):
+  fetching the result would block on a full dummy training run, which on a
+  slow backend (CPU CI) starves the real pipeline. Compilation is
+  synchronous at dispatch time, which is all the jit cache needs.
+- Warm through **one serialized worker**: a thread per bucket compiles
+  N programs concurrently and contends with the day loop for host CPU;
+  the queue keeps at most one background compile in flight, in request
+  order (enqueue nearest-day buckets first).
 
 This removes the per-bucket-crossing latency spike from the steady-state
 day loop entirely (the reference has no analogue — sklearn on CPU has no
-compile step, which is exactly why the TPU port must hide this cost).
+compile step, which is exactly why the TPU build must hide this cost).
 """
 from __future__ import annotations
 
@@ -25,30 +35,30 @@ from bodywork_tpu.utils.logging import get_logger
 
 log = get_logger("train.prewarm")
 
-#: buckets already compiled (or being compiled) this process, keyed by
-#: (model_type, frozen model kwargs, fit bucket, eval bucket)
+#: buckets already compiled (or queued to compile) this process, keyed by
+#: (model_type, frozen model kwargs, fit bucket, eval bucket, n_features)
 _warmed: set[tuple] = set()
+_queue: list[tuple] = []
+_worker: threading.Thread | None = None
 _lock = threading.Lock()
-_live: list[threading.Thread] = []
 _cancelled = threading.Event()
 
 
 @atexit.register
 def _drain() -> None:
-    """Join in-flight warm threads before interpreter teardown: killing a
-    daemon thread mid-XLA-compile aborts the whole process (pthread
-    cancellation unwinds through C++ noexcept frames -> std::terminate).
-    The cancel flag stops threads that haven't started their fit yet, so
-    exit blocks on at most the one in-flight XLA call — not on dummy
-    trainings for buckets no future day will use."""
+    """Stop the worker before interpreter teardown: killing a daemon thread
+    mid-XLA-compile aborts the whole process (pthread cancellation unwinds
+    through C++ noexcept frames -> std::terminate). The cancel flag drops
+    queued buckets; exit blocks on at most the one in-flight compile."""
     import logging
 
-    # log streams (e.g. pytest capture) may already be closed at exit;
-    # don't let the warm thread's completion log print handler diagnostics
+    # log streams (e.g. pytest capture) may already be closed at exit
     logging.raiseExceptions = False
     _cancelled.set()
-    for t in list(_live):
-        t.join()
+    with _lock:
+        worker = _worker
+    if worker is not None:
+        worker.join()
 
 
 def _key(
@@ -78,11 +88,45 @@ def register_compiled(
     n_features: int = 1,
 ) -> None:
     """Record that a real fit just compiled the buckets for ``n_total``
-    rows, so ``prewarm_async`` never re-runs a dummy fit of a bucket the
-    jit cache already holds."""
+    rows, so ``prewarm_async`` never re-queues a bucket the jit cache
+    already holds."""
     fit_b, eval_b = next_buckets(n_total, test_size)
     with _lock:
         _warmed.add(_key(model_type, model_kwargs, fit_b, eval_b, n_features))
+
+
+def _work_loop() -> None:
+    global _worker
+    while True:
+        with _lock:
+            if not _queue or _cancelled.is_set():
+                _worker = None
+                return
+            model_type, model_kwargs, fit_b, eval_b, n_features, key = (
+                _queue.pop(0)
+            )
+        try:
+            from bodywork_tpu.train.trainer import make_model
+
+            model = make_model(model_type, **(model_kwargs or {}))
+            # Arrays sized exactly to the bucket round-trip pad_rows
+            # unchanged, so this compiles precisely the trainer's fused
+            # program at the trainer's shapes — including the feature
+            # width. Values are irrelevant (nothing is fetched).
+            x1 = np.linspace(0.0, 100.0, fit_b, dtype=np.float32)
+            X = np.tile(x1[:, None], (1, n_features))
+            y = (1.0 + 0.5 * x1).astype(np.float32)
+            xe1 = np.linspace(0.0, 100.0, eval_b, dtype=np.float32)
+            Xe = np.tile(xe1[:, None], (1, n_features))
+            ye = (1.0 + 0.5 * xe1).astype(np.float32)
+            model.fit_and_evaluate(X, y, Xe, ye, materialize=False)
+            log.info(
+                f"pre-warmed {model_type} buckets fit={fit_b} eval={eval_b}"
+            )
+        except Exception as exc:  # never let warmup kill the pipeline
+            log.warning(f"bucket pre-warm failed (non-fatal): {exc!r}")
+            with _lock:
+                _warmed.discard(key)
 
 
 def prewarm_async(
@@ -92,58 +136,29 @@ def prewarm_async(
     test_size: float = 0.2,
     n_features: int = 1,
 ) -> threading.Thread | None:
-    """Compile the fit + fused-eval programs for ``n_total_next`` history
-    rows on a daemon thread, if not already compiled this process.
+    """Queue a compile of the fused fit+eval programs for ``n_total_next``
+    history rows on the single background worker, if not already compiled
+    or queued this process.
 
-    Over-estimating ``n_total_next`` is safe: buckets are monotone, so the
-    estimate's bucket is >= the actual bucket, and any bucket warmed early
-    is simply hit from cache on the day it is first needed. Warming
-    *executes* the fit (a dummy one) rather than AOT-lowering it, because
-    only execution populates the jit dispatch cache the real train hits;
-    the dedupe set bounds that cost to once per bucket per process.
+    Over-estimating ``n_total_next`` is safe in the sense that buckets are
+    monotone (an early-warmed larger bucket is hit from cache later), but
+    callers should enqueue their *nearest*-day estimates first — the queue
+    compiles in order. Returns the worker thread when this call queued a
+    new compile, None when the buckets were already warm/queued.
     """
+    global _worker
     fit_b, eval_b = next_buckets(n_total_next, test_size)
     key = _key(model_type, model_kwargs, fit_b, eval_b, n_features)
     with _lock:
-        if key in _warmed:
+        if key in _warmed or _cancelled.is_set():
             return None
         _warmed.add(key)
-
-    def _work():
-        try:
-            if _cancelled.is_set():  # process is exiting; skip the fit
-                return
-            from bodywork_tpu.train.trainer import make_model
-
-            model = make_model(model_type, **(model_kwargs or {}))
-            # Arrays sized exactly to the bucket round-trip pad_rows
-            # unchanged, so this compiles precisely tomorrow's programs —
-            # including the feature width, which must match the real data.
-            # Values are irrelevant (results are discarded); a non-trivial
-            # slope keeps the dummy fit numerically tame.
-            x1 = np.linspace(0.0, 100.0, fit_b, dtype=np.float32)
-            X = np.tile(x1[:, None], (1, n_features))
-            y = (1.0 + 0.5 * x1).astype(np.float32)
-            xe1 = np.linspace(0.0, 100.0, eval_b, dtype=np.float32)
-            Xe = np.tile(xe1[:, None], (1, n_features))
-            ye = (1.0 + 0.5 * xe1).astype(np.float32)
-            # compile exactly the program the trainer runs: the fused
-            # single-transfer fit+eval (models/fused.py)
-            model.fit_and_evaluate(X, y, Xe, ye)
-            log.info(
-                f"pre-warmed {model_type} buckets fit={fit_b} eval={eval_b}"
+        _queue.append(
+            (model_type, model_kwargs, fit_b, eval_b, n_features, key)
+        )
+        if _worker is None:
+            _worker = threading.Thread(
+                target=_work_loop, name="bucket-prewarm", daemon=True
             )
-        except Exception as exc:  # never let warmup kill the pipeline
-            log.warning(f"bucket pre-warm failed (non-fatal): {exc!r}")
-            with _lock:
-                _warmed.discard(key)
-        finally:
-            with _lock:
-                if t in _live:
-                    _live.remove(t)
-
-    t = threading.Thread(target=_work, name="bucket-prewarm", daemon=True)
-    with _lock:
-        _live.append(t)
-    t.start()
-    return t
+            _worker.start()
+        return _worker

@@ -110,13 +110,15 @@ def test_prewarm_bucket_math_matches_trainer():
 
 
 def test_prewarm_async_dedupes():
-    from bodywork_tpu.train.prewarm import prewarm_async
+    from bodywork_tpu.train import prewarm
 
-    t1 = prewarm_async("linear", None, 700)
-    t2 = prewarm_async("linear", None, 700)  # same buckets -> deduped
-    if t1 is not None:
-        t1.join()
+    # distinctive kwargs so no other test can have warmed this key already
+    kwargs = {"l2": 0.1234}
+    t1 = prewarm.prewarm_async("linear", kwargs, 700)
+    assert t1 is not None  # first call queues a compile
+    t2 = prewarm.prewarm_async("linear", kwargs, 700)  # deduped
     assert t2 is None
+    t1.join()
 
 
 def test_make_model_flat_kwargs():
