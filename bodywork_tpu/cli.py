@@ -85,6 +85,7 @@ def cmd_serve(args) -> int:
         port=args.port,
         block=True,
         mesh_data=args.mesh_data,
+        engine=args.engine,
     )
     return 0
 
@@ -267,6 +268,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--mesh-data", type=int, default=None,
         help="shard batches over this many devices (data-parallel serving)",
+    )
+    p.add_argument(
+        "--engine", default="xla", choices=["xla", "pallas"],
+        help="prediction engine: XLA apply or the fused Pallas MLP kernel",
     )
 
     p = add("test", cmd_test, help="test a live scoring service")

@@ -1,0 +1,7 @@
+from bodywork_tpu.ops.mlp_kernel import (
+    ROW_TILE,
+    fold_scaler_into_net,
+    make_pallas_mlp_apply,
+)
+
+__all__ = ["ROW_TILE", "fold_scaler_into_net", "make_pallas_mlp_apply"]

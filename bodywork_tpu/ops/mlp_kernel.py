@@ -1,0 +1,137 @@
+"""Pallas TPU kernel: the whole MLP scoring forward as ONE fused kernel.
+
+The serving hot path (reference ``stage_2_serve_model.py:78``, here
+``models.mlp.mlp_apply``) is standardise -> dense/relu stack ->
+unstandardise. XLA already fuses this well; the Pallas version exists for
+the serving regime where it can do strictly better: every weight stays
+resident in VMEM across the whole forward (one HBM->VMEM load per weight
+per kernel, amortised over the row grid), and the scaler is folded into
+the first/last layers' weights ahead of time so the kernel is a pure
+dense stack.
+
+Design (see /opt/skills/guides/pallas_guide.md):
+
+- **Scaler folding** (host-side algebra, done once per model):
+  ``W1' = W1 / x_std[:, None]``, ``b1' = b1 - (x_mean / x_std) @ W1``,
+  ``WL' = WL * y_std``, ``bL' = bL * y_std + y_mean`` — numerically
+  identical to ``mlp_apply`` up to float32 rounding.
+- **Lane padding**: all layer widths are zero-padded to multiples of 128
+  (the TPU lane width). Zero columns/rows are inert through matmul and
+  relu, so padding never changes results.
+- **Grid over rows**: each grid step processes a ``ROW_TILE x width``
+  block; weights use a constant index map (the compiler keeps them in
+  VMEM across steps).
+
+Used by serving when ``engine="pallas"`` (``serve.predictor``); tests run
+the kernel in interpreter mode on CPU against the XLA reference.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ROW_TILE = 256
+LANE = 128
+
+
+def _pad_to(x: jax.Array, rows: int | None = None, cols: int | None = None):
+    """Zero-pad a 1-D/2-D array up to (rows, cols)."""
+    if x.ndim == 1:
+        out = jnp.zeros((cols,), x.dtype)
+        return out.at[: x.shape[0]].set(x)
+    out = jnp.zeros((rows, cols), x.dtype)
+    return out.at[: x.shape[0], : x.shape[1]].set(x)
+
+
+def fold_scaler_into_net(params: dict) -> list[tuple[jax.Array, jax.Array]]:
+    """Fold the standardisation scaler into the dense stack's first and
+    last layers; returns [(W, b), ...] equivalent to ``mlp_apply``."""
+    s = params["scaler"]
+    layers = [(layer["w"], layer["b"]) for layer in params["net"]["layers"]]
+    w1, b1 = layers[0]
+    inv = 1.0 / s["x_std"]
+    w1f = w1 * inv[:, None]
+    b1f = b1 - (s["x_mean"] * inv) @ w1
+    layers[0] = (w1f, b1f)
+    # for a single-layer net layers[-1] IS layers[0], so the y-fold below
+    # correctly composes with the x-fold above
+    wl, bl = layers[-1]
+    layers[-1] = (wl * s["y_std"], bl * s["y_std"] + s["y_mean"])
+    return layers
+
+
+def _mlp_kernel(n_layers: int, *refs):
+    """Fused dense stack: x_ref, w0,b0, w1,b1, ..., out_ref."""
+    x_ref, out_ref = refs[0], refs[-1]
+    h = x_ref[:]
+    for i in range(n_layers):
+        w = refs[1 + 2 * i][:]
+        b = refs[2 + 2 * i][:]
+        h = jnp.dot(h, w, preferred_element_type=jnp.float32) + b[None, :]
+        if i < n_layers - 1:
+            h = jnp.maximum(h, 0.0)
+    out_ref[:] = h
+
+
+def make_pallas_mlp_apply(params: dict, interpret: bool = False):
+    """Build ``apply(X) -> y`` running the folded MLP as one Pallas kernel.
+
+    Weights are padded/folded once at build time and stay on device;
+    ``apply`` pads the batch to a ROW_TILE multiple and returns the first
+    column (the regression head) unpadded.
+    """
+    from jax.experimental import pallas as pl
+
+    folded = fold_scaler_into_net(params)
+    d_in = folded[0][0].shape[0]
+    widths = [d_in] + [w.shape[1] for w, _ in folded]
+    padded = [max(LANE, -(-w // LANE) * LANE) for w in widths]
+
+    weights = []
+    for (w, b), rows, cols in zip(folded, padded[:-1], padded[1:]):
+        weights.append(_pad_to(w, rows, cols))
+        weights.append(_pad_to(b, cols=cols))
+
+    n_layers = len(folded)
+    kernel = partial(_mlp_kernel, n_layers)
+    in_width, out_width = padded[0], padded[-1]
+
+    @jax.jit
+    def apply(X: jax.Array) -> jax.Array:
+        X = jnp.asarray(X, jnp.float32)
+        if X.ndim == 1:
+            X = X[:, None]
+        if X.shape[1] != d_in:
+            # zero-filling a short row would silently score garbage; match
+            # the XLA engine, which raises on a feature-count mismatch
+            raise ValueError(
+                f"expected {d_in} feature(s), got {X.shape[1]}"
+            )
+        n = X.shape[0]
+        n_pad = -(-n // ROW_TILE) * ROW_TILE
+        Xp = jnp.zeros((n_pad, in_width), jnp.float32)
+        Xp = Xp.at[:n, : X.shape[1]].set(X)
+
+        grid = (n_pad // ROW_TILE,)
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((n_pad, out_width), jnp.float32),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((ROW_TILE, in_width), lambda i: (i, 0)),
+            ]
+            + [
+                # constant index map: weights/biases identical every step,
+                # so they stay VMEM-resident across the row grid
+                pl.BlockSpec(w.shape, lambda i, nd=w.ndim: (0,) * nd)
+                for w in weights
+            ],
+            out_specs=pl.BlockSpec((ROW_TILE, out_width), lambda i: (i, 0)),
+            interpret=interpret,
+        )(Xp, *weights)
+        return out[:n, 0]
+
+    return apply

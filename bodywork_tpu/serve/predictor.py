@@ -13,6 +13,8 @@ pure TPU-serving design.
 """
 from __future__ import annotations
 
+import itertools
+
 import numpy as np
 
 from bodywork_tpu.models.base import Regressor
@@ -133,3 +135,37 @@ class PaddedPredictor:
         else:
             Xp = X
         return self._predict_padded(Xp)[:n]
+
+
+class PallasMLPPredictor(PaddedPredictor):
+    """Serves an MLP through the fused Pallas kernel
+    (:mod:`bodywork_tpu.ops.mlp_kernel`): scaler folded into the weights,
+    the whole forward as one VMEM-resident kernel per padded batch.
+
+    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU
+    tests); on TPU leave it False.
+    """
+
+    #: monotonic instance ids — id(self) could be recycled by the allocator
+    #: and alias a dead predictor's warm-cache entries
+    _instance_counter = itertools.count()
+
+    def __init__(self, model, buckets: tuple[int, ...] | None = None,
+                 interpret: bool = False):
+        from bodywork_tpu.ops import ROW_TILE, make_pallas_mlp_apply
+
+        if buckets is None:
+            # the kernel pads every batch to a ROW_TILE multiple anyway;
+            # sub-tile buckets would just compile duplicate programs
+            buckets = (ROW_TILE, 2 * ROW_TILE, 16 * ROW_TILE)
+        super().__init__(model, buckets)
+        self._apply = make_pallas_mlp_apply(model.params, interpret=interpret)
+        self._instance_id = next(self._instance_counter)
+
+    def _dispatch_padded(self, Xp: np.ndarray):
+        return self._apply(Xp)
+
+    def _warm_key_extra(self) -> tuple:
+        # params are baked into the kernel closure: never share warm state
+        # with other predictors (or other instances) of this model class
+        return ("pallas", self._instance_id)

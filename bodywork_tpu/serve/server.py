@@ -80,15 +80,40 @@ def serve_latest_model(
     port: int = 5000,
     block: bool = True,
     mesh_data: int | None = None,
+    engine: str = "xla",
 ):
     """Load latest model -> HBM, warm up, serve (reference ``stage_2`` main).
 
     ``mesh_data > 1`` serves through a data-parallel predictor sharding each
     batch over a ``(mesh_data, 1)`` device mesh (BASELINE.json config 4).
-    With ``block=False`` returns a started :class:`ServiceHandle`.
+    ``engine="pallas"`` serves an MLP through the fused Pallas kernel
+    (``ops.mlp_kernel``; single-device, TPU only). With ``block=False``
+    returns a started :class:`ServiceHandle`.
     """
     model, model_date = load_model(store)
     predictor = None
+    if engine == "pallas":
+        import jax
+
+        from bodywork_tpu.models.mlp import MLPRegressor
+        from bodywork_tpu.serve.predictor import PallasMLPPredictor
+
+        if mesh_data and mesh_data > 1:
+            raise ValueError("engine='pallas' is single-device; drop --mesh-data")
+        if not isinstance(model, MLPRegressor):
+            raise ValueError(
+                f"engine='pallas' serves MLP models; latest is {model.info}"
+            )
+        interpret = jax.devices()[0].platform != "tpu"
+        if interpret:
+            log.warning(
+                "engine='pallas' on a non-TPU backend runs the kernel in "
+                "the (slow) Pallas interpreter — use engine='xla' unless "
+                "you are testing the kernel itself"
+            )
+        predictor = PallasMLPPredictor(model, interpret=interpret)
+    elif engine != "xla":
+        raise ValueError(f"unknown serving engine {engine!r}")
     if mesh_data and mesh_data > 1:
         import jax
 

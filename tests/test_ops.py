@@ -1,0 +1,73 @@
+"""Pallas MLP serving kernel vs the XLA reference (interpret mode on CPU)."""
+import numpy as np
+import pytest
+
+from bodywork_tpu.models.mlp import MLPConfig, MLPRegressor, mlp_apply
+from bodywork_tpu.ops import fold_scaler_into_net, make_pallas_mlp_apply
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(0)
+    X = rng.uniform(0, 100, 512).astype(np.float32)
+    y = (1.0 + 0.5 * X + rng.normal(0, 1, 512)).astype(np.float32)
+    return MLPRegressor(MLPConfig(hidden=(16, 16), n_steps=200)).fit(X, y)
+
+
+def test_scaler_folding_matches_mlp_apply(fitted):
+    """Folded dense stack == mlp_apply, before any Pallas involvement."""
+    import jax.numpy as jnp
+
+    X = np.linspace(0, 100, 64, dtype=np.float32)[:, None]
+    layers = fold_scaler_into_net(fitted.params)
+    h = jnp.asarray(X)
+    for i, (w, b) in enumerate(layers):
+        h = h @ w + b
+        if i < len(layers) - 1:
+            h = jnp.maximum(h, 0.0)
+    np.testing.assert_allclose(
+        np.asarray(h[:, 0]), mlp_apply(fitted.params, jnp.asarray(X)),
+        rtol=2e-4, atol=2e-4,
+    )
+
+
+def test_pallas_kernel_matches_xla(fitted):
+    X = np.linspace(0, 100, 300, dtype=np.float32)  # non-multiple of tile
+    apply = make_pallas_mlp_apply(fitted.params, interpret=True)
+    got = np.asarray(apply(X))
+    import jax.numpy as jnp
+
+    want = np.asarray(mlp_apply(fitted.params, jnp.asarray(X)[:, None]))
+    assert got.shape == (300,)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_pallas_kernel_1d_and_2d_input_parity(fitted):
+    apply = make_pallas_mlp_apply(fitted.params, interpret=True)
+    X = np.linspace(0, 100, 40, dtype=np.float32)
+    np.testing.assert_allclose(
+        np.asarray(apply(X)), np.asarray(apply(X[:, None])), rtol=1e-6
+    )
+
+
+def test_pallas_predictor_serves_scoring_contract(fitted):
+    """The Pallas engine behind the frozen HTTP contract."""
+    from datetime import date
+
+    from bodywork_tpu.serve import create_app
+    from bodywork_tpu.serve.predictor import PallasMLPPredictor
+
+    predictor = PallasMLPPredictor(fitted, interpret=True)
+    app = create_app(fitted, date(2026, 7, 1), predictor=predictor)
+    client = app.test_client()
+    single = client.post("/score/v1", json={"X": 50}).get_json()
+    assert abs(single["prediction"] - float(fitted.predict(np.array([50.0]))[0])) < 1e-2
+    batch = client.post(
+        "/score/v1/batch", json={"X": [1.0, 50.0, 99.0]}
+    ).get_json()
+    assert batch["n"] == 3
+    np.testing.assert_allclose(
+        batch["predictions"],
+        np.asarray(fitted.predict(np.array([1.0, 50.0, 99.0]))),
+        rtol=1e-3, atol=1e-3,
+    )
