@@ -120,7 +120,9 @@ def cmd_run_sim(args) -> int:
     from bodywork_tpu.pipeline import LocalRunner
 
     runner = LocalRunner(_pipeline_spec(args), _store(args))
-    results = runner.run_simulation(_date(args), args.days)
+    results = runner.run_simulation(
+        _date(args), args.days, profile_dir=args.profile_dir
+    )
     total = sum(r.wall_clock_s for r in results)
     for r in results:
         print(f"day {r.day}: {r.wall_clock_s:.3f}s")
@@ -293,6 +295,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--date", default=None, help="start date (YYYY-MM-DD)")
     p.add_argument("--model", default="linear", choices=["linear", "mlp"])
     p.add_argument("--mode", default="batch", choices=["single", "batch"])
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler trace of the whole loop here")
 
     p = add("run-ab", cmd_run_ab,
             help="concurrent A/B model pipelines on one device pool")

@@ -31,7 +31,6 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 ROW_TILE = 256
 LANE = 128

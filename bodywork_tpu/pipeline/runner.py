@@ -190,13 +190,16 @@ class LocalRunner:
         parked in ``ctx.failures`` for the step barrier to re-raise — so
         sibling stages finish cleanly, as independent k8s pods would —
         instead of dying silently in the thread's excepthook."""
+        from bodywork_tpu.utils.profiling import annotate
+
         stage = self.spec.stages[stage_name]
         t0 = time.perf_counter()
         try:
-            if stage.kind == "service":
-                result = self._run_service_stage(stage, ctx)
-            else:
-                result = self._run_batch_stage(stage, ctx)
+            with annotate(stage_name):  # named span in an active trace
+                if stage.kind == "service":
+                    result = self._run_service_stage(stage, ctx)
+                else:
+                    result = self._run_batch_stage(stage, ctx)
         except BaseException as exc:
             stage_seconds[stage_name] = time.perf_counter() - t0
             if not concurrent:
@@ -425,10 +428,18 @@ class LocalRunner:
                 model_type, model_kwargs, n_now + int(i * per_day * 0.85)
             )
 
-    def run_simulation(self, start: date, days: int) -> list[DayResult]:
+    def run_simulation(
+        self, start: date, days: int, profile_dir: str | None = None
+    ) -> list[DayResult]:
         """The daily MLOps loop over N simulated days: each day trains on
         history to date, deploys, generates the next (drifted) day, and
-        tests the live service against it."""
+        tests the live service against it.
+
+        ``profile_dir`` wraps the whole loop in a ``jax.profiler`` trace
+        (the TPU-native analogue of the reference's full-sample-rate Sentry
+        tracing — SURVEY.md §5); view with TensorBoard or Perfetto."""
+        from bodywork_tpu.utils.profiling import maybe_trace
+
         self.bootstrap(start)
         self._prewarm_horizon(days)
         # queue every sampling round-trip of the horizon off-path now
@@ -440,9 +451,13 @@ class LocalRunner:
             ]
         )
         results = []
-        for i in range(days):
-            today = start + timedelta(days=i)
-            result = self.run_day(today, lookahead_train=(i < days - 1))
-            results.append(result)
-            log.info(f"simulated day {today}: {result.wall_clock_s:.2f}s wall-clock")
+        with maybe_trace(profile_dir, label=f"{days}-day simulation"):
+            for i in range(days):
+                today = start + timedelta(days=i)
+                result = self.run_day(today, lookahead_train=(i < days - 1))
+                results.append(result)
+                log.info(
+                    f"simulated day {today}: "
+                    f"{result.wall_clock_s:.2f}s wall-clock"
+                )
         return results

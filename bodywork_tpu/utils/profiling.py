@@ -1,0 +1,46 @@
+"""Tracing/profiling hooks (reference aux subsystem, SURVEY.md §5).
+
+The reference traces every stage through Sentry performance tracing at
+full sample rate (``sentry_sdk.init(..., traces_sample_rate=1.0)`` —
+``stage_1_train_model.py:171`` and clones) plus wall-clock request-latency
+measurement in the tester (``stage_4:75-78``). The TPU-native equivalents:
+
+- request latency is a first-class metric already (``monitor.tester``);
+- device-side visibility comes from ``jax.profiler`` traces, viewable in
+  TensorBoard/Perfetto or XProf — this module is the on/off switch the
+  orchestrator and CLI expose.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("profiling")
+
+
+@contextlib.contextmanager
+def maybe_trace(trace_dir: str | None, label: str = ""):
+    """``jax.profiler.trace`` when ``trace_dir`` is set, no-op otherwise.
+
+    Invocations must be sequential — the jax profiler raises if a trace
+    is already active, so wrap ONE outer region (e.g. the whole
+    simulation) and use :func:`annotate` for named spans inside it.
+    """
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    log.info(f"tracing {label or 'region'} -> {trace_dir}")
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named sub-region inside an active trace (shows up as a span)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -274,3 +274,13 @@ def test_wait_for_cli_gates(tmp_path):
     assert main(["generate", "--store", store, "--date", "2026-01-02"]) == 0
     assert main(["wait-for", "--store", store, "--dataset-newer-than-model",
                  "--timeout", "5"]) == 0
+
+
+def test_run_simulation_writes_profiler_trace(store, tmp_path):
+    """profile_dir wraps the day loop in a jax.profiler trace (the
+    reference's Sentry tracing analogue — SURVEY.md §5)."""
+    runner = LocalRunner(default_pipeline(scoring_mode="batch"), store)
+    trace_dir = tmp_path / "trace"
+    runner.run_simulation(date(2026, 1, 1), 1, profile_dir=str(trace_dir))
+    dumped = list(trace_dir.rglob("*"))
+    assert any(p.is_file() for p in dumped), "no trace files written"
