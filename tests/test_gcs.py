@@ -1,0 +1,138 @@
+"""GCSStore backend against an in-memory fake of google.cloud.storage
+(the real package is not a dependency; SURVEY.md C7's GCS-ready interface
+must still be exercised)."""
+import sys
+import types
+
+import pytest
+
+from bodywork_tpu.store.base import ArtefactNotFound
+
+
+class FakeBlob:
+    def __init__(self, bucket, name):
+        self._bucket = bucket
+        self.name = name
+
+    def exists(self):
+        return self.name in self._bucket._objects
+
+    def upload_from_string(self, data):
+        if isinstance(data, str):
+            data = data.encode()
+        gen = self._bucket._objects.get(self.name, (None, 0))[1] + 1
+        self._bucket._objects[self.name] = (data, gen)
+
+    def download_as_bytes(self):
+        return self._bucket._objects[self.name][0]
+
+    def delete(self):
+        del self._bucket._objects[self.name]
+
+    @property
+    def generation(self):
+        entry = self._bucket._objects.get(self.name)
+        return None if entry is None else entry[1]
+
+
+class FakeBucket:
+    def __init__(self, name):
+        self.name = name
+        self._objects = {}
+
+    def blob(self, name):
+        return FakeBlob(self, name)
+
+    def get_blob(self, name):
+        return FakeBlob(self, name) if name in self._objects else None
+
+
+class FakeClient:
+    _buckets: dict = {}
+
+    def bucket(self, name):
+        return self._buckets.setdefault(name, FakeBucket(name))
+
+    def list_blobs(self, bucket, prefix=""):
+        return [
+            FakeBlob(bucket, name)
+            for name in sorted(bucket._objects)
+            if name.startswith(prefix)
+        ]
+
+
+@pytest.fixture
+def gcs_store(monkeypatch):
+    fake_storage = types.SimpleNamespace(Client=FakeClient)
+    fake_cloud = types.ModuleType("google.cloud")
+    fake_cloud.storage = fake_storage
+    fake_google = types.ModuleType("google")
+    fake_google.cloud = fake_cloud
+    monkeypatch.setitem(sys.modules, "google", fake_google)
+    monkeypatch.setitem(sys.modules, "google.cloud", fake_cloud)
+    monkeypatch.setitem(sys.modules, "google.cloud.storage", fake_storage)
+    FakeClient._buckets = {}
+
+    from bodywork_tpu.store.gcs import GCSStore
+
+    return GCSStore.from_url("gs://test-bucket/exp1")
+
+
+def test_from_url_parses_bucket_and_prefix(gcs_store):
+    assert gcs_store._bucket.name == "test-bucket"
+    assert gcs_store._prefix == "exp1"
+
+
+def test_roundtrip_and_exists(gcs_store):
+    assert not gcs_store.exists("models/regressor-2026-01-01.npz")
+    gcs_store.put_bytes("models/regressor-2026-01-01.npz", b"abc")
+    assert gcs_store.exists("models/regressor-2026-01-01.npz")
+    assert gcs_store.get_bytes("models/regressor-2026-01-01.npz") == b"abc"
+    # keys are namespaced under the URL prefix inside the bucket
+    assert "exp1/models/regressor-2026-01-01.npz" in (
+        gcs_store._bucket._objects
+    )
+
+
+def test_get_missing_raises(gcs_store):
+    with pytest.raises(ArtefactNotFound):
+        gcs_store.get_bytes("models/nope.npz")
+    with pytest.raises(ArtefactNotFound):
+        gcs_store.delete("models/nope.npz")
+
+
+def test_history_and_latest(gcs_store):
+    for d in ("2026-01-02", "2026-01-01", "2026-01-03"):
+        gcs_store.put_text(f"datasets/regression-dataset-{d}.csv", d)
+    hist = gcs_store.history("datasets/")
+    assert [str(d) for _, d in hist] == ["2026-01-01", "2026-01-02", "2026-01-03"]
+    key, latest = gcs_store.latest("datasets/")
+    assert str(latest) == "2026-01-03" and key.endswith("2026-01-03.csv")
+
+
+def test_version_tokens_change_on_overwrite(gcs_store):
+    key = "datasets/regression-dataset-2026-01-01.csv"
+    gcs_store.put_text(key, "v1")
+    t1 = gcs_store.version_token(key)
+    tokens = gcs_store.version_tokens([key])
+    assert tokens[key] == t1
+    gcs_store.put_text(key, "v2")
+    assert gcs_store.version_token(key) != t1
+
+
+def test_version_tokens_batched_multiple_dirs(gcs_store):
+    keys = [
+        "datasets/regression-dataset-2026-01-01.csv",
+        "models/regressor-2026-01-01.npz",
+    ]
+    for k in keys:
+        gcs_store.put_text(k, "x")
+    tokens = gcs_store.version_tokens(keys)
+    assert set(tokens) == set(keys)
+    assert all(t is not None for t in tokens.values())
+
+
+def test_delete(gcs_store):
+    gcs_store.put_text("models/regressor-2026-01-01.npz", "x")
+    gcs_store.delete("models/regressor-2026-01-01.npz")
+    assert not gcs_store.exists("models/regressor-2026-01-01.npz")
