@@ -156,7 +156,33 @@ def bench_ab(days: int = 5) -> dict:
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", type=int, default=2, choices=[1, 2, 3, 4, 5])
+    parser.add_argument(
+        "--backend-timeout", type=float, default=240.0,
+        help="seconds to wait for the device backend before aborting "
+             "(a wedged TPU relay otherwise hangs jax.devices() forever)",
+    )
     args = parser.parse_args()
+
+    import os
+    import threading
+
+    # A wedged TPU relay blocks jax.devices() inside a C call, where
+    # neither KeyboardInterrupt nor SIGALRM handlers can run — only a
+    # watchdog thread calling os._exit can abort with a clear message.
+    backend_up = threading.Event()
+
+    def _backend_watchdog():
+        if not backend_up.wait(args.backend_timeout):
+            print(
+                "bench: device backend unreachable "
+                f"after {args.backend_timeout}s (TPU relay wedged?) — aborting",
+                file=sys.stderr,
+            )
+            sys.stderr.flush()
+            os._exit(3)
+
+    if args.backend_timeout > 0:  # <= 0 disables the watchdog
+        threading.Thread(target=_backend_watchdog, daemon=True).start()
 
     import jax
 
@@ -164,6 +190,7 @@ def main() -> int:
 
     configure_logger(stream=sys.stderr)  # keep stdout = the one JSON line
     print(f"bench devices: {jax.devices()}", file=sys.stderr)
+    backend_up.set()  # backend is up; the run itself is unbounded
 
     if args.config == 1:
         record = bench_single_day()
