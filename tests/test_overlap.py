@@ -168,3 +168,36 @@ def test_lookahead_never_persists_before_collection(store):
     runner2.run_day(start + timedelta(days=1))
     model_keys = [k for k, _ in store.history(MODELS_PREFIX)]
     assert f"models/regressor-{start + timedelta(days=1)}.npz" in model_keys
+
+
+def test_serve_falls_back_to_store_on_artefact_mismatch(runner, store):
+    """If the checkpoint in the store differs from the in-memory train
+    result (e.g. an operator replaced it), serve must serve the STORE's
+    params — the artefact is the source of truth."""
+    from bodywork_tpu.models import LinearRegressor, save_model
+    from bodywork_tpu.pipeline.stages import StageContext, serve_stage
+
+    start = date(2026, 3, 1)
+    runner.bootstrap(start)
+    result = runner.run_day(start)
+    tr = result.stage_results["stage-1-train-model"]
+
+    # overwrite the latest checkpoint with a different model
+    rng = np.random.default_rng(1)
+    X = rng.uniform(0, 100, 300).astype(np.float32)
+    other = LinearRegressor().fit(X, (5.0 + 2.0 * X).astype(np.float32))
+    save_model(store, other, start)
+
+    ctx = StageContext(store=store, today=start)
+    ctx.stage_results["stage-1-train-model"] = tr
+    handle = serve_stage(ctx, port=0)
+    try:
+        served = handle.app.predictor.model
+        assert served is not tr.model
+        np.testing.assert_allclose(
+            served.predict(np.array([50.0])),
+            other.predict(np.array([50.0])),
+            rtol=1e-6,
+        )
+    finally:
+        handle.stop()
