@@ -234,7 +234,13 @@ def cmd_deploy(args) -> int:
 
     spec = _pipeline_spec(args)
     written = write_manifests(
-        spec, args.out, store_path=args.store_path, image=args.image
+        spec,
+        args.out,
+        store_path=args.store_path,
+        image=args.image,
+        store_volume=args.store_volume,
+        storage_class=args.storage_class or None,
+        pvc_size=args.pvc_size,
     )
     for path in written:
         print(path)
@@ -336,6 +342,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--out", required=True)
     p.add_argument("--store-path", default="/mnt/artefact-store")
     p.add_argument("--image", default="bodywork-tpu/runtime:latest")
+    p.add_argument(
+        "--store-volume", default="auto",
+        choices=["auto", "pvc", "hostpath", "gcs"],
+        help="shared-store medium: ReadWriteMany PVC (multi-node safe), "
+             "hostPath (single-node clusters ONLY), or direct GCS; auto "
+             "picks gcs for gs:// store paths and pvc otherwise",
+    )
+    p.add_argument("--storage-class", default="standard-rwx",
+                   help="storageClassName for the store PVC (default: GKE "
+                        "Filestore's RWX class; pass '' for the cluster "
+                        "default, which must support ReadWriteMany)")
+    p.add_argument("--pvc-size", default="10Gi")
     p.add_argument("--model", default="linear", choices=["linear", "mlp"])
     p.add_argument("--mode", default="batch", choices=["single", "batch"])
 

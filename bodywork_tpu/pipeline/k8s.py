@@ -10,15 +10,30 @@ Here the framework emits those manifests itself, targeting GKE TPU node
 pools: stages with TPU resources get the standard GKE nodeSelectors
 (``cloud.google.com/gke-tpu-accelerator`` / ``gke-tpu-topology``) and a
 ``google.com/tpu`` resource request, per Google's TPU-on-GKE scheduling
-model. Artefacts flow over a shared volume (TPU-VM host filesystem /
-Filestore) mounted at the store path — the BASELINE.json north star — or GCS
-if the store URL says so.
+model.
+
+Artefacts flow through one of three store media (``store_volume=``),
+preserving the reference contract that every stage shares one bucket
+(``bodywork.yaml:22-26`` + S3 usage in all four stages):
+
+- ``pvc`` (default for filesystem paths) — a ``ReadWriteMany``
+  PersistentVolumeClaim (e.g. GKE Filestore CSI, ``standard-rwx``)
+  mounted at the store path in every pod; correct on multi-node clusters,
+  where train Jobs on the TPU node pool and serve Deployments on other
+  nodes must see the same filesystem.
+- ``hostpath`` — the node's own filesystem. **Single-node clusters
+  only** (explicit opt-in): a hostPath volume is per-node, so on any
+  multi-node cluster the stages would silently see different stores.
+- ``gcs`` (default for ``gs://`` store paths) — no volume at all; every
+  stage talks straight to the GCS artefact-store backend
+  (``store/gcs.py``), the closest analogue of the reference's S3 bucket.
 
 The daily loop is a CronJob running ``run-day`` (the reference re-runs the
 whole Bodywork deployment daily — README.md:5).
 """
 from __future__ import annotations
 
+import dataclasses
 import io
 from pathlib import Path
 
@@ -32,14 +47,81 @@ _SPEC_MOUNT = "/etc/bodywork"
 _SPEC_FILE = "pipeline.yaml"
 _DEFAULT_IMAGE = "bodywork-tpu/runtime:latest"
 
+STORE_VOLUME_MODES = ("auto", "pvc", "hostpath", "gcs")
 
-def _store_volume(store_path: str) -> tuple[dict, dict]:
-    volume = {
-        "name": _STORE_VOLUME,
-        "hostPath": {"path": store_path, "type": "DirectoryOrCreate"},
-    }
-    mount = {"name": _STORE_VOLUME, "mountPath": store_path}
-    return volume, mount
+
+@dataclasses.dataclass(frozen=True)
+class _StoreMedium:
+    """How pods reach the shared artefact store (see module docstring)."""
+
+    store_path: str
+    mode: str  # "pvc" | "hostpath" | "gcs"
+    claim_name: str = ""
+    storage_class: str | None = None
+    size: str = "10Gi"
+
+    def volume(self) -> dict | None:
+        if self.mode == "gcs":
+            return None
+        if self.mode == "hostpath":
+            source = {
+                "hostPath": {"path": self.store_path, "type": "DirectoryOrCreate"}
+            }
+        else:
+            source = {"persistentVolumeClaim": {"claimName": self.claim_name}}
+        return {"name": _STORE_VOLUME, **source}
+
+    def mount(self) -> dict | None:
+        if self.mode == "gcs":
+            return None
+        return {"name": _STORE_VOLUME, "mountPath": self.store_path}
+
+    def pvc_doc(self, namespace: str) -> dict:
+        assert self.mode == "pvc"
+        pvc_spec: dict = {
+            # ReadWriteMany: Jobs on the TPU node pool and the serve
+            # Deployment mount it concurrently from different nodes
+            "accessModes": ["ReadWriteMany"],
+            "resources": {"requests": {"storage": self.size}},
+        }
+        if self.storage_class:
+            pvc_spec["storageClassName"] = self.storage_class
+        return {
+            "apiVersion": "v1",
+            "kind": "PersistentVolumeClaim",
+            "metadata": {"name": self.claim_name, "namespace": namespace},
+            "spec": pvc_spec,
+        }
+
+
+def _resolve_store_medium(
+    spec: PipelineSpec,
+    store_path: str,
+    store_volume: str,
+    storage_class: str | None,
+    pvc_size: str,
+) -> _StoreMedium:
+    if store_volume not in STORE_VOLUME_MODES:
+        raise ValueError(
+            f"store_volume must be one of {STORE_VOLUME_MODES}, "
+            f"got {store_volume!r}"
+        )
+    is_gcs_path = store_path.startswith("gs://")
+    if store_volume == "auto":
+        store_volume = "gcs" if is_gcs_path else "pvc"
+    if is_gcs_path != (store_volume == "gcs"):
+        raise ValueError(
+            f"store_volume={store_volume!r} does not fit "
+            f"store_path={store_path!r}: use a gs:// path with 'gcs' and a "
+            "filesystem path with 'pvc'/'hostpath'"
+        )
+    return _StoreMedium(
+        store_path=store_path,
+        mode=store_volume,
+        claim_name=f"{spec.name}--store",
+        storage_class=storage_class,
+        size=pvc_size,
+    )
 
 
 def _spec_volume(spec: PipelineSpec) -> tuple[dict, dict]:
@@ -57,11 +139,11 @@ def _spec_volume(spec: PipelineSpec) -> tuple[dict, dict]:
 def _container(
     spec: PipelineSpec,
     stage: StageSpec,
-    store_path: str,
+    store: _StoreMedium,
     image: str,
     command: list[str],
 ) -> dict:
-    _, mount = _store_volume(store_path)
+    mount = store.mount()
     _, spec_mount = _spec_volume(spec)
     resources: dict = {
         "requests": {
@@ -77,7 +159,7 @@ def _container(
         "name": stage.name,
         "image": image,
         "command": command,
-        "volumeMounts": [mount, spec_mount],
+        "volumeMounts": [m for m in (mount, spec_mount) if m],
         "resources": resources,
     }
     if env:
@@ -95,18 +177,18 @@ def _container(
     return container
 
 
-def _pod_spec(spec: PipelineSpec, stage: StageSpec, store_path: str,
+def _pod_spec(spec: PipelineSpec, stage: StageSpec, store: _StoreMedium,
               image: str, command: list[str], restart_policy: str,
               gate_on_deps: bool = True) -> dict:
-    volume, _ = _store_volume(store_path)
+    volume = store.volume()
     spec_volume, _ = _spec_volume(spec)
     pod: dict = {
-        "containers": [_container(spec, stage, store_path, image, command)],
-        "volumes": [volume, spec_volume],
+        "containers": [_container(spec, stage, store, image, command)],
+        "volumes": [v for v in (volume, spec_volume) if v],
         "restartPolicy": restart_policy,
     }
     if gate_on_deps:
-        init_containers = _init_containers(spec, stage, store_path, image)
+        init_containers = _init_containers(spec, stage, store, image)
         if init_containers:
             pod["initContainers"] = init_containers
     r = stage.resources
@@ -120,7 +202,7 @@ def _pod_spec(spec: PipelineSpec, stage: StageSpec, store_path: str,
 
 
 def _init_containers(
-    spec: PipelineSpec, stage: StageSpec, store_path: str, image: str
+    spec: PipelineSpec, stage: StageSpec, store: _StoreMedium, image: str
 ) -> list[dict]:
     """DAG-ordering gates as initContainers.
 
@@ -158,7 +240,6 @@ def _init_containers(
         break  # only the immediately preceding step gates this stage
     if not conditions:
         return []
-    _, mount = _store_volume(store_path)
     _, spec_mount = _spec_volume(spec)
     return [
         {
@@ -166,9 +247,9 @@ def _init_containers(
             "image": image,
             "command": [
                 "python", "-m", "bodywork_tpu.cli", "wait-for",
-                "--store", store_path, *conditions,
+                "--store", store.store_path, *conditions,
             ],
-            "volumeMounts": [mount, spec_mount],
+            "volumeMounts": [m for m in (store.mount(), spec_mount) if m],
         }
     ]
 
@@ -196,8 +277,26 @@ def generate_manifests(
     image: str = _DEFAULT_IMAGE,
     namespace: str = "bodywork-tpu",
     daily_schedule: str | None = "0 6 * * *",
+    store_volume: str = "auto",
+    storage_class: str | None = "standard-rwx",
+    pvc_size: str = "10Gi",
 ) -> dict[str, dict]:
-    """Emit all k8s objects for the pipeline, keyed by filename."""
+    """Emit all k8s objects for the pipeline, keyed by filename.
+
+    ``store_volume`` selects the shared-store medium (module docstring):
+    ``"auto"`` picks ``"gcs"`` for ``gs://`` store paths and ``"pvc"``
+    (ReadWriteMany claim, ``storage_class``/``pvc_size``) otherwise;
+    ``"hostpath"`` is a single-node-cluster opt-in.
+
+    ``storage_class`` defaults to GKE Filestore CSI's ``standard-rwx``
+    because a ReadWriteMany claim cannot provision against the usual
+    RWO-only default class (stock GKE PD) — the claim would sit Pending
+    forever. Pass ``None``/empty to use the cluster's default class
+    (only correct if that class supports RWX).
+    """
+    store = _resolve_store_medium(
+        spec, store_path, store_volume, storage_class, pvc_size
+    )
     docs: dict[str, dict] = {
         "00-namespace.yaml": {
             "apiVersion": "v1",
@@ -211,6 +310,8 @@ def generate_manifests(
             "data": {_SPEC_FILE: spec.to_yaml()},
         },
     }
+    if store.mode == "pvc":
+        docs["00-store-pvc.yaml"] = store.pvc_doc(namespace)
     labels_base = {"app.kubernetes.io/part-of": spec.name}
     for i, step in enumerate(spec.dag, start=1):
         for stage_name in step:
@@ -233,7 +334,7 @@ def generate_manifests(
                         "template": {
                             "metadata": {"labels": labels},
                             "spec": _pod_spec(
-                                spec, stage, store_path, image, command, "Never"
+                                spec, stage, store, image, command, "Never"
                             ),
                         },
                     },
@@ -249,7 +350,7 @@ def generate_manifests(
                         "template": {
                             "metadata": {"labels": labels},
                             "spec": _pod_spec(
-                                spec, stage, store_path, image, command,
+                                spec, stage, store, image, command,
                                 "Always",
                             ),
                         },
@@ -283,7 +384,7 @@ def generate_manifests(
                             "spec": _pod_spec(
                                 spec,
                                 next(iter(spec.stages.values())),
-                                store_path,
+                                store,
                                 image,
                                 ["python", "-m", "bodywork_tpu.cli", "run-day",
                                  "--store", store_path,

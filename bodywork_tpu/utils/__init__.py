@@ -6,8 +6,14 @@ from bodywork_tpu.utils.dates import (
     parse_date,
 )
 from bodywork_tpu.utils.errors import init_error_monitoring, StageError
+from bodywork_tpu.utils.watchdog import (
+    abort_if_backend_hangs,
+    backend_timeout_from_env,
+)
 
 __all__ = [
+    "abort_if_backend_hangs",
+    "backend_timeout_from_env",
     "configure_logger",
     "DATE_PATTERN",
     "date_from_key",

@@ -1,0 +1,115 @@
+"""Backend bring-up watchdog + wedged-relay bypass.
+
+A wedged TPU relay blocks the first ``jax.devices()`` inside a C call,
+where neither KeyboardInterrupt nor SIGALRM handlers can run — only a
+watchdog thread calling ``os._exit`` can abort the process with a clear
+message, and only neutralizing the relay probe *before* backend init can
+avoid the block entirely. Both defenses live here, shared by ``bench.py``
+and ``__graft_entry__.py`` (the reference has no analogue; its failure
+harness is ``stage_1_train_model.py:170-178``'s try/except, which cannot
+interrupt a blocked C call either).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+
+#: exit code for "device backend unreachable" aborts (bench.py contract)
+BACKEND_UNREACHABLE_EXIT = 3
+
+
+def backend_timeout_from_env(
+    var: str = "GRAFT_BACKEND_TIMEOUT_S", default: float = 120.0
+) -> float:
+    """Read a watchdog timeout from the environment; malformed values fall
+    back to the default with a warning rather than crashing the caller."""
+    raw = os.environ.get(var)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        print(
+            f"watchdog: ignoring malformed {var}={raw!r}; "
+            f"using {default}s",
+            file=sys.stderr,
+        )
+        return default
+
+
+def force_cpu_platform(n_devices: int | None = None):
+    """Switch the live JAX process to the CPU platform, bypassing the
+    accelerator relay entirely, and return a ``restore()`` callable.
+
+    The env alone is not enough: sitecustomize pre-imports jax with the
+    accelerator plugin registered, so the switch must go through the live
+    config, and any already-initialized backend must be cleared for it to
+    take effect. The relay-pool env var is emptied first — the plugin
+    reads it at backend init, and an empty pool makes its probe a no-op.
+
+    With ``n_devices``, ensures at least that many CPU devices exist
+    (honouring an ``XLA_FLAGS=--xla_force_host_platform_device_count``
+    already consumed at first init, else via the ``jax_num_cpu_devices``
+    config, which is legal while no CPU backend is live).
+
+    ``restore()`` puts the config and env back and clears backends again;
+    live arrays from before either switch do not survive it.
+    """
+    import jax
+    from jax.extend.backend import clear_backends
+
+    _unset = object()
+    saved_pool = os.environ.get("PALLAS_AXON_POOL_IPS", _unset)
+    saved_platforms = jax.config.jax_platforms
+    saved_num_cpu = jax.config.jax_num_cpu_devices
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    jax.config.update("jax_platforms", "cpu")
+    clear_backends()
+    if n_devices is not None and len(jax.devices()) < n_devices:
+        clear_backends()
+        jax.config.update("jax_num_cpu_devices", n_devices)
+
+    def restore() -> None:
+        clear_backends()
+        jax.config.update("jax_platforms", saved_platforms)
+        jax.config.update("jax_num_cpu_devices", saved_num_cpu)
+        if saved_pool is _unset:
+            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        else:
+            os.environ["PALLAS_AXON_POOL_IPS"] = saved_pool
+
+    return restore
+
+
+@contextlib.contextmanager
+def abort_if_backend_hangs(timeout_s: float, what: str = "device backend"):
+    """Abort the process (exit code 3) with a clear message if the body of
+    the ``with`` block does not complete within ``timeout_s`` seconds.
+
+    ``timeout_s <= 0`` disables the watchdog entirely. The watchdog is
+    disarmed on every exit path, including exceptions, so a non-hang
+    failure inside the block cannot leave an armed timer that kills the
+    process later.
+    """
+    if timeout_s <= 0:
+        yield
+        return
+    done = threading.Event()
+
+    def _watchdog():
+        if not done.wait(timeout_s):
+            print(
+                f"{what} unreachable after {timeout_s}s "
+                "(TPU relay wedged?) — aborting",
+                file=sys.stderr,
+            )
+            sys.stderr.flush()
+            os._exit(BACKEND_UNREACHABLE_EXIT)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+    try:
+        yield
+    finally:
+        done.set()

@@ -143,6 +143,15 @@ def test_batch_stage_timeout_enforced(store):
         LocalRunner(spec, store).run_day(date(2026, 1, 1))
 
 
+def _pod_volumes(doc) -> list[dict]:
+    """The pod volumes of any workload manifest (empty for non-workloads)."""
+    spec = doc.get("spec", {})
+    if doc["kind"] == "CronJob":
+        spec = spec["jobTemplate"]["spec"]
+    template = spec.get("template")
+    return template["spec"].get("volumes", []) if template else []
+
+
 def test_manifests_structure(tmp_path):
     spec = default_pipeline()
     docs = generate_manifests(spec, store_path="/mnt/store")
@@ -151,9 +160,22 @@ def test_manifests_structure(tmp_path):
         kinds.setdefault(doc["kind"], 0)
         kinds[doc["kind"]] += 1
     assert kinds == {
-        "Namespace": 1, "ConfigMap": 1, "Job": 3, "Deployment": 1,
-        "Service": 1, "CronJob": 1,
+        "Namespace": 1, "ConfigMap": 1, "PersistentVolumeClaim": 1,
+        "Job": 3, "Deployment": 1, "Service": 1, "CronJob": 1,
     }
+    # default store medium is a ReadWriteMany PVC (multi-node safe): every
+    # pod mounts the claim, nothing references the node's own filesystem
+    pvc = docs["00-store-pvc.yaml"]
+    assert pvc["spec"]["accessModes"] == ["ReadWriteMany"]
+    # RWX cannot provision on the usual RWO-only default class, so the
+    # default must name an RWX class (GKE Filestore CSI)
+    assert pvc["spec"]["storageClassName"] == "standard-rwx"
+    for doc in docs.values():
+        for vol in _pod_volumes(doc):
+            assert "hostPath" not in vol
+            if vol["name"] == "artefact-store":
+                assert vol["persistentVolumeClaim"]["claimName"] == pvc[
+                    "metadata"]["name"]
     # the deploy-time spec rides into pods as a ConfigMap, and every stage
     # command loads it — so non-default model/mode choices round-trip
     cm = docs["00-pipeline-spec-configmap.yaml"]
@@ -234,6 +256,44 @@ def test_spec_file_round_trips_nondefault_choices(tmp_path):
     assert _pipeline_spec(args).stages["stage-1-train-model"].args[
         "model_type"
     ] == "mlp"
+
+
+def test_manifest_store_volume_modes():
+    spec = default_pipeline()
+    # hostpath: explicit single-node opt-in, no PVC emitted
+    docs = generate_manifests(
+        spec, store_path="/mnt/store", store_volume="hostpath"
+    )
+    assert "00-store-pvc.yaml" not in docs
+    job_vols = _pod_volumes(docs["01-stage-1-train-model-job.yaml"])
+    assert any(
+        v.get("hostPath", {}).get("path") == "/mnt/store" for v in job_vols
+    )
+    # gcs (auto-selected from the gs:// path): no store volume at all;
+    # stages reach the bucket through --store, like the reference's S3
+    docs = generate_manifests(spec, store_path="gs://bucket/root")
+    assert "00-store-pvc.yaml" not in docs
+    for doc in docs.values():
+        for vol in _pod_volumes(doc):
+            assert vol["name"] != "artefact-store"
+    cmd = docs["01-stage-1-train-model-job.yaml"]["spec"]["template"]["spec"][
+        "containers"][0]["command"]
+    assert "gs://bucket/root" in cmd
+    # storage class reaches the PVC
+    docs = generate_manifests(
+        spec, store_path="/mnt/store", storage_class="standard-rwx",
+        pvc_size="50Gi",
+    )
+    assert docs["00-store-pvc.yaml"]["spec"]["storageClassName"] == "standard-rwx"
+    assert docs["00-store-pvc.yaml"]["spec"]["resources"]["requests"][
+        "storage"] == "50Gi"
+    # mismatched medium/path combinations are rejected, not silently broken
+    with pytest.raises(ValueError, match="does not fit"):
+        generate_manifests(spec, store_path="gs://bucket", store_volume="pvc")
+    with pytest.raises(ValueError, match="does not fit"):
+        generate_manifests(spec, store_path="/mnt/store", store_volume="gcs")
+    with pytest.raises(ValueError, match="store_volume"):
+        generate_manifests(spec, store_path="/mnt/store", store_volume="nfs")
 
 
 def test_manifests_enforce_dag_order_via_init_containers():
