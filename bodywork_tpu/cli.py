@@ -68,7 +68,12 @@ def cmd_generate(args) -> int:
 def cmd_train(args) -> int:
     from bodywork_tpu.train import train_on_history
 
-    result = train_on_history(_store(args), args.model)
+    result = train_on_history(
+        _store(args),
+        args.model,
+        mesh_data=args.mesh_data,
+        mesh_model=args.mesh_model,
+    )
     print(
         f"{result.model_artefact_key} MAPE={result.metrics['MAPE']:.4f} "
         f"r2={result.metrics['r_squared']:.4f}"
@@ -268,6 +273,14 @@ def build_parser() -> argparse.ArgumentParser:
     p = add("train", cmd_train, help="train on all history, persist model")
     p.add_argument("--store", **common_store)
     p.add_argument("--model", default="linear", choices=["linear", "mlp"])
+    p.add_argument(
+        "--mesh-data", type=int, default=None,
+        help="data-parallel mesh axis for sharded training (mlp only)",
+    )
+    p.add_argument(
+        "--mesh-model", type=int, default=1,
+        help="tensor-parallel mesh axis for sharded training (mlp only)",
+    )
 
     p = add("serve", cmd_serve, help="serve the latest model over HTTP")
     p.add_argument("--store", **common_store)

@@ -72,6 +72,10 @@ def multihost_init() -> bool:
     if os.environ.get("COORDINATOR_ADDRESS") or os.environ.get(
         "JAX_COORDINATOR_ADDRESS"
     ):
+        # idempotent: the daily retrain loop calls this every day, but
+        # jax.distributed.initialize raises RuntimeError on a second call
+        if jax.distributed.is_initialized():
+            return True
         jax.distributed.initialize()
         log.info(
             f"joined distributed cluster: process {jax.process_index()} / "

@@ -48,7 +48,8 @@ class PipelineVariant:
 class VariantResult:
     name: str
     results: list[DayResult]
-    store: "ArtefactStore"
+    #: None when the arm failed before its store could be constructed
+    store: "ArtefactStore | None"
     error: BaseException | None = None
 
 
@@ -108,17 +109,21 @@ def run_ab_simulation(
         return FilesystemStore(Path(root) / name)
 
     def _run(variant: PipelineVariant, group) -> None:
-        store = _variant_store(variant.name)
-        # the runner's device knob pins every thread it spawns (DAG step
-        # threads, prefetch worker, lookahead train) — a bare
-        # jax.default_device() here would be thread-local and miss them
-        runner = LocalRunner(
-            variant.spec,
-            store,
-            drift=variant.drift,
-            device=group[0] if group else None,
-        )
+        # everything inside the try: a failure ANYWHERE (e.g. a bad gs://
+        # root in store construction) must surface as the variant's error,
+        # not die silently on the thread leaving the arm absent from `out`
+        store = None
         try:
+            store = _variant_store(variant.name)
+            # the runner's device knob pins every thread it spawns (DAG
+            # step threads, prefetch worker, lookahead train) — a bare
+            # jax.default_device() here would be thread-local and miss them
+            runner = LocalRunner(
+                variant.spec,
+                store,
+                drift=variant.drift,
+                device=group[0] if group else None,
+            )
             results = runner.run_simulation(start, days)
             out[variant.name] = VariantResult(variant.name, results, store)
         except BaseException as exc:

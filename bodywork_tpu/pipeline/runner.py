@@ -150,8 +150,13 @@ class LocalRunner:
     def _start_and_health_gate(self, stage: StageSpec, ctx: StageContext):
         fn = resolve_executable(stage.executable)
         deadline = time.monotonic() + stage.max_startup_time_s
+        args = dict(stage.args)
+        if stage.replicas > 1:
+            # honour the spec's replica count locally (reference
+            # bodywork.yaml:40), not just in emitted Deployment YAML
+            args.setdefault("replicas", stage.replicas)
         with _device_ctx(self.device):
-            handle = fn(ctx, **stage.args)
+            handle = fn(ctx, **args)
         # health-check before the DAG proceeds (k8s readiness probe analogue)
         import requests
 

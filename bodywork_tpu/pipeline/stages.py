@@ -111,8 +111,18 @@ def generate_stage(ctx: StageContext, offset_days: int = 1) -> str:
     return key
 
 
-def train_stage(ctx: StageContext, model_type: str = "linear", **model_kwargs):
+def train_stage(
+    ctx: StageContext,
+    model_type: str = "linear",
+    mesh_data: int | None = None,
+    mesh_model: int = 1,
+    **model_kwargs,
+):
     """Train on all data to date, persist model + metrics (reference stage 1).
+
+    ``mesh_data``/``mesh_model`` > 1 (spec args or ``train --mesh-data``)
+    run the fit as the dp x tp sharded training step over a device mesh —
+    see :func:`bodywork_tpu.train.train_on_history`.
 
     If the runner already ran this day's train as a lookahead (overlapped
     with the previous day's test stage — the training set for day d is
@@ -142,6 +152,8 @@ def train_stage(ctx: StageContext, model_type: str = "linear", **model_kwargs):
         prewarm_next=ctx.persistent_process,
         rows_per_day=ctx.drift.n_samples,
         persist=not ctx.defer_artefacts,
+        mesh_data=mesh_data,
+        mesh_model=mesh_model,
     )
 
 
@@ -150,6 +162,7 @@ def serve_stage(
     host: str = "127.0.0.1",
     port: int = 0,
     buckets: tuple[int, ...] | None = None,
+    replicas: int = 1,
 ) -> ServiceHandle:
     """Load the latest model into device HBM and start the scoring service
     on a background thread (reference stage 2). Returns the handle; the
@@ -158,7 +171,14 @@ def serve_stage(
 
     ``buckets`` narrows the predictor's compiled shape set (each warmed
     bucket costs one device dispatch at startup) — the pipeline spec sets it
-    to match the tester's request sizes."""
+    to match the tester's request sizes.
+
+    ``replicas > 1`` (the runner passes the spec's count — reference
+    ``bodywork.yaml:40``) serves through N independent app instances behind
+    a round-robin front, so multi-replica semantics are exercised locally,
+    not just in emitted Deployment YAML. Replicas share the HBM-resident
+    params (read-only), like the reference's replicas share the S3
+    artefact."""
     # Load the artefact WITHOUT the host->device transfer first: if the
     # in-process train stage produced this exact checkpoint this day, its
     # params are already resident in HBM — verify the artefact bytes match
@@ -187,13 +207,20 @@ def serve_stage(
     # syncs when something new was dispatched — so the persistent day-loop
     # pays the error-surfacing sync exactly once (day 1), one-shot pods
     # always (device faults fail startup, not requests)
-    app = create_app(
-        model,
-        model_date,
-        buckets=tuple(buckets) if buckets else None,
-    )
-    handle = ServiceHandle(app, host=host, port=port).start()
-    handle.app = app
+    apps = [
+        create_app(
+            model,
+            model_date,
+            buckets=tuple(buckets) if buckets else None,
+        )
+        for _ in range(max(replicas, 1))
+    ]
+    from bodywork_tpu.serve.server import RoundRobinApp
+
+    front = RoundRobinApp(apps) if len(apps) > 1 else apps[0]
+    handle = ServiceHandle(front, host=host, port=port).start()
+    handle.app = front
+    handle.replica_apps = apps
     return handle
 
 

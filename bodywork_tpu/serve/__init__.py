@@ -1,5 +1,15 @@
 from bodywork_tpu.serve.predictor import PaddedPredictor
 from bodywork_tpu.serve.app import create_app
-from bodywork_tpu.serve.server import ServiceHandle, serve_latest_model
+from bodywork_tpu.serve.server import (
+    RoundRobinApp,
+    ServiceHandle,
+    serve_latest_model,
+)
 
-__all__ = ["PaddedPredictor", "create_app", "ServiceHandle", "serve_latest_model"]
+__all__ = [
+    "PaddedPredictor",
+    "RoundRobinApp",
+    "create_app",
+    "ServiceHandle",
+    "serve_latest_model",
+]

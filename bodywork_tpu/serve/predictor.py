@@ -106,9 +106,20 @@ class PaddedPredictor:
         )
 
     def _warm_key_extra(self) -> tuple:
-        """Extra warm-cache key material for subclasses whose compiled
-        program depends on more than (model class, shape) — e.g. the mesh."""
-        return ()
+        """Extra warm-cache key material beyond (model class, shape): the
+        params' device placement. Two same-shape models pinned to different
+        devices (an A/B run) compile distinct per-device executables — a
+        shared key would skip the second variant's warmup and push its
+        compile (and any device fault) onto the first scoring request.
+        Subclasses add what else their program depends on (e.g. the mesh).
+        """
+        import jax
+
+        ids = set()
+        for leaf in jax.tree_util.tree_leaves(self.model.params):
+            if isinstance(leaf, jax.Array):
+                ids.update(d.id for d in leaf.devices())
+        return tuple(sorted(ids))
 
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:

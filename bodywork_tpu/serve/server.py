@@ -12,6 +12,7 @@ Two run modes replace the reference's bare ``app.run`` (``stage_2:108-116``):
 """
 from __future__ import annotations
 
+import itertools
 import threading
 
 from werkzeug.serving import make_server
@@ -22,6 +23,33 @@ from bodywork_tpu.store.base import ArtefactStore
 from bodywork_tpu.utils.logging import get_logger
 
 log = get_logger("serve.server")
+
+
+class RoundRobinApp:
+    """WSGI front alternating requests across N replica apps.
+
+    The local stand-in for the k8s Service load-balancing across the
+    reference's 2 Deployment replicas (``bodywork.yaml:40-42``): replicas
+    are stateless with read-only model state, so a request is served
+    identically by any of them; this front just guarantees every replica
+    actually takes traffic in local runs and tests.
+    """
+
+    def __init__(self, apps):
+        assert apps, "need at least one replica app"
+        self.apps = list(apps)
+        self._counter = itertools.count()
+
+    def __call__(self, environ, start_response):
+        app = self.apps[next(self._counter) % len(self.apps)]
+        return app(environ, start_response)
+
+    def test_client(self):
+        """Werkzeug test client over the front (same shape as
+        ``Flask.test_client`` — what ``InProcessScoringClient`` needs)."""
+        from werkzeug.test import Client
+
+        return Client(self)
 
 
 class ServiceHandle:

@@ -61,15 +61,24 @@ def _drain() -> None:
         worker.join()
 
 
+def _caller_device():
+    """The caller's effective default device (respects the thread-local
+    ``jax.default_device`` context an A/B runner pins its threads with)."""
+    import jax
+
+    return jax.config.jax_default_device
+
+
 def _key(
     model_type: str,
     model_kwargs: dict | None,
     fit_b: int,
     eval_b: int,
     n_features: int,
+    device,
 ):
     frozen = tuple(sorted((model_kwargs or {}).items(), key=repr))
-    return (model_type, repr(frozen), fit_b, eval_b, n_features)
+    return (model_type, repr(frozen), fit_b, eval_b, n_features, str(device))
 
 
 def next_buckets(n_total_next: int, test_size: float) -> tuple[int, int]:
@@ -91,8 +100,11 @@ def register_compiled(
     rows, so ``prewarm_async`` never re-queues a bucket the jit cache
     already holds."""
     fit_b, eval_b = next_buckets(n_total, test_size)
+    device = _caller_device()
     with _lock:
-        _warmed.add(_key(model_type, model_kwargs, fit_b, eval_b, n_features))
+        _warmed.add(
+            _key(model_type, model_kwargs, fit_b, eval_b, n_features, device)
+        )
 
 
 def _work_loop() -> None:
@@ -102,10 +114,14 @@ def _work_loop() -> None:
             if not _queue or _cancelled.is_set():
                 _worker = None
                 return
-            model_type, model_kwargs, fit_b, eval_b, n_features, key = (
+            model_type, model_kwargs, fit_b, eval_b, n_features, device, key = (
                 _queue.pop(0)
             )
         try:
+            import contextlib
+
+            import jax
+
             from bodywork_tpu.train.trainer import make_model
 
             model = make_model(model_type, **(model_kwargs or {}))
@@ -119,7 +135,16 @@ def _work_loop() -> None:
             xe1 = np.linspace(0.0, 100.0, eval_b, dtype=np.float32)
             Xe = np.tile(xe1[:, None], (1, n_features))
             ye = (1.0 + 0.5 * xe1).astype(np.float32)
-            model.fit_and_evaluate(X, y, Xe, ye, materialize=False)
+            # compile for the enqueuing caller's device (an A/B variant
+            # pinned off device 0 must not warm — or contend with — the
+            # default device), not the worker thread's own default
+            ctx = (
+                jax.default_device(device)
+                if device is not None
+                else contextlib.nullcontext()
+            )
+            with ctx:
+                model.fit_and_evaluate(X, y, Xe, ye, materialize=False)
             log.info(
                 f"pre-warmed {model_type} buckets fit={fit_b} eval={eval_b}"
             )
@@ -148,13 +173,14 @@ def prewarm_async(
     """
     global _worker
     fit_b, eval_b = next_buckets(n_total_next, test_size)
-    key = _key(model_type, model_kwargs, fit_b, eval_b, n_features)
+    device = _caller_device()
+    key = _key(model_type, model_kwargs, fit_b, eval_b, n_features, device)
     with _lock:
         if key in _warmed or _cancelled.is_set():
             return None
         _warmed.add(key)
         _queue.append(
-            (model_type, model_kwargs, fit_b, eval_b, n_features, key)
+            (model_type, model_kwargs, fit_b, eval_b, n_features, device, key)
         )
         if _worker is None:
             _worker = threading.Thread(

@@ -95,6 +95,36 @@ def persist_metrics(
     return key
 
 
+def _fit_sharded(model, model_type, split, mesh_data, mesh_model, fit_seed):
+    """Fit over a dp x tp mesh and evaluate on the held-out split."""
+    if model_type != "mlp":
+        raise ValueError(
+            f"sharded training (mesh_data={mesh_data}, "
+            f"mesh_model={mesh_model}) requires model_type='mlp', "
+            f"got {model_type!r}"
+        )
+    import jax
+
+    from bodywork_tpu.models.metrics import regression_metrics
+    from bodywork_tpu.parallel import make_mesh, multihost_init, train_mlp_sharded
+
+    multihost_init()
+    devices = jax.devices()
+    data = mesh_data if mesh_data else max(len(devices) // mesh_model, 1)
+    n_needed = data * mesh_model
+    if n_needed > len(devices):
+        raise ValueError(
+            f"mesh {data}x{mesh_model} needs {n_needed} devices, "
+            f"have {len(devices)}"
+        )
+    mesh = make_mesh(data=data, model=mesh_model, devices=devices[:n_needed])
+    fitted = train_mlp_sharded(
+        split.X_train, split.y_train, model.config, mesh, seed=fit_seed
+    )
+    metrics = regression_metrics(split.y_test, fitted.predict(split.X_test))
+    return fitted, metrics
+
+
 def train_on_history(
     store: ArtefactStore,
     model_type: str = "linear",
@@ -105,6 +135,8 @@ def train_on_history(
     prewarm_next: bool = False,
     rows_per_day: int | None = None,
     persist: bool = True,
+    mesh_data: int | None = None,
+    mesh_model: int = 1,
 ) -> TrainResult:
     """Run the full train stage against an artefact store.
 
@@ -117,15 +149,31 @@ def train_on_history(
     block at exit joining the warm thread, so it defaults off.
     ``rows_per_day`` bounds tomorrow's history growth (defaults to the
     standard generator's daily sample count).
+
+    ``mesh_data``/``mesh_model`` > 1 route the fit through the dp x tp
+    sharded training step (:func:`~bodywork_tpu.parallel.train_mlp_sharded`)
+    over a ``(mesh_data, mesh_model)`` device mesh — MLP only (the linear
+    model is closed-form; sharding it has nothing to parallelise). On a
+    multi-host pool the process joins the JAX cluster first
+    (:func:`~bodywork_tpu.parallel.multihost_init`), so the mesh may span
+    hosts. The fitted model checkpoints and serves exactly like the
+    single-device one.
     """
+    use_mesh = (mesh_data or 0) > 1 or mesh_model > 1
     ds = load_all_datasets(store)
     split = train_test_split(ds.X, ds.y, test_size=test_size, seed=split_seed)
     model = make_model(model_type, **(model_kwargs or {}))
-    # fused fit+eval: one XLA program, one device->host transfer for params
-    # and metrics together (models/fused.py)
-    fitted, metrics = model.fit_and_evaluate(
-        split.X_train, split.y_train, split.X_test, split.y_test, seed=fit_seed
-    )
+    if use_mesh:
+        fitted, metrics = _fit_sharded(
+            model, model_type, split, mesh_data, mesh_model, fit_seed
+        )
+    else:
+        # fused fit+eval: one XLA program, one device->host transfer for
+        # params and metrics together (models/fused.py)
+        fitted, metrics = model.fit_and_evaluate(
+            split.X_train, split.y_train, split.X_test, split.y_test,
+            seed=fit_seed,
+        )
     log.info(
         f"trained {fitted.info} on {len(ds)} rows to {ds.date}: "
         f"MAPE={metrics['MAPE']:.4f} r2={metrics['r_squared']:.4f} "
@@ -139,7 +187,9 @@ def train_on_history(
         metrics_key = persist_metrics(store, metrics, ds.date)
     else:
         model_key_ = metrics_key = None
-    if prewarm_next:
+    if prewarm_next and not use_mesh:
+        # the prewarm machinery compiles the single-device fused-fit
+        # buckets, which the sharded path never dispatches
         from bodywork_tpu.data.generator import DriftConfig
         from bodywork_tpu.train.prewarm import prewarm_async, register_compiled
 

@@ -145,7 +145,8 @@ def test_serve_reuses_hbm_resident_params(runner, store):
     result = runner.run_day(start)
     tr = result.stage_results["stage-1-train-model"]
     handle = result.stage_results["stage-2-serve-model"]
-    assert handle.app.predictor.model is tr.model
+    # every replica app (spec replicas: 2) shares the HBM-resident model
+    assert all(app.predictor.model is tr.model for app in handle.replica_apps)
 
 
 def test_lookahead_never_persists_before_collection(store):

@@ -194,3 +194,53 @@ def test_app_with_data_parallel_predictor(linear_model):
     np.testing.assert_allclose(
         body["predictions"], model.predict(X[:100, None]), rtol=1e-4
     )
+
+
+def test_day_loop_with_sharded_training(tmp_path):
+    # VERDICT r1 #4 done-criterion: a full simulated day runs end-to-end
+    # with dp x tp sharded training on the virtual 8-device mesh, driven
+    # purely by pipeline-spec args (what the CLI/YAML path expresses)
+    from datetime import date
+
+    from bodywork_tpu.pipeline import LocalRunner, default_pipeline
+    from bodywork_tpu.store import FilesystemStore
+
+    spec = default_pipeline(model_type="mlp")
+    spec.stages["stage-1-train-model"].args.update(
+        {"mesh_data": 4, "mesh_model": 2, "hidden": [8, 8], "n_steps": 12}
+    )
+    spec.stages["stage-1-train-model"].max_completion_time_s = 120.0
+    store = FilesystemStore(tmp_path / "artefacts")
+    runner = LocalRunner(spec, store)
+    results = runner.run_simulation(date(2026, 1, 1), 2)
+    assert len(results) == 2
+    from bodywork_tpu.store.schema import MODELS_PREFIX, TEST_METRICS_PREFIX
+
+    assert len(store.history(MODELS_PREFIX)) == 2
+    assert len(store.history(TEST_METRICS_PREFIX)) == 2
+
+
+def test_multihost_init_joins_only_with_coordinator(monkeypatch):
+    import jax
+
+    from bodywork_tpu.parallel.mesh import multihost_init
+
+    # no coordinator env: a single-host process must not try to join
+    monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    assert multihost_init() is False
+
+    # with the GKE-style coordinator env, the process joins the cluster
+    calls = []
+    monkeypatch.setenv("COORDINATOR_ADDRESS", "coordinator:8476")
+    monkeypatch.setattr(jax.distributed, "initialize", lambda: calls.append(1))
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    assert multihost_init() is True
+    assert calls == [1]
+
+    # idempotent: the daily retrain path calls it every day, and
+    # jax.distributed.initialize raises if called twice
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+    assert multihost_init() is True
+    assert calls == [1]

