@@ -153,8 +153,17 @@ class LocalRunner:
         args = dict(stage.args)
         if stage.replicas > 1:
             # honour the spec's replica count locally (reference
-            # bodywork.yaml:40), not just in emitted Deployment YAML
-            args.setdefault("replicas", stage.replicas)
+            # bodywork.yaml:40), not just in emitted Deployment YAML —
+            # but only for executables that can take it (a custom service
+            # callable without the parameter must keep working)
+            import inspect
+
+            params = inspect.signature(fn).parameters
+            if "replicas" in params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params.values()
+            ):
+                args.setdefault("replicas", stage.replicas)
         with _device_ctx(self.device):
             handle = fn(ctx, **args)
         # health-check before the DAG proceeds (k8s readiness probe analogue)

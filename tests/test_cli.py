@@ -31,3 +31,161 @@ def test_deploy_writes_manifests(tmp_path, capsys):
     files = sorted(p.name for p in out_dir.iterdir())
     assert "00-namespace.yaml" in files
     assert any("cronjob" in f for f in files)
+
+
+def _seed(store, days=1):
+    for i in range(days):
+        assert main(["generate", "--store", store, "--date", f"2026-01-0{i+1}"]) == 0
+
+
+def test_serve_subcommand_over_http(tmp_path):
+    # VERDICT r1 #7: `serve` had no CLI-level test. Run the real blocking
+    # entrypoint in a subprocess on port 0, find the bound URL from its
+    # log line, and hit /healthz and /score/v1 over the socket.
+    import os
+    import re
+    import subprocess
+    import sys
+
+    import requests
+
+    store = str(tmp_path / "artefacts")
+    _seed(store)
+    assert main(["train", "--store", store]) == 0
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "bodywork_tpu.cli", "serve", "--store", store,
+         "--host", "127.0.0.1", "--port", "0"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+    )
+    try:
+        # read on a thread: a silently-hung child would otherwise block
+        # the pipe read forever and the deadline would never be checked
+        import threading
+
+        found = {}
+        got_url = threading.Event()
+
+        def _scan():
+            for line in proc.stdout:
+                m = re.search(r"listening on (http://\S+)/score/v1", line)
+                if m:
+                    found["url"] = m.group(1)
+                    got_url.set()
+                    return
+            got_url.set()  # EOF: child exited without serving
+
+        threading.Thread(target=_scan, daemon=True).start()
+        assert got_url.wait(60), "serve never reported its URL within 60s"
+        url = found.get("url")
+        assert url, f"serve exited early: rc={proc.poll()}"
+        assert requests.get(url + "/healthz", timeout=5).ok
+        body = requests.post(url + "/score/v1", json={"X": 50}, timeout=5).json()
+        assert "prediction" in body and "model_info" in body
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_test_subcommand_against_live_service(tmp_path, capsys):
+    # `test` scores the latest dataset through a live HTTP service and
+    # persists drift metrics (reference stage 4)
+    from datetime import date as _date
+
+    from bodywork_tpu.models.checkpoint import load_model
+    from bodywork_tpu.serve import ServiceHandle, create_app
+    from bodywork_tpu.store import open_store
+
+    store = str(tmp_path / "artefacts")
+    _seed(store)
+    assert main(["train", "--store", store]) == 0
+    model, model_date = load_model(open_store(store))
+    app = create_app(model, model_date, warmup=False)
+    with ServiceHandle(app, port=0) as handle:
+        base = handle.url.replace("/score/v1", "")
+        assert main(
+            ["test", "--store", store, "--scoring-url", base + "/score/v1"]
+        ) == 0
+    out = capsys.readouterr().out
+    assert "MAPE" in out
+    from bodywork_tpu.store.schema import TEST_METRICS_PREFIX
+
+    assert open_store(store).history(TEST_METRICS_PREFIX)
+
+
+def test_run_sim_two_days(tmp_path, capsys):
+    store = str(tmp_path / "artefacts")
+    assert main(["run-sim", "--store", store, "--days", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "mean" in out and "2 day(s)" in out
+
+
+def test_run_ab_on_cpu_mesh(tmp_path, capsys):
+    root = str(tmp_path / "ab")
+    assert main(
+        ["run-ab", "--store", root, "--days", "1", "--date", "2026-01-01",
+         "--models", "linear,linear"]
+    ) == 0
+    out = capsys.readouterr().out
+    # one row per (day, variant); variant column present
+    assert "a-linear" in out and "b-linear" in out
+
+
+def test_run_stage_single_stage(tmp_path):
+    from bodywork_tpu.store import open_store
+    from bodywork_tpu.store.schema import DATASETS_PREFIX
+
+    store = str(tmp_path / "artefacts")
+    assert main(
+        ["run-stage", "--store", store, "--stage",
+         "stage-3-generate-next-dataset", "--date", "2026-01-01"]
+    ) == 0
+    # generate stage produces *tomorrow's* dataset (reference stage 3)
+    history = open_store(store).history(DATASETS_PREFIX)
+    assert [d for _k, d in history] == [__import__("datetime").date(2026, 1, 2)]
+
+
+def test_wait_for_success_and_timeout(tmp_path, capsys):
+    store = str(tmp_path / "artefacts")
+    # timeout path: no model ever appears -> exit 1
+    assert main(
+        ["wait-for", "--store", store, "--model", "--timeout", "0.3",
+         "--poll-interval", "0.05"]
+    ) == 1
+    # success path: dataset exists -> exit 0
+    _seed(store)
+    assert main(["wait-for", "--store", store, "--dataset",
+                 "--timeout", "5"]) == 0
+    assert "conditions met" in capsys.readouterr().out
+
+
+def test_deploy_spec_file_precedence(tmp_path):
+    # an explicit --spec wins over --model/--mode flags (how in-cluster
+    # pods receive the deploy-time configuration)
+    import yaml
+
+    from bodywork_tpu.pipeline import default_pipeline
+
+    spec_file = tmp_path / "pipeline.yaml"
+    spec_file.write_text(default_pipeline(model_type="mlp").to_yaml())
+    out_dir = tmp_path / "k8s"
+    assert main(["deploy", "--out", str(out_dir), "--spec", str(spec_file),
+                 "--model", "linear"]) == 0
+    cm = yaml.safe_load((out_dir / "00-pipeline-spec-configmap.yaml").read_text())
+    assert "model_type: mlp" in cm["data"]["pipeline.yaml"]
+
+
+def test_train_mesh_flags_reach_sharded_path(tmp_path, capsys):
+    # `train --mesh-data/--mesh-model` arg wiring: rejects linear (the
+    # sharded path is MLP-only), exit-code contract intact
+    store = str(tmp_path / "artefacts")
+    _seed(store)
+    assert main(["train", "--store", store, "--model", "linear",
+                 "--mesh-data", "4"]) == 1

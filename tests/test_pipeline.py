@@ -363,3 +363,29 @@ def test_day_loop_honours_service_replicas(tmp_path):
     assert len(handle.replica_apps) == 2
     metrics = result.stage_results["stage-4-test-model-scoring-service"]
     assert float(metrics["MAPE"].iloc[0]) > 0
+
+
+def _minimal_service_stage(ctx, host="127.0.0.1", port=0):
+    # a custom service executable WITHOUT a `replicas` parameter
+    from bodywork_tpu.serve import ServiceHandle
+
+    def ok_app(environ, start_response):
+        start_response("200 OK", [("Content-Type", "application/json")])
+        return [b'{"status": "ok"}']
+
+    # routes /healthz and everything else identically
+    return ServiceHandle(ok_app, host=host, port=port).start()
+
+
+def test_replica_count_not_forced_on_custom_service_executables(store):
+    # a spec with replicas: 2 and a custom serve callable lacking the
+    # parameter must still start (the runner only injects `replicas` when
+    # the executable can accept it)
+    stage = StageSpec(
+        name="svc", kind="service",
+        executable="tests.test_pipeline:_minimal_service_stage",
+        replicas=2, retries=0,
+    )
+    spec = PipelineSpec(name="t", dag=[["svc"]], stages={"svc": stage})
+    result = LocalRunner(spec, store).run_day(date(2026, 1, 1))
+    assert "svc" in result.stage_results

