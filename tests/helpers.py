@@ -1,0 +1,64 @@
+"""Shared test harnesses (used by test_cli.py and test_examples.py)."""
+from __future__ import annotations
+
+import contextlib
+import os
+import re
+import subprocess
+import sys
+import threading
+
+_LISTEN_RE = re.compile(r"listening on (http://\S+)/score/v1")
+
+
+@contextlib.contextmanager
+def serve_subprocess(argv: list[str], timeout_s: float = 60.0):
+    """Spawn a blocking serve entrypoint as a subprocess and yield its bound
+    base URL (port 0 resolution read from the 'listening on' log line).
+
+    Reads the child's output on a thread: a silently-hung child would
+    otherwise block the pipe read forever and no deadline could fire.
+    """
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.Popen(
+        [sys.executable, *argv],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+    )
+    try:
+        found: dict = {}
+        ready = threading.Event()
+
+        def _scan():
+            for line in proc.stdout:
+                m = _LISTEN_RE.search(line)
+                if m:
+                    found["url"] = m.group(1)
+                    ready.set()
+                    return
+            ready.set()  # EOF: child exited without serving
+
+        threading.Thread(target=_scan, daemon=True).start()
+        assert ready.wait(timeout_s), (
+            f"serve never reported its URL within {timeout_s}s"
+        )
+        assert "url" in found, f"serve exited early: rc={proc.poll()}"
+        yield found["url"]
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+@contextlib.contextmanager
+def live_scoring_service(store):
+    """Serve the store's latest checkpoint in-process and yield the base URL
+    (strip the scoring path to get the service root)."""
+    from bodywork_tpu.models.checkpoint import load_model
+    from bodywork_tpu.serve import ServiceHandle, create_app
+
+    model, model_date = load_model(store)
+    app = create_app(model, model_date, warmup=False)
+    with ServiceHandle(app, port=0) as handle:
+        yield handle.url.replace("/score/v1", "")

@@ -42,74 +42,33 @@ def test_serve_subcommand_over_http(tmp_path):
     # VERDICT r1 #7: `serve` had no CLI-level test. Run the real blocking
     # entrypoint in a subprocess on port 0, find the bound URL from its
     # log line, and hit /healthz and /score/v1 over the socket.
-    import os
-    import re
-    import subprocess
-    import sys
-
     import requests
+
+    from tests.helpers import serve_subprocess
 
     store = str(tmp_path / "artefacts")
     _seed(store)
     assert main(["train", "--store", store]) == 0
-    env = dict(
-        os.environ,
-        JAX_PLATFORMS="cpu",
-        PALLAS_AXON_POOL_IPS="",
-    )
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "bodywork_tpu.cli", "serve", "--store", store,
-         "--host", "127.0.0.1", "--port", "0"],
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-        text=True,
-        env=env,
-    )
-    try:
-        # read on a thread: a silently-hung child would otherwise block
-        # the pipe read forever and the deadline would never be checked
-        import threading
-
-        found = {}
-        got_url = threading.Event()
-
-        def _scan():
-            for line in proc.stdout:
-                m = re.search(r"listening on (http://\S+)/score/v1", line)
-                if m:
-                    found["url"] = m.group(1)
-                    got_url.set()
-                    return
-            got_url.set()  # EOF: child exited without serving
-
-        threading.Thread(target=_scan, daemon=True).start()
-        assert got_url.wait(60), "serve never reported its URL within 60s"
-        url = found.get("url")
-        assert url, f"serve exited early: rc={proc.poll()}"
+    with serve_subprocess(
+        ["-m", "bodywork_tpu.cli", "serve", "--store", store,
+         "--host", "127.0.0.1", "--port", "0"]
+    ) as url:
         assert requests.get(url + "/healthz", timeout=5).ok
         body = requests.post(url + "/score/v1", json={"X": 50}, timeout=5).json()
         assert "prediction" in body and "model_info" in body
-    finally:
-        proc.terminate()
-        proc.wait(timeout=10)
 
 
 def test_test_subcommand_against_live_service(tmp_path, capsys):
     # `test` scores the latest dataset through a live HTTP service and
     # persists drift metrics (reference stage 4)
-    from datetime import date as _date
-
-    from bodywork_tpu.models.checkpoint import load_model
-    from bodywork_tpu.serve import ServiceHandle, create_app
     from bodywork_tpu.store import open_store
+
+    from tests.helpers import live_scoring_service
 
     store = str(tmp_path / "artefacts")
     _seed(store)
     assert main(["train", "--store", store]) == 0
-    model, model_date = load_model(open_store(store))
-    app = create_app(model, model_date, warmup=False)
-    with ServiceHandle(app, port=0) as handle:
-        base = handle.url.replace("/score/v1", "")
+    with live_scoring_service(open_store(store)) as base:
         assert main(
             ["test", "--store", store, "--scoring-url", base + "/score/v1"]
         ) == 0
