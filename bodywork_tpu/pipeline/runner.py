@@ -422,8 +422,13 @@ class LocalRunner:
         from bodywork_tpu.train.prewarm import prewarm_async
 
         model_type = stage.args.get("model_type", "linear")
+        if stage.args.get("mesh_data") or stage.args.get("mesh_model", 1) > 1:
+            # sharded training dispatches mesh programs the single-device
+            # prewarm cannot represent (and mesh_* are not model kwargs)
+            return
         model_kwargs = {
-            k: v for k, v in stage.args.items() if k != "model_type"
+            k: v for k, v in stage.args.items()
+            if k not in ("model_type", "mesh_data", "mesh_model")
         } or None
         # Base the estimate on the ACTUAL persisted history size (the y>=0
         # filter drops a sigma-dependent fraction of n_samples, so counting
@@ -464,6 +469,20 @@ class LocalRunner:
                 for o in self._generate_offsets()
             ]
         )
+        # Pay ALL the horizon's bucket compiles during bootstrap (dataset
+        # prefetch above overlaps the wait). A compile (~0.3 s linear,
+        # seconds for the MLP) dwarfs a steady-state day, so letting the
+        # serialized prewarm worker race the loop puts bucket-crossing
+        # compiles back on the critical path it exists to clear.
+        if days > 1:
+            from bodywork_tpu.train.prewarm import wait_idle
+
+            t0 = time.perf_counter()
+            wait_idle()
+            log.info(
+                f"horizon bucket compiles drained in "
+                f"{time.perf_counter() - t0:.2f}s (bootstrap cost)"
+            )
         results = []
         with maybe_trace(profile_dir, label=f"{days}-day simulation"):
             for i in range(days):

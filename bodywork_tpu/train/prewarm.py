@@ -154,6 +154,31 @@ def _work_loop() -> None:
                 _warmed.discard(key)
 
 
+def wait_idle(timeout_s: float | None = None) -> bool:
+    """Block until every queued bucket compile has finished (or the timeout
+    elapses; returns False then). Lets an orchestrator that knows its whole
+    horizon pay ALL compiles during bootstrap — steady-state days then never
+    race the background worker for a bucket-crossing compile."""
+    import time as _time
+
+    deadline = None if timeout_s is None else _time.monotonic() + timeout_s
+    while True:
+        with _lock:
+            worker = _worker
+            empty = not _queue
+        if worker is None and empty:
+            return True
+        if worker is not None:
+            remaining = None if deadline is None else deadline - _time.monotonic()
+            if remaining is not None and remaining <= 0:
+                return False
+            worker.join(timeout=remaining)
+        if deadline is not None and _time.monotonic() > deadline:
+            with _lock:
+                done = _worker is None and not _queue
+            return done
+
+
 def prewarm_async(
     model_type: str,
     model_kwargs: dict | None,
