@@ -109,10 +109,61 @@ def _time_requests(url: str, payload: dict, rows: int, requests: int) -> float:
     return (time.perf_counter() - t0) / requests
 
 
+def time_device_batch(dispatch, X, iters: int = 30) -> dict:
+    """Device-side (HTTP-free) latency of one batch through ``dispatch``.
+
+    The input is ``device_put`` once so no per-call host->device transfer is
+    timed. Two numbers, because on a tunnel-attached TPU they differ by the
+    tunnel round-trip:
+
+    - ``sync_s`` — mean of per-dispatch ``block_until_ready``: what one
+      isolated request would wait for the device, including one full
+      host<->device round-trip per call (RTT-floor-bound over a tunnel).
+    - ``pipelined_s`` — N dispatches then ONE block, divided by N: the
+      round-trip amortises away, leaving per-batch device execution +
+      dispatch cost. This is the number that isolates the serving engine
+      (XLA vs Pallas) from the transport.
+    """
+    import jax
+
+    Xd = jax.device_put(jnp_float32(X))
+    jax.block_until_ready(dispatch(Xd))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        jax.block_until_ready(dispatch(Xd))
+    sync_s = (time.perf_counter() - t0) / iters
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = dispatch(Xd)
+    jax.block_until_ready(out)
+    pipelined_s = (time.perf_counter() - t0) / iters
+    return {
+        "device_sync_s": round(sync_s, 6),
+        "device_pipelined_s": round(pipelined_s, 6),
+        "iters": iters,
+    }
+
+
+def jnp_float32(X):
+    import jax.numpy as jnp
+    import numpy as np
+
+    X = np.asarray(X, dtype=np.float32)
+    if X.ndim == 1:
+        X = X[:, None]
+    return jnp.asarray(X)
+
+
 def bench_batched_scoring(rows: int = 1000, requests: int = 20) -> dict:
     """Config 4: 1k-row predict requests through the (data-parallel when
     the pool allows) scoring service; on a real TPU also through the fused
     Pallas MLP kernel (``engine='pallas'``) for an engine-vs-engine record.
+
+    Each engine sub-record additionally carries a device-side measurement
+    (:func:`time_device_batch`) so the record separates what the tunnel
+    costs (end-to-end HTTP value minus ``device_sync_s``) from what the
+    engine costs (``device_pipelined_s``).
     """
     import jax
     import numpy as np
@@ -122,14 +173,17 @@ def bench_batched_scoring(rows: int = 1000, requests: int = 20) -> dict:
     from bodywork_tpu.store import FilesystemStore
     from bodywork_tpu.train import train_on_history
 
+    from functools import partial
+
     store = FilesystemStore(tempfile.mkdtemp(prefix="bench-score-"))
     d = date(2026, 1, 1)
     X, y = generate_day(d)
     persist_dataset(store, Dataset(X, y, d))
-    train_on_history(store, "linear")
+    linear_result = train_on_history(store, "linear")
     n_dev = len(jax.devices())
     rng = np.random.default_rng(0)
-    payload = {"X": [float(v) for v in rng.uniform(0, 100, rows)]}
+    request_rows = rng.uniform(0, 100, rows)
+    payload = {"X": [float(v) for v in request_rows]}
 
     handle = serve_latest_model(
         store,
@@ -149,6 +203,13 @@ def bench_batched_scoring(rows: int = 1000, requests: int = 20) -> dict:
         # reference scores serially at 8.22 ms/row => 1k rows = 8.22 s
         "vs_baseline": round(rows * BASELINE_REQUEST_S / value, 2),
     }
+    # device-side view of the same batch, no HTTP: end-to-end minus
+    # device_sync is what the transport (tunnel) costs
+    linear_model = linear_result.model
+    linear_apply = jax.jit(type(linear_model).apply)
+    record["device_batch_linear"] = time_device_batch(
+        partial(linear_apply, linear_model.params), request_rows
+    )
 
     # Engine-vs-engine sub-records: the SAME MLP checkpoint timed through
     # the XLA apply and through the fused Pallas kernel, so the pair
@@ -160,7 +221,21 @@ def bench_batched_scoring(rows: int = 1000, requests: int = 20) -> dict:
         # a sub-bench failure (e.g. the first real-TPU Mosaic compile)
         # must not discard the already-measured records above
         try:
-            train_on_history(store, "mlp", model_kwargs={"hidden": [64, 64, 64]})
+            from bodywork_tpu.ops import make_pallas_mlp_apply
+
+            mlp_result = train_on_history(
+                store, "mlp", model_kwargs={"hidden": [64, 64, 64]}
+            )
+            mlp_model = mlp_result.model
+            xla_apply = jax.jit(type(mlp_model).apply)
+            device_views = {
+                "xla": time_device_batch(
+                    partial(xla_apply, mlp_model.params), request_rows
+                ),
+                "pallas": time_device_batch(
+                    make_pallas_mlp_apply(mlp_model.params), request_rows
+                ),
+            }
             engine_values = {}
             for engine in ("xla", "pallas"):
                 handle = serve_latest_model(
@@ -178,6 +253,9 @@ def bench_batched_scoring(rows: int = 1000, requests: int = 20) -> dict:
                     "value": round(value, 5),
                     "unit": "s/request",
                     "vs_baseline": round(rows * BASELINE_REQUEST_S / value, 2),
+                    # the engine-isolating number: device_pipelined_s is
+                    # per-batch execution with the tunnel RTT amortised out
+                    **device_views[engine],
                 }
         except Exception as exc:
             record["pallas_engine"] = {
