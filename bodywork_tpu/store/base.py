@@ -26,6 +26,19 @@ class ArtefactNotFound(KeyError):
 class ArtefactStore(abc.ABC):
     """Flat byte store with ``/``-separated keys and date-key versioning."""
 
+    @staticmethod
+    def validate_key(key: str) -> str:
+        """Reject keys that could escape or alias the store namespace.
+
+        Part of the backend contract (every backend enforces it, not just
+        the filesystem one where it doubles as path-traversal protection):
+        a key accepted by one backend must be accepted by all, or artefacts
+        written locally could be unwritable against GCS and vice versa.
+        """
+        if not key or key.startswith(("/", "..")) or ".." in key.split("/"):
+            raise ValueError(f"invalid artefact key: {key!r}")
+        return key
+
     # -- raw byte plane ----------------------------------------------------
     @abc.abstractmethod
     def put_bytes(self, key: str, data: bytes) -> None: ...

@@ -20,9 +20,7 @@ class FilesystemStore(ArtefactStore):
         self.root.mkdir(parents=True, exist_ok=True)
 
     def _path(self, key: str) -> Path:
-        if not key or key.startswith(("/", "..")) or ".." in key.split("/"):
-            raise ValueError(f"invalid artefact key: {key!r}")
-        return self.root / key
+        return self.root / self.validate_key(key)
 
     def put_bytes(self, key: str, data: bytes) -> None:
         path = self._path(key)

@@ -30,6 +30,7 @@ class GCSStore(ArtefactStore):
         return cls(bucket, prefix)
 
     def _blob_name(self, key: str) -> str:
+        self.validate_key(key)
         return f"{self._prefix}/{key}" if self._prefix else key
 
     def exists(self, key: str) -> bool:
@@ -45,7 +46,8 @@ class GCSStore(ArtefactStore):
         return blob.download_as_bytes()
 
     def list_keys(self, prefix: str = "") -> list[str]:
-        full = self._blob_name(prefix)
+        # a prefix is not a key (may legitimately be empty) — no validation
+        full = f"{self._prefix}/{prefix}" if self._prefix else prefix
         strip = len(self._prefix) + 1 if self._prefix else 0
         return sorted(b.name[strip:] for b in self._client.list_blobs(self._bucket, prefix=full))
 
@@ -56,8 +58,13 @@ class GCSStore(ArtefactStore):
         blob.delete()
 
     def version_token(self, key: str):
-        # GCS object generation changes on every overwrite
-        blob = self._bucket.get_blob(self._blob_name(key))
+        # GCS object generation changes on every overwrite; invalid keys
+        # report "no token" like the filesystem backend (contract: token
+        # queries never raise)
+        try:
+            blob = self._bucket.get_blob(self._blob_name(key))
+        except ValueError:
+            return None
         return None if blob is None else blob.generation
 
     def version_tokens(self, keys: list[str]) -> dict[str, object]:
@@ -66,7 +73,14 @@ class GCSStore(ArtefactStore):
         # round-trip per key, without ever listing unrelated bucket
         # contents (keys from different prefixes must not degrade to a
         # whole-bucket listing).
-        wanted = {self._blob_name(k): k for k in keys}
+        wanted = {}
+        for k in keys:
+            try:
+                wanted[self._blob_name(k)] = k
+            except ValueError:
+                continue  # contract: token queries never raise; no token
+        if not wanted:
+            return {}
         dirs = {name.rsplit("/", 1)[0] + "/" if "/" in name else "" for name in wanted}
         out = {}
         for d in sorted(dirs):

@@ -1,4 +1,5 @@
-"""Shared test harnesses (used by test_cli.py and test_examples.py)."""
+"""Shared test harnesses (used by test_cli.py, test_examples.py, and the
+store contract suite)."""
 from __future__ import annotations
 
 import contextlib
@@ -7,6 +8,81 @@ import re
 import subprocess
 import sys
 import threading
+import types
+
+
+class FakeBlob:
+    """In-memory stand-in for google.cloud.storage.Blob (the subset the
+    GCSStore backend touches). Objects carry (bytes, generation) so
+    overwrite bumps the generation exactly as real GCS does."""
+
+    def __init__(self, bucket, name):
+        self._bucket = bucket
+        self.name = name
+
+    def exists(self):
+        return self.name in self._bucket._objects
+
+    def upload_from_string(self, data):
+        if isinstance(data, str):
+            data = data.encode()
+        gen = self._bucket._objects.get(self.name, (None, 0))[1] + 1
+        self._bucket._objects[self.name] = (data, gen)
+
+    def download_as_bytes(self):
+        return self._bucket._objects[self.name][0]
+
+    def delete(self):
+        del self._bucket._objects[self.name]
+
+    @property
+    def generation(self):
+        entry = self._bucket._objects.get(self.name)
+        return None if entry is None else entry[1]
+
+
+class FakeBucket:
+    def __init__(self, name):
+        self.name = name
+        self._objects = {}
+
+    def blob(self, name):
+        return FakeBlob(self, name)
+
+    def get_blob(self, name):
+        return FakeBlob(self, name) if name in self._objects else None
+
+
+class FakeClient:
+    _buckets: dict = {}
+
+    def bucket(self, name):
+        return self._buckets.setdefault(name, FakeBucket(name))
+
+    def list_blobs(self, bucket, prefix=""):
+        return [
+            FakeBlob(bucket, name)
+            for name in sorted(bucket._objects)
+            if name.startswith(prefix)
+        ]
+
+
+def install_fake_gcs(monkeypatch):
+    """Install the in-memory google.cloud.storage fake into sys.modules and
+    reset its bucket registry; returns the GCSStore class ready to use."""
+    fake_storage = types.SimpleNamespace(Client=FakeClient)
+    fake_cloud = types.ModuleType("google.cloud")
+    fake_cloud.storage = fake_storage
+    fake_google = types.ModuleType("google")
+    fake_google.cloud = fake_cloud
+    monkeypatch.setitem(sys.modules, "google", fake_google)
+    monkeypatch.setitem(sys.modules, "google.cloud", fake_cloud)
+    monkeypatch.setitem(sys.modules, "google.cloud.storage", fake_storage)
+    FakeClient._buckets = {}
+
+    from bodywork_tpu.store.gcs import GCSStore
+
+    return GCSStore
 
 _LISTEN_RE = re.compile(r"listening on (http://\S+)/score/v1")
 

@@ -1,81 +1,17 @@
-"""GCSStore backend against an in-memory fake of google.cloud.storage
-(the real package is not a dependency; SURVEY.md C7's GCS-ready interface
-must still be exercised)."""
-import sys
-import types
-
+"""GCSStore specifics beyond the backend contract (tests/test_store.py runs
+the shared contract suite over this backend): URL parsing, in-bucket prefix
+namespacing, and the batched version-token listing. Uses the in-memory
+google.cloud.storage fake from tests.helpers (the real package is not a
+dependency)."""
 import pytest
 
 from bodywork_tpu.store.base import ArtefactNotFound
-
-
-class FakeBlob:
-    def __init__(self, bucket, name):
-        self._bucket = bucket
-        self.name = name
-
-    def exists(self):
-        return self.name in self._bucket._objects
-
-    def upload_from_string(self, data):
-        if isinstance(data, str):
-            data = data.encode()
-        gen = self._bucket._objects.get(self.name, (None, 0))[1] + 1
-        self._bucket._objects[self.name] = (data, gen)
-
-    def download_as_bytes(self):
-        return self._bucket._objects[self.name][0]
-
-    def delete(self):
-        del self._bucket._objects[self.name]
-
-    @property
-    def generation(self):
-        entry = self._bucket._objects.get(self.name)
-        return None if entry is None else entry[1]
-
-
-class FakeBucket:
-    def __init__(self, name):
-        self.name = name
-        self._objects = {}
-
-    def blob(self, name):
-        return FakeBlob(self, name)
-
-    def get_blob(self, name):
-        return FakeBlob(self, name) if name in self._objects else None
-
-
-class FakeClient:
-    _buckets: dict = {}
-
-    def bucket(self, name):
-        return self._buckets.setdefault(name, FakeBucket(name))
-
-    def list_blobs(self, bucket, prefix=""):
-        return [
-            FakeBlob(bucket, name)
-            for name in sorted(bucket._objects)
-            if name.startswith(prefix)
-        ]
+from tests.helpers import install_fake_gcs
 
 
 @pytest.fixture
 def gcs_store(monkeypatch):
-    fake_storage = types.SimpleNamespace(Client=FakeClient)
-    fake_cloud = types.ModuleType("google.cloud")
-    fake_cloud.storage = fake_storage
-    fake_google = types.ModuleType("google")
-    fake_google.cloud = fake_cloud
-    monkeypatch.setitem(sys.modules, "google", fake_google)
-    monkeypatch.setitem(sys.modules, "google.cloud", fake_cloud)
-    monkeypatch.setitem(sys.modules, "google.cloud.storage", fake_storage)
-    FakeClient._buckets = {}
-
-    from bodywork_tpu.store.gcs import GCSStore
-
-    return GCSStore.from_url("gs://test-bucket/exp1")
+    return install_fake_gcs(monkeypatch).from_url("gs://test-bucket/exp1")
 
 
 def test_from_url_parses_bucket_and_prefix(gcs_store):
