@@ -162,10 +162,15 @@ def cmd_run_stage(args) -> int:
     from bodywork_tpu.pipeline.runner import resolve_executable
     from bodywork_tpu.pipeline.stages import StageContext
 
+    from bodywork_tpu.utils.errors import tag_stage
+
     spec = _pipeline_spec(args)
     if args.stage not in spec.stages:
         log.error(f"unknown stage {args.stage!r}; have {sorted(spec.stages)}")
         return 1
+    # every stage pod reports under its own stage name, not the shared
+    # 'cli-run-stage' tag main() set before the stage was known
+    tag_stage(args.stage)
     stage = spec.stages[args.stage]
     ctx = StageContext(
         store=_store(args), today=_date(args), scoring_url=args.scoring_url
@@ -226,11 +231,16 @@ def cmd_wait_for(args) -> int:
 def cmd_report(args) -> int:
     from bodywork_tpu.monitor import drift_report
 
-    report = drift_report(_store(args))
+    store = _store(args)
+    report = drift_report(store)
     if report.empty:
         print("no metric history yet")
-    else:
-        print(report.to_string(index=False))
+        return 0
+    print(report.to_string(index=False))
+    if args.plot:
+        from bodywork_tpu.monitor import render_drift_dashboard
+
+        print(render_drift_dashboard(store, args.plot, report=report))
     return 0
 
 
@@ -349,6 +359,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = add("report", cmd_report, help="longitudinal train-vs-live drift report")
     p.add_argument("--store", **common_store)
+    p.add_argument("--plot", default=None, metavar="OUT.png",
+                   help="also render the drift dashboard PNG here "
+                        "(requires matplotlib)")
 
     p = add("deploy", cmd_deploy, help="write GKE TPU manifests")
     p.add_argument("--spec", default=None, help="pipeline spec YAML (overrides --model/--mode)")

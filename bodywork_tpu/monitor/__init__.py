@@ -7,7 +7,11 @@ from bodywork_tpu.monitor.tester import (
     score_dataset,
     scoring_endpoint,
 )
-from bodywork_tpu.monitor.analytics import drift_report, load_metric_history
+from bodywork_tpu.monitor.analytics import (
+    drift_report,
+    load_metric_history,
+    render_drift_dashboard,
+)
 
 __all__ = [
     "HttpScoringClient",
@@ -19,4 +23,5 @@ __all__ = [
     "scoring_endpoint",
     "drift_report",
     "load_metric_history",
+    "render_drift_dashboard",
 ]

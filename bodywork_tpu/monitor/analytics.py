@@ -59,3 +59,114 @@ def drift_report(store: ArtefactStore) -> pd.DataFrame:
         how="outer",
     ).sort_values("date")
     return report.reset_index(drop=True)
+
+
+# categorical slots 1-2 of the validated reference palette (adjacent-pair
+# CVD dE 9.1, normal-vision dE 19.6 on the light surface — passes all gates)
+_TRAIN_COLOR = "#2a78d6"  # blue: train-time metrics
+_LIVE_COLOR = "#eb6834"   # orange: live-test metrics
+_SURFACE = "#fcfcfb"
+_INK = "#0b0b0b"
+_INK_2 = "#52514e"
+_GRID = "#e4e3df"
+
+
+def render_drift_dashboard(store: ArtefactStore, out_path, report=None) -> "Path":
+    """Render the longitudinal drift dashboard to a PNG (reference C12's
+    visual half: ``model-performance-analytics.ipynb`` cells 7-8 eyeball
+    per-day train-vs-live tables; here they are drawn).
+
+    Three stacked panels over simulated days — same x-axis, one y-scale
+    each (two measures never share an axis):
+
+    1. MAPE, train vs live — the gap is the concept-drift signal.
+    2. train R^2 vs live score/label correlation (the reference labels the
+       live one ``r_squared`` — ``stage_4:103``).
+    3. mean scoring-service response time (ms) — the latency channel
+       (``stage_4:105``).
+
+    ``report`` short-circuits the store read for callers that just computed
+    :func:`drift_report` themselves (the CLI prints it before plotting —
+    re-deriving it would double every per-day metric fetch against a
+    remote store).
+
+    Requires matplotlib (optional dependency); raises RuntimeError with a
+    clear message when unavailable.
+    """
+    from pathlib import Path
+
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")  # headless: never require a display
+        import matplotlib.pyplot as plt
+    except ImportError as exc:  # pragma: no cover - env without matplotlib
+        raise RuntimeError(
+            "rendering the drift dashboard requires matplotlib "
+            "(pip install matplotlib)"
+        ) from exc
+
+    if report is None:
+        report = drift_report(store)
+    if report.empty:
+        raise ValueError("no metric history to plot (run some days first)")
+
+    days = pd.to_datetime(report["date"])
+    fig, axes = plt.subplots(
+        3, 1, figsize=(9, 9), sharex=True, facecolor=_SURFACE
+    )
+
+    def _style(ax, title, ylabel):
+        ax.set_facecolor(_SURFACE)
+        ax.set_title(title, color=_INK, fontsize=11, loc="left", pad=8)
+        ax.set_ylabel(ylabel, color=_INK_2, fontsize=9)
+        ax.grid(True, color=_GRID, linewidth=0.8)
+        ax.tick_params(colors=_INK_2, labelsize=8)
+        for side in ("top", "right"):
+            ax.spines[side].set_visible(False)
+        for side in ("left", "bottom"):
+            ax.spines[side].set_color(_GRID)
+
+    line_kw = dict(linewidth=2, marker="o", markersize=5, clip_on=False)
+
+    def _series(ax, col, color, label):
+        if col in report and report[col].notna().any():
+            ax.plot(days, report[col], color=color, label=label, **line_kw)
+
+    _series(axes[0], "MAPE_train", _TRAIN_COLOR, "train (held-out)")
+    _series(axes[0], "MAPE_live", _LIVE_COLOR, "live service")
+    _style(axes[0], "MAPE per simulated day — the drift gap", "MAPE")
+
+    _series(axes[1], "r_squared_train", _TRAIN_COLOR, "train R²")
+    _series(axes[1], "r_squared_live", _LIVE_COLOR, "live score/label corr")
+    _style(axes[1], "Fit quality per day", "R² / corr")
+
+    if (
+        "mean_response_time_live" in report
+        and report["mean_response_time_live"].notna().any()
+    ):
+        axes[2].plot(
+            days,
+            report["mean_response_time_live"] * 1000.0,
+            color=_TRAIN_COLOR,
+            **line_kw,
+        )
+    _style(axes[2], "Mean scoring-service response time", "ms")
+
+    for ax in axes[:2]:
+        if ax.has_data():
+            legend = ax.legend(
+                loc="best", fontsize=8, frameon=False, labelcolor=_INK
+            )
+            for line in legend.get_lines():
+                line.set_linewidth(2)
+    axes[2].tick_params(axis="x", rotation=30)
+    fig.align_ylabels(axes)
+    fig.tight_layout()
+
+    out = Path(out_path)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    fig.savefig(out, dpi=144, facecolor=_SURFACE, bbox_inches="tight")
+    plt.close(fig)
+    log.info(f"drift dashboard rendered to {out}")
+    return out

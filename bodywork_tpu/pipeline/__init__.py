@@ -7,6 +7,11 @@ from bodywork_tpu.pipeline.spec import (
 )
 from bodywork_tpu.pipeline.runner import DayResult, LocalRunner, StageFailure
 from bodywork_tpu.pipeline.k8s import generate_manifests, write_manifests
+from bodywork_tpu.pipeline.k8s_validate import (
+    ManifestError,
+    validate_manifest,
+    validate_manifests,
+)
 from bodywork_tpu.pipeline.ab import (
     PipelineVariant,
     VariantResult,
@@ -31,4 +36,7 @@ __all__ = [
     "StageFailure",
     "generate_manifests",
     "write_manifests",
+    "ManifestError",
+    "validate_manifest",
+    "validate_manifests",
 ]

@@ -154,7 +154,13 @@ def _container(
     if stage.resources.tpu_chips:
         resources["limits"] = {"google.com/tpu": stage.resources.tpu_chips}
     env = [{"name": k, "value": str(v)} for k, v in stage.env.items()]
-    env_from = [{"secretRef": {"name": s}} for s in stage.secrets]
+    # optional: the default pipeline's sentry-integration secret backs a
+    # feature that is a no-op when unconfigured (utils/errors.py); a
+    # required ref would CreateContainerConfigError every pod on clusters
+    # that never created the secret
+    env_from = [
+        {"secretRef": {"name": s, "optional": True}} for s in stage.secrets
+    ]
     container = {
         "name": stage.name,
         "image": image,
@@ -366,6 +372,40 @@ def generate_manifests(
                         "type": "ClusterIP",
                     },
                 }
+                if stage.ingress:
+                    # the reference's per-service `ingress` knob
+                    # (bodywork.yaml:42); Bodywork exposes the service at
+                    # /<project>/<stage> behind the cluster ingress
+                    # controller — same path convention here
+                    docs[f"{i:02d}-{stage.name}-ingress.yaml"] = {
+                        "apiVersion": "networking.k8s.io/v1",
+                        "kind": "Ingress",
+                        "metadata": meta,
+                        "spec": {
+                            "rules": [
+                                {
+                                    "http": {
+                                        "paths": [
+                                            {
+                                                "path": f"/{spec.name}/{stage.name}",
+                                                "pathType": "Prefix",
+                                                "backend": {
+                                                    "service": {
+                                                        "name": spec.service_dns(
+                                                            stage.name
+                                                        ),
+                                                        "port": {
+                                                            "number": stage.port
+                                                        },
+                                                    }
+                                                },
+                                            }
+                                        ]
+                                    }
+                                }
+                            ]
+                        },
+                    }
     if daily_schedule:
         docs["99-daily-loop-cronjob.yaml"] = {
             "apiVersion": "batch/v1",
@@ -399,6 +439,11 @@ def generate_manifests(
                 },
             },
         }
+    # strict structural validation: a typo'd field name fails HERE, at
+    # generation, not at `kubectl apply` (k8s_validate module docstring)
+    from bodywork_tpu.pipeline.k8s_validate import validate_manifests
+
+    validate_manifests(docs)
     return docs
 
 

@@ -1,0 +1,437 @@
+"""Strict structural validation of the emitted k8s manifests.
+
+The manifest generator (:mod:`bodywork_tpu.pipeline.k8s`) is tested for
+structure, but a structure test cannot catch a typo'd *field name* — k8s
+object schemas treat unknown fields as errors only at ``kubectl apply``
+(server-side validation), which is exactly the wrong time to find out.
+This module is the CI-time stand-in for that server-side check: a strict
+per-kind whitelist validator. Every mapping level the generator emits is
+checked against the set of field names the k8s OpenAPI schema defines
+there (the subset this framework can emit, plus common optional siblings),
+and required fields are enforced. An unknown key — i.e. any misspelling —
+fails validation.
+
+This is deliberately NOT a vendored OpenAPI schema: the whitelists cover
+the object kinds the generator emits (Namespace, ConfigMap,
+PersistentVolumeClaim, Job, Deployment, Service, Ingress, CronJob) and
+fail loudly on anything outside them, which is the correct behaviour for
+a generator whose output surface is closed.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+#: apiVersion each kind must carry (a wrong group/version also only fails
+#: at apply time otherwise)
+EXPECTED_API_VERSION = {
+    "Namespace": "v1",
+    "ConfigMap": "v1",
+    "PersistentVolumeClaim": "v1",
+    "Service": "v1",
+    "Job": "batch/v1",
+    "CronJob": "batch/v1",
+    "Deployment": "apps/v1",
+    "Ingress": "networking.k8s.io/v1",
+}
+
+
+class ManifestError(ValueError):
+    """One or more emitted manifests are structurally invalid."""
+
+
+def _check(
+    obj: Any,
+    allowed: dict[str, Any],
+    required: tuple[str, ...],
+    path: str,
+    errors: list[str],
+) -> None:
+    """Validate one mapping level: required keys present, no unknown keys,
+    and recurse where the whitelist provides a sub-validator."""
+    if not isinstance(obj, dict):
+        errors.append(f"{path}: expected a mapping, got {type(obj).__name__}")
+        return
+    for key in required:
+        if key not in obj:
+            errors.append(f"{path}: missing required field {key!r}")
+    for key, value in obj.items():
+        if key not in allowed:
+            errors.append(
+                f"{path}: unknown field {key!r} (allowed: {sorted(allowed)})"
+            )
+            continue
+        sub = allowed[key]
+        if callable(sub):
+            sub(value, f"{path}.{key}", errors)
+
+
+def _scalar(value: Any, path: str, errors: list[str]) -> None:
+    if isinstance(value, (dict, list)):
+        errors.append(f"{path}: expected a scalar")
+
+
+def _str_map(value: Any, path: str, errors: list[str]) -> None:
+    if not isinstance(value, dict):
+        errors.append(f"{path}: expected a mapping")
+        return
+    for k, v in value.items():
+        if not isinstance(k, str):
+            errors.append(f"{path}: non-string key {k!r}")
+        if isinstance(v, (dict, list)):
+            errors.append(f"{path}.{k}: expected a scalar value")
+
+
+def _each(item_validator):
+    def validate(value: Any, path: str, errors: list[str]) -> None:
+        if not isinstance(value, list):
+            errors.append(f"{path}: expected a list")
+            return
+        for i, item in enumerate(value):
+            item_validator(item, f"{path}[{i}]", errors)
+
+    return validate
+
+
+def _mapping(allowed: dict[str, Any], required: tuple[str, ...] = ()):
+    def validate(value: Any, path: str, errors: list[str]) -> None:
+        _check(value, allowed, required, path, errors)
+
+    return validate
+
+
+_metadata = _mapping(
+    {
+        "name": _scalar,
+        "namespace": _scalar,
+        "labels": _str_map,
+        "annotations": _str_map,
+    },
+    required=("name",),
+)
+
+_env_var = _mapping(
+    {"name": _scalar, "value": _scalar},
+    required=("name",),
+)
+
+_env_from = _mapping(
+    {
+        "secretRef": _mapping(
+            {"name": _scalar, "optional": _scalar}, required=("name",)
+        ),
+        "configMapRef": _mapping(
+            {"name": _scalar, "optional": _scalar}, required=("name",)
+        ),
+    },
+)
+
+_volume_mount = _mapping(
+    {"name": _scalar, "mountPath": _scalar, "readOnly": _scalar,
+     "subPath": _scalar},
+    required=("name", "mountPath"),
+)
+
+_probe = _mapping(
+    {
+        "httpGet": _mapping(
+            {"path": _scalar, "port": _scalar, "scheme": _scalar},
+            required=("port",),
+        ),
+        "tcpSocket": _mapping({"port": _scalar}, required=("port",)),
+        "exec": _mapping({"command": _each(_scalar)}, required=("command",)),
+        "initialDelaySeconds": _scalar,
+        "periodSeconds": _scalar,
+        "timeoutSeconds": _scalar,
+        "failureThreshold": _scalar,
+        "successThreshold": _scalar,
+    },
+)
+
+_container = _mapping(
+    {
+        "name": _scalar,
+        "image": _scalar,
+        "command": _each(_scalar),
+        "args": _each(_scalar),
+        "env": _each(_env_var),
+        "envFrom": _each(_env_from),
+        "volumeMounts": _each(_volume_mount),
+        "resources": _mapping(
+            {"requests": _str_map, "limits": _str_map},
+        ),
+        "ports": _each(
+            _mapping(
+                {"containerPort": _scalar, "name": _scalar, "protocol": _scalar},
+                required=("containerPort",),
+            )
+        ),
+        "readinessProbe": _probe,
+        "livenessProbe": _probe,
+        "workingDir": _scalar,
+        "imagePullPolicy": _scalar,
+    },
+    required=("name", "image"),
+)
+
+_volume = _mapping(
+    {
+        "name": _scalar,
+        "hostPath": _mapping(
+            {"path": _scalar, "type": _scalar}, required=("path",)
+        ),
+        "persistentVolumeClaim": _mapping(
+            {"claimName": _scalar, "readOnly": _scalar},
+            required=("claimName",),
+        ),
+        "configMap": _mapping(
+            {"name": _scalar, "items": _each(_mapping(
+                {"key": _scalar, "path": _scalar}, required=("key", "path")
+            ))},
+            required=("name",),
+        ),
+        "emptyDir": _mapping({"medium": _scalar, "sizeLimit": _scalar}),
+    },
+    required=("name",),
+)
+
+_pod_spec = _mapping(
+    {
+        "containers": _each(_container),
+        "initContainers": _each(_container),
+        "volumes": _each(_volume),
+        "restartPolicy": _scalar,
+        "nodeSelector": _str_map,
+        "serviceAccountName": _scalar,
+        "terminationGracePeriodSeconds": _scalar,
+        "tolerations": _each(_mapping(
+            {"key": _scalar, "operator": _scalar, "value": _scalar,
+             "effect": _scalar},
+        )),
+    },
+    required=("containers",),
+)
+
+_pod_template = _mapping(
+    {
+        "metadata": _mapping({"labels": _str_map, "annotations": _str_map}),
+        "spec": _pod_spec,
+    },
+    required=("spec",),
+)
+
+_job_spec = _mapping(
+    {
+        "backoffLimit": _scalar,
+        "activeDeadlineSeconds": _scalar,
+        "completions": _scalar,
+        "parallelism": _scalar,
+        "ttlSecondsAfterFinished": _scalar,
+        "template": _pod_template,
+    },
+    required=("template",),
+)
+
+_KIND_SPEC_VALIDATORS: dict[str, Any] = {
+    "Namespace": _mapping({"metadata": _metadata}, required=("metadata",)),
+    "ConfigMap": _mapping(
+        {
+            "metadata": _metadata,
+            "data": _str_map,
+            "binaryData": _str_map,
+            "immutable": _scalar,
+        },
+        required=("metadata",),
+    ),
+    "PersistentVolumeClaim": _mapping(
+        {
+            "metadata": _metadata,
+            "spec": _mapping(
+                {
+                    "accessModes": _each(_scalar),
+                    "resources": _mapping(
+                        {"requests": _str_map, "limits": _str_map},
+                        required=("requests",),
+                    ),
+                    "storageClassName": _scalar,
+                    "volumeMode": _scalar,
+                },
+                required=("accessModes", "resources"),
+            ),
+        },
+        required=("metadata", "spec"),
+    ),
+    "Job": _mapping(
+        {"metadata": _metadata, "spec": _job_spec},
+        required=("metadata", "spec"),
+    ),
+    "Deployment": _mapping(
+        {
+            "metadata": _metadata,
+            "spec": _mapping(
+                {
+                    "replicas": _scalar,
+                    "selector": _mapping(
+                        {"matchLabels": _str_map}, required=("matchLabels",)
+                    ),
+                    "template": _pod_template,
+                    "strategy": _mapping(
+                        {"type": _scalar, "rollingUpdate": _str_map},
+                    ),
+                },
+                required=("selector", "template"),
+            ),
+        },
+        required=("metadata", "spec"),
+    ),
+    "Service": _mapping(
+        {
+            "metadata": _metadata,
+            "spec": _mapping(
+                {
+                    "selector": _str_map,
+                    "ports": _each(
+                        _mapping(
+                            {
+                                "port": _scalar,
+                                "targetPort": _scalar,
+                                "name": _scalar,
+                                "protocol": _scalar,
+                                "nodePort": _scalar,
+                            },
+                            required=("port",),
+                        )
+                    ),
+                    "type": _scalar,
+                    "clusterIP": _scalar,
+                },
+                required=("ports",),
+            ),
+        },
+        required=("metadata", "spec"),
+    ),
+    "Ingress": _mapping(
+        {
+            "metadata": _metadata,
+            "spec": _mapping(
+                {
+                    "ingressClassName": _scalar,
+                    "defaultBackend": _mapping(
+                        {
+                            "service": _mapping(
+                                {
+                                    "name": _scalar,
+                                    "port": _mapping(
+                                        {"number": _scalar, "name": _scalar},
+                                    ),
+                                },
+                                required=("name",),
+                            )
+                        },
+                    ),
+                    "rules": _each(
+                        _mapping(
+                            {
+                                "host": _scalar,
+                                "http": _mapping(
+                                    {
+                                        "paths": _each(
+                                            _mapping(
+                                                {
+                                                    "path": _scalar,
+                                                    "pathType": _scalar,
+                                                    "backend": _mapping(
+                                                        {
+                                                            "service": _mapping(
+                                                                {
+                                                                    "name": _scalar,
+                                                                    "port": _mapping(
+                                                                        {
+                                                                            "number": _scalar,
+                                                                            "name": _scalar,
+                                                                        },
+                                                                    ),
+                                                                },
+                                                                required=("name",),
+                                                            )
+                                                        },
+                                                        required=("service",),
+                                                    ),
+                                                },
+                                                required=("pathType", "backend"),
+                                            )
+                                        )
+                                    },
+                                    required=("paths",),
+                                ),
+                            },
+                        )
+                    ),
+                    "tls": _each(_mapping(
+                        {"hosts": _each(_scalar), "secretName": _scalar},
+                    )),
+                },
+            ),
+        },
+        required=("metadata", "spec"),
+    ),
+    "CronJob": _mapping(
+        {
+            "metadata": _metadata,
+            "spec": _mapping(
+                {
+                    "schedule": _scalar,
+                    "concurrencyPolicy": _scalar,
+                    "startingDeadlineSeconds": _scalar,
+                    "suspend": _scalar,
+                    "successfulJobsHistoryLimit": _scalar,
+                    "failedJobsHistoryLimit": _scalar,
+                    "jobTemplate": _mapping(
+                        {
+                            "metadata": _mapping(
+                                {"labels": _str_map, "annotations": _str_map}
+                            ),
+                            "spec": _job_spec,
+                        },
+                        required=("spec",),
+                    ),
+                },
+                required=("schedule", "jobTemplate"),
+            ),
+        },
+        required=("metadata", "spec"),
+    ),
+}
+
+
+def validate_manifest(doc: dict, origin: str = "<manifest>") -> list[str]:
+    """Validate one emitted k8s object; returns error strings (empty = ok)."""
+    errors: list[str] = []
+    if not isinstance(doc, dict):
+        return [f"{origin}: manifest must be a mapping"]
+    kind = doc.get("kind")
+    if kind not in _KIND_SPEC_VALIDATORS:
+        return [
+            f"{origin}: unknown or missing kind {kind!r} "
+            f"(validatable: {sorted(_KIND_SPEC_VALIDATORS)})"
+        ]
+    expected_version = EXPECTED_API_VERSION[kind]
+    if doc.get("apiVersion") != expected_version:
+        errors.append(
+            f"{origin}: {kind} apiVersion must be {expected_version!r}, "
+            f"got {doc.get('apiVersion')!r}"
+        )
+    body = {k: v for k, v in doc.items() if k not in ("apiVersion", "kind")}
+    _KIND_SPEC_VALIDATORS[kind](body, f"{origin}:{kind}", errors)
+    return errors
+
+
+def validate_manifests(docs: dict[str, dict]) -> None:
+    """Validate every generated manifest; raise :class:`ManifestError`
+    listing ALL problems (not just the first) on any failure."""
+    errors: list[str] = []
+    for filename, doc in docs.items():
+        errors.extend(validate_manifest(doc, filename))
+    if errors:
+        raise ManifestError(
+            "invalid generated manifests:\n  " + "\n  ".join(errors)
+        )

@@ -195,12 +195,18 @@ def default_pipeline(
         tpu_topology="1x1",
         tpu_chips=1,
     )
+    # the reference injects its secrets into EVERY stage (bodywork.yaml:22-26
+    # mounts aws-credentials + sentry-integration); the store needs no
+    # credential secret here (PVC/GCS workload identity), so the per-stage
+    # list is the error-monitoring secret carrying SENTRY_DSN
+    secrets = ["sentry-integration"]
     stages = {
         "stage-1-train-model": StageSpec(
             name="stage-1-train-model",
             kind="batch",
             executable="bodywork_tpu.pipeline.stages:train_stage",
             args={"model_type": model_type},
+            secrets=list(secrets),
             resources=v5e,
         ),
         "stage-2-serve-model": StageSpec(
@@ -213,12 +219,14 @@ def default_pipeline(
             replicas=2,
             port=port,
             ingress=False,
+            secrets=list(secrets),
             resources=v5e,
         ),
         "stage-3-generate-next-dataset": StageSpec(
             name="stage-3-generate-next-dataset",
             kind="batch",
             executable="bodywork_tpu.pipeline.stages:generate_stage",
+            secrets=list(secrets),
             resources=dataclasses.replace(v5e, tpu_chips=1),
         ),
         "stage-4-test-model-scoring-service": StageSpec(
@@ -232,6 +240,7 @@ def default_pipeline(
                 if scoring_mode == "batch"
                 else {"mode": scoring_mode}
             ),
+            secrets=list(secrets),
             resources=ResourceSpec(cpu_request=0.5, memory_mb=256),
         ),
     }

@@ -43,3 +43,22 @@ def init_error_monitoring(stage: str, traces_sample_rate: float = 1.0) -> bool:
     sentry_sdk.init(dsn, traces_sample_rate=traces_sample_rate)
     sentry_sdk.set_tag("stage", stage)
     return True
+
+
+def tag_stage(stage: str) -> None:
+    """Re-tag the active error-monitoring scope with the actual stage name.
+
+    The CLI initialises monitoring before the stage is known (the pod
+    entrypoint tags ``cli-run-stage``); once ``run-stage`` resolves its
+    stage, this overrides the tag so every stage pod reports under its own
+    name — the reference tags each entrypoint with its stage
+    (``stage_1_train_model.py:172``; its stage-4 copy-paste bug fixed).
+    No-op when monitoring is disabled.
+    """
+    if not os.environ.get("SENTRY_DSN"):
+        return
+    try:
+        import sentry_sdk  # type: ignore
+    except ImportError:
+        return
+    sentry_sdk.set_tag("stage", stage)

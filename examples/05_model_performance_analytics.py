@@ -24,10 +24,14 @@ DEFAULT_STORE = "/tmp/bodywork-tpu-example-store"
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--store", default=DEFAULT_STORE)
+    p.add_argument("--plot", default=None, metavar="OUT.png",
+                   help="also render the drift dashboard PNG (the visual "
+                        "half of the reference notebook)")
     args = p.parse_args()
 
     configure_logger()
-    report = drift_report(open_store(args.store))
+    store = open_store(args.store)
+    report = drift_report(store)
     if report.empty:
         print("no metric history yet - run the pipeline first")
         return
@@ -37,6 +41,10 @@ def main() -> None:
         gap = (report["MAPE_live"] - report["MAPE_train"]).dropna()
         if len(gap):
             print(f"\nmean live-vs-train MAPE gap over {len(gap)} day(s): {gap.mean():+.4f}")
+    if args.plot:
+        from bodywork_tpu.monitor import render_drift_dashboard
+
+        print(f"dashboard: {render_drift_dashboard(store, args.plot, report=report)}")
 
 
 if __name__ == "__main__":

@@ -141,6 +141,52 @@ def test_deploy_spec_file_precedence(tmp_path):
     assert "model_type: mlp" in cm["data"]["pipeline.yaml"]
 
 
+def test_run_stage_tags_actual_stage_name(tmp_path, monkeypatch):
+    # Sentry stage-tag parity (reference stage_1:172 tags each entrypoint
+    # with its stage; its stage-4 copy-paste bug fixed): the pod entrypoint
+    # must end up tagged with the stage it runs, not the generic
+    # 'cli-run-stage' main() sets before the stage is known.
+    import sys
+    import types
+
+    calls = []
+    fake = types.ModuleType("sentry_sdk")
+    fake.init = lambda dsn, **kw: calls.append(("init", dsn))
+    fake.set_tag = lambda k, v: calls.append(("tag", k, v))
+    monkeypatch.setitem(sys.modules, "sentry_sdk", fake)
+    monkeypatch.setenv("SENTRY_DSN", "https://fake@sentry.invalid/1")
+
+    store = str(tmp_path / "artefacts")
+    assert main(
+        ["run-stage", "--store", store, "--stage",
+         "stage-3-generate-next-dataset", "--date", "2026-01-01"]
+    ) == 0
+    tags = [c for c in calls if c[0] == "tag" and c[1] == "stage"]
+    assert tags[-1] == ("tag", "stage", "stage-3-generate-next-dataset")
+
+
+def test_default_pipeline_declares_and_injects_secrets(tmp_path):
+    # the reference mounts its secrets into every stage
+    # (bodywork.yaml:22-26); the default spec must declare them and the
+    # manifests must inject them via envFrom secretRef
+    import yaml
+
+    from bodywork_tpu.pipeline import default_pipeline, generate_manifests
+
+    spec = default_pipeline()
+    for stage in spec.stages.values():
+        assert "sentry-integration" in stage.secrets
+    docs = generate_manifests(spec)
+    workloads = [
+        d for d in docs.values() if d["kind"] in ("Job", "Deployment")
+    ]
+    assert workloads
+    for doc in workloads:
+        container = doc["spec"]["template"]["spec"]["containers"][0]
+        refs = [e["secretRef"]["name"] for e in container.get("envFrom", [])]
+        assert "sentry-integration" in refs
+
+
 def test_train_mesh_flags_reach_sharded_path(tmp_path, capsys):
     # `train --mesh-data/--mesh-model` arg wiring: rejects linear (the
     # sharded path is MLP-only), exit-code contract intact

@@ -178,3 +178,109 @@ def test_multi_feature_dataset_served_and_tested(tmp_path):
         rec = metrics.iloc[0]
         assert rec.n_failures == 0, mode
         assert rec.MAPE < 0.01, mode  # noiseless linear data
+
+
+def test_render_drift_dashboard_writes_png(store, tmp_path):
+    # C12's visual half (model-performance-analytics.ipynb cells 7-8):
+    # the rendered dashboard must be a real PNG artifact
+    from datetime import date
+
+    import pandas as pd
+
+    from bodywork_tpu.monitor import render_drift_dashboard
+    from bodywork_tpu.monitor.tester import persist_test_metrics
+    from bodywork_tpu.train.trainer import persist_metrics
+
+    for day in (1, 2, 3):
+        d = date(2026, 1, day)
+        persist_metrics(
+            store,
+            {"MAPE": 0.8 + 0.05 * day, "r_squared": 0.65, "max_residual": 20.0},
+            d,
+        )
+        persist_test_metrics(
+            store,
+            pd.DataFrame(
+                {
+                    "date": [d],
+                    "MAPE": [0.9 + 0.1 * day],
+                    "r_squared": [0.8 - 0.02 * day],
+                    "max_residual": [100.0],
+                    "mean_response_time": [0.002],
+                    "n_failures": [0],
+                }
+            ),
+            d,
+        )
+    out = render_drift_dashboard(store, tmp_path / "plots" / "drift.png")
+    data = out.read_bytes()
+    assert data[:8] == b"\x89PNG\r\n\x1a\n"
+    assert len(data) > 10_000  # a drawn figure, not an empty canvas
+
+
+def test_render_drift_dashboard_empty_store_raises(store, tmp_path):
+    import pytest
+
+    from bodywork_tpu.monitor import render_drift_dashboard
+
+    with pytest.raises(ValueError, match="no metric history"):
+        render_drift_dashboard(store, tmp_path / "drift.png")
+
+
+def test_cli_report_plot_flag(store, tmp_path):
+    from datetime import date
+
+    from bodywork_tpu.cli import main
+    from bodywork_tpu.train.trainer import persist_metrics
+
+    persist_metrics(
+        store, {"MAPE": 0.8, "r_squared": 0.65, "max_residual": 20.0},
+        date(2026, 1, 1),
+    )
+    out = tmp_path / "dash.png"
+    assert main(["report", "--store", str(store.root), "--plot", str(out)]) == 0
+    assert out.exists() and out.read_bytes()[:8] == b"\x89PNG\r\n\x1a\n"
+
+
+def test_live_metric_parity_at_reference_recorded_regime(tmp_path):
+    """Pin the live-test metrics to the reference's single recorded run
+    (BASELINE.md live-test rows: MAPE 0.801, corr 0.805, max APE 126.9,
+    captured 2021-04-08 = day-of-year 98).
+
+    Seeded history at the matched day-of-year, trained and served
+    in-process, the stable statistic — the score/label correlation the
+    reference mislabels ``r_squared`` (``stage_4:103``) — must land in a
+    band around the recorded 0.805. The mean-APE side is asserted on the
+    tail-robust *median*: per-row APE divides by labels that the y>=0
+    filter (``stage_3:43``) lets approach zero, so the recorded mean is a
+    heavy-tailed draw (the bench has logged live means from 0.8 to 3.0 in
+    the same regime) while the median is regime-stable.
+    """
+    from bodywork_tpu.data import load_latest_dataset
+    from bodywork_tpu.store import FilesystemStore
+
+    store = FilesystemStore(tmp_path / "artefacts")
+    # two days of history through 2021-04-07 (the reference trains on all
+    # data to date), then the recorded test day's drifted data arrives
+    for d in (date(2021, 4, 6), date(2021, 4, 7)):
+        X, y = generate_day(d)
+        persist_dataset(store, Dataset(X, y, d))
+    result = train_on_history(store, "linear")
+    X, y = generate_day(date(2021, 4, 8))
+    persist_dataset(store, Dataset(X, y, date(2021, 4, 8)))
+
+    app = create_app(result.model, result.data_date, buckets=(2048,), warmup=False)
+    ds = load_latest_dataset(store)
+    results = score_dataset(
+        InProcessScoringClient(app).batch_sibling(), ds, mode="batch",
+        batch_size=2048,
+    )
+    metrics = compute_test_metrics(results, ds.date)
+    rec = metrics.iloc[0]
+    assert rec.n_failures == 0
+    # corr: the regime-stable statistic; recorded 0.805 (BASELINE.md)
+    assert 0.805 - 0.06 <= rec.r_squared <= 0.805 + 0.06
+    # tail-robust APE location: the recorded mean 0.801 sits above the
+    # median by the tail; the median regime is well under it
+    median_ape = float(results[results["ok"]]["APE"].median())
+    assert 0.05 < median_ape < 0.65
