@@ -8,16 +8,22 @@ cost — 8.22 ms/request x 1317 rows = 10.83 s for the stage-4 loop alone
 which *understates* the reference's full day (it excludes train/generate/
 deploy overhead), so ``vs_baseline`` = baseline_s / ours_s is conservative.
 
-With no arguments, runs ALL FIVE BASELINE.json configs and prints ONE JSON
-line whose top-level metric is the north-star config-2 record, with every
-per-config record under ``"configs"``. ``--config N`` runs a single config:
+With no arguments, runs the five BASELINE.json configs plus the wide
+config and prints ONE JSON line whose top-level metric is the north-star
+config-2 record, with every per-config record under ``"configs"``.
+``--config N`` runs a single config:
 
 1. single simulated day, in-process train+serve (includes first-compile)
 2. jitted linear regressor, 7-day drift loop with daily retrain
 3. 3-layer MLP, 30-day drift loop with daily retrain + test
 4. batched scoring: 1k-row requests through the data-parallel service
-   (plus, on a real TPU, the fused Pallas-kernel engine as a sub-record)
+   (plus, on a real TPU, the fused Pallas-kernel engine as a sub-record,
+   each with a device-side HTTP-free latency view)
 5. two concurrent A/B pipelines (linear vs MLP) sharing the pool
+6. the WIDE workload (beyond-reference): (1024,1024,1024) MLP, 32
+   features, batch 8192 — single-device XLA train with an MFU estimate,
+   dp x tp sharded train when the pool allows, device-side serving
+   through both engines
 
 Protocol (configs 2/3/5): bootstrap a fresh store, run the multi-day
 simulation, report the mean wall-clock of the steady-state days (day 1
@@ -46,8 +52,23 @@ from datetime import date
 BASELINE_DAY_S = 1317 * 0.00822  # reference stage-4 scoring loop, see above
 BASELINE_REQUEST_S = 0.00822  # reference per-request scoring latency
 
-ALL_CONFIGS = (1, 2, 3, 4, 5)
+ALL_CONFIGS = (1, 2, 3, 4, 5, 6)
 HEADLINE_CONFIG = 2  # the north-star day loop
+
+# -- config 6: the "wide" workload (no reference analogue) -------------------
+# The BASELINE.json configs are all KB-scale (d=2 OLS, 64-wide MLP) — every
+# matmul is sub-MXU-tile, so they measure round-trips, not the TPU-first
+# design. Config 6 is the first workload where the MXU, the Pallas kernel's
+# VMEM residency, and the dp x tp shardings can win or lose: a
+# (1024, 1024, 1024) MLP over 32 features, batch 8192.
+WIDE_HIDDEN = (1024, 1024, 1024)
+WIDE_FEATURES = 32
+WIDE_BATCH = 8192
+WIDE_STEPS = 50
+#: bf16 MXU peak of one v5e chip (~197 TFLOP/s). MFU here is an *estimate*:
+#: the train step runs float32 arrays through XLA's default matmul
+#: precision, which on TPU executes bf16 MXU passes.
+PEAK_FLOPS_V5E = 197e12
 
 
 def _steady_mean(results) -> float:
@@ -270,25 +291,211 @@ def bench_batched_scoring(rows: int = 1000, requests: int = 20) -> dict:
     return record
 
 
-def bench_ab(days: int = 5) -> dict:
+def wide_train_flops_per_step(
+    batch: int = WIDE_BATCH,
+    d_in: int = WIDE_FEATURES,
+    hidden: tuple = WIDE_HIDDEN,
+) -> float:
+    """Matmul FLOPs of one optimisation step of the wide MLP: forward
+    2*b*sum(in_i*out_i) over the dense stack, backward ~2x forward (dL/dW
+    and dL/dx matmuls), so ~3x forward per step. Elementwise/optimizer
+    FLOPs are noise next to the matmuls and are ignored."""
+    widths = (d_in, *hidden, 1)
+    fwd = sum(2.0 * batch * a * b for a, b in zip(widths[:-1], widths[1:]))
+    return 3.0 * fwd
+
+
+def _wide_data(n_rows: int = 2 * WIDE_BATCH):
+    """Synthetic 32-feature regression data (the drift generator is the
+    1-feature parity workload; the wide config is beyond-reference)."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    X = rng.uniform(-1.0, 1.0, (n_rows, WIDE_FEATURES)).astype(np.float32)
+    w = rng.normal(size=WIDE_FEATURES).astype(np.float32)
+    y = X @ w + 0.1 * rng.normal(size=n_rows).astype(np.float32)
+    return X, y
+
+
+def bench_wide(steps: int = WIDE_STEPS) -> dict:
+    """Config 6: the wide MLP through (a) single-device XLA training with an
+    MFU estimate, (b) dp x tp sharded training when the pool has >1 device,
+    and (c) batched serving device-side through both engines.
+
+    Training records time a *second* fit (the first pays the XLA compile)
+    and report seconds/step, model FLOP/s, and estimated MFU against the
+    v5e bf16 peak. Serving records use the device-side pipelined timing
+    (:func:`time_device_batch`) on one 8192-row batch.
+    """
+    import jax
+    import numpy as np
+
+    from bodywork_tpu.models.mlp import MLPConfig, MLPRegressor
+    from bodywork_tpu.ops import make_pallas_mlp_apply
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    peak = PEAK_FLOPS_V5E if on_tpu else None
+    X, y = _wide_data()
+    cfg = MLPConfig(
+        hidden=WIDE_HIDDEN, batch_size=WIDE_BATCH, n_steps=steps,
+        learning_rate=1e-3,
+    )
+    flops_per_step = wide_train_flops_per_step()
+
+    def _train_record(fit, n_chips: int) -> dict:
+        fit()  # compile
+        t0 = time.perf_counter()
+        model = fit()
+        jax.block_until_ready(model.params)
+        elapsed = time.perf_counter() - t0
+        flops_s = steps * flops_per_step / elapsed
+        rec = {
+            "seconds_per_step": round(elapsed / steps, 6),
+            "model_tflops_s": round(flops_s / 1e12, 2),
+            "steps": steps,
+            "batch": WIDE_BATCH,
+        }
+        if peak:
+            rec["mfu_pct_est"] = round(100.0 * flops_s / (peak * n_chips), 2)
+        return rec, model
+
+    record: dict = {
+        "metric": "wide_mlp_1024x3",
+        "hidden": list(WIDE_HIDDEN),
+        "features": WIDE_FEATURES,
+        "flops_per_step": flops_per_step,
+    }
+
+    xla_rec, model = _train_record(lambda: MLPRegressor(cfg).fit(X, y), 1)
+    record["train_xla_single"] = xla_rec
+
+    n_dev = len(jax.devices())
+    if n_dev >= 2:
+        # a sub-bench failure must not discard the already-measured
+        # single-device record above (same guard as config 4's engines)
+        try:
+            from bodywork_tpu.parallel import make_mesh, train_mlp_sharded
+
+            dp = n_dev // 2  # odd pools: use the largest even subset
+            devices = jax.devices()[: dp * 2]
+            mesh = make_mesh(data=dp, model=2, devices=devices)
+
+            sharded_rec, _ = _train_record(
+                lambda: train_mlp_sharded(X, y, cfg, mesh), len(devices)
+            )
+            sharded_rec["mesh"] = f"{dp}x2"
+            record["train_sharded_dp_tp"] = sharded_rec
+        except Exception as exc:
+            record["train_sharded_dp_tp"] = {
+                "error": f"{type(exc).__name__}: {exc}"
+            }
+            print(f"bench: wide sharded sub-bench FAILED: {exc!r}",
+                  file=sys.stderr)
+    else:
+        record["train_sharded_dp_tp"] = {
+            "skipped": f"{n_dev} device(s); dp x tp needs >= 2"
+        }
+
+    # serving: one 8192x32 batch, device-side, engine vs engine
+    Xb = X[:WIDE_BATCH]
+    from functools import partial
+
+    xla_apply = jax.jit(type(model).apply)
+    record["serve_xla"] = time_device_batch(
+        partial(xla_apply, model.params), Xb, iters=20
+    )
+    if on_tpu:
+        record["serve_pallas"] = time_device_batch(
+            make_pallas_mlp_apply(model.params), Xb, iters=20
+        )
+    else:
+        record["serve_pallas"] = {
+            "skipped": "non-tpu backend; the kernel would run in the "
+            "interpreter"
+        }
+    # rows/s through the faster engine's pipelined path, for scale feel
+    best = min(
+        v["device_pipelined_s"]
+        for v in (record["serve_xla"], record.get("serve_pallas", {}))
+        if "device_pipelined_s" in v
+    )
+    record["serve_rows_per_s"] = round(WIDE_BATCH / best, 1)
+    record["value"] = record["train_xla_single"]["seconds_per_step"]
+    record["unit"] = "s/step"
+    return record
+
+
+def bench_ab(days: int = 5, model_types=("linear", "mlp")) -> dict:
+    """Config 5: N concurrent A/B pipelines sharing the pool.
+
+    Protocol now matches configs 2/3 (steady-state mean, day 1 excluded):
+    the round-2 capture divided TOTAL wall-clock — including each
+    variant's day-1 XLA compiles and store bootstrap — by pipeline-days,
+    which is what produced the unexplained '7.4x config 2' number
+    (VERDICT r2 item 3); the per-variant steady means (0.10-0.13 s/day on
+    the same capture) only went to stderr. Here the headline is the mean
+    of per-variant steady-state s/day, and the JSON carries the full
+    attribution: per-variant steady mean, first-day cost, per-stage steady
+    seconds, the untimed bootstrap overhead, and the total wall-clock the
+    old protocol measured.
+
+    Attribution note: ``run_simulation`` pays store bootstrap and the
+    horizon's train-bucket compiles BEFORE its timed day loop, so
+    ``day1_s`` is the first *timed* day (it still pays the serve-path
+    compiles); the pre-loop cost appears as ``untimed_bootstrap_s``
+    (total wall-clock minus the slowest variant's summed day times).
+    """
     from bodywork_tpu.pipeline import run_ab_simulation, variants_from_model_types
 
     root = tempfile.mkdtemp(prefix="bench-ab-")
-    variants = variants_from_model_types(["linear", "mlp"])
+    variants = variants_from_model_types(list(model_types))
     t0 = time.perf_counter()
     results = run_ab_simulation(variants, root, date(2026, 1, 1), days)
     total = time.perf_counter() - t0
+
+    variant_records = {}
+    steady_means = []
+    slowest_day_sum = 0.0
     for name, vr in results.items():
         if vr.error is not None:
             raise RuntimeError(f"variant {name} failed: {vr.error!r}")
-        print(f"  {name}: {_steady_mean(vr.results):.3f}s/day steady", file=sys.stderr)
-    # N pipelines' days delivered per wall-clock second vs one reference day
-    value = total / (len(variants) * days)
+        # ONE steady-day slice for both the mean and the stage attribution,
+        # so the two can never describe different day sets
+        steady_days = vr.results[1:] or vr.results
+        steady = sum(r.wall_clock_s for r in steady_days) / len(steady_days)
+        steady_means.append(steady)
+        slowest_day_sum = max(
+            slowest_day_sum, sum(r.wall_clock_s for r in vr.results)
+        )
+        stage_means = {}
+        for r in steady_days:
+            for stage, secs in r.stage_seconds.items():
+                stage_means.setdefault(stage, []).append(secs)
+        variant_records[name] = {
+            "steady_s_per_day": round(steady, 4),
+            "day1_s": round(vr.results[0].wall_clock_s, 4),
+            "stage_seconds_steady": {
+                stage: round(sum(v) / len(v), 4)
+                for stage, v in sorted(stage_means.items())
+            },
+        }
+        print(f"  {name}: {steady:.3f}s/day steady", file=sys.stderr)
+
+    value = sum(steady_means) / len(steady_means)
     return {
         "metric": "ab_day_wallclock_per_pipeline_day",
         "value": round(value, 4),
         "unit": "s/pipeline-day",
         "vs_baseline": round(BASELINE_DAY_S / value, 2),
+        "protocol": "steady-state mean over variants, day 1 excluded "
+                    "(same as configs 2/3); day1_s is the first TIMED day "
+                    "(serve-path compiles) — store bootstrap and horizon "
+                    "train-compile prewarm run before the timer and are "
+                    "untimed_bootstrap_s",
+        "variants": variant_records,
+        "total_wallclock_s": round(total, 2),
+        "untimed_bootstrap_s": round(max(total - slowest_day_sum, 0.0), 2),
+        "days": days,
     }
 
 
@@ -301,6 +508,8 @@ def run_config(n: int) -> dict:
         return bench_day_loop("mlp", days=30, model_kwargs={"hidden": [64, 64, 64]})
     if n == 4:
         return bench_batched_scoring()
+    if n == 6:
+        return bench_wide()
     return bench_ab()
 
 
@@ -335,7 +544,8 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--config", type=int, default=None, choices=ALL_CONFIGS,
-        help="run a single BASELINE.json config (default: all five)",
+        help="run a single config: 1-5 = BASELINE.json, 6 = the "
+             "beyond-reference wide workload (default: all six)",
     )
     parser.add_argument(
         "--backend-timeout", type=float, default=180.0,

@@ -207,3 +207,52 @@ def test_mlp_fused_fit_eval_matches_separate(linear_data):
     assert np.isfinite(fused.final_loss)
     clone = load_model_bytes(save_model_bytes(fused))
     np.testing.assert_allclose(clone.predict(X), fused.predict(X), rtol=1e-5)
+
+
+def test_wide_mlp_trains_serves_and_roundtrips_checkpoints(store):
+    """The wide workload (bench config 6: hidden=(1024,1024,1024), 32
+    features) through the full lifecycle — fit+eval, checkpoint store
+    round-trip, HTTP serving, and the Pallas kernel — at the widths where
+    tensor shapes first exceed MXU tiles. Steps/rows are tiny (CPU suite);
+    the shapes are the full wide config's."""
+    import numpy as np
+
+    from bodywork_tpu.models import MLPConfig, MLPRegressor
+    from bodywork_tpu.ops import make_pallas_mlp_apply
+    from bodywork_tpu.serve import create_app
+
+    rng = np.random.default_rng(7)
+    n, d = 512, 32
+    X = rng.uniform(-1.0, 1.0, (n, d)).astype(np.float32)
+    w = rng.normal(size=d).astype(np.float32)
+    y = (X @ w + 0.1 * rng.normal(size=n)).astype(np.float32)
+
+    cfg = MLPConfig(hidden=(1024, 1024, 1024), batch_size=128, n_steps=2)
+    model, metrics = MLPRegressor(cfg).fit_and_evaluate(
+        X[:400], y[:400], X[400:], y[400:]
+    )
+    assert np.isfinite(metrics["MAPE"]) and np.isfinite(metrics["r_squared"])
+    assert model.n_features == d
+
+    # checkpoint round-trip through the store preserves predictions exactly
+    key = save_model(store, model, date(2026, 1, 1))
+    clone, model_date = load_model(store, key)
+    assert clone.config.hidden == (1024, 1024, 1024)
+    np.testing.assert_array_equal(clone.predict(X[:8]), model.predict(X[:8]))
+
+    # serves over the frozen batch contract with 32-feature rows
+    app = create_app(clone, model_date, buckets=(64,), warmup=False)
+    body = app.test_client().post(
+        "/score/v1/batch", json={"X": [[float(v) for v in row] for row in X[:8]]}
+    ).get_json()
+    np.testing.assert_allclose(
+        np.asarray(body["predictions"]), model.predict(X[:8]), rtol=1e-4
+    )
+
+    # the Pallas kernel (interpret mode here) agrees with the XLA apply at
+    # wide widths — scaler folding + lane padding hold beyond one MXU tile
+    pallas_apply = make_pallas_mlp_apply(model.params, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(pallas_apply(X[:8])), model.predict(X[:8]),
+        rtol=2e-3, atol=2e-3,
+    )
