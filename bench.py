@@ -360,22 +360,26 @@ def bench_wide(steps: int = WIDE_STEPS) -> dict:
     )
     flops_per_step = wide_train_flops_per_step()
 
-    def _train_record(fit, n_chips: int) -> dict:
-        fit()  # compile
-        t0 = time.perf_counter()
-        model = fit()
-        jax.block_until_ready(model.params)
-        elapsed = time.perf_counter() - t0
-        flops_s = steps * flops_per_step / elapsed
+    def _throughput_record(elapsed_s: float, n_chips: int) -> dict:
+        """seconds/step + model FLOP/s + MFU estimate — ONE definition for
+        the single-device and sharded records so they can't diverge."""
+        flops_s = steps * flops_per_step / elapsed_s
         rec = {
-            "seconds_per_step": round(elapsed / steps, 6),
+            "seconds_per_step": round(elapsed_s / steps, 6),
             "model_tflops_s": round(flops_s / 1e12, 2),
             "steps": steps,
             "batch": WIDE_BATCH,
         }
         if peak:
             rec["mfu_pct_est"] = round(100.0 * flops_s / (peak * n_chips), 2)
-        return rec, model
+        return rec
+
+    def _train_record(fit, n_chips: int):
+        fit()  # compile
+        t0 = time.perf_counter()
+        model = fit()
+        jax.block_until_ready(model.params)
+        return _throughput_record(time.perf_counter() - t0, n_chips), model
 
     record: dict = {
         "metric": "wide_mlp_1024x3",
@@ -405,20 +409,9 @@ def bench_wide(steps: int = WIDE_STEPS) -> dict:
             # timed host work invert the dp x tp conclusion
             timings: dict = {}
             train_mlp_sharded(X, y, cfg, mesh, timings=timings)
-            scan_s = timings["scan_s"]
-            flops_s = steps * flops_per_step / scan_s
-            sharded_rec = {
-                "seconds_per_step": round(scan_s / steps, 6),
-                "model_tflops_s": round(flops_s / 1e12, 2),
-                "steps": steps,
-                "batch": WIDE_BATCH,
-                "host_staging_s": round(timings["staging_s"], 4),
-                "mesh": f"{dp}x2",
-            }
-            if peak:
-                sharded_rec["mfu_pct_est"] = round(
-                    100.0 * flops_s / (peak * len(devices)), 2
-                )
+            sharded_rec = _throughput_record(timings["scan_s"], len(devices))
+            sharded_rec["host_staging_s"] = round(timings["staging_s"], 4)
+            sharded_rec["mesh"] = f"{dp}x2"
             record["train_sharded_dp_tp"] = sharded_rec
         except Exception as exc:
             record["train_sharded_dp_tp"] = {
@@ -457,6 +450,11 @@ def bench_wide(steps: int = WIDE_STEPS) -> dict:
     record["serve_rows_per_s"] = round(WIDE_BATCH / best, 1)
     record["value"] = record["train_xla_single"]["seconds_per_step"]
     record["unit"] = "s/step"
+    record["vs_baseline"] = None
+    record["baseline_note"] = (
+        "no reference analogue — beyond-reference workload; the reference's "
+        "only model is d=2 OLS (SURVEY.md §2)"
+    )
     return record
 
 

@@ -1,0 +1,82 @@
+"""The wide workload: a (1024, 1024, 1024) MLP lifecycle (beyond-reference).
+
+The reference's only model is a 1-feature OLS; every matmul in the parity
+workloads is smaller than one MXU tile. This example runs the framework's
+wide configuration (bench config 6) — 32 features, kilowide hidden layers —
+through the full lifecycle: fused fit+eval, date-keyed checkpoint, batch
+serving through the shape-bucketed predictor, and a cross-check of the
+Pallas serving kernel against the XLA apply.
+
+Sized down by default (--rows/--steps) so it runs in seconds on CPU; on a
+TPU the same shapes hit the MXU (see README "The wide workload" for the
+measured throughput).
+"""
+import argparse
+import sys
+from datetime import date
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo-root run
+
+import numpy as np
+
+from bodywork_tpu.models import MLPConfig, MLPRegressor, load_model, save_model
+from bodywork_tpu.ops import make_pallas_mlp_apply
+from bodywork_tpu.serve import create_app
+from bodywork_tpu.store import open_store
+from bodywork_tpu.utils.logging import configure_logger
+
+DEFAULT_STORE = "/tmp/bodywork-tpu-wide-example"
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--store", default=DEFAULT_STORE)
+    p.add_argument("--rows", type=int, default=1024)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--hidden", type=int, default=1024)
+    args = p.parse_args()
+
+    configure_logger()
+    store = open_store(args.store)
+
+    rng = np.random.default_rng(7)
+    d = 32
+    X = rng.uniform(-1.0, 1.0, (args.rows, d)).astype(np.float32)
+    w = rng.normal(size=d).astype(np.float32)
+    y = (X @ w + 0.1 * rng.normal(size=args.rows)).astype(np.float32)
+
+    cfg = MLPConfig(
+        hidden=(args.hidden,) * 3, batch_size=min(256, args.rows),
+        n_steps=args.steps, learning_rate=1e-3,
+    )
+    split = int(args.rows * 0.8)
+    model, metrics = MLPRegressor(cfg).fit_and_evaluate(
+        X[:split], y[:split], X[split:], y[split:]
+    )
+    print(f"trained {model.info}: MAPE={metrics['MAPE']:.4f} "
+          f"r2={metrics['r_squared']:.4f}")
+
+    key = save_model(store, model, date(2026, 1, 1))
+    clone, model_date = load_model(store)
+    print(f"checkpoint round-trip: {key} ({model_date})")
+
+    app = create_app(clone, model_date, buckets=(64,), warmup=False)
+    body = app.test_client().post(
+        "/score/v1/batch",
+        json={"X": [[float(v) for v in row] for row in X[:8]]},
+    ).get_json()
+    print(f"served {body['n']} rows via /score/v1/batch "
+          f"({body['model_info']})")
+
+    import jax
+
+    interpret = jax.devices()[0].platform != "tpu"
+    pallas_apply = make_pallas_mlp_apply(clone.params, interpret=interpret)
+    delta = np.max(np.abs(np.asarray(pallas_apply(X[:8])) - clone.predict(X[:8])))
+    print(f"pallas-vs-xla max abs delta on 8 rows: {delta:.5f} "
+          f"({'interpreter' if interpret else 'TPU kernel'})")
+
+
+if __name__ == "__main__":
+    main()

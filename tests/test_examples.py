@@ -144,3 +144,19 @@ def test_example_02_serve_over_http(tmp_path):
         assert set(body) == {"prediction", "model_info", "model_date"}
         # alpha(1)=1.0, beta=0.5 => E[y|X=50] ~= 26
         assert body["prediction"] == pytest.approx(26.0, abs=3.0)
+
+
+def test_example_07_wide_model(tmp_path, monkeypatch, capsys):
+    # sized down (128-wide, 4 steps) but same lifecycle as the wide config:
+    # fused fit+eval, checkpoint round-trip, batch serving, pallas cross-check
+    _run_example(
+        monkeypatch, "07_wide_model",
+        "--store", str(tmp_path / "wide"), "--rows", "256", "--steps", "4",
+        "--hidden", "128",
+    )
+    out = capsys.readouterr().out
+    assert "trained MLPRegressor(hidden=[128, 128, 128])" in out
+    assert "checkpoint round-trip: models/regressor-2026-01-01.npz" in out
+    assert "served 8 rows via /score/v1/batch" in out
+    delta = float(out.rsplit("delta on 8 rows: ", 1)[1].split()[0])
+    assert delta < 0.01
