@@ -472,3 +472,33 @@ def test_every_default_manifest_kind_is_validatable():
         from bodywork_tpu.pipeline.k8s_validate import _KIND_SPEC_VALIDATORS
 
         assert kinds <= set(_KIND_SPEC_VALIDATORS)
+
+
+def test_manifest_validator_covers_service_ingress_cronjob_paths():
+    import copy
+    import dataclasses as _dc
+
+    from bodywork_tpu.pipeline import validate_manifest
+
+    spec = default_pipeline()
+    spec.stages["stage-2-serve-model"] = _dc.replace(
+        spec.stages["stage-2-serve-model"], ingress=True
+    )
+    docs = generate_manifests(spec, store_path="/mnt/store")
+
+    svc = copy.deepcopy(next(d for d in docs.values() if d["kind"] == "Service"))
+    del svc["spec"]["ports"]
+    assert any("ports" in e for e in validate_manifest(svc, "svc"))
+
+    ing = copy.deepcopy(next(d for d in docs.values() if d["kind"] == "Ingress"))
+    path0 = ing["spec"]["rules"][0]["http"]["paths"][0]
+    path0["backend"]["servce"] = path0["backend"].pop("service")  # typo
+    errs = validate_manifest(ing, "ing")
+    assert any("unknown field 'servce'" in e for e in errs)
+    assert any("missing required field 'service'" in e for e in errs)
+
+    cron = copy.deepcopy(next(d for d in docs.values() if d["kind"] == "CronJob"))
+    cron["spec"]["schedle"] = cron["spec"].pop("schedule")  # typo
+    errs = validate_manifest(cron, "cron")
+    assert any("unknown field 'schedle'" in e for e in errs)
+    assert any("missing required field 'schedule'" in e for e in errs)
