@@ -335,7 +335,9 @@ def _wide_data(n_rows: int = 2 * WIDE_BATCH):
     return X, y
 
 
-def bench_wide(steps: int = WIDE_STEPS) -> dict:
+def bench_wide(
+    steps: int = WIDE_STEPS, serve_iters: int = 20, serve_repeats: int = 3
+) -> dict:
     """Config 6: the wide MLP through (a) single-device XLA training with an
     MFU estimate, (b) dp x tp sharded training when the pool has >1 device,
     and (c) batched serving device-side through both engines.
@@ -430,11 +432,13 @@ def bench_wide(steps: int = WIDE_STEPS) -> dict:
 
     xla_apply = jax.jit(type(model).apply)
     record["serve_xla"] = time_device_batch(
-        partial(xla_apply, model.params), Xb, iters=20
+        partial(xla_apply, model.params), Xb,
+        iters=serve_iters, repeats=serve_repeats,
     )
     if on_tpu:
         record["serve_pallas"] = time_device_batch(
-            make_pallas_mlp_apply(model.params), Xb, iters=20
+            make_pallas_mlp_apply(model.params), Xb,
+            iters=serve_iters, repeats=serve_repeats,
         )
     else:
         record["serve_pallas"] = {

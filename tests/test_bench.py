@@ -103,3 +103,24 @@ def test_bench_ab_record_attribution():
     )
     assert record["total_wallclock_s"] >= slowest * 0.9
     assert record["untimed_bootstrap_s"] >= 0
+
+
+def test_bench_wide_record_shape():
+    """Config 6's record: throughput fields from the shared helper, sharded
+    sub-record with honest staging/scan split (8-device mesh), device-side
+    serving views, and the self-describing missing-baseline note."""
+    record = bench.bench_wide(steps=2, serve_iters=2, serve_repeats=1)
+    assert record["metric"] == "wide_mlp_1024x3"
+    assert record["value"] == record["train_xla_single"]["seconds_per_step"]
+    assert record["unit"] == "s/step"
+    assert record["vs_baseline"] is None and "baseline_note" in record
+    xla = record["train_xla_single"]
+    assert xla["model_tflops_s"] > 0 and xla["steps"] == 2
+    assert "mfu_pct_est" not in xla  # no peak estimate off-TPU
+    sh = record["train_sharded_dp_tp"]
+    assert sh["mesh"] == "4x2"
+    assert sh["host_staging_s"] > 0 and sh["seconds_per_step"] > 0
+    dev = record["serve_xla"]
+    assert dev["device_pipelined_s"] == min(dev["device_pipelined_passes"])
+    assert "skipped" in record["serve_pallas"]  # interpreter off-TPU
+    assert record["serve_rows_per_s"] > 0
