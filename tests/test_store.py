@@ -184,3 +184,41 @@ def test_atomic_write_leaves_no_tmp_files(tmp_path):
     fs.put_bytes("a/b.bin", b"x" * 1024)
     leftover = [p for p in (fs.root / "a").iterdir() if p.name.startswith(".tmp-")]
     assert leftover == []
+
+
+def test_concurrent_reader_never_sees_torn_write(tmp_path):
+    # the serve stage reads artefacts while batch stages write them (two
+    # pods sharing the PVC); the filesystem backend's tmp-file + rename
+    # write means a reader sees either the old or the new bytes, never a
+    # prefix. Hammer one key from a writer thread while reading.
+    import threading
+
+    fs = FilesystemStore(tmp_path / "artefacts")
+    payloads = [bytes([i]) * 4096 for i in range(8)]
+    fs.put_bytes("models/current.npz", payloads[0])
+    stop = threading.Event()
+    errors = []
+
+    writer_failure = []
+
+    def writer():
+        i = 0
+        try:
+            while not stop.is_set():
+                fs.put_bytes("models/current.npz", payloads[i % len(payloads)])
+                i += 1
+        except BaseException as exc:  # a dead writer must FAIL the test,
+            writer_failure.append(exc)  # not let it pass vacuously
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        for _ in range(500):
+            data = fs.get_bytes("models/current.npz")
+            if data not in payloads:
+                errors.append(len(data))
+    finally:
+        stop.set()
+        t.join()
+    assert errors == []
+    assert writer_failure == []
