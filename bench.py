@@ -638,8 +638,12 @@ def main() -> int:
         record["elapsed_s"] = round(time.perf_counter() - t0, 2)
         records.append(record)
 
-    backend_note = f"{platform} (fallback: tpu relay unreachable)" if fallback \
+    backend_note = (
+        f"{platform} (fallback: tpu relay unreachable; TPU-backed capture "
+        "of the same configs: BENCH_DEV_r03.json)"
+        if fallback
         else platform
+    )
     ok = [r for r in records if "error" not in r]
     if not ok:
         print(json.dumps({"error": "all configs failed", "backend": backend_note,
