@@ -40,6 +40,9 @@ from pathlib import Path
 import yaml
 
 from bodywork_tpu.pipeline.spec import PipelineSpec, StageSpec
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("pipeline.k8s")
 
 _STORE_VOLUME = "artefact-store"
 _SPEC_VOLUME = "pipeline-spec"
@@ -300,6 +303,17 @@ def generate_manifests(
     forever. Pass ``None``/empty to use the cluster's default class
     (only correct if that class supports RWX).
     """
+    for stage in spec.stages.values():
+        if stage.kind == "service" and stage.resources.tpu_hosts > 1:
+            # silently emitting a single-host Deployment would defer the
+            # misconfiguration to runtime (a model sharded for N hosts
+            # cannot fit one host's chips)
+            raise ValueError(
+                f"stage {stage.name!r}: tpu_hosts > 1 is only supported "
+                "for batch stages (Indexed Jobs); multi-host serving "
+                "Deployments are not materialisable"
+            )
+    multihost = any(s.resources.tpu_hosts > 1 for s in spec.stages.values())
     store = _resolve_store_medium(
         spec, store_path, store_volume, storage_class, pvc_size
     )
@@ -330,20 +344,62 @@ def generate_manifests(
                 "labels": labels,
             }
             if stage.kind == "batch":
+                job_spec: dict = {
+                    "backoffLimit": stage.retries,
+                    "activeDeadlineSeconds": int(stage.max_completion_time_s),
+                    "template": {
+                        "metadata": {"labels": labels},
+                        "spec": _pod_spec(
+                            spec, stage, store, image, command, "Never"
+                        ),
+                    },
+                }
+                n_hosts = stage.resources.tpu_hosts
+                if n_hosts > 1:
+                    # one worker failure cascades to ALL n pods (the
+                    # coordinator heartbeat kills the slice), so a logical
+                    # retry costs n pod failures — scale the budget or a
+                    # single failure exhausts it
+                    job_spec["backoffLimit"] = stage.retries * n_hosts
+                    # multi-host TPU slice: one Indexed pod per worker host.
+                    # Indexed pods get stable hostnames <job>-<index>; with
+                    # `subdomain` + the headless Service below, pod 0 is
+                    # resolvable as the JAX coordinator, which is the env
+                    # trigger parallel.multihost_init keys on (GKE's TPU
+                    # webhook supplies worker ids/hostnames to
+                    # jax.distributed.initialize itself).
+                    job_name = meta["name"]
+                    job_spec["completions"] = n_hosts
+                    job_spec["parallelism"] = n_hosts
+                    job_spec["completionMode"] = "Indexed"
+                    pod = job_spec["template"]["spec"]
+                    pod["subdomain"] = job_name
+                    container = pod["containers"][0]
+                    container.setdefault("env", []).append(
+                        {
+                            "name": "JAX_COORDINATOR_ADDRESS",
+                            "value": f"{job_name}-0.{job_name}:8476",
+                        }
+                    )
+                    docs[f"{i:02d}-{stage.name}-workers-headless.yaml"] = {
+                        "apiVersion": "v1",
+                        "kind": "Service",
+                        "metadata": meta,
+                        "spec": {
+                            "clusterIP": "None",
+                            # per-pod DNS must exist BEFORE readiness, or
+                            # workers racing ahead of pod 0 get NXDOMAIN
+                            # on the coordinator name at startup
+                            "publishNotReadyAddresses": True,
+                            "selector": {"app": labels["app"]},
+                            "ports": [{"port": 8476, "name": "jax-coord"}],
+                        },
+                    }
                 docs[f"{i:02d}-{stage.name}-job.yaml"] = {
                     "apiVersion": "batch/v1",
                     "kind": "Job",
                     "metadata": meta,
-                    "spec": {
-                        "backoffLimit": stage.retries,
-                        "activeDeadlineSeconds": int(stage.max_completion_time_s),
-                        "template": {
-                            "metadata": {"labels": labels},
-                            "spec": _pod_spec(
-                                spec, stage, store, image, command, "Never"
-                            ),
-                        },
-                    },
+                    "spec": job_spec,
                 }
             else:
                 docs[f"{i:02d}-{stage.name}-deployment.yaml"] = {
@@ -406,7 +462,18 @@ def generate_manifests(
                             ]
                         },
                     }
-    if daily_schedule:
+    if daily_schedule and multihost:
+        # run-day in ONE CronJob pod cannot drive a multi-host slice (TPU
+        # init needs every host of the slice to participate); the daily
+        # loop for a multi-host spec is re-applying the per-stage Jobs
+        # (the Indexed Job IS the multi-host path), so emitting the
+        # single-pod CronJob would ship a retrain that hangs on day 1
+        log.warning(
+            "daily-loop CronJob omitted: spec has multi-host stages "
+            "(tpu_hosts > 1); schedule re-application of the per-stage "
+            "Jobs instead"
+        )
+    elif daily_schedule:
         docs["99-daily-loop-cronjob.yaml"] = {
             "apiVersion": "batch/v1",
             "kind": "CronJob",

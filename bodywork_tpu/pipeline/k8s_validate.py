@@ -201,6 +201,7 @@ _pod_spec = _mapping(
         "volumes": _each(_volume),
         "restartPolicy": _scalar,
         "nodeSelector": _str_map,
+        "subdomain": _scalar,
         "serviceAccountName": _scalar,
         "terminationGracePeriodSeconds": _scalar,
         "tolerations": _each(_mapping(
@@ -225,6 +226,7 @@ _job_spec = _mapping(
         "activeDeadlineSeconds": _scalar,
         "completions": _scalar,
         "parallelism": _scalar,
+        "completionMode": _scalar,
         "ttlSecondsAfterFinished": _scalar,
         "template": _pod_template,
     },
@@ -303,6 +305,7 @@ _KIND_SPEC_VALIDATORS: dict[str, Any] = {
                     ),
                     "type": _scalar,
                     "clusterIP": _scalar,
+                    "publishNotReadyAddresses": _scalar,
                 },
                 required=("ports",),
             ),

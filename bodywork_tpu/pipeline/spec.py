@@ -47,8 +47,13 @@ class ResourceSpec:
     tpu_accelerator: str | None = None
     #: GKE TPU topology for nodeSelector, e.g. "1x1" (v5e-1) or "2x4" (v5e-8)
     tpu_topology: str | None = None
-    #: chips requested as the ``google.com/tpu`` resource
+    #: chips requested as the ``google.com/tpu`` resource (PER HOST)
     tpu_chips: int = 0
+    #: worker hosts in the TPU slice. >1 turns a batch stage's Job into an
+    #: Indexed multi-host Job (one pod per host) with a headless Service
+    #: and JAX coordinator wiring, so ``parallel.multihost_init`` joins the
+    #: pods into one jax.distributed cluster (mesh over ICI+DCN)
+    tpu_hosts: int = 1
 
 
 @dataclasses.dataclass

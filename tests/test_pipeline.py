@@ -502,3 +502,72 @@ def test_manifest_validator_covers_service_ingress_cronjob_paths():
     errs = validate_manifest(cron, "cron")
     assert any("unknown field 'schedle'" in e for e in errs)
     assert any("missing required field 'schedule'" in e for e in errs)
+
+
+def test_multihost_tpu_slice_emits_indexed_job_and_headless_service():
+    # deployment half of the multi-host story: tpu_hosts > 1 provisions one
+    # Indexed pod per worker host with stable DNS and the coordinator env
+    # var that parallel.multihost_init keys on (mesh over ICI + DCN)
+    import dataclasses as _dc
+
+    from bodywork_tpu.pipeline import validate_manifests
+
+    spec = default_pipeline(model_type="mlp")
+    train = spec.stages["stage-1-train-model"]
+    spec.stages["stage-1-train-model"] = _dc.replace(
+        train,
+        resources=_dc.replace(
+            train.resources, tpu_hosts=4, tpu_topology="4x4", tpu_chips=4
+        ),
+    )
+    docs = generate_manifests(spec, store_path="/mnt/store")
+    validate_manifests(docs)
+
+    job = next(
+        d for n, d in docs.items()
+        if d["kind"] == "Job" and "train" in n
+    )
+    assert job["spec"]["completions"] == 4
+    assert job["spec"]["parallelism"] == 4
+    assert job["spec"]["completionMode"] == "Indexed"
+    # one logical failure cascades to all 4 pods: the retry budget scales
+    assert job["spec"]["backoffLimit"] == 2 * 4
+    pod = job["spec"]["template"]["spec"]
+    job_name = job["metadata"]["name"]
+    assert pod["subdomain"] == job_name
+    env = {e["name"]: e["value"] for e in pod["containers"][0]["env"]}
+    assert env["JAX_COORDINATOR_ADDRESS"] == f"{job_name}-0.{job_name}:8476"
+
+    headless = [
+        d for n, d in docs.items()
+        if d["kind"] == "Service" and "headless" in n
+    ]
+    assert len(headless) == 1
+    assert headless[0]["spec"]["clusterIP"] == "None"
+    assert headless[0]["spec"]["selector"]["app"] == job_name
+    # coordinator DNS must resolve before pod 0 is Ready (startup race)
+    assert headless[0]["spec"]["publishNotReadyAddresses"] is True
+
+    # the single-pod daily CronJob cannot drive a multi-host slice: omitted
+    assert not any("cronjob" in n for n in docs)
+
+    # single-host stages are untouched
+    other = next(
+        d for n, d in docs.items()
+        if d["kind"] == "Job" and "generate" in n
+    )
+    assert "completionMode" not in other["spec"]
+    assert "subdomain" not in other["spec"]["template"]["spec"]
+
+    # and the resources knob round-trips YAML like every other field
+    clone = PipelineSpec.from_yaml(spec.to_yaml())
+    assert clone.stages["stage-1-train-model"].resources.tpu_hosts == 4
+
+    # multi-host SERVING is not materialisable: fail at generation, not
+    # at runtime on a model that cannot fit one host
+    serve = spec.stages["stage-2-serve-model"]
+    spec.stages["stage-2-serve-model"] = _dc.replace(
+        serve, resources=_dc.replace(serve.resources, tpu_hosts=2)
+    )
+    with pytest.raises(ValueError, match="batch stages"):
+        generate_manifests(spec, store_path="/mnt/store")
