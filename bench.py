@@ -30,13 +30,29 @@ simulation, report the mean wall-clock of the steady-state days (day 1
 pays one-time XLA compiles and is excluded). Config 4 reports mean seconds
 per 1k-row scoring request; config 1 reports the single day.
 
-Backend bring-up is self-defending: the device backend is probed in a
-subprocess with a timeout, and if it is unreachable (wedged TPU relay —
-the round-1 failure mode) the whole bench falls back to the CPU platform
-and says so in the emitted record, so a driver capture always yields
-numbers instead of a watchdog abort.
+Driver-robustness layer (each defends against an observed failure mode):
 
-Prints ONE JSON line to stdout; progress goes to stderr.
+- **Per-config subprocesses.** The parent runs every config in a fresh
+  child process with a timeout, so a TPU relay that wedges mid-run (the
+  round-3 failure: the probe passed, then the relay died) kills one
+  config's child, not the whole capture.
+- **Bounded re-probe with backoff.** The backend is probed in a throwaway
+  subprocess before each config; a dead relay triggers a bounded
+  backoff-and-retry cycle (shared budget), and a relay that recovers
+  mid-run is picked up by the next config instead of the whole bench
+  staying on CPU.
+- **Per-config fallback.** Only configs the relay refuses run on CPU;
+  each record carries its own ``backend`` field, and the top-level
+  ``backend`` summarises ("tpu", "cpu", or "mixed").
+- **Resume.** Completed records are staged in ``.bench_state/`` keyed by a
+  source-tree fingerprint; a re-run reuses fresh TPU-backed records
+  instead of discarding them (``--no-resume`` disables).
+- **Compact stdout.** The driver archives a bounded tail of stdout and
+  parses the last line; round 3's full record outgrew it and parsed as
+  null. stdout now gets a compact summary line (headline + per-config
+  one-liners), and the full record goes to ``bench_full.json``.
+
+Prints ONE compact JSON line to stdout; progress goes to stderr.
 """
 from __future__ import annotations
 
@@ -65,9 +81,16 @@ WIDE_HIDDEN = (1024, 1024, 1024)
 WIDE_FEATURES = 32
 WIDE_BATCH = 8192
 WIDE_STEPS = 50
-#: bf16 MXU peak of one v5e chip (~197 TFLOP/s). MFU here is an *estimate*:
-#: the train step runs float32 arrays through XLA's default matmul
-#: precision, which on TPU executes bf16 MXU passes.
+#: scan length of the MFU-timed training program: long enough that the
+#: ~67 ms tunnel round-trip amortised over a group of back-to-back runs
+#: is noise next to device time (the round-3 protocol timed 50 steps
+#: through fit() — ~214 ms wall including 2+ RTTs and host staging, which
+#: understated MFU by ~3x)
+MFU_STEPS = 200
+#: bf16 MXU peak of one v5e chip (~197 TFLOP/s) — the MFU denominator;
+#: the timed program's matmul operands are bf16 (``compute_dtype``), so
+#: the bf16 peak is the honest basis (it is also the *harder* denominator
+#: for any f32 comparison record).
 PEAK_FLOPS_V5E = 197e12
 
 
@@ -336,83 +359,193 @@ def _wide_data(n_rows: int = 2 * WIDE_BATCH):
 
 
 def bench_wide(
-    steps: int = WIDE_STEPS, serve_iters: int = 20, serve_repeats: int = 3
+    steps: int = WIDE_STEPS,
+    serve_iters: int = 20,
+    serve_repeats: int = 3,
+    mfu_steps: int = MFU_STEPS,
+    mfu_groups: int = 3,
+    mfu_runs_per_group: int = 2,
+    include_f32: bool = True,
 ) -> dict:
-    """Config 6: the wide MLP through (a) single-device XLA training with an
-    MFU estimate, (b) dp x tp sharded training when the pool has >1 device,
-    and (c) batched serving device-side through both engines.
+    """Config 6: the wide MLP through (a) single-device training throughput
+    at an explicit bf16 mixed-precision policy (with an f32 comparison
+    record), (b) dp x tp sharded training when the pool has >1 device, and
+    (c) batched serving device-side through both engines.
 
-    Training records time a *second* fit (the first pays the XLA compile)
-    and report seconds/step, model FLOP/s, and estimated MFU against the
-    v5e bf16 peak. Serving records use the device-side pipelined timing
-    (:func:`time_device_batch`) on one 8192-row batch.
+    Training throughput protocol (VERDICT r3 item 2): the timed object is
+    the jitted ``lax.scan`` training program alone — data device-resident,
+    no host staging, no result fetch — dispatched ``mfu_runs_per_group``
+    times back-to-back with ONE block per group, min over ``mfu_groups``
+    groups. Over a tunnel-attached TPU one blocked call pays a ~67 ms RTT,
+    so short timed runs (the round-3 protocol: 50 steps through ``fit``)
+    measure mostly transport; here the RTT is amortised to
+    ``rtt / (runs * mfu_steps)`` per step. MFU methodology is recorded in
+    the record itself.
     """
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
-    from bodywork_tpu.models.mlp import MLPConfig, MLPRegressor
+    from bodywork_tpu.models.mlp import (
+        MLPConfig,
+        MLPRegressor,
+        _scaled_splits,
+        _train_core,
+        init_mlp_params,
+    )
     from bodywork_tpu.ops import make_pallas_mlp_apply
 
     on_tpu = jax.devices()[0].platform == "tpu"
     peak = PEAK_FLOPS_V5E if on_tpu else None
     X, y = _wide_data()
-    cfg = MLPConfig(
-        hidden=WIDE_HIDDEN, batch_size=WIDE_BATCH, n_steps=steps,
-        learning_rate=1e-3,
-    )
     flops_per_step = wide_train_flops_per_step()
+    sizes = (WIDE_FEATURES, *WIDE_HIDDEN, 1)
 
-    def _throughput_record(elapsed_s: float, n_chips: int) -> dict:
+    # device-resident standardised dataset, shared by every timed path
+    ones = jnp.ones(X.shape[0], jnp.float32)
+    Xs, ys, _scaler = _scaled_splits(jnp.asarray(X), jnp.asarray(y), ones)
+    jax.block_until_ready((Xs, ys))
+
+    def _throughput_record(per_step_s: float, n_chips: int,
+                           compute_dtype: str | None,
+                           group_times: list, timed_steps: int) -> dict:
         """seconds/step + model FLOP/s + MFU estimate — ONE definition for
         the single-device and sharded records so they can't diverge."""
-        flops_s = steps * flops_per_step / elapsed_s
+        flops_s = flops_per_step / per_step_s
         rec = {
-            "seconds_per_step": round(elapsed_s / steps, 6),
+            "seconds_per_step": round(per_step_s, 6),
             "model_tflops_s": round(flops_s / 1e12, 2),
-            "steps": steps,
+            "steps": timed_steps,
             "batch": WIDE_BATCH,
+            "compute_dtype": compute_dtype or "float32(default-precision)",
+            "group_seconds": [round(t, 4) for t in group_times],
         }
         if peak:
             rec["mfu_pct_est"] = round(100.0 * flops_s / (peak * n_chips), 2)
         return rec
 
-    def _train_record(fit, n_chips: int):
-        fit()  # compile
-        t0 = time.perf_counter()
-        model = fit()
-        jax.block_until_ready(model.params)
-        return _throughput_record(time.perf_counter() - t0, n_chips), model
+    def _time_groups(dispatch_once) -> tuple[float, list]:
+        """min-over-groups of back-to-back dispatches, one block/group."""
+        group_times = []
+        for _ in range(mfu_groups):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(mfu_runs_per_group):
+                out = dispatch_once()
+            jax.block_until_ready(out)
+            group_times.append(
+                (time.perf_counter() - t0) / mfu_runs_per_group
+            )
+        return min(group_times), group_times
+
+    train_nodonate = jax.jit(_train_core, static_argnames=("cfg",))
+
+    def _single_device_record(compute_dtype: str | None) -> dict:
+        cfg_t = MLPConfig(hidden=WIDE_HIDDEN, batch_size=WIDE_BATCH,
+                          n_steps=mfu_steps, learning_rate=1e-3,
+                          compute_dtype=compute_dtype)
+        key = jax.random.PRNGKey(0)
+        net0 = jax.jit(init_mlp_params, static_argnums=(1,))(key, sizes)
+        # compile + warm
+        out = train_nodonate(net0, Xs, ys, ones, key, cfg_t)
+        jax.block_until_ready(out[1])
+        best, groups = _time_groups(
+            lambda: train_nodonate(net0, Xs, ys, ones, key, cfg_t)[1]
+        )
+        return _throughput_record(best / mfu_steps, 1, compute_dtype,
+                                  groups, mfu_steps)
 
     record: dict = {
         "metric": "wide_mlp_1024x3",
         "hidden": list(WIDE_HIDDEN),
         "features": WIDE_FEATURES,
         "flops_per_step": flops_per_step,
+        "mfu_methodology": {
+            "peak_flops_per_chip": PEAK_FLOPS_V5E,
+            "peak_basis": "v5e bf16 MXU peak per chip",
+            "flops_counted": "dense matmuls only, bwd = 2x fwd (3x total); "
+                             "elementwise/optimizer FLOPs ignored",
+            "timing": f"min over {mfu_groups} groups of "
+                      f"{mfu_runs_per_group} back-to-back dispatches of the "
+                      f"{mfu_steps}-step jitted scan, one block per group; "
+                      "dataset device-resident; tunnel RTT amortised",
+        },
     }
 
-    xla_rec, model = _train_record(lambda: MLPRegressor(cfg).fit(X, y), 1)
-    record["train_xla_single"] = xla_rec
+    record["train_xla_single"] = _single_device_record("bfloat16")
+    if include_f32:
+        record["train_xla_single_f32"] = _single_device_record(None)
+
+    # the round-3-style end-to-end fit (host staging + transfers + fetch
+    # included) stays as a comparison record so the protocol change is
+    # visible in the capture, not silently re-based
+    cfg_fit = MLPConfig(hidden=WIDE_HIDDEN, batch_size=WIDE_BATCH,
+                        n_steps=steps, learning_rate=1e-3,
+                        compute_dtype="bfloat16")
+    MLPRegressor(cfg_fit).fit(X, y)  # compile
+    t0 = time.perf_counter()
+    model = MLPRegressor(cfg_fit).fit(X, y)
+    jax.block_until_ready(model.params)
+    record["train_fit_e2e"] = {
+        "seconds_per_step": round((time.perf_counter() - t0) / steps, 6),
+        "steps": steps,
+        "note": "whole fit() incl. host staging, transfers and final "
+                "fetch — NOT an MFU basis; kept for protocol continuity",
+    }
 
     n_dev = len(jax.devices())
     if n_dev >= 2:
         # a sub-bench failure must not discard the already-measured
         # single-device record above (same guard as config 4's engines)
         try:
-            from bodywork_tpu.parallel import make_mesh, train_mlp_sharded
+            import optax
+
+            from bodywork_tpu.parallel import make_mesh
+            from bodywork_tpu.parallel.sharding import mlp_param_sharding
+            from bodywork_tpu.parallel.train_step import _sharded_train_fn
+            from jax.sharding import NamedSharding, PartitionSpec as P
 
             dp = n_dev // 2  # odd pools: use the largest even subset
             devices = jax.devices()[: dp * 2]
             mesh = make_mesh(data=dp, model=2, devices=devices)
+            cfg_t = MLPConfig(hidden=WIDE_HIDDEN, batch_size=WIDE_BATCH,
+                              n_steps=mfu_steps, learning_rate=1e-3,
+                              compute_dtype="bfloat16")
 
-            train_mlp_sharded(X, y, cfg, mesh)  # compile
-            # time via the path's own staging/scan split: billing the
-            # host-side batch-schedule staging (which the single-device
-            # program performs on-device) to MFU would let untimed-vs-
-            # timed host work invert the dp x tp conclusion
-            timings: dict = {}
-            train_mlp_sharded(X, y, cfg, mesh, timings=timings)
-            sharded_rec = _throughput_record(timings["scan_s"], len(devices))
-            sharded_rec["host_staging_s"] = round(timings["staging_s"], 4)
+            net_tmpl = jax.eval_shape(
+                lambda k: init_mlp_params(k, sizes), jax.random.PRNGKey(0)
+            )
+            specs = mlp_param_sharding(mesh, {"net": net_tmpl, "scaler": {}})
+            shardings = jax.tree.map(
+                lambda s: NamedSharding(mesh, s), specs["net"],
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            init_j = jax.jit(init_mlp_params, static_argnums=(1,),
+                             out_shardings=shardings)
+            opt_init_j = jax.jit(optax.adam(cfg_t.learning_rate).init)
+            replicated = NamedSharding(mesh, P())
+            t_stage = time.perf_counter()
+            Xd = jax.device_put(np.asarray(Xs), replicated)
+            yd = jax.device_put(np.asarray(ys), replicated)
+            jax.block_until_ready((Xd, yd))
+            staging_s = time.perf_counter() - t_stage
+            run = _sharded_train_fn(mesh, cfg_t)
+            key = jax.random.PRNGKey(0)
+
+            def _one_sharded_run():
+                # fresh (sharded) net + opt state per run: the train fn
+                # donates them; init is on-device and pipelines with the
+                # scan, so no host round-trip sneaks into the group
+                net = init_j(key, sizes)
+                opt_state = opt_init_j(net)
+                return run(net, opt_state, Xd, yd, key)[2]
+
+            jax.block_until_ready(_one_sharded_run())  # compile + warm
+            best, groups = _time_groups(_one_sharded_run)
+            sharded_rec = _throughput_record(
+                best / mfu_steps, len(devices), "bfloat16", groups, mfu_steps
+            )
+            sharded_rec["dataset_staging_s"] = round(staging_s, 4)
             sharded_rec["mesh"] = f"{dp}x2"
             record["train_sharded_dp_tp"] = sharded_rec
         except Exception as exc:
@@ -581,21 +714,276 @@ def probe_backend(timeout_s: float) -> bool:
         return False
 
 
-def main() -> int:
-    parser = argparse.ArgumentParser()
-    parser.add_argument(
-        "--config", type=int, default=None, choices=ALL_CONFIGS,
-        help="run a single config: 1-5 = BASELINE.json, 6 = the "
-             "beyond-reference wide workload (default: all six)",
-    )
-    parser.add_argument(
-        "--backend-timeout", type=float, default=180.0,
-        help="seconds to wait for the device backend before falling back "
-             "to CPU (a wedged TPU relay otherwise hangs jax.devices() "
-             "forever); <= 0 skips the probe and trusts the backend",
-    )
-    args = parser.parse_args()
+# ---------------------------------------------------------------------------
+# Driver-robustness layer (VERDICT r3 item 1): parent/child orchestration,
+# bounded re-probe, per-config resume, compact stdout.
+# ---------------------------------------------------------------------------
 
+#: bump when record shapes change — stale .bench_state entries never match
+SCHEMA_VERSION = 4
+#: reuse window for staged records; beyond this a capture is re-measured
+RESUME_MAX_AGE_S = 6 * 3600
+#: per-config child timeouts, sized at ~4x the round-3 TPU capture plus
+#: fresh-process JAX init + compiles (each child is a cold process)
+CONFIG_TIMEOUT_S = {1: 300, 2: 300, 3: 600, 4: 600, 5: 450, 6: 600}
+
+
+def tree_fingerprint(root: str | None = None) -> str:
+    """Content hash of bench.py + the package source — the resume key.
+    Deliberately git-independent: the driver may run with a dirty tree."""
+    import hashlib
+    from pathlib import Path
+
+    root_p = Path(root or os.path.dirname(os.path.abspath(__file__)))
+    h = hashlib.sha256()
+    files = sorted((root_p / "bodywork_tpu").rglob("*.py"))
+    files.append(root_p / "bench.py")
+    for p in files:
+        h.update(str(p.relative_to(root_p)).encode())
+        h.update(p.read_bytes())
+    return h.hexdigest()[:16]
+
+
+def load_staged_record(state_dir, n: int, fingerprint: str):
+    """A previously captured config record, if it is reusable: same schema
+    and source fingerprint, fresh enough, error-free, and TPU-backed (CPU
+    records are cheap to re-measure; TPU ones are the precious captures a
+    mid-run wedge must not discard)."""
+    from pathlib import Path
+
+    path = Path(state_dir) / f"config_{n}.json"
+    if not path.exists():
+        return None
+    try:
+        staged = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    record = staged.get("record") or {}
+    if (
+        staged.get("schema") == SCHEMA_VERSION
+        and staged.get("fingerprint") == fingerprint
+        and time.time() - staged.get("created_unix", 0) < RESUME_MAX_AGE_S
+        and "error" not in record
+        and record.get("backend") == "tpu"
+    ):
+        return record
+    return None
+
+
+def save_staged_record(state_dir, n: int, fingerprint: str, record: dict):
+    from pathlib import Path
+
+    state_dir = Path(state_dir)
+    state_dir.mkdir(parents=True, exist_ok=True)
+    tmp = state_dir / f"config_{n}.json.tmp"
+    tmp.write_text(json.dumps({
+        "schema": SCHEMA_VERSION,
+        "fingerprint": fingerprint,
+        "created_unix": time.time(),
+        "record": record,
+    }))
+    tmp.replace(state_dir / f"config_{n}.json")
+
+
+class RelayGate:
+    """Bounded re-probe with backoff for a flaky TPU relay.
+
+    The first refusal walks the full backoff schedule; after a full cycle
+    has failed, later configs get single cheap probes (so a relay that
+    recovers mid-run is still picked up without re-paying the backoff).
+    All probe + sleep time draws from one budget, bounding the whole
+    bench's probe spend.
+    """
+
+    def __init__(self, probe_timeout_s: float = 60.0,
+                 budget_s: float = 480.0,
+                 backoff_s: tuple = (15.0, 45.0, 90.0)):
+        self.probe_timeout_s = probe_timeout_s
+        self.budget_s = budget_s
+        self.backoff_s = backoff_s
+        self.spent_s = 0.0
+        self.full_cycle_failed = False
+
+    def _probe_once(self) -> bool:
+        t0 = time.perf_counter()
+        ok = probe_backend(self.probe_timeout_s)
+        self.spent_s += time.perf_counter() - t0
+        return ok
+
+    def acquire(self, allow_backoff: bool = True) -> bool:
+        """True when the device backend is reachable right now."""
+        if self.spent_s + self.probe_timeout_s > self.budget_s:
+            print("bench: probe budget exhausted; staying on CPU",
+                  file=sys.stderr)
+            return False
+        if self._probe_once():
+            self.full_cycle_failed = False
+            return True
+        if not allow_backoff or self.full_cycle_failed:
+            return False
+        for delay in self.backoff_s:
+            if self.spent_s + delay + self.probe_timeout_s > self.budget_s:
+                break
+            print(f"bench: relay down; retrying probe in {delay:.0f}s",
+                  file=sys.stderr)
+            time.sleep(delay)
+            self.spent_s += delay
+            if self._probe_once():
+                self.full_cycle_failed = False
+                return True
+        self.full_cycle_failed = True
+        return False
+
+
+def run_config_child(
+    n: int,
+    use_tpu: bool,
+    state_dir,
+    cache_dir=None,
+    timeout_s: float | None = None,
+    backend_timeout_s: float = 120.0,
+    skip_probe: bool = False,
+) -> dict | None:
+    """One config in a fresh child process.
+
+    Returns the record the child wrote — including an ``error`` record for
+    a deterministic config failure (those are terminal: retrying a
+    reproducible exception on another backend would burn the timeout
+    budget and lose the message). ``None`` means the child produced no
+    record at all (timeout/crash — the mid-config-wedge signature), and
+    the caller decides on retry/fallback.
+
+    A fresh process per config means a mid-config relay wedge cannot take
+    already-captured configs with it, at the cost of each child re-paying
+    JAX init; ``cache_dir`` (persistent XLA compilation cache) claws the
+    compile share of that back. ``skip_probe`` skips the child's own
+    backend probe (the parent's gate just ran one) while keeping its
+    bring-up watchdog armed.
+    """
+    from pathlib import Path
+
+    out_file = Path(state_dir) / f"config_{n}.child.json"
+    out_file.unlink(missing_ok=True)
+    cmd = [
+        sys.executable, os.path.abspath(__file__),
+        "--config", str(n),
+        "--json-out", str(out_file),
+        "--backend-timeout", str(backend_timeout_s if use_tpu else 0),
+    ]
+    if skip_probe and use_tpu:
+        cmd.append("--skip-probe")
+    env = os.environ.copy()
+    if not use_tpu:
+        # bypass the relay entirely: the axon plugin probes its pool at
+        # backend init even under JAX_PLATFORMS=cpu
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        # a virtual 8-device mesh (the test env) so the sharded/mesh
+        # sub-benches still execute structurally in a CPU fallback record
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    if cache_dir is not None:
+        env["JAX_COMPILATION_CACHE_DIR"] = str(cache_dir)
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    timeout_s = timeout_s or CONFIG_TIMEOUT_S.get(n, 600)
+    try:
+        proc = subprocess.run(
+            cmd, timeout=timeout_s, capture_output=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"bench: config {n} child timed out after {timeout_s}s",
+              file=sys.stderr)
+        return None
+    # the child's stdout/stderr are progress, never the parent's one line
+    for stream in (proc.stdout, proc.stderr):
+        text = stream.decode(errors="replace").strip()
+        if text:
+            print(text[-4000:], file=sys.stderr)
+    # a written record wins even on rc != 0: the child captured a
+    # deterministic config failure, which is a result, not a wedge
+    if out_file.exists():
+        try:
+            return json.loads(out_file.read_text())
+        except ValueError as exc:
+            print(f"bench: config {n} child record unparseable: {exc}",
+                  file=sys.stderr)
+            return None
+    print(f"bench: config {n} child died without a record "
+          f"(rc={proc.returncode})", file=sys.stderr)
+    return None
+
+
+def summarize_backends(records: list[dict]) -> str:
+    def label(r: dict) -> str:
+        b = r.get("backend", "unknown")
+        if b == "cpu":
+            return "cpu fallback"
+        if b == "tpu":
+            return "tpu"
+        return "failed (no measurement)"
+
+    backends = {r.get("backend", "unknown") for r in records}
+    if backends == {"tpu"}:
+        return "tpu"
+    if backends == {"cpu"}:
+        return "cpu (fallback: tpu relay unreachable for every config)"
+    exceptions = "; ".join(
+        f"config {r.get('config')}: {label(r)}"
+        for r in records if r.get("backend") != "tpu"
+    )
+    if "tpu" in backends:
+        return f"mixed (tpu, except {exceptions})"
+    return f"cpu/failed ({exceptions})"
+
+
+def compact_output(records: list[dict], backend: str,
+                   full_record_path: str) -> dict:
+    """The ONE stdout line: headline + per-config one-liners. The driver
+    archives only a bounded tail of stdout and parses its last line —
+    round 3's full record outgrew that tail and parsed as null — so this
+    line stays small and the detail goes to ``full_record_path``."""
+    ok = [r for r in records if "error" not in r]
+    headline = next(
+        (r for r in ok if r.get("config") == HEADLINE_CONFIG),
+        ok[0] if ok else None,
+    )
+    out: dict = {}
+    if headline is None:
+        out["error"] = "all configs failed"
+    else:
+        for k in ("metric", "value", "unit", "vs_baseline"):
+            out[k] = headline.get(k)
+        if headline.get("config") != HEADLINE_CONFIG:
+            out["headline_fallback"] = (
+                f"config {HEADLINE_CONFIG} failed; headline is "
+                f"config {headline['config']}"
+            )
+    out["backend"] = backend
+    out["schema"] = SCHEMA_VERSION
+    out["configs"] = [
+        {
+            # error messages are truncated: a multi-KB JAX traceback in
+            # one config would push this line past the driver's tail and
+            # recreate the parsed-as-null failure (full text is in the
+            # full record)
+            k: (r[k][:160] if k == "error" else r[k])
+            for k in ("config", "metric", "value", "unit", "vs_baseline",
+                      "backend", "elapsed_s", "resumed", "error")
+            if k in r
+        }
+        for r in records
+    ]
+    out["full_record"] = full_record_path
+    return out
+
+
+def _child_main(args) -> int:
+    """Single-config mode: run one config in THIS process and write the
+    record to ``--json-out`` (parent mode) and stdout (human use)."""
     from bodywork_tpu.utils.logging import configure_logger
     from bodywork_tpu.utils.watchdog import (
         abort_if_backend_hangs,
@@ -603,17 +991,16 @@ def main() -> int:
     )
 
     fallback = False
-    if args.backend_timeout > 0 and not probe_backend(args.backend_timeout):
-        # The relay is down: record CPU numbers with a caveat rather than
-        # aborting with nothing (round-1 outcome: parsed=null).
+    if (
+        args.backend_timeout > 0
+        and not args.skip_probe
+        and not probe_backend(args.backend_timeout)
+    ):
         force_cpu_platform()
         fallback = True
         print("bench: falling back to the CPU platform", file=sys.stderr)
 
-    configure_logger(stream=sys.stderr)  # keep stdout = the one JSON line
-
-    # Belt and braces: the probe said the backend is fine (or was skipped),
-    # but bring-up in *this* process still gets a watchdog.
+    configure_logger(stream=sys.stderr)
     with abort_if_backend_hangs(
         args.backend_timeout if args.backend_timeout > 0 else 0.0,
         what="bench: device backend",
@@ -622,47 +1009,160 @@ def main() -> int:
 
         devices = jax.devices()
     print(f"bench devices: {devices}", file=sys.stderr)
-    platform = devices[0].platform
 
-    configs = [args.config] if args.config else list(ALL_CONFIGS)
-    records = []
-    for n in configs:
-        print(f"bench: running config {n} ...", file=sys.stderr)
-        t0 = time.perf_counter()
-        try:
-            record = run_config(n)
-        except Exception as exc:  # record the failure, keep benching
-            record = {"error": f"{type(exc).__name__}: {exc}"}
-            print(f"bench: config {n} FAILED: {record['error']}", file=sys.stderr)
-        record["config"] = n
-        record["elapsed_s"] = round(time.perf_counter() - t0, 2)
+    t0 = time.perf_counter()
+    try:
+        record = run_config(args.config)
+    except Exception as exc:
+        record = {"error": f"{type(exc).__name__}: {exc}"}
+        print(f"bench: config {args.config} FAILED: {record['error']}",
+              file=sys.stderr)
+    record["config"] = args.config
+    record["elapsed_s"] = round(time.perf_counter() - t0, 2)
+    record["backend"] = devices[0].platform
+    if fallback:
+        record["backend_note"] = "cpu fallback: tpu relay unreachable"
+    line = json.dumps(record)
+    if args.json_out:
+        from pathlib import Path
+
+        Path(args.json_out).write_text(line)
+    print(line)
+    return 0 if "error" not in record else 1
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--config", type=int, default=None, choices=ALL_CONFIGS,
+        help="run a single config IN-PROCESS: 1-5 = BASELINE.json, 6 = the "
+             "beyond-reference wide workload (default: orchestrate all six "
+             "in per-config child processes)",
+    )
+    parser.add_argument(
+        "--json-out", default=None,
+        help="(single-config mode) also write the record JSON to this file",
+    )
+    parser.add_argument(
+        "--backend-timeout", type=float, default=120.0,
+        help="seconds to wait for the device backend before falling back "
+             "to CPU (a wedged TPU relay otherwise hangs jax.devices() "
+             "forever); <= 0 skips every probe and trusts the backend",
+    )
+    parser.add_argument(
+        "--skip-probe", action="store_true",
+        help="(single-config mode) skip the child's own backend probe — "
+             "the parent's gate just ran one — but keep the bring-up "
+             "watchdog armed",
+    )
+    parser.add_argument(
+        "--state-dir", default=None,
+        help="staging dir for per-config records + the XLA compile cache "
+             "(default: .bench_state next to bench.py)",
+    )
+    parser.add_argument(
+        "--full-out", default=None,
+        help="where the full record is written "
+             "(default: bench_full.json next to bench.py)",
+    )
+    parser.add_argument(
+        "--no-resume", action="store_true",
+        help="ignore staged records from a previous (wedged) run",
+    )
+    parser.add_argument(
+        "--probe-budget", type=float, default=480.0,
+        help="total seconds the parent may spend probing/backing off on a "
+             "flaky relay across the whole run",
+    )
+    args = parser.parse_args()
+
+    if args.config is not None:
+        return _child_main(args)
+
+    from pathlib import Path
+
+    here = Path(os.path.dirname(os.path.abspath(__file__)))
+    state_dir = Path(args.state_dir) if args.state_dir else here / ".bench_state"
+    state_dir.mkdir(parents=True, exist_ok=True)
+    full_out = Path(args.full_out) if args.full_out else here / "bench_full.json"
+    cache_dir = state_dir / "xla_cache"
+    fingerprint = tree_fingerprint()
+    # <= 0 trusts the backend: no parent gate, no child probes (children
+    # still run without watchdogs only in this trust mode)
+    trust_backend = args.backend_timeout <= 0
+    gate = None if trust_backend else RelayGate(
+        probe_timeout_s=max(min(args.backend_timeout, 90.0), 10.0),
+        budget_s=args.probe_budget,
+    )
+    child_timeout = 0.0 if trust_backend else args.backend_timeout
+
+    def _child(n, use_tpu):
+        return run_config_child(
+            n, use_tpu, state_dir, cache_dir,
+            backend_timeout_s=child_timeout,
+            # the gate's probe (moments ago) stands in for the child's
+            skip_probe=True,
+        )
+
+    records: list[dict] = []
+    for n in ALL_CONFIGS:
+        if not args.no_resume:
+            staged = load_staged_record(state_dir, n, fingerprint)
+            if staged is not None:
+                print(f"bench: config {n} resumed from staged TPU record",
+                      file=sys.stderr)
+                staged["resumed"] = True
+                records.append(staged)
+                continue
+
+        use_tpu = True if trust_backend else gate.acquire()
+        print(f"bench: running config {n} "
+              f"({'tpu' if use_tpu else 'cpu fallback'}) ...", file=sys.stderr)
+        if n == 1:
+            # config 1 measures a cold process INCLUDING first-compile: run
+            # it against a fresh compile cache, then again warm — the pair
+            # is the persistent-cache before/after evidence
+            import shutil
+
+            shutil.rmtree(cache_dir, ignore_errors=True)
+        record = _child(n, use_tpu)
+        if record is None and use_tpu and not trust_backend:
+            # the relay may have wedged mid-config; one full backoff cycle,
+            # then one retry on whatever backend that leaves us
+            retry_tpu = gate.acquire(allow_backoff=True)
+            print(f"bench: retrying config {n} "
+                  f"({'tpu' if retry_tpu else 'cpu fallback'}) ...",
+                  file=sys.stderr)
+            record = _child(n, retry_tpu)
+        if record is None and use_tpu:
+            record = _child(n, False)
+        if record is None:
+            record = {
+                "config": n, "backend": "none",
+                "error": "child process died without a record on every "
+                         "backend (timeout/crash)",
+            }
+        if n == 1 and "error" not in record:
+            warm = _child(n, record.get("backend") == "tpu")
+            if warm is not None and "error" not in warm:
+                record["warm_cache_rerun"] = {
+                    "value": warm["value"],
+                    "unit": warm.get("unit"),
+                    "elapsed_s": warm.get("elapsed_s"),
+                    "note": "same config, fresh process, persistent XLA "
+                            "compile cache warm (pipeline/k8s daily-pod "
+                            "regime)",
+                }
+        save_staged_record(state_dir, n, fingerprint, record)
         records.append(record)
 
-    backend_note = (
-        f"{platform} (fallback: tpu relay unreachable; TPU-backed capture "
-        "of the same configs: BENCH_DEV_r03.json)"
-        if fallback
-        else platform
-    )
-    ok = [r for r in records if "error" not in r]
-    if not ok:
-        print(json.dumps({"error": "all configs failed", "backend": backend_note,
-                          "configs": records}))
-        return 1
-    headline = next(
-        (r for r in ok if r["config"] == HEADLINE_CONFIG), ok[0]
-    )
-    out = dict(headline)
-    if len(configs) > 1:
-        out["configs"] = records
-        if headline["config"] != HEADLINE_CONFIG:
-            out["headline_fallback"] = (
-                f"config {HEADLINE_CONFIG} failed; headline is "
-                f"config {headline['config']}"
-            )
-    out["backend"] = backend_note
+    backend = summarize_backends(records)
+    full = {"backend": backend, "schema": SCHEMA_VERSION, "configs": records}
+    full_out.write_text(json.dumps(full, indent=1))
+    print(f"bench: full record -> {full_out}", file=sys.stderr)
+    out = compact_output(records, backend, full_out.name)
     print(json.dumps(out))
-    return 0
+    return 0 if any("error" not in r for r in records) else 1
 
 
 if __name__ == "__main__":

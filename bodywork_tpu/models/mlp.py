@@ -35,6 +35,12 @@ class MLPConfig:
     batch_size: int = 256
     n_steps: int = 2000
     seed: int = 0
+    #: training matmul precision policy (a string so the config stays
+    #: hashable/serialisable): None = float32 operands under XLA's default
+    #: TPU precision; "bfloat16" = cast matmul operands to bf16 (params,
+    #: optimizer state, and the loss stay f32 — standard mixed precision,
+    #: single-pass MXU). Serving (``mlp_apply``) always runs f32.
+    compute_dtype: str | None = None
 
     def __post_init__(self):
         object.__setattr__(self, "hidden", tuple(self.hidden))
@@ -50,14 +56,22 @@ def init_mlp_params(key: jax.Array, sizes: tuple[int, ...]) -> dict:
     return {"layers": layers}
 
 
-def mlp_forward(net_params: dict, x: jax.Array) -> jax.Array:
-    """Dense->relu stack; returns (n,) predictions in standardised space."""
-    h = x
+def mlp_forward(
+    net_params: dict, x: jax.Array, compute_dtype: str | None = None
+) -> jax.Array:
+    """Dense->relu stack; returns (n,) predictions in standardised space.
+
+    ``compute_dtype="bfloat16"`` casts every matmul operand (activations,
+    weights, biases) to bf16 so the MXU runs single-pass; autodiff then
+    computes the backward matmuls in bf16 too, with gradients cast back to
+    the params' f32 on the way out. The (n,) output is always f32."""
     layers = net_params["layers"]
+    cast = (lambda a: a.astype(compute_dtype)) if compute_dtype else (lambda a: a)
+    h = cast(x)
     for layer in layers[:-1]:
-        h = jax.nn.relu(h @ layer["w"] + layer["b"])
-    out = h @ layers[-1]["w"] + layers[-1]["b"]
-    return out[:, 0]
+        h = jax.nn.relu(h @ cast(layer["w"]) + cast(layer["b"]))
+    out = h @ cast(layers[-1]["w"]) + cast(layers[-1]["b"])
+    return out[:, 0].astype(jnp.float32)
 
 
 def mlp_apply(params: dict, x: jax.Array) -> jax.Array:
@@ -68,8 +82,8 @@ def mlp_apply(params: dict, x: jax.Array) -> jax.Array:
     return out * s["y_std"] + s["y_mean"]
 
 
-def _loss(net_params, xb, yb, wb):
-    pred = mlp_forward(net_params, xb)
+def _loss(net_params, xb, yb, wb, compute_dtype: str | None = None):
+    pred = mlp_forward(net_params, xb, compute_dtype)
     return jnp.sum(wb * (pred - yb) ** 2) / jnp.maximum(jnp.sum(wb), 1.0)
 
 
@@ -94,7 +108,9 @@ def _train_core(net_params, X, y, w, key, cfg: MLPConfig):
         key, k_idx = jax.random.split(key)
         idx = jax.random.randint(k_idx, (cfg.batch_size,), 0, X.shape[0])
         xb, yb, wb = X[idx], y[idx], w[idx]
-        loss, grads = jax.value_and_grad(_loss)(params, xb, yb, wb)
+        loss, grads = jax.value_and_grad(_loss)(
+            params, xb, yb, wb, cfg.compute_dtype
+        )
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return (params, opt_state, key), loss

@@ -63,7 +63,9 @@ def _sharded_train_fn(mesh: Mesh, cfg: MLPConfig):
             )
             idx = jax.lax.with_sharding_constraint(idx, idx_sharding)
             xb, yb = Xs[idx], ys[idx]
-            loss, grads = jax.value_and_grad(_loss)(net, xb, yb, wb)
+            loss, grads = jax.value_and_grad(_loss)(
+                net, xb, yb, wb, cfg.compute_dtype
+            )
             updates, opt_state = opt.update(grads, opt_state, net)
             net = optax.apply_updates(net, updates)
             return (net, opt_state, key), loss

@@ -105,21 +105,148 @@ def test_bench_ab_record_attribution():
     assert record["untimed_bootstrap_s"] >= 0
 
 
+def test_tree_fingerprint_content_keyed(tmp_path):
+    """The resume key tracks source CONTENT — two identical trees match,
+    one changed byte doesn't (stale staged records must never be reused)."""
+    for name in ("a", "b"):
+        pkg = tmp_path / name / "bodywork_tpu"
+        pkg.mkdir(parents=True)
+        (pkg / "mod.py").write_text("x = 1\n")
+        (tmp_path / name / "bench.py").write_text("# bench\n")
+    fa = bench.tree_fingerprint(tmp_path / "a")
+    assert fa == bench.tree_fingerprint(tmp_path / "b")
+    (tmp_path / "b" / "bodywork_tpu" / "mod.py").write_text("x = 2\n")
+    assert fa != bench.tree_fingerprint(tmp_path / "b")
+
+
+def test_staged_record_reuse_rules(tmp_path):
+    """Only fresh, same-source, error-free TPU records are reused; CPU
+    fallback records are re-measured on the next run."""
+    rec = {"config": 3, "metric": "m", "value": 1.0, "backend": "tpu"}
+    bench.save_staged_record(tmp_path, 3, "fp", rec)
+    assert bench.load_staged_record(tmp_path, 3, "fp") == rec
+    assert bench.load_staged_record(tmp_path, 3, "other-fp") is None
+    assert bench.load_staged_record(tmp_path, 4, "fp") is None
+
+    bench.save_staged_record(tmp_path, 5, "fp", {**rec, "backend": "cpu"})
+    assert bench.load_staged_record(tmp_path, 5, "fp") is None
+    bench.save_staged_record(tmp_path, 6, "fp", {**rec, "error": "boom"})
+    assert bench.load_staged_record(tmp_path, 6, "fp") is None
+
+    # stale: created beyond the reuse window
+    import json as _json
+    import time as _time
+
+    path = tmp_path / "config_3.json"
+    staged = _json.loads(path.read_text())
+    staged["created_unix"] = _time.time() - bench.RESUME_MAX_AGE_S - 1
+    path.write_text(_json.dumps(staged))
+    assert bench.load_staged_record(tmp_path, 3, "fp") is None
+
+
+def test_relay_gate_backoff_bounded(monkeypatch):
+    """A dead relay costs one full backoff cycle, then single probes; a
+    recovery mid-run is picked up; all spend draws from one budget."""
+    calls = []
+    monkeypatch.setattr(bench, "probe_backend", lambda t: calls.append(t) or False)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    gate = bench.RelayGate(probe_timeout_s=10, budget_s=10_000,
+                           backoff_s=(1.0, 2.0))
+    assert gate.acquire() is False
+    assert len(calls) == 3  # initial + one per backoff step
+    assert gate.full_cycle_failed
+    assert gate.acquire() is False
+    assert len(calls) == 4  # single cheap probe once a full cycle failed
+
+    monkeypatch.setattr(bench, "probe_backend", lambda t: True)
+    assert gate.acquire() is True
+    assert not gate.full_cycle_failed
+
+    gate2 = bench.RelayGate(probe_timeout_s=10, budget_s=5)
+    monkeypatch.setattr(bench, "probe_backend",
+                        lambda t: pytest.fail("over-budget probe"))
+    assert gate2.acquire() is False  # budget cannot cover even one probe
+
+
+def test_summarize_backends():
+    tpu = [{"config": 1, "backend": "tpu"}, {"config": 2, "backend": "tpu"}]
+    assert bench.summarize_backends(tpu) == "tpu"
+    cpu = [{"config": 1, "backend": "cpu"}]
+    assert bench.summarize_backends(cpu).startswith("cpu (fallback")
+    mixed = tpu + [{"config": 3, "backend": "cpu"}]
+    s = bench.summarize_backends(mixed)
+    assert s.startswith("mixed") and "config 3: cpu fallback" in s
+    # a config that never ran must not be reported as a CPU measurement
+    failed = tpu + [{"config": 4, "backend": "none", "error": "boom"}]
+    s = bench.summarize_backends(failed)
+    assert s.startswith("mixed") and "config 4: failed (no measurement)" in s
+
+
+def test_compact_output_fits_driver_tail():
+    """The driver archives a 2000-char stdout tail and parses its last
+    line (round 3's full record outgrew it -> parsed null). The compact
+    line must stay well under that for all six configs."""
+    import json as _json
+
+    records = []
+    for n in bench.ALL_CONFIGS:
+        records.append({
+            "config": n,
+            "metric": "e2e_day_wallclock_config_%d" % n,
+            "value": 123.4567,
+            "unit": "s/day",
+            "vs_baseline": 1234.56,
+            "backend": "tpu",
+            "elapsed_s": 999.99,
+            "resumed": True,
+            # bulky fields that must NOT leak into the compact line
+            "variants": {"a": {"x": list(range(100))}},
+            "device_pipelined_passes": [0.1] * 50,
+        })
+    out = bench.compact_output(records, "tpu", "bench_full.json")
+    line = _json.dumps(out)
+    assert len(line) < 1500, len(line)
+    assert out["metric"] == "e2e_day_wallclock_config_%d" % bench.HEADLINE_CONFIG
+    assert out["full_record"] == "bench_full.json"
+    assert len(out["configs"]) == 6
+    assert all("variants" not in c for c in out["configs"])
+
+    # headline falls back when config 2 failed, and the error line says so
+    # — with the (potentially multi-KB) error message truncated so the
+    # compact line cannot outgrow the tail
+    records[1] = {"config": 2, "backend": "cpu", "error": "boom " * 200}
+    out = bench.compact_output(records, "mixed", "bench_full.json")
+    assert out["headline_fallback"].startswith("config 2 failed")
+    assert out["configs"][1]["error"].startswith("boom")
+    assert len(out["configs"][1]["error"]) <= 160
+    assert len(_json.dumps(out)) < 1800
+
+
 def test_bench_wide_record_shape():
-    """Config 6's record: throughput fields from the shared helper, sharded
-    sub-record with honest staging/scan split (8-device mesh), device-side
-    serving views, and the self-describing missing-baseline note."""
-    record = bench.bench_wide(steps=2, serve_iters=2, serve_repeats=1)
+    """Config 6's record: device-isolated throughput at the explicit bf16
+    policy with recorded methodology, the fit-e2e continuity record, the
+    sharded sub-record (8-device mesh), device-side serving views, and the
+    self-describing missing-baseline note."""
+    record = bench.bench_wide(
+        steps=2, serve_iters=2, serve_repeats=1,
+        mfu_steps=2, mfu_groups=1, mfu_runs_per_group=1, include_f32=False,
+    )
     assert record["metric"] == "wide_mlp_1024x3"
     assert record["value"] == record["train_xla_single"]["seconds_per_step"]
     assert record["unit"] == "s/step"
     assert record["vs_baseline"] is None and "baseline_note" in record
+    meth = record["mfu_methodology"]
+    assert meth["peak_basis"].startswith("v5e bf16")
     xla = record["train_xla_single"]
     assert xla["model_tflops_s"] > 0 and xla["steps"] == 2
+    assert xla["compute_dtype"] == "bfloat16"
+    assert len(xla["group_seconds"]) == 1
     assert "mfu_pct_est" not in xla  # no peak estimate off-TPU
+    assert record["train_fit_e2e"]["seconds_per_step"] > 0
     sh = record["train_sharded_dp_tp"]
     assert sh["mesh"] == "4x2"
-    assert sh["host_staging_s"] > 0 and sh["seconds_per_step"] > 0
+    assert sh["dataset_staging_s"] > 0 and sh["seconds_per_step"] > 0
+    assert sh["compute_dtype"] == "bfloat16"
     dev = record["serve_xla"]
     assert dev["device_pipelined_s"] == min(dev["device_pipelined_passes"])
     assert "skipped" in record["serve_pallas"]  # interpreter off-TPU
