@@ -240,7 +240,13 @@ def cmd_report(args) -> int:
     if args.plot:
         from bodywork_tpu.monitor import render_drift_dashboard
 
-        print(render_drift_dashboard(store, args.plot, report=report))
+        try:
+            print(render_drift_dashboard(store, args.plot, report=report))
+        except RuntimeError as exc:
+            # e.g. matplotlib not installed: the CLI contract is a logged
+            # error + exit 1, never an uncaught traceback
+            log.error(exc)
+            return 1
     return 0
 
 
@@ -267,6 +273,12 @@ def build_parser() -> argparse.ArgumentParser:
         prog="bodywork_tpu", description="TPU-native ML pipeline framework"
     )
     parser.add_argument("--log-level", default="INFO")
+    parser.add_argument(
+        "--compile-cache", default=None, metavar="DIR",
+        help="persistent XLA compilation-cache dir, so cold processes "
+             "(daily pods) reuse previous compiles; defaults to "
+             "$BODYWORK_TPU_COMPILE_CACHE, else disabled",
+    )
     sub = parser.add_subparsers(dest="command", required=True)
 
     def add(name, fn, **kwargs):
@@ -391,6 +403,9 @@ def main(argv: list[str] | None = None) -> int:
     configure_logger(args.log_level)
     init_error_monitoring(f"cli-{args.command}")
     try:
+        from bodywork_tpu.utils.compile_cache import enable_compile_cache
+
+        enable_compile_cache(args.compile_cache)
         return args.fn(args)
     except Exception as exc:
         log.error(exc)

@@ -52,6 +52,10 @@ _DEFAULT_IMAGE = "bodywork-tpu/runtime:latest"
 
 STORE_VOLUME_MODES = ("auto", "pvc", "hostpath", "gcs")
 
+#: identity-checked sentinel: an implicit default schedule on a multi-host
+#: spec is omitted with a warning; an EXPLICIT schedule raises
+DEFAULT_DAILY_SCHEDULE = "0 6 * * *"
+
 
 @dataclasses.dataclass(frozen=True)
 class _StoreMedium:
@@ -157,16 +161,30 @@ def _container(
     if stage.resources.tpu_chips:
         resources["limits"] = {"google.com/tpu": stage.resources.tpu_chips}
     env = [{"name": k, "value": str(v)} for k, v in stage.env.items()]
-    # optional: the default pipeline's sentry-integration secret backs a
-    # feature that is a no-op when unconfigured (utils/errors.py); a
-    # required ref would CreateContainerConfigError every pod on clusters
-    # that never created the secret
-    env_from = [
-        {"secretRef": {"name": s, "optional": True}} for s in stage.secrets
+    if store.mode != "gcs":
+        # persistent XLA compilation cache on the shared store volume: a
+        # one-shot daily pod re-pays every compile otherwise (the local
+        # runner's prewarm machinery never reaches a fresh pod). Dotted
+        # dir: invisible to the store's prefix/date-key listing protocol.
+        # gcs mode is skipped — jax's gs:// cache needs extra deps.
+        declared = set(stage.env)
+        for name, value in (
+            ("JAX_COMPILATION_CACHE_DIR", f"{store.store_path}/.xla-cache"),
+            ("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5"),
+        ):
+            if name not in declared:
+                env.append({"name": name, "value": value})
+    # required secrets fail fast at admission (CreateContainerConfigError);
+    # optional ones back features that are no-ops when unconfigured (the
+    # default pipeline's sentry-integration DSN, utils/errors.py)
+    env_from = [{"secretRef": {"name": s}} for s in stage.secrets]
+    env_from += [
+        {"secretRef": {"name": s, "optional": True}}
+        for s in stage.optional_secrets
     ]
     container = {
         "name": stage.name,
-        "image": image,
+        "image": stage.image or image,
         "command": command,
         "volumeMounts": [m for m in (mount, spec_mount) if m],
         "resources": resources,
@@ -253,7 +271,9 @@ def _init_containers(
     return [
         {
             "name": "wait-for-deps",
-            "image": image,
+            # the stage's own image (when overridden): the gate must run
+            # in the same dependency set the stage was pinned to
+            "image": stage.image or image,
             "command": [
                 "python", "-m", "bodywork_tpu.cli", "wait-for",
                 "--store", store.store_path, *conditions,
@@ -285,7 +305,7 @@ def generate_manifests(
     store_path: str = "/mnt/artefact-store",
     image: str = _DEFAULT_IMAGE,
     namespace: str = "bodywork-tpu",
-    daily_schedule: str | None = "0 6 * * *",
+    daily_schedule: str | None = DEFAULT_DAILY_SCHEDULE,
     store_volume: str = "auto",
     storage_class: str | None = "standard-rwx",
     pvc_size: str = "10Gi",
@@ -431,20 +451,32 @@ def generate_manifests(
                 if stage.ingress:
                     # the reference's per-service `ingress` knob
                     # (bodywork.yaml:42); Bodywork exposes the service at
-                    # /<project>/<stage> behind the cluster ingress
-                    # controller — same path convention here
+                    # /<project>/<stage> behind an nginx ingress controller
+                    # WITH a rewrite, so the app still sees its own routes
+                    # (/score/v1, /healthz). Same here: without the
+                    # rewrite-target every proxied request would reach the
+                    # app prefixed and 404.
                     docs[f"{i:02d}-{stage.name}-ingress.yaml"] = {
                         "apiVersion": "networking.k8s.io/v1",
                         "kind": "Ingress",
-                        "metadata": meta,
+                        "metadata": {
+                            **meta,
+                            "annotations": {
+                                "nginx.ingress.kubernetes.io/rewrite-target":
+                                    "/$2",
+                            },
+                        },
                         "spec": {
                             "rules": [
                                 {
                                     "http": {
                                         "paths": [
                                             {
-                                                "path": f"/{spec.name}/{stage.name}",
-                                                "pathType": "Prefix",
+                                                # capture group 2 is the
+                                                # app-relative path the
+                                                # rewrite forwards
+                                                "path": f"/{spec.name}/{stage.name}(/|$)(.*)",
+                                                "pathType": "ImplementationSpecific",
                                                 "backend": {
                                                     "service": {
                                                         "name": spec.service_dns(
@@ -468,6 +500,16 @@ def generate_manifests(
         # loop for a multi-host spec is re-applying the per-stage Jobs
         # (the Indexed Job IS the multi-host path), so emitting the
         # single-pod CronJob would ship a retrain that hangs on day 1
+        if daily_schedule is not DEFAULT_DAILY_SCHEDULE:
+            # the caller EXPLICITLY asked for a schedule: refuse loudly
+            # (consistent with the multi-host serving check above) instead
+            # of shipping manifests that silently lack the daily loop
+            raise ValueError(
+                "daily_schedule is not materialisable for a spec with "
+                "multi-host stages (tpu_hosts > 1): a single CronJob pod "
+                "cannot drive the slice; pass daily_schedule=None and "
+                "schedule re-application of the per-stage Jobs instead"
+            )
         log.warning(
             "daily-loop CronJob omitted: spec has multi-host stages "
             "(tpu_hosts > 1); schedule re-application of the per-stage "

@@ -73,8 +73,17 @@ class StageSpec:
     port: int | None = None               # bodywork.yaml:41
     ingress: bool = False                 # bodywork.yaml:42
     env: dict[str, str] = dataclasses.field(default_factory=dict)
-    #: names of k8s secrets to inject as env vars (bodywork.yaml:22-26)
+    #: names of k8s secrets to inject as env vars (bodywork.yaml:22-26);
+    #: these are REQUIRED — a missing secret fails the pod at admission
+    #: (CreateContainerConfigError), not obscurely at runtime
     secrets: list[str] = dataclasses.field(default_factory=list)
+    #: secrets injected with ``optional: true`` — for features that are
+    #: no-ops when unconfigured (e.g. the sentry-integration DSN)
+    optional_secrets: list[str] = dataclasses.field(default_factory=list)
+    #: container image override for THIS stage's pods (reference parity:
+    #: per-stage dependency isolation, bodywork.yaml:10-16 pins each
+    #: stage's own requirements); None = the pipeline-wide image
+    image: str | None = None
     resources: ResourceSpec = dataclasses.field(default_factory=ResourceSpec)
 
     def __post_init__(self):
@@ -154,6 +163,10 @@ def _stage_to_doc(stage: StageSpec) -> dict:
         doc["env"] = dict(stage.env)
     if stage.secrets:
         doc["secrets"] = list(stage.secrets)
+    if stage.optional_secrets:
+        doc["optional_secrets"] = list(stage.optional_secrets)
+    if stage.image:
+        doc["image"] = stage.image
     return doc
 
 
@@ -172,6 +185,8 @@ def _stage_from_doc(name: str, doc: dict) -> StageSpec:
         ingress=doc.get("ingress", False),
         env=doc.get("env", {}),
         secrets=doc.get("secrets", []),
+        optional_secrets=doc.get("optional_secrets", []),
+        image=doc.get("image"),
         resources=resources,
     )
 
@@ -203,7 +218,9 @@ def default_pipeline(
     # the reference injects its secrets into EVERY stage (bodywork.yaml:22-26
     # mounts aws-credentials + sentry-integration); the store needs no
     # credential secret here (PVC/GCS workload identity), so the per-stage
-    # list is the error-monitoring secret carrying SENTRY_DSN
+    # list is the error-monitoring secret carrying SENTRY_DSN — OPTIONAL,
+    # because error monitoring is a no-op when unconfigured (utils/errors.py)
+    # and a required ref would fail every pod on clusters without it
     secrets = ["sentry-integration"]
     stages = {
         "stage-1-train-model": StageSpec(
@@ -211,7 +228,7 @@ def default_pipeline(
             kind="batch",
             executable="bodywork_tpu.pipeline.stages:train_stage",
             args={"model_type": model_type},
-            secrets=list(secrets),
+            optional_secrets=list(secrets),
             resources=v5e,
         ),
         "stage-2-serve-model": StageSpec(
@@ -224,14 +241,14 @@ def default_pipeline(
             replicas=2,
             port=port,
             ingress=False,
-            secrets=list(secrets),
+            optional_secrets=list(secrets),
             resources=v5e,
         ),
         "stage-3-generate-next-dataset": StageSpec(
             name="stage-3-generate-next-dataset",
             kind="batch",
             executable="bodywork_tpu.pipeline.stages:generate_stage",
-            secrets=list(secrets),
+            optional_secrets=list(secrets),
             resources=dataclasses.replace(v5e, tpu_chips=1),
         ),
         "stage-4-test-model-scoring-service": StageSpec(
@@ -245,7 +262,7 @@ def default_pipeline(
                 if scoring_mode == "batch"
                 else {"mode": scoring_mode}
             ),
-            secrets=list(secrets),
+            optional_secrets=list(secrets),
             resources=ResourceSpec(cpu_request=0.5, memory_mb=256),
         ),
     }

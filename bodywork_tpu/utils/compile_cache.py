@@ -1,0 +1,53 @@
+"""Persistent XLA compilation-cache wiring (VERDICT r3 item 5).
+
+The reference pays no compile cost — its model compute is sklearn
+(``stage_1_train_model.py:105-106``). Here every cold process re-traces
+and re-compiles each XLA program (~2.5 s on day 1 vs ~0.09 s steady in
+the config-1 bench), and the k8s materialisation runs each daily stage as
+a one-shot pod, so without a persistent cache the pipeline re-pays every
+compile every day. JAX's persistent compilation cache keys executables by
+program fingerprint; pointing it at the shared store volume (or any
+stable path) lets today's pod reuse yesterday's compiles.
+
+Resolution order: explicit path > ``BODYWORK_TPU_COMPILE_CACHE`` env >
+``JAX_COMPILATION_CACHE_DIR`` env (native JAX config-from-env — already
+live, nothing to do) > disabled.
+"""
+from __future__ import annotations
+
+import os
+
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("utils.compile_cache")
+
+ENV_VAR = "BODYWORK_TPU_COMPILE_CACHE"
+
+
+def enable_compile_cache(
+    path: str | None = None, min_compile_time_s: float = 0.5
+) -> str | None:
+    """Point JAX's persistent compilation cache at ``path`` and return the
+    resolved path (``None`` = disabled, no config touched).
+
+    Must run before the programs whose compiles should be cached are first
+    traced (any time before is fine — the cache is consulted per compile).
+    ``min_compile_time_s`` floors which compiles are persisted; the
+    default catches every real XLA program here while skipping trivial
+    sub-second op compiles.
+    """
+    path = path or os.environ.get(ENV_VAR)
+    if not path:
+        return None
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", str(path))
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", float(min_compile_time_s)
+    )
+    # the default cache policy skips "uninteresting" backends/programs;
+    # the daily pods want every program cached, CPU CI included
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    log.info(f"persistent XLA compilation cache at {path}")
+    return str(path)
