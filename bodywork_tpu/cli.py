@@ -91,6 +91,7 @@ def cmd_serve(args) -> int:
         block=True,
         mesh_data=args.mesh_data,
         engine=args.engine,
+        watch_interval_s=args.reload_interval if args.reload_interval > 0 else None,
     )
     return 0
 
@@ -240,13 +241,9 @@ def cmd_report(args) -> int:
     if args.plot:
         from bodywork_tpu.monitor import render_drift_dashboard
 
-        try:
-            print(render_drift_dashboard(store, args.plot, report=report))
-        except RuntimeError as exc:
-            # e.g. matplotlib not installed: the CLI contract is a logged
-            # error + exit 1, never an uncaught traceback
-            log.error(exc)
-            return 1
+        # a failure here (e.g. matplotlib missing) propagates to main()'s
+        # catch-all: logged error + exit 1, never an uncaught traceback
+        print(render_drift_dashboard(store, args.plot, report=report))
     return 0
 
 
@@ -313,8 +310,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="shard batches over this many devices (data-parallel serving)",
     )
     p.add_argument(
-        "--engine", default="xla", choices=["xla", "pallas"],
-        help="prediction engine: XLA apply or the fused Pallas MLP kernel",
+        "--engine", default="auto", choices=["auto", "xla", "pallas"],
+        help="prediction engine: the XLA apply, the fused Pallas MLP "
+             "kernel, or auto (kernel only where it wins: wide MLPs on "
+             "a real TPU)",
+    )
+    p.add_argument(
+        "--reload-interval", type=float, default=30.0,
+        help="poll the store every N seconds and hot-swap newer model "
+             "checkpoints into the running service (0 disables; the "
+             "service then serves its boot-time model until restart)",
     )
 
     p = add("test", cmd_test, help="test a live scoring service")

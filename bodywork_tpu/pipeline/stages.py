@@ -163,6 +163,7 @@ def serve_stage(
     port: int = 0,
     buckets: tuple[int, ...] | None = None,
     replicas: int = 1,
+    watch_interval_s: float | None = None,
 ) -> ServiceHandle:
     """Load the latest model into device HBM and start the scoring service
     on a background thread (reference stage 2). Returns the handle; the
@@ -185,7 +186,10 @@ def serve_stage(
     # the in-memory copy and reuse it, saving the re-upload round-trip.
     # (The artefact is still read and remains the source of truth: any
     # mismatch falls back to serving exactly what the store holds.)
-    model, model_date = load_model(ctx.store, device=False)
+    from bodywork_tpu.store.schema import MODELS_PREFIX as _MODELS_PREFIX
+
+    served_key, _ = ctx.store.latest(_MODELS_PREFIX)
+    model, model_date = load_model(ctx.store, served_key, device=False)
     reused = False
     # snapshot: concurrent step siblings may insert results mid-iteration
     for result in list(ctx.stage_results.values()):
@@ -218,7 +222,20 @@ def serve_stage(
     from bodywork_tpu.serve.server import RoundRobinApp
 
     front = RoundRobinApp(apps) if len(apps) > 1 else apps[0]
-    handle = ServiceHandle(front, host=host, port=port).start()
+    handle = ServiceHandle(front, host=host, port=port)
+    if watch_interval_s:
+        # hot reload (beyond-parity): the deployed service lives across
+        # days, swapping in each retrain's checkpoint instead of being
+        # re-rolled per day like the reference's stage 2
+        from bodywork_tpu.serve.reload import CheckpointWatcher
+
+        watcher = CheckpointWatcher(
+            apps, ctx.store, poll_interval_s=watch_interval_s,
+            served_key=served_key,
+        )
+        watcher.start()
+        handle.add_cleanup(watcher.stop)
+    handle.start()
     handle.app = front
     handle.replica_apps = apps
     return handle

@@ -1,15 +1,21 @@
 from bodywork_tpu.serve.predictor import PaddedPredictor
 from bodywork_tpu.serve.app import create_app
+from bodywork_tpu.serve.reload import CheckpointWatcher
 from bodywork_tpu.serve.server import (
     RoundRobinApp,
     ServiceHandle,
+    build_predictor,
+    resolve_engine,
     serve_latest_model,
 )
 
 __all__ = [
+    "CheckpointWatcher",
     "PaddedPredictor",
     "RoundRobinApp",
+    "build_predictor",
     "create_app",
+    "resolve_engine",
     "ServiceHandle",
     "serve_latest_model",
 ]

@@ -47,8 +47,25 @@ def _json_response(payload: dict, status: int = 200) -> Response:
     )
 
 
+class _Served:
+    """One served model: predictor + identity, swapped as a unit so a
+    request can never pair one model's prediction with another's info."""
+
+    __slots__ = ("predictor", "model_info", "model_date")
+
+    def __init__(self, predictor, model_info: str, model_date: str | None):
+        self.predictor = predictor
+        self.model_info = model_info
+        self.model_date = model_date
+
+
 class ScoringApp:
-    """WSGI scoring application over a shape-bucketed predictor."""
+    """WSGI scoring application over a shape-bucketed predictor.
+
+    The served model is held as one immutable bundle behind a single
+    attribute, so :meth:`swap_model` (hot reload) is an atomic pointer
+    swap under the GIL — in-flight requests finish on the model they
+    started with."""
 
     def __init__(
         self,
@@ -59,16 +76,47 @@ class ScoringApp:
     ):
         # a custom predictor (e.g. parallel.DataParallelPredictor over a
         # device mesh) replaces the single-device bucketed default
-        self.predictor = predictor or (
+        predictor = predictor or (
             PaddedPredictor(model, buckets) if buckets else PaddedPredictor(model)
         )
-        self.model_info = model.info
-        self.model_date = str(model_date) if model_date else None
+        self._served = _Served(
+            predictor, model.info, str(model_date) if model_date else None
+        )
         self._routes = {
             ("POST", "/score/v1"): self.score_data_instance,
             ("POST", "/score/v1/batch"): self.score_batch,
             ("GET", "/healthz"): self.healthz,
         }
+
+    # -- served-model access (single read point for atomic swaps) ----------
+    @property
+    def predictor(self):
+        return self._served.predictor
+
+    @property
+    def model_info(self) -> str:
+        return self._served.model_info
+
+    @property
+    def model_date(self) -> str | None:
+        return self._served.model_date
+
+    def swap_model(
+        self,
+        model: Regressor,
+        model_date: date | None = None,
+        predictor=None,
+    ) -> None:
+        """Atomically replace the served model (hot reload). The caller is
+        responsible for warming the new predictor OFF the request path
+        first (``serve.reload.CheckpointWatcher`` does)."""
+        predictor = predictor or PaddedPredictor(
+            model, self._served.predictor.buckets
+        )
+        self._served = _Served(
+            predictor, model.info, str(model_date) if model_date else None
+        )
+        log.info(f"hot-swapped served model -> {model.info} ({model_date})")
 
     # -- WSGI plumbing -----------------------------------------------------
     def __call__(self, environ, start_response):
@@ -117,13 +165,14 @@ class ScoringApp:
         X, err = self._features_from(request)
         if err is not None:
             return err
+        served = self._served  # one read: stable across a hot swap
         X = np.array(X, ndmin=2)  # scalar -> (1, 1), as the reference
-        prediction = self.predictor.predict(X)
+        prediction = served.predictor.predict(X)
         return _json_response(
             {
                 "prediction": float(prediction[0]),
-                "model_info": self.model_info,
-                "model_date": self.model_date,
+                "model_info": served.model_info,
+                "model_date": served.model_date,
             }
         )
 
@@ -132,24 +181,26 @@ class ScoringApp:
         X, err = self._features_from(request)
         if err is not None:
             return err
+        served = self._served  # one read: stable across a hot swap
         if X.ndim == 0:
             X = X[None]
-        predictions = self.predictor.predict(X)
+        predictions = served.predictor.predict(X)
         return _json_response(
             {
                 "predictions": [float(p) for p in predictions],
                 "n": int(len(predictions)),
-                "model_info": self.model_info,
-                "model_date": self.model_date,
+                "model_info": served.model_info,
+                "model_date": served.model_date,
             }
         )
 
     def healthz(self, request: Request) -> Response:
+        served = self._served  # one read: stable across a hot swap
         return _json_response(
             {
                 "status": "ok",
-                "model_info": self.model_info,
-                "model_date": self.model_date,
+                "model_info": served.model_info,
+                "model_date": served.model_date,
             }
         )
 

@@ -1,0 +1,118 @@
+"""Model hot-reload for the scoring service (beyond-parity; SURVEY §3.2).
+
+The reference loads its model once at boot (``stage_2_serve_model.py:57-65,
+113``): serving a new day's model requires the orchestrator to re-deploy
+the whole service. Here a :class:`CheckpointWatcher` polls the store's
+``models/`` prefix for a newer artefact — latest date key plus the
+backend's version token, so an in-place overwrite of the same key is also
+seen — loads and warms the replacement OFF the request path, then swaps it
+into the running :class:`~bodywork_tpu.serve.app.ScoringApp` atomically.
+A k8s serve Deployment therefore lives across days instead of being
+re-rolled per retrain.
+"""
+from __future__ import annotations
+
+import threading
+
+from bodywork_tpu.models.checkpoint import load_model
+from bodywork_tpu.store.base import ArtefactNotFound, ArtefactStore
+from bodywork_tpu.store.schema import MODELS_PREFIX
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("serve.reload")
+
+
+class CheckpointWatcher:
+    """Polls ``store`` for a newer model checkpoint and hot-swaps it into
+    ``app``. Load + predictor build + bucket warmup all happen on the
+    watcher thread; the request path only ever sees the finished swap.
+    """
+
+    def __init__(
+        self,
+        app,
+        store: ArtefactStore,
+        poll_interval_s: float = 30.0,
+        mesh_data: int | None = None,
+        engine: str = "xla",
+        served_key: str | None = None,
+    ):
+        # one watcher drives every replica app: replicas share read-only
+        # model state by design, so one load+warm serves them all
+        self.apps = list(app) if isinstance(app, (list, tuple)) else [app]
+        self.store = store
+        self.poll_interval_s = poll_interval_s
+        self.mesh_data = mesh_data
+        self.engine = engine
+        # what the app serves now: (key, version token). ``served_key``
+        # should be the key the caller actually LOADED — snapshotting
+        # latest() here instead would mark a checkpoint published during
+        # the caller's (slow, compile-heavy) warmup as already served and
+        # skip it until the next one lands.
+        self._current: tuple | None = None
+        if served_key is None:
+            try:
+                served_key, _ = store.latest(MODELS_PREFIX)
+            except ArtefactNotFound:
+                served_key = None
+        if served_key is not None:
+            self._current = (served_key, store.version_token(served_key))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="checkpoint-watcher", daemon=True
+        )
+
+    def check_once(self) -> bool:
+        """One poll: swap if the store has a different latest checkpoint.
+        Returns whether a swap happened. Load/warm errors are logged and
+        swallowed — the service keeps answering with the current model and
+        retries on the next poll (a half-written checkpoint must never
+        take the service down)."""
+        try:
+            key, model_date = self.store.latest(MODELS_PREFIX)
+        except ArtefactNotFound:
+            return False
+        candidate = (key, self.store.version_token(key))
+        if candidate == self._current:
+            return False
+        try:
+            model, model_date = load_model(self.store, key)
+            from bodywork_tpu.serve.server import build_predictor
+
+            predictor = build_predictor(model, self.mesh_data, self.engine)
+            if predictor is None:
+                from bodywork_tpu.serve.predictor import PaddedPredictor
+
+                predictor = PaddedPredictor(
+                    model, self.apps[0].predictor.buckets
+                )
+            # warm every bucket BEFORE the swap: the first request after
+            # reload must not pay the new model's compiles
+            predictor.warmup()
+        except Exception as exc:
+            log.error(f"hot reload of {key} failed (will retry): {exc!r}")
+            return False
+        for app in self.apps:
+            app.swap_model(model, model_date, predictor)
+        self._current = candidate
+        return True
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.check_once()
+            except Exception as exc:  # a poll error must not kill the loop
+                log.error(f"checkpoint watch poll failed: {exc!r}")
+
+    def start(self) -> "CheckpointWatcher":
+        self._thread.start()
+        log.info(
+            f"watching {MODELS_PREFIX} for new checkpoints every "
+            f"{self.poll_interval_s:.0f}s"
+        )
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.ident is not None:
+            self._thread.join(timeout=10)

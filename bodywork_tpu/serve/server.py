@@ -60,6 +60,7 @@ class ServiceHandle:
         self._server = make_server(host, port, app, threaded=True)
         self.host = host
         self.port = self._server.server_port
+        self._cleanups: list = []
         # poll_interval bounds how long shutdown() blocks (socketserver's
         # serve_forever only notices the shutdown flag between polls)
         self._thread = threading.Thread(
@@ -67,6 +68,10 @@ class ServiceHandle:
             name="scoring-service",
             daemon=True,
         )
+
+    def add_cleanup(self, fn) -> None:
+        """Run ``fn`` on :meth:`stop` (e.g. a checkpoint watcher's stop)."""
+        self._cleanups.append(fn)
 
     @property
     def url(self) -> str:
@@ -89,6 +94,8 @@ class ServiceHandle:
         self._thread.join()
 
     def stop(self) -> None:
+        for fn in self._cleanups:
+            fn()
         self._server.shutdown()
         # in serve_forever mode the background thread was never started
         if self._thread.ident is not None:
@@ -102,23 +109,53 @@ class ServiceHandle:
         self.stop()
 
 
-def serve_latest_model(
-    store: ArtefactStore,
-    host: str = "0.0.0.0",
-    port: int = 5000,
-    block: bool = True,
-    mesh_data: int | None = None,
-    engine: str = "xla",
-):
-    """Load latest model -> HBM, warm up, serve (reference ``stage_2`` main).
+#: minimum hidden width at which ``engine="auto"`` picks the Pallas kernel.
+#: Measured regime split (BENCH_DEV_r03 config 4 vs 6): at width 64 the
+#: XLA apply beat the kernel (2.47 vs 2.77 ms/1k-row batch) — sub-lane
+#: widths pad to 128 and the kernel's fixed overhead dominates; at width
+#: 1024 the kernel's VMEM-resident weights win. The crossover sits between;
+#: 256 (two lane-widths) is the conservative cut until a finer sweep moves it.
+PALLAS_AUTO_MIN_WIDTH = 256
 
-    ``mesh_data > 1`` serves through a data-parallel predictor sharding each
-    batch over a ``(mesh_data, 1)`` device mesh (BASELINE.json config 4).
-    ``engine="pallas"`` serves an MLP through the fused Pallas kernel
-    (``ops.mlp_kernel``; single-device, TPU only). With ``block=False``
-    returns a started :class:`ServiceHandle`.
-    """
-    model, model_date = load_model(store)
+
+def resolve_engine(
+    engine: str,
+    model,
+    mesh_data: int | None = None,
+    platform: str | None = None,
+) -> str:
+    """Resolve ``engine="auto"`` to the faster engine for the regime:
+    the fused Pallas kernel only ever wins for wide MLPs on a real TPU
+    (see :data:`PALLAS_AUTO_MIN_WIDTH`); everything else serves through
+    the XLA apply. Explicit engine choices pass through untouched."""
+    if engine != "auto":
+        return engine
+    from bodywork_tpu.models.mlp import MLPRegressor
+
+    if mesh_data and mesh_data > 1:
+        return "xla"  # the kernel is single-device
+    if not isinstance(model, MLPRegressor):
+        return "xla"
+    if platform is None:
+        import jax
+
+        platform = jax.devices()[0].platform
+    if platform != "tpu":
+        return "xla"  # off-TPU the kernel runs in the interpreter
+    widths = [
+        layer["w"].shape[1] for layer in model.params["net"]["layers"][:-1]
+    ]
+    if widths and min(widths) >= PALLAS_AUTO_MIN_WIDTH:
+        return "pallas"
+    return "xla"
+
+
+def build_predictor(model, mesh_data: int | None = None, engine: str = "xla"):
+    """The predictor for a (resolved) engine choice, or ``None`` for the
+    app's single-device bucketed default. Shared by boot-time serving and
+    the hot-reload watcher so a swapped-in model goes through exactly the
+    engine selection the booted one did."""
+    engine = resolve_engine(engine, model, mesh_data)
     predictor = None
     if engine == "pallas":
         import jax
@@ -155,8 +192,46 @@ def serve_latest_model(
             )
         mesh = make_mesh(data=mesh_data, devices=devices[:mesh_data])
         predictor = DataParallelPredictor(model, mesh)
+    return predictor
+
+
+def serve_latest_model(
+    store: ArtefactStore,
+    host: str = "0.0.0.0",
+    port: int = 5000,
+    block: bool = True,
+    mesh_data: int | None = None,
+    engine: str = "xla",
+    watch_interval_s: float | None = None,
+):
+    """Load latest model -> HBM, warm up, serve (reference ``stage_2`` main).
+
+    ``mesh_data > 1`` serves through a data-parallel predictor sharding each
+    batch over a ``(mesh_data, 1)`` device mesh (BASELINE.json config 4).
+    ``engine="pallas"`` serves an MLP through the fused Pallas kernel
+    (``ops.mlp_kernel``; single-device, TPU only); ``engine="auto"`` picks
+    the engine by regime (:func:`resolve_engine`). ``watch_interval_s``
+    starts a checkpoint watcher that hot-swaps newer models from the store
+    without a restart (``serve.reload``; the reference re-deploys the
+    service for every new day's model — ``stage_2:113``). With
+    ``block=False`` returns a started :class:`ServiceHandle`.
+    """
+    from bodywork_tpu.store.schema import MODELS_PREFIX
+
+    served_key, _ = store.latest(MODELS_PREFIX)
+    model, model_date = load_model(store, served_key)
+    predictor = build_predictor(model, mesh_data, engine)
     app = create_app(model, model_date, predictor=predictor)
     handle = ServiceHandle(app, host, port)
+    if watch_interval_s:
+        from bodywork_tpu.serve.reload import CheckpointWatcher
+
+        watcher = CheckpointWatcher(
+            app, store, poll_interval_s=watch_interval_s,
+            mesh_data=mesh_data, engine=engine, served_key=served_key,
+        )
+        watcher.start()
+        handle.add_cleanup(watcher.stop)
     if block:
         handle.serve_forever()
         return None

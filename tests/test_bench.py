@@ -37,6 +37,8 @@ def test_time_device_batch_linear(store):
     assert rec["iters"] == 3
     assert rec["device_sync_s"] > 0
     assert rec["device_pipelined_s"] > 0
+    assert rec["device_pipelined_median_s"] >= rec["device_pipelined_s"]
+    assert rec["device_pipelined_spread_s"] >= 0
     # pipelined dispatch can never be slower than per-call blocking by more
     # than noise; allow generous slack for CI jitter
     assert rec["device_pipelined_s"] <= rec["device_sync_s"] * 5
