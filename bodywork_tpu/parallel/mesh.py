@@ -66,20 +66,42 @@ def multihost_init() -> bool:
     """Join a multi-host JAX cluster if the standard coordinator env vars are
     present (GKE TPU pod slices set these); no-op on a single host.
 
-    After this, ``jax.devices()`` spans all hosts and meshes built on it
-    compile collectives over ICI within a slice and DCN across slices.
+    When the process topology is also in the env — ``NUM_PROCESSES`` plus a
+    process id (``PROCESS_ID``, or the ``JOB_COMPLETION_INDEX`` Kubernetes
+    injects into every Indexed-Job pod, which is exactly what the emitted
+    multi-host manifests are) — it is passed explicitly, so generic
+    clusters work too, not only environments JAX's cluster auto-detection
+    recognises. After this, ``jax.devices()`` spans all hosts and meshes
+    built on it compile collectives over ICI within a slice and DCN across
+    slices.
     """
-    if os.environ.get("COORDINATOR_ADDRESS") or os.environ.get(
+    addr = os.environ.get("COORDINATOR_ADDRESS") or os.environ.get(
         "JAX_COORDINATOR_ADDRESS"
-    ):
-        # idempotent: the daily retrain loop calls this every day, but
-        # jax.distributed.initialize raises RuntimeError on a second call
-        if jax.distributed.is_initialized():
-            return True
-        jax.distributed.initialize()
-        log.info(
-            f"joined distributed cluster: process {jax.process_index()} / "
-            f"{jax.process_count()}, {jax.device_count()} global devices"
-        )
+    )
+    if not addr:
+        return False
+    # idempotent: the daily retrain loop calls this every day, but
+    # jax.distributed.initialize raises RuntimeError on a second call
+    if jax.distributed.is_initialized():
         return True
-    return False
+    n_proc = os.environ.get("NUM_PROCESSES") or os.environ.get(
+        "JAX_NUM_PROCESSES"
+    )
+    proc_id = (
+        os.environ.get("PROCESS_ID")
+        or os.environ.get("JAX_PROCESS_ID")
+        or os.environ.get("JOB_COMPLETION_INDEX")
+    )
+    if n_proc is not None and proc_id is not None:
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=int(n_proc),
+            process_id=int(proc_id),
+        )
+    else:
+        jax.distributed.initialize()  # cluster auto-detection (GKE TPU)
+    log.info(
+        f"joined distributed cluster: process {jax.process_index()} / "
+        f"{jax.process_count()}, {jax.device_count()} global devices"
+    )
+    return True

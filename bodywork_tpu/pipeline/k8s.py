@@ -395,11 +395,21 @@ def generate_manifests(
                     pod = job_spec["template"]["spec"]
                     pod["subdomain"] = job_name
                     container = pod["containers"][0]
-                    container.setdefault("env", []).append(
-                        {
-                            "name": "JAX_COORDINATOR_ADDRESS",
-                            "value": f"{job_name}-0.{job_name}:8476",
-                        }
+                    container.setdefault("env", []).extend(
+                        [
+                            {
+                                "name": "JAX_COORDINATOR_ADDRESS",
+                                "value": f"{job_name}-0.{job_name}:8476",
+                            },
+                            # with JOB_COMPLETION_INDEX (k8s-injected per
+                            # Indexed pod) this gives multihost_init the
+                            # full explicit topology — no reliance on
+                            # JAX's cluster auto-detection
+                            {
+                                "name": "NUM_PROCESSES",
+                                "value": str(n_hosts),
+                            },
+                        ]
                     )
                     docs[f"{i:02d}-{stage.name}-workers-headless.yaml"] = {
                         "apiVersion": "v1",

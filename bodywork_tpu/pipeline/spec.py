@@ -170,8 +170,22 @@ def _stage_to_doc(stage: StageSpec) -> dict:
     return doc
 
 
+#: secrets the framework itself declares optional-by-design; YAML written
+#: before the required/optional split listed them under plain ``secrets``,
+#: and materialising those as required refs would CreateContainerConfigError
+#: every pod on clusters that never created them
+_KNOWN_OPTIONAL_SECRETS = ("sentry-integration",)
+
+
 def _stage_from_doc(name: str, doc: dict) -> StageSpec:
     resources = ResourceSpec(**doc.get("resources", {}))
+    secrets = list(doc.get("secrets", []))
+    optional_secrets = list(doc.get("optional_secrets", []))
+    for known in _KNOWN_OPTIONAL_SECRETS:
+        if known in secrets:  # legacy-doc migration
+            secrets.remove(known)
+            if known not in optional_secrets:
+                optional_secrets.append(known)
     return StageSpec(
         name=name,
         kind=doc["kind"],
@@ -184,8 +198,8 @@ def _stage_from_doc(name: str, doc: dict) -> StageSpec:
         port=doc.get("port"),
         ingress=doc.get("ingress", False),
         env=doc.get("env", {}),
-        secrets=doc.get("secrets", []),
-        optional_secrets=doc.get("optional_secrets", []),
+        secrets=secrets,
+        optional_secrets=optional_secrets,
         image=doc.get("image"),
         resources=resources,
     )

@@ -1,0 +1,65 @@
+"""Worker process for the 2-process jax.distributed CPU-cluster test
+(underscore-prefixed: a helper pytest must not collect).
+
+Each worker joins the cluster through the SAME entrypoint the emitted
+Indexed-Job pods use (``parallel.multihost_init`` keyed on the coordinator
++ topology env), builds a mesh spanning both processes' devices, runs the
+production sharded training path, and writes the fully-replicated
+predictions (and cluster facts) to its output file for the test to
+compare across processes and against a single-process run.
+
+Usage: python _multihost_worker.py <out_file>
+(env supplies COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID, CPU
+platform, and the per-process virtual device count.)
+"""
+import json
+import sys
+
+
+def main() -> int:
+    out_file = sys.argv[1]
+
+    import numpy as np
+
+    from bodywork_tpu.parallel import make_mesh, multihost_init, train_mlp_sharded
+
+    assert multihost_init(), "coordinator env not detected"
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from bodywork_tpu.models.mlp import MLPConfig
+
+    facts = {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "global_devices": jax.device_count(),
+        "local_devices": jax.local_device_count(),
+    }
+
+    # deterministic dataset, identical in every process
+    rng = np.random.default_rng(5)
+    n = 1024
+    X = rng.uniform(0, 100, n).astype(np.float32)
+    y = (1.0 + 0.5 * X + rng.normal(0, 1, n)).astype(np.float32)
+    cfg = MLPConfig(hidden=(16, 16), n_steps=120, batch_size=128,
+                    learning_rate=1e-2)
+
+    mesh = make_mesh(data=jax.device_count() // 2, model=2)
+    model = train_mlp_sharded(X, y, cfg, mesh, seed=7)
+
+    # fully-replicated prediction fetch: addressable in every process
+    Xq = np.linspace(0.0, 100.0, 32, dtype=np.float32)[:, None]
+    apply = jax.jit(
+        type(model).apply, out_shardings=NamedSharding(mesh, P())
+    )
+    preds = np.asarray(apply(model.params, Xq))
+
+    facts["predictions"] = [float(p) for p in preds]
+    with open(out_file, "w") as f:
+        json.dump(facts, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

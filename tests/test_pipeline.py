@@ -499,6 +499,21 @@ def test_required_secrets_not_marked_optional():
     ]
 
 
+def test_legacy_yaml_sentry_secret_migrates_to_optional():
+    """Spec YAML written before the required/optional split listed the
+    framework's own optional-by-design secret under plain `secrets`; it
+    must migrate, not start failing pods at admission."""
+    legacy = default_pipeline().to_yaml().replace(
+        "optional_secrets:\n    - sentry-integration",
+        "secrets:\n    - sentry-integration",
+    )
+    assert "optional_secrets" not in legacy  # the doc really is old-style
+    clone = PipelineSpec.from_yaml(legacy)
+    for stage in clone.stages.values():
+        assert stage.secrets == []
+        assert stage.optional_secrets == ["sentry-integration"]
+
+
 def test_explicit_schedule_with_multihost_raises():
     """ADVICE r3: an explicitly requested daily schedule that cannot be
     materialised must raise, not vanish with a log line; the implicit
