@@ -159,23 +159,53 @@ def _time_requests(url: str, payload: dict, rows: int, requests: int) -> float:
     return (time.perf_counter() - t0) / requests
 
 
-def time_device_batch(dispatch, X, iters: int = 30, repeats: int = 3) -> dict:
+def measure_sync_overhead(repeats: int = 5) -> float:
+    """The fixed cost of one ``fence`` on an already-computed array: tiny
+    derived-scalar dispatch + one host<->device round-trip + 4-byte fetch.
+    Median over ``repeats``. Timed loops that end in one fence subtract
+    this so the reported per-iteration time is device execution, not
+    transport."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+
+    from bodywork_tpu.utils.sync import fence
+
+    ready = fence(jnp.arange(8, dtype=jnp.float32) + 1.0)
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fence(ready)
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times)
+
+
+def time_device_batch(dispatch, X, iters: int = 30, repeats: int = 3,
+                      sync_overhead_s: float | None = None) -> dict:
     """Device-side (HTTP-free) latency of one batch through ``dispatch``.
 
     The input is ``device_put`` once so no per-call host->device transfer is
-    timed. Two numbers, because on a tunnel-attached TPU they differ by the
-    tunnel round-trip:
+    timed. Every synchronisation is a ``fence`` (derived-scalar
+    ``device_get``), NOT ``block_until_ready`` — over the axon relay the
+    latter can return before execution finishes (see
+    ``bodywork_tpu.utils.sync``), which made round-4's first capture report
+    engine times that were pure dispatch overhead. Two numbers, because on
+    a tunnel-attached TPU they differ by the tunnel round-trip:
 
-    - ``pipelined_s`` — N dispatches then ONE block, divided by N: the
+    - ``pipelined_s`` — N dispatches then ONE fence, divided by N: the
       round-trip amortises away, leaving per-batch device execution +
       dispatch cost. This is the number that isolates the serving engine
-      (XLA vs Pallas) from the transport.
-    - ``sync_s`` — mean of per-dispatch ``block_until_ready``: what one
-      isolated request would wait for the device, including one full
-      host<->device round-trip per call (RTT-floor-bound over a tunnel).
+      (XLA vs Pallas) from the transport. The fence's own fixed cost
+      (measured by ``measure_sync_overhead``) is subtracted from each
+      pass before dividing; the raw passes are recorded alongside.
+    - ``sync_s`` — mean of per-dispatch fences: what one isolated request
+      would wait for the device, including one full host<->device
+      round-trip per call (RTT-floor-bound over a tunnel). Not corrected —
+      the round-trip is part of what it measures.
 
     Protocol: the pipelined measurement is the MIN over ``repeats``
-    passes (each: N dispatches, one block), run BEFORE the sync pass.
+    passes (each: N dispatches, one fence), run BEFORE the sync pass.
     Repeated passes through the tunnel are visibly bimodal — the same
     Pallas executable measured 4.0 ms on one pass and 1.9 ms on a later
     pass in the same process while XLA sat at ~3.5 ms throughout — so a
@@ -187,20 +217,25 @@ def time_device_batch(dispatch, X, iters: int = 30, repeats: int = 3) -> dict:
 
     import jax
 
+    from bodywork_tpu.utils.sync import fence
+
+    if sync_overhead_s is None:
+        sync_overhead_s = measure_sync_overhead()
     Xd = jax.device_put(jnp_float32(X))
-    jax.block_until_ready(dispatch(Xd))  # compile + warm
-    passes = []
+    fence(dispatch(Xd))  # compile + warm
+    raw_totals = []
     for _ in range(repeats):
         t0 = time.perf_counter()
         out = None
         for _ in range(iters):
             out = dispatch(Xd)
-        jax.block_until_ready(out)
-        passes.append((time.perf_counter() - t0) / iters)
+        fence(out)
+        raw_totals.append(time.perf_counter() - t0)
     t0 = time.perf_counter()
     for _ in range(iters):
-        jax.block_until_ready(dispatch(Xd))
+        fence(dispatch(Xd))
     sync_s = (time.perf_counter() - t0) / iters
+    passes = [max(t - sync_overhead_s, 0.0) / iters for t in raw_totals]
     return {
         "device_sync_s": round(sync_s, 6),
         "device_pipelined_s": round(min(passes), 6),
@@ -210,6 +245,10 @@ def time_device_batch(dispatch, X, iters: int = 30, repeats: int = 3) -> dict:
         "device_pipelined_median_s": round(statistics.median(passes), 6),
         "device_pipelined_spread_s": round(max(passes) - min(passes), 6),
         "device_pipelined_passes": [round(p, 6) for p in passes],
+        "device_pipelined_raw_pass_totals": [round(t, 6) for t in raw_totals],
+        "sync_overhead_s": round(sync_overhead_s, 6),
+        "sync_method": "fence (derived-scalar device_get); "
+                       "block_until_ready is unreliable over the relay",
         "iters": iters,
     }
 
@@ -407,46 +446,68 @@ def bench_wide(
     )
     from bodywork_tpu.ops import make_pallas_mlp_apply
 
+    from bodywork_tpu.utils.sync import fence
+
     on_tpu = jax.devices()[0].platform == "tpu"
     peak = PEAK_FLOPS_V5E if on_tpu else None
     X, y = _wide_data()
     flops_per_step = wide_train_flops_per_step()
     sizes = (WIDE_FEATURES, *WIDE_HIDDEN, 1)
+    sync_overhead_s = measure_sync_overhead()
 
     # device-resident standardised dataset, shared by every timed path
     ones = jnp.ones(X.shape[0], jnp.float32)
     Xs, ys, _scaler = _scaled_splits(jnp.asarray(X), jnp.asarray(y), ones)
-    jax.block_until_ready((Xs, ys))
+    fence((Xs, ys))
 
     def _throughput_record(per_step_s: float, n_chips: int,
                            compute_dtype: str | None,
                            group_times: list, timed_steps: int) -> dict:
         """seconds/step + model FLOP/s + MFU estimate — ONE definition for
-        the single-device and sharded records so they can't diverge."""
-        flops_s = flops_per_step / per_step_s
+        the single-device and sharded records so they can't diverge. A
+        physically impossible number (non-positive interval, or MFU above
+        peak — exactly what the broken ``block_until_ready`` produced) is
+        flagged as ``timing_anomaly`` instead of being published as a
+        result."""
         rec = {
             "seconds_per_step": round(per_step_s, 6),
-            "model_tflops_s": round(flops_s / 1e12, 2),
             "steps": timed_steps,
             "batch": WIDE_BATCH,
             "compute_dtype": compute_dtype or "float32(default-precision)",
             "group_seconds": [round(t, 4) for t in group_times],
         }
+        if per_step_s <= 0:
+            rec["timing_anomaly"] = (
+                "non-positive timed interval — the sync did not actually "
+                "wait for the device; throughput not computed"
+            )
+            return rec
+        flops_s = flops_per_step / per_step_s
+        rec["model_tflops_s"] = round(flops_s / 1e12, 2)
         if peak:
-            rec["mfu_pct_est"] = round(100.0 * flops_s / (peak * n_chips), 2)
+            mfu = 100.0 * flops_s / (peak * n_chips)
+            rec["mfu_pct_est"] = round(mfu, 2)
+            if mfu > 100.0:
+                rec["timing_anomaly"] = (
+                    "MFU above hardware peak — timed interval too short "
+                    "to be a real execution; treat as invalid"
+                )
         return rec
 
     def _time_groups(dispatch_once) -> tuple[float, list]:
-        """min-over-groups of back-to-back dispatches, one block/group."""
+        """min-over-groups of back-to-back dispatches, one fence/group;
+        the fence's fixed transport cost is subtracted from each group
+        before dividing by the runs it contains."""
         group_times = []
         for _ in range(mfu_groups):
             t0 = time.perf_counter()
             out = None
             for _ in range(mfu_runs_per_group):
                 out = dispatch_once()
-            jax.block_until_ready(out)
+            fence(out)
+            elapsed = time.perf_counter() - t0
             group_times.append(
-                (time.perf_counter() - t0) / mfu_runs_per_group
+                max(elapsed - sync_overhead_s, 0.0) / mfu_runs_per_group
             )
         return min(group_times), group_times
 
@@ -460,7 +521,7 @@ def bench_wide(
         net0 = jax.jit(init_mlp_params, static_argnums=(1,))(key, sizes)
         # compile + warm
         out = train_nodonate(net0, Xs, ys, ones, key, cfg_t)
-        jax.block_until_ready(out[1])
+        fence(out[1])
         best, groups = _time_groups(
             lambda: train_nodonate(net0, Xs, ys, ones, key, cfg_t)[1]
         )
@@ -479,8 +540,11 @@ def bench_wide(
                              "elementwise/optimizer FLOPs ignored",
             "timing": f"min over {mfu_groups} groups of "
                       f"{mfu_runs_per_group} back-to-back dispatches of the "
-                      f"{mfu_steps}-step jitted scan, one block per group; "
-                      "dataset device-resident; tunnel RTT amortised",
+                      f"{mfu_steps}-step jitted scan, one fence per group "
+                      "(derived-scalar device_get; block_until_ready is "
+                      "unreliable over the relay), fence overhead "
+                      "subtracted; dataset device-resident",
+            "sync_overhead_s": round(sync_overhead_s, 6),
         },
     }
 
@@ -494,10 +558,12 @@ def bench_wide(
     cfg_fit = MLPConfig(hidden=WIDE_HIDDEN, batch_size=WIDE_BATCH,
                         n_steps=steps, learning_rate=1e-3,
                         compute_dtype="bfloat16")
-    MLPRegressor(cfg_fit).fit(X, y)  # compile
+    # compile-warm the fit AND the fence's own per-leaf getitem programs,
+    # so neither trace lands inside the timed window below
+    fence(MLPRegressor(cfg_fit).fit(X, y).params)
     t0 = time.perf_counter()
     model = MLPRegressor(cfg_fit).fit(X, y)
-    jax.block_until_ready(model.params)
+    fence(model.params)
     record["train_fit_e2e"] = {
         "seconds_per_step": round((time.perf_counter() - t0) / steps, 6),
         "steps": steps,
@@ -539,7 +605,7 @@ def bench_wide(
             t_stage = time.perf_counter()
             Xd = jax.device_put(np.asarray(Xs), replicated)
             yd = jax.device_put(np.asarray(ys), replicated)
-            jax.block_until_ready((Xd, yd))
+            fence((Xd, yd))
             staging_s = time.perf_counter() - t_stage
             run = _sharded_train_fn(mesh, cfg_t)
             key = jax.random.PRNGKey(0)
@@ -552,7 +618,7 @@ def bench_wide(
                 opt_state = opt_init_j(net)
                 return run(net, opt_state, Xd, yd, key)[2]
 
-            jax.block_until_ready(_one_sharded_run())  # compile + warm
+            fence(_one_sharded_run())  # compile + warm
             best, groups = _time_groups(_one_sharded_run)
             sharded_rec = _throughput_record(
                 best / mfu_steps, len(devices), "bfloat16", groups, mfu_steps
@@ -579,11 +645,13 @@ def bench_wide(
     record["serve_xla"] = time_device_batch(
         partial(xla_apply, model.params), Xb,
         iters=serve_iters, repeats=serve_repeats,
+        sync_overhead_s=sync_overhead_s,
     )
     if on_tpu:
         record["serve_pallas"] = time_device_batch(
             make_pallas_mlp_apply(model.params), Xb,
             iters=serve_iters, repeats=serve_repeats,
+            sync_overhead_s=sync_overhead_s,
         )
     else:
         record["serve_pallas"] = {
@@ -596,8 +664,16 @@ def bench_wide(
         for v in (record["serve_xla"], record.get("serve_pallas", {}))
         if "device_pipelined_s" in v
     )
-    record["serve_rows_per_s"] = round(WIDE_BATCH / best, 1)
-    record["value"] = record["train_xla_single"]["seconds_per_step"]
+    record["serve_rows_per_s"] = (
+        round(WIDE_BATCH / best, 1) if best > 0 else None
+    )
+    # a flagged sub-record must not leak its impossible number into the
+    # headline value the driver summarises
+    if "timing_anomaly" in record["train_xla_single"]:
+        record["value"] = None
+        record["timing_anomaly"] = record["train_xla_single"]["timing_anomaly"]
+    else:
+        record["value"] = record["train_xla_single"]["seconds_per_step"]
     record["unit"] = "s/step"
     record["vs_baseline"] = None
     record["baseline_note"] = (
@@ -732,7 +808,9 @@ def probe_backend(timeout_s: float) -> bool:
 # ---------------------------------------------------------------------------
 
 #: bump when record shapes change — stale .bench_state entries never match
-SCHEMA_VERSION = 4
+#: (v5: fence-based sync; v4 records timed block_until_ready, which does
+#: not block over the relay and produced impossible numbers)
+SCHEMA_VERSION = 5
 #: reuse window for staged records; beyond this a capture is re-measured
 RESUME_MAX_AGE_S = 6 * 3600
 #: per-config child timeouts, sized at ~4x the round-3 TPU capture plus

@@ -32,6 +32,7 @@ from bodywork_tpu.models.mlp import (
     init_mlp_params,
 )
 from bodywork_tpu.utils.logging import get_logger
+from bodywork_tpu.utils.sync import fence
 
 log = get_logger("parallel.train_step")
 
@@ -140,14 +141,14 @@ def train_mlp_sharded(
     replicated = NamedSharding(mesh, P())
     Xd = jax.device_put(Xs.astype(np.float32), replicated)
     yd = jax.device_put(ys.astype(np.float32), replicated)
-    jax.block_until_ready((Xd, yd))
+    fence((Xd, yd))
     t_staged = _time.perf_counter()
 
     net, opt_state, losses = _sharded_train_fn(mesh, cfg)(
         net, opt_state, Xd, yd, k_batch
     )
     if timings is not None:
-        jax.block_until_ready(losses)
+        fence(losses)
         timings["staging_s"] = t_staged - t_start
         timings["scan_s"] = _time.perf_counter() - t_staged
     log.info(

@@ -60,11 +60,12 @@ class PaddedPredictor:
         The feature dimension defaults to the fitted model's own, so the
         shapes compiled here are exactly the request-path shapes. All
         buckets are dispatched first (XLA compiles synchronously at
-        dispatch; execution drains asynchronously), then with ``sync`` one
-        ``block_until_ready`` surfaces any device-side execution error
+        dispatch; execution drains asynchronously), then with ``sync`` a
+        ``fence`` (``utils.sync``) surfaces any device-side execution error
         (e.g. HBM OOM on the largest bucket) HERE — before the health gate
-        reports ready — at the cost of a single device sync, with no
-        device->host data transfer. ``sync=False`` is for callers that
+        reports ready — at the cost of one tiny fetch per bucket
+        (``block_until_ready`` would be transfer-free but does not actually
+        wait over the axon relay). ``sync=False`` is for callers that
         already executed these exact shapes in this process (the local
         day-loop re-serving each day).
         """
@@ -95,7 +96,9 @@ class PaddedPredictor:
                 _WARMED_SHAPES.add(key)
                 added.append(key)
             if sync and results:
-                jax.block_until_ready(results)
+                from bodywork_tpu.utils.sync import fence
+
+                fence(results)
         except BaseException:
             # a failed warm must be retryable, not silently skipped forever
             _WARMED_SHAPES.difference_update(added)

@@ -6,6 +6,7 @@ from bodywork_tpu.utils.dates import (
     parse_date,
 )
 from bodywork_tpu.utils.errors import init_error_monitoring, StageError
+from bodywork_tpu.utils.sync import fence
 from bodywork_tpu.utils.watchdog import (
     abort_if_backend_hangs,
     backend_timeout_from_env,
@@ -18,6 +19,7 @@ __all__ = [
     "DATE_PATTERN",
     "date_from_key",
     "day_of_year",
+    "fence",
     "parse_date",
     "init_error_monitoring",
     "StageError",

@@ -1,0 +1,39 @@
+"""Reliable device fencing for timing and error-surfacing.
+
+``jax.block_until_ready`` is the canonical way to wait for async dispatch,
+and on CPU and directly-attached TPU it works. Over the tunnel-attached
+'axon' TPU relay (the dev/bench environment here) it is NOT reliable: it
+can return ~0.1 ms after dispatching a 200-step training scan whose real
+execution time is ~240 ms (observed on jax 0.9.0; the round-4 bench
+capture briefly reported a physically impossible 163057% MFU because of
+it). Fetching a result-derived scalar IS reliable — the transfer cannot
+complete until the producing computation has.
+
+``fence`` therefore synchronises by ``jax.device_get`` of one scalar per
+array leaf (4 bytes + one round-trip each). Because a TPU device executes
+programs in dispatch order, fencing an output also fences everything
+queued before it on that device, so fencing a *list* of results from
+back-to-back dispatches costs one round-trip per leaf but is never wrong.
+"""
+from __future__ import annotations
+
+__all__ = ["fence"]
+
+
+def fence(out):
+    """Wait until every computation feeding ``out`` has finished on device.
+
+    Accepts any pytree of jax/numpy arrays (scalars and non-array leaves
+    are ignored). Returns ``out`` so it can wrap an expression in place:
+    ``losses = fence(fn(...))``. Device-side execution errors surface here,
+    like ``block_until_ready`` promises (and, over the relay, actually
+    delivers only through a fetch).
+    """
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(out):
+        size = getattr(leaf, "size", None)
+        if not size:  # non-arrays and empty arrays have nothing to fence
+            continue
+        jax.device_get(leaf.ravel()[0])
+    return out

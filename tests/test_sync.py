@@ -1,0 +1,66 @@
+"""The reliable device fence (``utils.sync.fence``).
+
+``jax.block_until_ready`` does not actually wait over the tunnel-attached
+TPU relay (a 240 ms training scan "blocked" in 0.1 ms in the round-4
+capture), so every timing/error-surfacing sync in the package goes through
+``fence`` — a derived-scalar ``device_get`` per leaf, which cannot return
+before the producing computation completes. These tests pin its contract
+on the CPU backend (where both mechanisms work, so we test semantics, not
+the relay's bug).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bodywork_tpu.utils.sync import fence
+
+
+def test_fence_returns_input_identity():
+    x = jnp.arange(4.0)
+    assert fence(x) is x
+
+
+def test_fence_pytree_and_non_array_leaves():
+    tree = {
+        "a": jnp.ones((2, 3)),
+        "b": [np.arange(3), "not-an-array", 7],
+        "c": {"empty": jnp.zeros((0,)), "scalar": jnp.float32(1.5)},
+    }
+    assert fence(tree) is tree  # no leaf kind may break it
+
+
+def test_fence_forces_computation_result_visible():
+    # after fence, the value is definitely computed: fetching it again is
+    # pure transfer and must agree with the analytic result
+    x = jnp.full((16,), 2.0)
+    y = fence(x * 3.0)
+    np.testing.assert_allclose(np.asarray(y), 6.0)
+
+
+def test_fence_fetches_every_array_leaf(monkeypatch):
+    # the error-surfacing contract IS the fetch: a device-side failure can
+    # only surface through device_get, so fence must fetch once per array
+    # leaf (a refactor that drops the fetch, or fences only the first
+    # leaf, silently reverts to block_until_ready semantics — which do
+    # not wait over the relay)
+    fetched = []
+    real_get = jax.device_get
+
+    def counting_get(x):
+        fetched.append(x)
+        return real_get(x)
+
+    monkeypatch.setattr(jax, "device_get", counting_get)
+    tree = {"a": jnp.ones((2, 3)), "b": [np.arange(3), "skip", 7],
+            "empty": jnp.zeros((0,))}
+    fence(tree)
+    # two fetchable array leaves: "a" and the numpy arange; strings,
+    # ints and empty arrays are not fetched
+    assert len(fetched) == 2
+    assert all(np.asarray(f).size == 1 for f in fetched)  # scalars only
+
+
+def test_fence_list_of_results_fences_each():
+    outs = [jnp.arange(3.0) + i for i in range(4)]
+    assert fence(outs) is outs
