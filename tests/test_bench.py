@@ -150,6 +150,12 @@ def test_staged_record_reuse_rules(tmp_path):
     assert bench.load_staged_record(tmp_path, 5, "fp") is None
     bench.save_staged_record(tmp_path, 6, "fp", {**rec, "error": "boom"})
     assert bench.load_staged_record(tmp_path, 6, "fp") is None
+    # an anomalous capture (impossible timing) must re-measure, not pin
+    # an invalid record for the whole resume window
+    bench.save_staged_record(
+        tmp_path, 6, "fp", {**rec, "timing_anomaly": "MFU above peak"}
+    )
+    assert bench.load_staged_record(tmp_path, 6, "fp") is None
 
     # stale: created beyond the reuse window
     import json as _json
