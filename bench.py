@@ -413,6 +413,16 @@ def _wide_data(n_rows: int = 2 * WIDE_BATCH):
     return X, y
 
 
+#: the MXU saturation sweep around the flagship (8192, 1024x3) point:
+#: batch scaling at fixed width, width scaling at fixed batch
+MXU_SWEEP_POINTS = (
+    (2048, (1024, 1024, 1024)),
+    (32768, (1024, 1024, 1024)),
+    (8192, (512, 512, 512)),
+    (8192, (2048, 2048, 2048)),
+)
+
+
 def bench_wide(
     steps: int = WIDE_STEPS,
     serve_iters: int = 20,
@@ -421,6 +431,9 @@ def bench_wide(
     mfu_groups: int = 3,
     mfu_runs_per_group: int = 2,
     include_f32: bool = True,
+    sweep_points: tuple = MXU_SWEEP_POINTS,
+    sweep_steps: int = 100,
+    force_sweep: bool = False,
 ) -> dict:
     """Config 6: the wide MLP through (a) single-device training throughput
     at an explicit bf16 mixed-precision policy (with an f32 comparison
@@ -466,17 +479,20 @@ def bench_wide(
 
     def _throughput_record(per_step_s: float, n_chips: int,
                            compute_dtype: str | None,
-                           group_times: list, timed_steps: int) -> dict:
+                           group_times: list, timed_steps: int,
+                           flops: float | None = None,
+                           batch: int = WIDE_BATCH) -> dict:
         """seconds/step + model FLOP/s + MFU estimate — ONE definition for
-        the single-device and sharded records so they can't diverge. A
-        physically impossible number (non-positive interval, or MFU above
-        peak — exactly what the broken ``block_until_ready`` produced) is
-        flagged as ``timing_anomaly`` instead of being published as a
-        result."""
+        the single-device, sharded, and sweep records so they can't
+        diverge. A physically impossible number (non-positive interval, or
+        MFU above peak — exactly what the broken ``block_until_ready``
+        produced) is flagged as ``timing_anomaly`` instead of being
+        published as a result."""
+        flops = flops_per_step if flops is None else flops
         rec = {
             "seconds_per_step": round(per_step_s, 6),
             "steps": timed_steps,
-            "batch": WIDE_BATCH,
+            "batch": batch,
             "compute_dtype": compute_dtype or "float32(default-precision)",
             "group_seconds": [round(t, 4) for t in group_times],
         }
@@ -486,7 +502,7 @@ def bench_wide(
                 "wait for the device; throughput not computed"
             )
             return rec
-        flops_s = flops_per_step / per_step_s
+        flops_s = flops / per_step_s
         if peak and 100.0 * flops_s / (peak * n_chips) > 100.0:
             # withhold the impossible values entirely — a reader scanning
             # model_tflops_s must never see a number the flag disowns
@@ -500,39 +516,50 @@ def bench_wide(
             rec["mfu_pct_est"] = round(100.0 * flops_s / (peak * n_chips), 2)
         return rec
 
-    def _time_groups(dispatch_once) -> tuple[float, list]:
+    def _time_groups(dispatch_once, groups: int | None = None,
+                     runs: int | None = None) -> tuple[float, list]:
         """min-over-groups of back-to-back dispatches, one fence/group;
         the fence's fixed transport cost is subtracted from each group
         before dividing by the runs it contains."""
+        groups = mfu_groups if groups is None else groups
+        runs = mfu_runs_per_group if runs is None else runs
         group_times = []
-        for _ in range(mfu_groups):
+        for _ in range(groups):
             t0 = time.perf_counter()
             out = None
-            for _ in range(mfu_runs_per_group):
+            for _ in range(runs):
                 out = dispatch_once()
             fence(out)
             elapsed = time.perf_counter() - t0
-            group_times.append(
-                max(elapsed - sync_overhead_s, 0.0) / mfu_runs_per_group
-            )
+            group_times.append(max(elapsed - sync_overhead_s, 0.0) / runs)
         return min(group_times), group_times
 
     train_nodonate = jax.jit(_train_core, static_argnames=("cfg",))
 
-    def _single_device_record(compute_dtype: str | None) -> dict:
-        cfg_t = MLPConfig(hidden=WIDE_HIDDEN, batch_size=WIDE_BATCH,
-                          n_steps=mfu_steps, learning_rate=1e-3,
+    def _single_device_record(compute_dtype: str | None,
+                              hidden: tuple = WIDE_HIDDEN,
+                              batch: int = WIDE_BATCH,
+                              steps: int | None = None,
+                              groups: int | None = None) -> dict:
+        steps = mfu_steps if steps is None else steps
+        cfg_t = MLPConfig(hidden=hidden, batch_size=batch,
+                          n_steps=steps, learning_rate=1e-3,
                           compute_dtype=compute_dtype)
+        pt_sizes = (WIDE_FEATURES, *hidden, 1)
         key = jax.random.PRNGKey(0)
-        net0 = jax.jit(init_mlp_params, static_argnums=(1,))(key, sizes)
+        net0 = jax.jit(init_mlp_params, static_argnums=(1,))(key, pt_sizes)
         # compile + warm
         out = train_nodonate(net0, Xs, ys, ones, key, cfg_t)
         fence(out[1])
-        best, groups = _time_groups(
-            lambda: train_nodonate(net0, Xs, ys, ones, key, cfg_t)[1]
+        best, groups_t = _time_groups(
+            lambda: train_nodonate(net0, Xs, ys, ones, key, cfg_t)[1],
+            groups=groups,
         )
-        return _throughput_record(best / mfu_steps, 1, compute_dtype,
-                                  groups, mfu_steps)
+        return _throughput_record(
+            best / steps, 1, compute_dtype, groups_t, steps,
+            flops=wide_train_flops_per_step(batch=batch, hidden=hidden),
+            batch=batch,
+        )
 
     record: dict = {
         "metric": "wide_mlp_1024x3",
@@ -557,6 +584,40 @@ def bench_wide(
     record["train_xla_single"] = _single_device_record("bfloat16")
     if include_f32:
         record["train_xla_single_f32"] = _single_device_record(None)
+
+    # the MXU saturation sweep (VERDICT r3 item 2's "batch & width sweep"):
+    # where does the flagship point sit on the batch and width scaling
+    # curves? TPU-only — on CPU these shapes measure the host BLAS
+    # (``force_sweep`` lets tests drive the loop with tiny points).
+    if (on_tpu or force_sweep) and sweep_points:
+        pts = []
+        for b, h in sweep_points:
+            try:
+                r = _single_device_record("bfloat16", hidden=tuple(h),
+                                          batch=b, steps=sweep_steps,
+                                          groups=1)
+            except Exception as exc:  # one OOM must not void the sweep
+                r = {"error": f"{type(exc).__name__}: {exc}"}
+            pts.append({"point": f"b{b}_h{h[0]}x{len(h)}", **r})
+        record["mxu_sweep"] = {
+            "points": pts,
+            "note": "single group of back-to-back runs per point — a "
+                    "scaling curve around the flagship, not a headline",
+        }
+        # an anomalous point means the sync misbehaved in THIS process —
+        # suspicion extends to every number here, so hoist the flag to the
+        # top level (where the resume filter looks) and re-measure the
+        # whole config next run rather than pinning a tainted capture
+        tainted = [p["point"] for p in pts if "timing_anomaly" in p]
+        if tainted:
+            record["timing_anomaly"] = (
+                f"sweep point(s) {tainted} timed impossibly — sync "
+                "unreliable in this capture"
+            )
+    else:
+        record["mxu_sweep"] = {
+            "skipped": "non-tpu backend" if not on_tpu else "disabled"
+        }
 
     # the round-3-style end-to-end fit (host staging + transfers + fetch
     # included) stays as a comparison record so the protocol change is
@@ -820,8 +881,12 @@ SCHEMA_VERSION = 5
 #: reuse window for staged records; beyond this a capture is re-measured
 RESUME_MAX_AGE_S = 6 * 3600
 #: per-config child timeouts, sized at ~4x the round-3 TPU capture plus
-#: fresh-process JAX init + compiles (each child is a cold process)
-CONFIG_TIMEOUT_S = {1: 300, 2: 300, 3: 600, 4: 600, 5: 450, 6: 600}
+#: fresh-process JAX init + compiles (each child is a cold process);
+#: config 6 carries the MXU sweep — 4 extra scan compiles at new static
+#: shapes, two of them ~4x the flagship FLOPs — on top of the budget the
+#: 600 s figure was sized for (the .bench_state compile cache amortises
+#: the compiles on any retry)
+CONFIG_TIMEOUT_S = {1: 300, 2: 300, 3: 600, 4: 600, 5: 450, 6: 1200}
 
 
 def tree_fingerprint(root: str | None = None) -> str:

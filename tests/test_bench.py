@@ -274,4 +274,44 @@ def test_bench_wide_record_shape():
     dev = record["serve_xla"]
     assert dev["device_pipelined_s"] == min(dev["device_pipelined_passes"])
     assert "skipped" in record["serve_pallas"]  # interpreter off-TPU
+    assert "skipped" in record["mxu_sweep"]  # TPU-only scaling curve
     assert record["serve_rows_per_s"] > 0
+
+
+def test_bench_wide_mxu_sweep_loop():
+    """The sweep loop itself (force-driven with tiny points on CPU): one
+    record per point, labeled, sharing the flagship's throughput-record
+    shape — so the TPU capture can't be the first time this code runs."""
+    record = bench.bench_wide(
+        steps=2, serve_iters=1, serve_repeats=1,
+        mfu_steps=2, mfu_groups=1, mfu_runs_per_group=1, include_f32=False,
+        sweep_points=((64, (8, 8)), (128, (8, 8))), sweep_steps=2,
+        force_sweep=True,
+    )
+    pts = record["mxu_sweep"]["points"]
+    assert [p["point"] for p in pts] == ["b64_h8x2", "b128_h8x2"]
+    for p in pts:
+        assert "error" not in p
+        assert p["seconds_per_step"] > 0
+        assert p["compute_dtype"] == "bfloat16"
+    # batch threads through to each point's record (not the flagship's)
+    assert pts[0]["batch"] == 64 and pts[1]["batch"] == 128
+
+
+def test_bench_wide_anomaly_hoists_and_blocks_resume(monkeypatch, tmp_path):
+    """If the sync misbehaves anywhere in a config-6 capture (flagship OR
+    a sweep point), the record carries a top-level timing_anomaly and the
+    resume filter refuses to pin it — the whole point of the fence work."""
+    # an absurd overhead clamps every timed group to zero -> anomalies
+    monkeypatch.setattr(bench, "measure_sync_overhead", lambda *a, **k: 1e6)
+    record = bench.bench_wide(
+        steps=2, serve_iters=1, serve_repeats=1,
+        mfu_steps=2, mfu_groups=1, mfu_runs_per_group=1, include_f32=False,
+        sweep_points=((64, (8, 8)),), sweep_steps=2, force_sweep=True,
+    )
+    assert "timing_anomaly" in record["train_xla_single"]
+    assert "timing_anomaly" in record  # hoisted
+    assert record["value"] is None  # impossible number never the headline
+    staged = {**record, "config": 6, "backend": "tpu"}
+    bench.save_staged_record(tmp_path, 6, "fp", staged)
+    assert bench.load_staged_record(tmp_path, 6, "fp") is None
