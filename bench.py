@@ -604,16 +604,6 @@ def bench_wide(
             "note": "single group of back-to-back runs per point — a "
                     "scaling curve around the flagship, not a headline",
         }
-        # an anomalous point means the sync misbehaved in THIS process —
-        # suspicion extends to every number here, so hoist the flag to the
-        # top level (where the resume filter looks) and re-measure the
-        # whole config next run rather than pinning a tainted capture
-        tainted = [p["point"] for p in pts if "timing_anomaly" in p]
-        if tainted:
-            record["timing_anomaly"] = (
-                f"sweep point(s) {tainted} timed impossibly — sync "
-                "unreliable in this capture"
-            )
     else:
         record["mxu_sweep"] = {
             "skipped": "non-tpu backend" if not on_tpu else "disabled"
@@ -714,6 +704,16 @@ def bench_wide(
         iters=serve_iters, repeats=serve_repeats,
         sync_overhead_s=sync_overhead_s,
     )
+    # the opt-in bf16 serving engine — timed through the SAME shared jit
+    # the BF16MLPPredictor serves with, so the measured engine is the
+    # served one
+    from bodywork_tpu.serve.predictor import bf16_mlp_apply
+
+    record["serve_xla_bf16"] = time_device_batch(
+        partial(bf16_mlp_apply(), model.params), Xb,
+        iters=serve_iters, repeats=serve_repeats,
+        sync_overhead_s=sync_overhead_s,
+    )
     if on_tpu:
         record["serve_pallas"] = time_device_batch(
             make_pallas_mlp_apply(model.params), Xb,
@@ -725,22 +725,24 @@ def bench_wide(
             "skipped": "non-tpu backend; the kernel would run in the "
             "interpreter"
         }
-    # rows/s through the faster engine's pipelined path, for scale feel
-    best = min(
-        v["device_pipelined_s"]
-        for v in (record["serve_xla"], record.get("serve_pallas", {}))
-        if "device_pipelined_s" in v
-    )
-    record["serve_rows_per_s"] = (
-        round(WIDE_BATCH / best, 1) if best > 0 else None
-    )
-    # a flagged sub-record must not leak its impossible number into the
-    # headline value the driver summarises
-    if "timing_anomaly" in record["train_xla_single"]:
-        record["value"] = None
-        record["timing_anomaly"] = record["train_xla_single"]["timing_anomaly"]
+    # rows/s through the fastest engine's pipelined path, for scale feel
+    engine_views = {
+        "xla": record["serve_xla"],
+        "xla-bf16": record.get("serve_xla_bf16", {}),
+        "pallas": record.get("serve_pallas", {}),
+    }
+    timed = {
+        name: v["device_pipelined_s"]
+        for name, v in engine_views.items()
+        if v.get("device_pipelined_s", 0) > 0
+    }
+    if timed:
+        best_engine = min(timed, key=timed.get)
+        record["serve_rows_per_s"] = round(WIDE_BATCH / timed[best_engine], 1)
+        record["serve_fastest_engine"] = best_engine
     else:
-        record["value"] = record["train_xla_single"]["seconds_per_step"]
+        record["serve_rows_per_s"] = None
+    _finalize_wide_anomalies(record)
     record["unit"] = "s/step"
     record["vs_baseline"] = None
     record["baseline_note"] = (
@@ -748,6 +750,30 @@ def bench_wide(
         "only model is d=2 OLS (SURVEY.md §2)"
     )
     return record
+
+
+def _finalize_wide_anomalies(record: dict) -> None:
+    """Set config 6's headline ``value`` with one anomaly policy: any
+    impossible timing anywhere in the capture (flagship or sweep point)
+    means the sync misbehaved in this process, so no number from it can be
+    the headline — ``value`` goes null and a combined top-level
+    ``timing_anomaly`` (which the resume filter refuses to pin) says what
+    was tainted, losing neither message."""
+    msgs = []
+    flagship = record["train_xla_single"]
+    if "timing_anomaly" in flagship:
+        msgs.append(f"flagship: {flagship['timing_anomaly']}")
+    sweep_pts = record.get("mxu_sweep", {}).get("points", [])
+    tainted = [p["point"] for p in sweep_pts if "timing_anomaly" in p]
+    if tainted:
+        msgs.append(f"sweep point(s) {tainted} timed impossibly")
+    if msgs:
+        record["value"] = None
+        record["timing_anomaly"] = (
+            "; ".join(msgs) + " — sync unreliable in this capture"
+        )
+    else:
+        record["value"] = flagship["seconds_per_step"]
 
 
 def bench_ab(days: int = 5, model_types=("linear", "mlp")) -> dict:

@@ -310,10 +310,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="shard batches over this many devices (data-parallel serving)",
     )
     p.add_argument(
-        "--engine", default="auto", choices=["auto", "xla", "pallas"],
-        help="prediction engine: the XLA apply, the fused Pallas MLP "
-             "kernel, or auto (kernel only where it wins: wide MLPs on "
-             "a real TPU)",
+        "--engine", default="auto",
+        choices=["auto", "xla", "xla-bf16", "pallas"],
+        help="prediction engine: the XLA apply (f32), the bf16-matmul "
+             "XLA apply (explicit precision/throughput trade, MLP only), "
+             "the fused Pallas MLP kernel, or auto (kernel only where it "
+             "wins: wide MLPs on a real TPU; never bf16)",
     )
     p.add_argument(
         "--reload-interval", type=float, default=30.0,

@@ -74,11 +74,17 @@ def mlp_forward(
     return out[:, 0].astype(jnp.float32)
 
 
-def mlp_apply(params: dict, x: jax.Array) -> jax.Array:
-    """Full apply incl. the folded-in scaler: raw X -> raw prediction."""
+def mlp_apply(
+    params: dict, x: jax.Array, compute_dtype: str | None = None
+) -> jax.Array:
+    """Full apply incl. the folded-in scaler: raw X -> raw prediction.
+
+    ``compute_dtype="bfloat16"`` runs the dense stack's matmuls in bf16
+    (single-pass MXU — the opt-in ``xla-bf16`` serving engine); the scaler
+    arithmetic and the output stay f32 either way."""
     s = params["scaler"]
     h = (x - s["x_mean"]) / s["x_std"]
-    out = mlp_forward(params["net"], h)
+    out = mlp_forward(params["net"], h, compute_dtype)
     return out * s["y_std"] + s["y_mean"]
 
 

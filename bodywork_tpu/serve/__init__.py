@@ -1,4 +1,4 @@
-from bodywork_tpu.serve.predictor import PaddedPredictor
+from bodywork_tpu.serve.predictor import BF16MLPPredictor, PaddedPredictor
 from bodywork_tpu.serve.app import create_app
 from bodywork_tpu.serve.reload import CheckpointWatcher
 from bodywork_tpu.serve.server import (
@@ -10,6 +10,7 @@ from bodywork_tpu.serve.server import (
 )
 
 __all__ = [
+    "BF16MLPPredictor",
     "CheckpointWatcher",
     "PaddedPredictor",
     "RoundRobinApp",

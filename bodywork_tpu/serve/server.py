@@ -177,6 +177,16 @@ def build_predictor(model, mesh_data: int | None = None, engine: str = "xla"):
                 "you are testing the kernel itself"
             )
         predictor = PallasMLPPredictor(model, interpret=interpret)
+    elif engine == "xla-bf16":
+        from bodywork_tpu.serve.predictor import BF16MLPPredictor
+
+        if mesh_data and mesh_data > 1:
+            raise ValueError(
+                "engine='xla-bf16' is single-device; drop --mesh-data"
+            )
+        # never chosen by "auto": trading prediction precision (bf16's ~3
+        # significant digits) for throughput is an explicit caller decision
+        predictor = BF16MLPPredictor(model)
     elif engine != "xla":
         raise ValueError(f"unknown serving engine {engine!r}")
     if mesh_data and mesh_data > 1:

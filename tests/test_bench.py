@@ -275,7 +275,9 @@ def test_bench_wide_record_shape():
     assert dev["device_pipelined_s"] == min(dev["device_pipelined_passes"])
     assert "skipped" in record["serve_pallas"]  # interpreter off-TPU
     assert "skipped" in record["mxu_sweep"]  # TPU-only scaling curve
+    assert record["serve_xla_bf16"]["device_sync_s"] > 0
     assert record["serve_rows_per_s"] > 0
+    assert record["serve_fastest_engine"] in ("xla", "xla-bf16")
 
 
 def test_bench_wide_mxu_sweep_loop():
@@ -315,3 +317,29 @@ def test_bench_wide_anomaly_hoists_and_blocks_resume(monkeypatch, tmp_path):
     staged = {**record, "config": 6, "backend": "tpu"}
     bench.save_staged_record(tmp_path, 6, "fp", staged)
     assert bench.load_staged_record(tmp_path, 6, "fp") is None
+
+
+def test_finalize_wide_anomalies_mixed_cases():
+    """One policy for every taint combination: clean flagship + tainted
+    sweep still nulls the headline; both tainted keeps both messages."""
+    clean = {"seconds_per_step": 0.004}
+    bad = {"timing_anomaly": "non-positive timed interval"}
+    sweep = {"points": [{"point": "b64_h8x2", "timing_anomaly": "x"},
+                        {"point": "b128_h8x2", "seconds_per_step": 0.01}]}
+
+    rec = {"train_xla_single": dict(clean), "mxu_sweep": sweep}
+    bench._finalize_wide_anomalies(rec)
+    assert rec["value"] is None  # sweep taint alone nulls the headline
+    assert "b64_h8x2" in rec["timing_anomaly"]
+    assert "flagship" not in rec["timing_anomaly"]
+
+    rec = {"train_xla_single": dict(bad), "mxu_sweep": sweep}
+    bench._finalize_wide_anomalies(rec)
+    assert rec["value"] is None
+    assert "flagship" in rec["timing_anomaly"]  # neither message lost
+    assert "b64_h8x2" in rec["timing_anomaly"]
+
+    rec = {"train_xla_single": dict(clean),
+           "mxu_sweep": {"skipped": "non-tpu backend"}}
+    bench._finalize_wide_anomalies(rec)
+    assert rec["value"] == 0.004 and "timing_anomaly" not in rec

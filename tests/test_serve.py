@@ -190,12 +190,45 @@ def test_resolve_engine_picks_kernel_only_where_it_wins(fitted_model):
     # explicit choices pass through
     assert resolve_engine("xla", wide, platform="tpu") == "xla"
     assert resolve_engine("pallas", narrow, platform="tpu") == "pallas"
-    # auto: kernel only for wide MLPs on TPU, single-device
+    assert resolve_engine("xla-bf16", wide, platform="cpu") == "xla-bf16"
+    # auto: kernel only for wide MLPs on TPU, single-device — and never
+    # bf16 (precision loss must be an explicit caller decision)
     assert resolve_engine("auto", wide, platform="tpu") == "pallas"
     assert resolve_engine("auto", narrow, platform="tpu") == "xla"
     assert resolve_engine("auto", wide, platform="cpu") == "xla"
     assert resolve_engine("auto", wide, mesh_data=4, platform="tpu") == "xla"
     assert resolve_engine("auto", fitted_model, platform="tpu") == "xla"
+
+
+def test_bf16_engine_serves_close_to_f32(fitted_model):
+    """The opt-in xla-bf16 engine: same predictions to bf16 precision
+    (~3 significant digits), MLP-only, single-device, distinct warm key."""
+    import pytest
+
+    from bodywork_tpu.models import MLPConfig, MLPRegressor
+    from bodywork_tpu.serve.predictor import BF16MLPPredictor
+    from bodywork_tpu.serve.server import build_predictor
+
+    rng = np.random.default_rng(3)
+    X = rng.uniform(0, 100, 400).astype(np.float32)
+    y = (1.0 + 0.5 * X).astype(np.float32)
+    mlp = MLPRegressor(MLPConfig(hidden=(32, 32), n_steps=200)).fit(X, y)
+
+    p16 = build_predictor(mlp, engine="xla-bf16")
+    assert isinstance(p16, BF16MLPPredictor)
+    Xq = rng.uniform(0, 100, 64).astype(np.float32)
+    f32 = mlp.predict(Xq)
+    b16 = p16.predict(Xq)
+    np.testing.assert_allclose(b16, f32, rtol=2e-2, atol=0.5)
+    assert not np.allclose(b16, f32, rtol=1e-6, atol=0)  # really bf16
+
+    # linear models refuse; data-parallel meshes refuse; auto never picks it
+    with pytest.raises(ValueError, match="MLP"):
+        build_predictor(fitted_model, engine="xla-bf16")
+    with pytest.raises(ValueError, match="single-device"):
+        build_predictor(mlp, mesh_data=2, engine="xla-bf16")
+    # the engine's warmup key is disjoint from the f32 predictor's
+    assert p16._warm_key_extra()[0] == "xla-bf16"
 
 
 def _save_model_for_day(store, day, slope):
