@@ -720,16 +720,24 @@ def bench_wide(
             iters=serve_iters, repeats=serve_repeats,
             sync_overhead_s=sync_overhead_s,
         )
+        record["serve_pallas_bf16"] = time_device_batch(
+            make_pallas_mlp_apply(model.params, compute_dtype="bfloat16"),
+            Xb, iters=serve_iters, repeats=serve_repeats,
+            sync_overhead_s=sync_overhead_s,
+        )
     else:
-        record["serve_pallas"] = {
+        skip = {
             "skipped": "non-tpu backend; the kernel would run in the "
             "interpreter"
         }
+        record["serve_pallas"] = dict(skip)
+        record["serve_pallas_bf16"] = dict(skip)
     # rows/s through the fastest engine's pipelined path, for scale feel
     engine_views = {
         "xla": record["serve_xla"],
         "xla-bf16": record.get("serve_xla_bf16", {}),
         "pallas": record.get("serve_pallas", {}),
+        "pallas-bf16": record.get("serve_pallas_bf16", {}),
     }
     timed = {
         name: v["device_pipelined_s"]

@@ -311,11 +311,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--engine", default="auto",
-        choices=["auto", "xla", "xla-bf16", "pallas"],
+        choices=["auto", "xla", "xla-bf16", "pallas", "pallas-bf16"],
         help="prediction engine: the XLA apply (f32), the bf16-matmul "
              "XLA apply (explicit precision/throughput trade, MLP only), "
-             "the fused Pallas MLP kernel, or auto (kernel only where it "
-             "wins: wide MLPs on a real TPU; never bf16)",
+             "the fused Pallas MLP kernel (f32 or bf16 weights), or auto "
+             "(kernel only where it wins: wide MLPs on a real TPU; "
+             "never bf16)",
     )
     p.add_argument(
         "--reload-interval", type=float, default=30.0,

@@ -62,28 +62,49 @@ def fold_scaler_into_net(params: dict) -> list[tuple[jax.Array, jax.Array]]:
     return layers
 
 
-def _mlp_kernel(n_layers: int, *refs):
-    """Fused dense stack: x_ref, w0,b0, w1,b1, ..., out_ref."""
+def _mlp_kernel(n_layers: int, operand_dtype, *refs):
+    """Fused dense stack: x_ref, w0,b0, w1,b1, ..., out_ref.
+
+    ``operand_dtype`` is the matmul-operand dtype: f32 (default engine) or
+    bf16 (the weights arrive pre-cast; activations are cast at each dot).
+    Accumulation is always f32 (``preferred_element_type``), as are the
+    bias adds and relu, so only the multiplies lose precision."""
     x_ref, out_ref = refs[0], refs[-1]
     h = x_ref[:]
     for i in range(n_layers):
         w = refs[1 + 2 * i][:]
         b = refs[2 + 2 * i][:]
-        h = jnp.dot(h, w, preferred_element_type=jnp.float32) + b[None, :]
+        h = jnp.dot(
+            h.astype(operand_dtype), w,
+            preferred_element_type=jnp.float32,
+        ) + b[None, :]
         if i < n_layers - 1:
             h = jnp.maximum(h, 0.0)
     out_ref[:] = h
 
 
-def make_pallas_mlp_apply(params: dict, interpret: bool = False):
+def make_pallas_mlp_apply(params: dict, interpret: bool = False,
+                          compute_dtype: str | None = None):
     """Build ``apply(X) -> y`` running the folded MLP as one Pallas kernel.
 
     Weights are padded/folded once at build time and stay on device;
     ``apply`` pads the batch to a ROW_TILE multiple and returns the first
     column (the regression head) unpadded.
+
+    ``compute_dtype="bfloat16"`` stores the padded weights in bf16 (half
+    the VMEM bytes per weight; since square-layer weight bytes grow as
+    width², that buys ~1.4x the width before spilling, not 2x) and runs
+    the matmuls with bf16 operands on the MXU's native path; accumulation,
+    biases, and relu stay f32 here — slightly *tighter* numerics than the
+    ``xla-bf16`` engine, whose activations and biases are bf16 end-to-end
+    — so the two bf16 engines agree only to bf16 precision, not bitwise.
+    Same ~3-significant-digit prediction trade, opt-in the same way.
     """
     from jax.experimental import pallas as pl
 
+    operand_dtype = (
+        jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
+    )
     folded = fold_scaler_into_net(params)
     d_in = folded[0][0].shape[0]
     widths = [d_in] + [w.shape[1] for w, _ in folded]
@@ -91,11 +112,13 @@ def make_pallas_mlp_apply(params: dict, interpret: bool = False):
 
     weights = []
     for (w, b), rows, cols in zip(folded, padded[:-1], padded[1:]):
-        weights.append(_pad_to(w, rows, cols))
+        # only the matmul LHS/RHS drop to bf16; biases stay f32 and are
+        # added to the f32 accumulator
+        weights.append(_pad_to(w, rows, cols).astype(operand_dtype))
         weights.append(_pad_to(b, cols=cols))
 
     n_layers = len(folded)
-    kernel = partial(_mlp_kernel, n_layers)
+    kernel = partial(_mlp_kernel, n_layers, operand_dtype)
     in_width, out_width = padded[0], padded[-1]
 
     @jax.jit

@@ -218,7 +218,8 @@ class PallasMLPPredictor(PaddedPredictor):
     _instance_counter = itertools.count()
 
     def __init__(self, model, buckets: tuple[int, ...] | None = None,
-                 interpret: bool = False):
+                 interpret: bool = False,
+                 compute_dtype: str | None = None):
         from bodywork_tpu.ops import ROW_TILE, make_pallas_mlp_apply
 
         if buckets is None:
@@ -226,7 +227,9 @@ class PallasMLPPredictor(PaddedPredictor):
             # sub-tile buckets would just compile duplicate programs
             buckets = (ROW_TILE, 2 * ROW_TILE, 16 * ROW_TILE)
         super().__init__(model, buckets)
-        self._apply = make_pallas_mlp_apply(model.params, interpret=interpret)
+        self._apply = make_pallas_mlp_apply(
+            model.params, interpret=interpret, compute_dtype=compute_dtype
+        )
         self._instance_id = next(self._instance_counter)
 
     def _dispatch_padded(self, Xp: np.ndarray):

@@ -157,26 +157,31 @@ def build_predictor(model, mesh_data: int | None = None, engine: str = "xla"):
     engine selection the booted one did."""
     engine = resolve_engine(engine, model, mesh_data)
     predictor = None
-    if engine == "pallas":
+    if engine in ("pallas", "pallas-bf16"):
         import jax
 
         from bodywork_tpu.models.mlp import MLPRegressor
         from bodywork_tpu.serve.predictor import PallasMLPPredictor
 
         if mesh_data and mesh_data > 1:
-            raise ValueError("engine='pallas' is single-device; drop --mesh-data")
+            raise ValueError(
+                f"engine={engine!r} is single-device; drop --mesh-data"
+            )
         if not isinstance(model, MLPRegressor):
             raise ValueError(
-                f"engine='pallas' serves MLP models; latest is {model.info}"
+                f"engine={engine!r} serves MLP models; latest is {model.info}"
             )
         interpret = jax.devices()[0].platform != "tpu"
         if interpret:
             log.warning(
-                "engine='pallas' on a non-TPU backend runs the kernel in "
-                "the (slow) Pallas interpreter — use engine='xla' unless "
-                "you are testing the kernel itself"
+                f"engine={engine!r} on a non-TPU backend runs the kernel "
+                "in the (slow) Pallas interpreter — use engine='xla' "
+                "unless you are testing the kernel itself"
             )
-        predictor = PallasMLPPredictor(model, interpret=interpret)
+        predictor = PallasMLPPredictor(
+            model, interpret=interpret,
+            compute_dtype="bfloat16" if engine == "pallas-bf16" else None,
+        )
     elif engine == "xla-bf16":
         from bodywork_tpu.serve.predictor import BF16MLPPredictor
 

@@ -42,6 +42,35 @@ def test_pallas_kernel_matches_xla(fitted):
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
+def test_pallas_kernel_bf16_close_to_f32(fitted):
+    """The bf16 kernel variant: same predictions to bf16 precision, and
+    genuinely bf16 (not silently f32)."""
+    X = np.linspace(0, 100, 300, dtype=np.float32)
+    f32 = np.asarray(make_pallas_mlp_apply(fitted.params, interpret=True)(X))
+    b16 = np.asarray(
+        make_pallas_mlp_apply(
+            fitted.params, interpret=True, compute_dtype="bfloat16"
+        )(X)
+    )
+    np.testing.assert_allclose(b16, f32, rtol=2e-2, atol=0.5)
+    assert not np.allclose(b16, f32, rtol=1e-6, atol=0)
+
+
+def test_pallas_bf16_engine_resolves_and_serves(fitted):
+    """engine='pallas-bf16' builds the bf16 kernel predictor and answers
+    the frozen contract within bf16 tolerance; 'auto' never picks it."""
+    from bodywork_tpu.serve.predictor import PallasMLPPredictor
+    from bodywork_tpu.serve.server import build_predictor, resolve_engine
+
+    assert resolve_engine("pallas-bf16", fitted, platform="tpu") == "pallas-bf16"
+    assert resolve_engine("auto", fitted, platform="tpu") != "pallas-bf16"
+    p = build_predictor(fitted, engine="pallas-bf16")
+    assert isinstance(p, PallasMLPPredictor)
+    got = p.predict(np.array([50.0], dtype=np.float32))
+    want = float(fitted.predict(np.array([50.0]))[0])
+    assert abs(got[0] - want) / abs(want) < 2e-2
+
+
 def test_pallas_kernel_1d_and_2d_input_parity(fitted):
     apply = make_pallas_mlp_apply(fitted.params, interpret=True)
     X = np.linspace(0, 100, 40, dtype=np.float32)
