@@ -1,0 +1,87 @@
+"""Property-based tests (hypothesis) for the protocol-critical invariants.
+
+Two pieces of the framework are pure protocol where an edge case silently
+corrupts the whole system rather than crashing it: the date-key versioning
+grammar every store consumer re-derives (SURVEY.md §1 L2), and the padded
+predictor's bucket/pad/chunk algebra that every scoring request rides
+through. Example-based tests pin known cases; these pin the laws.
+"""
+from datetime import date
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+# test_metrics_key must be aliased or pytest collects it as a test
+from bodywork_tpu.store.schema import (
+    dataset_key,
+    model_key,
+    model_metrics_key,
+    test_metrics_key as live_metrics_key,
+)
+from bodywork_tpu.utils.dates import date_from_key
+
+#: the protocol's whole domain: the reference regex admits years 2020-2099
+DATES = st.dates(min_value=date(2020, 1, 1), max_value=date(2099, 12, 31))
+
+
+@given(DATES)
+def test_every_key_kind_roundtrips_its_date(d):
+    for make in (dataset_key, model_key, model_metrics_key, live_metrics_key):
+        assert date_from_key(make(d)) == d
+
+
+@given(st.text(max_size=40))
+def test_date_from_key_never_raises_on_garbage(s):
+    out = date_from_key(s)
+    assert out is None or isinstance(out, date)
+
+
+@given(DATES, DATES)
+def test_key_ordering_matches_date_ordering(a, b):
+    """latest()/history() sort keys lexicographically within a prefix; the
+    ISO date embedding must make that identical to date ordering."""
+    assert (dataset_key(a) <= dataset_key(b)) == (a <= b)
+
+
+# -- padded predictor algebra ------------------------------------------------
+
+_BUCKETS = st.lists(
+    st.integers(min_value=1, max_value=512), min_size=1, max_size=5,
+    unique=True,
+).map(lambda bs: tuple(sorted(bs)))
+
+
+@settings(deadline=None)  # first example pays module imports
+@given(_BUCKETS, st.integers(min_value=1, max_value=2048))
+def test_bucket_for_picks_smallest_admitting_bucket(buckets, n):
+    from bodywork_tpu.models.linear import LinearRegressor
+    from bodywork_tpu.serve.predictor import PaddedPredictor
+
+    model = LinearRegressor()
+    model.params = {"coef": np.array([1.0]), "intercept": np.array(0.0)}
+    p = PaddedPredictor.__new__(PaddedPredictor)
+    p.model, p.buckets = model, buckets
+    b = p._bucket_for(n)
+    assert b in buckets
+    admitting = [x for x in buckets if x >= n]
+    # smallest bucket that fits, else the largest (caller chunks through it)
+    assert b == (min(admitting) if admitting else max(buckets))
+
+
+@settings(deadline=None, max_examples=20)  # each example dispatches XLA
+@given(st.integers(min_value=1, max_value=300))
+def test_padding_and_chunking_never_change_predictions(n):
+    """For ANY request size — sub-bucket, exact, oversized-chunked — the
+    padded predictor's output equals the model's direct prediction."""
+    from bodywork_tpu.models.linear import LinearRegressor
+    from bodywork_tpu.serve.predictor import PaddedPredictor
+
+    rng = np.random.default_rng(n)
+    X = rng.uniform(0, 100, 200).astype(np.float32)
+    y = (1.0 + 0.5 * X).astype(np.float32)
+    model = LinearRegressor().fit(X, y)
+    p = PaddedPredictor(model, buckets=(4, 32, 64))  # 300 rows > max: chunks
+    Xq = rng.uniform(0, 100, n).astype(np.float32)
+    np.testing.assert_allclose(
+        p.predict(Xq), model.predict(Xq), rtol=1e-5, atol=1e-4
+    )

@@ -1,0 +1,69 @@
+"""The backend bring-up watchdog (``utils.watchdog``).
+
+A wedged TPU relay blocks the process inside a C call, so the only abort
+path is a watchdog thread calling ``os._exit`` — which means the hang case
+must be tested in a CHILD process (the watchdog kills whoever armed it).
+"""
+import subprocess
+import sys
+import textwrap
+
+from bodywork_tpu.utils.watchdog import (
+    BACKEND_UNREACHABLE_EXIT,
+    abort_if_backend_hangs,
+    backend_timeout_from_env,
+)
+
+
+def test_timeout_from_env_parses_and_defaults(monkeypatch, capsys):
+    monkeypatch.delenv("GRAFT_BACKEND_TIMEOUT_S", raising=False)
+    assert backend_timeout_from_env() == 120.0
+    monkeypatch.setenv("GRAFT_BACKEND_TIMEOUT_S", "7.5")
+    assert backend_timeout_from_env() == 7.5
+    monkeypatch.setenv("GRAFT_BACKEND_TIMEOUT_S", "not-a-number")
+    assert backend_timeout_from_env() == 120.0  # malformed -> default
+    assert "malformed" in capsys.readouterr().err
+
+
+def test_fast_body_completes_unharmed():
+    with abort_if_backend_hangs(30.0):
+        x = 1 + 1
+    assert x == 2  # and the process is still here
+
+
+def test_disabled_watchdog_never_arms():
+    with abort_if_backend_hangs(0):
+        pass
+    with abort_if_backend_hangs(-1):
+        pass
+
+
+def test_exception_in_body_disarms_watchdog():
+    import time
+
+    try:
+        with abort_if_backend_hangs(0.2, what="exploding body"):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    # if the exception path left the timer armed, this sleep would die
+    time.sleep(0.4)
+
+
+def test_hang_aborts_child_with_contract_exit_code():
+    """The real contract: a hung block dies with exit code 3 and a clear
+    message — exercised in a child because the watchdog kills its host."""
+    code = textwrap.dedent("""
+        import time
+        from bodywork_tpu.utils.watchdog import abort_if_backend_hangs
+        with abort_if_backend_hangs(0.3, what="test backend"):
+            time.sleep(30)
+        print("unreachable")
+    """)
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=20,
+    )
+    assert proc.returncode == BACKEND_UNREACHABLE_EXIT
+    assert "test backend unreachable after 0.3s" in proc.stderr
+    assert "unreachable" not in proc.stdout  # the body never completed
