@@ -374,6 +374,34 @@ def bench_batched_scoring(rows: int = 1000, requests: int = 20) -> dict:
                     # per-batch execution with the tunnel RTT amortised out
                     **device_views[engine],
                 }
+            # the bf16 engines in the narrow regime — device-side views
+            # only (the HTTP path is transport-bound and measured twice
+            # above), each in its OWN guard so a bf16 compile failure
+            # cannot discard the f32 records already attached
+            from bodywork_tpu.serve.predictor import bf16_mlp_apply
+
+            bf16_dispatches = {
+                "xla_bf16": lambda: partial(bf16_mlp_apply(),
+                                            mlp_model.params),
+                "pallas_bf16": lambda: make_pallas_mlp_apply(
+                    mlp_model.params, compute_dtype="bfloat16"
+                ),
+            }
+            for engine, make_dispatch in bf16_dispatches.items():
+                try:
+                    record[f"{engine}_engine_mlp"] = {
+                        "metric": f"device_batch_latency_mlp_{engine}",
+                        **time_device_batch(
+                            make_dispatch(), request_rows,
+                            repeats=10, sync_overhead_s=sync_overhead_s,
+                        ),
+                    }
+                except Exception as exc:
+                    record[f"{engine}_engine_mlp"] = {
+                        "error": f"{type(exc).__name__}: {exc}"
+                    }
+                    print(f"bench: {engine} sub-bench FAILED: {exc!r}",
+                          file=sys.stderr)
         except Exception as exc:
             record["pallas_engine"] = {
                 "error": f"{type(exc).__name__}: {exc}"
