@@ -73,9 +73,24 @@ def main() -> None:
 
     interpret = jax.devices()[0].platform != "tpu"
     pallas_apply = make_pallas_mlp_apply(clone.params, interpret=interpret)
-    delta = np.max(np.abs(np.asarray(pallas_apply(X[:8])) - clone.predict(X[:8])))
+    f32 = clone.predict(X[:8])
+    delta = np.max(np.abs(np.asarray(pallas_apply(X[:8])) - f32))
     print(f"pallas-vs-xla max abs delta on 8 rows: {delta:.5f} "
           f"({'interpreter' if interpret else 'TPU kernel'})")
+
+    # the bf16 engines (opt-in precision/throughput trades) agree with the
+    # f32 apply to bf16's ~3 significant digits
+    from bodywork_tpu.serve.predictor import bf16_mlp_apply
+
+    scale = np.max(np.abs(f32)) or 1.0
+    b16 = np.asarray(bf16_mlp_apply()(clone.params, X[:8]))
+    p16 = np.asarray(
+        make_pallas_mlp_apply(
+            clone.params, interpret=interpret, compute_dtype="bfloat16"
+        )(X[:8])
+    )
+    print(f"xla-bf16    max rel delta vs f32: {np.max(np.abs(b16 - f32)) / scale:.5f}")
+    print(f"pallas-bf16 max rel delta vs f32: {np.max(np.abs(p16 - f32)) / scale:.5f}")
 
 
 if __name__ == "__main__":

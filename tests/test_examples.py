@@ -160,3 +160,10 @@ def test_example_07_wide_model(tmp_path, monkeypatch, capsys):
     assert "served 8 rows via /score/v1/batch" in out
     delta = float(out.rsplit("delta on 8 rows: ", 1)[1].split()[0])
     assert delta < 0.01
+    # the bf16 engine cross-checks: loose bound — the example's 4-step
+    # model is barely trained, so outputs are small and relative error
+    # runs hotter than on a converged model (tighter parity is pinned in
+    # tests/test_ops.py and tests/test_serve.py on trained models)
+    for line in ("xla-bf16    max rel delta", "pallas-bf16 max rel delta"):
+        rel = float(out.rsplit(line + " vs f32: ", 1)[1].split()[0])
+        assert rel < 0.05
