@@ -229,8 +229,14 @@ def cmd_wait_for(args) -> int:
     return 0
 
 
+#: ``report --fail-on-drift`` exit code. Deliberately NOT 1 (generic CLI
+#: failure), 2 (argparse usage error — a gate keyed on 2 would fire on a
+#: mistyped flag), or 3 (backend-unreachable, utils.watchdog).
+DRIFT_EXIT = 4
+
+
 def cmd_report(args) -> int:
-    from bodywork_tpu.monitor import drift_report
+    from bodywork_tpu.monitor import detect_drift, drift_report
 
     store = _store(args)
     report = drift_report(store)
@@ -244,6 +250,18 @@ def cmd_report(args) -> int:
         # a failure here (e.g. matplotlib missing) propagates to main()'s
         # catch-all: logged error + exit 1, never an uncaught traceback
         print(render_drift_dashboard(store, args.plot, report=report))
+    verdict = detect_drift(
+        report, mape_ratio=args.mape_ratio, corr_floor=args.corr_floor
+    )
+    if verdict["drifted"]:
+        print(
+            f"DRIFT: {len(verdict['flagged_dates'])}/{verdict['n_days']} "
+            f"day(s) flagged, first {verdict['first_flagged_date']} "
+            f"(MAPE_live > {args.mape_ratio} x MAPE_train or corr < "
+            f"{args.corr_floor})"
+        )
+        if args.fail_on_drift:
+            return DRIFT_EXIT
     return 0
 
 
@@ -382,6 +400,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--plot", default=None, metavar="OUT.png",
                    help="also render the drift dashboard PNG here "
                         "(requires matplotlib)")
+    p.add_argument("--fail-on-drift", action="store_true",
+                   help="exit 4 when the drift rule flags any day — lets "
+                        "a CronJob/CI gate react to drift instead of an "
+                        "analyst eyeballing the table (4 is unambiguous: "
+                        "1=error, 2=usage, 3=backend unreachable)")
+    p.add_argument("--mape-ratio", type=float, default=1.5,
+                   help="flag a day when MAPE_live exceeds this multiple "
+                        "of MAPE_train (default 1.5)")
+    p.add_argument("--corr-floor", type=float, default=0.5,
+                   help="flag a day when the live score/label correlation "
+                        "falls below this (default 0.5)")
 
     p = add("deploy", cmd_deploy, help="write GKE TPU manifests")
     p.add_argument("--spec", default=None, help="pipeline spec YAML (overrides --model/--mode)")

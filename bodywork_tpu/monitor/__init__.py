@@ -8,6 +8,7 @@ from bodywork_tpu.monitor.tester import (
     scoring_endpoint,
 )
 from bodywork_tpu.monitor.analytics import (
+    detect_drift,
     drift_report,
     load_metric_history,
     render_drift_dashboard,
@@ -21,6 +22,7 @@ __all__ = [
     "run_service_test",
     "score_dataset",
     "scoring_endpoint",
+    "detect_drift",
     "drift_report",
     "load_metric_history",
     "render_drift_dashboard",

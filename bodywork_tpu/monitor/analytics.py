@@ -61,6 +61,63 @@ def drift_report(store: ArtefactStore) -> pd.DataFrame:
     return report.reset_index(drop=True)
 
 
+def detect_drift(
+    report: pd.DataFrame,
+    mape_ratio: float = 1.5,
+    corr_floor: float = 0.5,
+) -> dict:
+    """Turn the longitudinal report into an actionable drift verdict.
+
+    The reference stops at *surfacing* drift (an analyst eyeballs the
+    joined tables — ``model-performance-analytics.ipynb`` cells 7-8);
+    this adds the decision rule so the pipeline itself can react (the
+    CLI's ``report --fail-on-drift`` exit code feeds a k8s CronJob or CI
+    gate). A day is flagged when either:
+
+    - ``MAPE_live > mape_ratio * MAPE_train`` — the live error has pulled
+      away from what the model showed at train time (the drift signature:
+      trained through yesterday, scored on today). Needs BOTH sides of
+      the join; a perfect train fit (``MAPE_train == 0``) with any
+      positive live MAPE flags (the ratio is infinite), or
+    - ``r_squared_live < corr_floor`` — the score/label correlation (the
+      reference's "r_squared", ``stage_4:103``) has collapsed outright.
+      Needs only the live side: a collapsed service is evidence by
+      itself, train history or not.
+
+    Returns ``{drifted, first_flagged_date, flagged_dates, n_days,
+    thresholds}``. A day missing the inputs a rule needs is not flagged
+    by that rule (no evidence is not drift).
+    """
+    out = {
+        "drifted": False,
+        "first_flagged_date": None,
+        "flagged_dates": [],
+        "n_days": 0 if report is None or report.empty else len(report),
+        "thresholds": {"mape_ratio": mape_ratio, "corr_floor": corr_floor},
+    }
+    if report is None or report.empty:
+        return out
+    flagged = []
+    for _, row in report.iterrows():
+        mape_t = row.get("MAPE_train")
+        mape_l = row.get("MAPE_live")
+        corr_l = row.get("r_squared_live")
+        hit = False
+        if pd.notna(mape_t) and pd.notna(mape_l):
+            # mape_t == 0 (perfect train fit): any positive live error is
+            # an infinite ratio — textbook drift, not a skipped rule
+            hit = (mape_l > mape_ratio * mape_t) if mape_t > 0 else mape_l > 0
+        if not hit and pd.notna(corr_l):
+            hit = corr_l < corr_floor
+        if hit:
+            flagged.append(str(row["date"]))
+    if flagged:
+        out.update(
+            drifted=True, first_flagged_date=flagged[0], flagged_dates=flagged
+        )
+    return out
+
+
 # categorical slots 1-2 of the validated reference palette (adjacent-pair
 # CVD dE 9.1, normal-vision dE 19.6 on the light surface — passes all gates)
 _TRAIN_COLOR = "#2a78d6"  # blue: train-time metrics

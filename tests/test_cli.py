@@ -13,6 +13,28 @@ def test_generate_then_train_then_report(tmp_path, capsys):
     assert "MAPE" in capsys.readouterr().out
 
 
+def test_report_fail_on_drift_exit_code(tmp_path, capsys):
+    """report --fail-on-drift: exit DRIFT_EXIT (4 — distinct from error=1,
+    usage=2, backend=3) when the rule flags a day, exit 0 otherwise — the
+    CronJob/CI gate contract."""
+    from bodywork_tpu.cli import DRIFT_EXIT
+
+    store = str(tmp_path / "artefacts")
+    assert main(["run-day", "--store", store, "--date", "2026-01-01"]) == 0
+    capsys.readouterr()
+    # absurd thresholds nothing real trips -> clean exit
+    assert main(["report", "--store", store, "--fail-on-drift",
+                 "--mape-ratio", "1000", "--corr-floor", "-10"]) == 0
+    assert "DRIFT" not in capsys.readouterr().out
+    # a correlation floor above any achievable corr -> flagged, exit 4
+    assert main(["report", "--store", store, "--fail-on-drift",
+                 "--corr-floor", "2.0"]) == DRIFT_EXIT == 4
+    assert "DRIFT:" in capsys.readouterr().out
+    # without --fail-on-drift the verdict prints but the exit stays 0
+    assert main(["report", "--store", store, "--corr-floor", "2.0"]) == 0
+    assert "DRIFT:" in capsys.readouterr().out
+
+
 def test_run_day_smoke(tmp_path, capsys):
     store = str(tmp_path / "artefacts")
     assert main(["run-day", "--store", store, "--date", "2026-01-01"]) == 0

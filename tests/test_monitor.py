@@ -144,6 +144,45 @@ def test_drift_report_joins_histories(served_store):
     assert len(train_df) == 1 and len(test_df) == 1
 
 
+def test_detect_drift_rules_and_edges():
+    """The decision rule over the joined report: MAPE ratio, correlation
+    floor, missing-side days never flagged, empty report never drifted."""
+    import pandas as pd
+
+    from bodywork_tpu.monitor import detect_drift
+
+    report = pd.DataFrame(
+        {
+            "date": [date(2026, 1, d) for d in (1, 2, 3, 4)],
+            "MAPE_train": [0.8, 0.8, 0.8, None],
+            "MAPE_live": [0.9, 1.5, None, 2.0],  # day2: 1.875x -> flagged
+            "r_squared_live": [0.8, 0.8, 0.8, None],
+        }
+    )
+    verdict = detect_drift(report, mape_ratio=1.5, corr_floor=0.5)
+    assert verdict["drifted"] is True
+    assert verdict["flagged_dates"] == ["2026-01-02"]
+    assert verdict["first_flagged_date"] == "2026-01-02"
+    assert verdict["n_days"] == 4  # missing-side days counted, not flagged
+
+    # correlation collapse flags even when MAPE looks fine — and it needs
+    # only the live side (day 3 has no MAPE_live but corr evidence counts)
+    report.loc[0, "r_squared_live"] = 0.1
+    verdict = detect_drift(report, mape_ratio=10.0, corr_floor=0.5)
+    assert verdict["flagged_dates"] == ["2026-01-01"]
+
+    # a perfect train fit (MAPE_train == 0) with positive live error is an
+    # infinite ratio: always drift, never a silently skipped rule
+    perfect = pd.DataFrame(
+        {"date": [date(2026, 2, 1)], "MAPE_train": [0.0],
+         "MAPE_live": [0.4], "r_squared_live": [0.9]}
+    )
+    assert detect_drift(perfect)["drifted"] is True
+
+    assert detect_drift(pd.DataFrame())["drifted"] is False
+    assert detect_drift(None)["drifted"] is False
+
+
 def test_scoring_endpoint_normalisation():
     from bodywork_tpu.monitor import scoring_endpoint
 
