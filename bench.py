@@ -1207,6 +1207,58 @@ def compact_output(records: list[dict], backend: str,
     return out
 
 
+def diff_captures(path_a: str, path_b: str) -> list[str]:
+    """Per-config headline comparison of two capture files (the full
+    record written by the orchestrator, or an interim/dev capture with a
+    ``configs`` list). Prints one line per config present in either:
+    value A -> value B, the ratio, and backend changes — the tool for
+    truthing up README claims against a fresh capture."""
+
+    def load(path):
+        with open(path) as f:
+            data = json.loads(f.read())
+        # entries without a config number can't be paired — report, don't
+        # crash on a hand-written/truncated capture
+        return {
+            c["config"]: c
+            for c in data.get("configs", [])
+            if c.get("config") is not None
+        }
+
+    a, b = load(path_a), load(path_b)
+    lines = [f"capture diff: A={path_a}  B={path_b}"]
+    for n in sorted(set(a) | set(b), key=str):
+        ra, rb = a.get(n), b.get(n)
+        if ra is None or rb is None:
+            lines.append(f"  config {n}: only in {'B' if ra is None else 'A'}")
+            continue
+        va, vb = ra.get("value"), rb.get("value")
+        ua, ub = ra.get("unit"), rb.get("unit")
+        backends = f"{ra.get('backend')}->{rb.get('backend')}"
+        if ua != ub:
+            # a ratio of incommensurable values would be a wildly wrong
+            # verdict in exactly the README-truthing workflow this is for
+            lines.append(
+                f"  config {n}: {va} {ua} -> {vb} {ub} ({backends}; "
+                f"units differ — not comparable)"
+            )
+        elif isinstance(va, (int, float)) and isinstance(vb, (int, float)) \
+                and va and vb:
+            # every headline is time-per-X: lower is better
+            speedup = va / vb
+            verdict = (f"B {speedup:.2f}x faster" if speedup >= 1
+                       else f"B {1 / speedup:.2f}x slower")
+            lines.append(
+                f"  config {n}: {va} -> {vb} {ub} ({verdict}, {backends})"
+            )
+        else:
+            lines.append(
+                f"  config {n}: {va} -> {vb} ({backends}; "
+                f"non-numeric or anomalous on one side)"
+            )
+    return lines
+
+
 def _child_main(args) -> int:
     """Single-config mode: run one config in THIS process and write the
     record to ``--json-out`` (parent mode) and stdout (human use)."""
@@ -1300,7 +1352,17 @@ def main() -> int:
         help="total seconds the parent may spend probing/backing off on a "
              "flaky relay across the whole run",
     )
+    parser.add_argument(
+        "--diff", nargs=2, metavar=("A.json", "B.json"), default=None,
+        help="compare two capture files per-config (no benching): "
+             "value A -> B, speedup, backend changes",
+    )
     args = parser.parse_args()
+
+    if args.diff:
+        for line in diff_captures(*args.diff):
+            print(line)
+        return 0
 
     if args.config is not None:
         return _child_main(args)

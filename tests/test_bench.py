@@ -319,6 +319,39 @@ def test_bench_wide_anomaly_hoists_and_blocks_resume(monkeypatch, tmp_path):
     assert bench.load_staged_record(tmp_path, 6, "fp") is None
 
 
+def test_diff_captures(tmp_path):
+    """The capture-diff tool: speedup direction, backend changes, one-sided
+    configs, and anomalous (null) values all render without crashing."""
+    import json as _json
+
+    a = {"configs": [
+        {"config": 1, "value": 3.0, "unit": "s", "backend": "cpu"},
+        {"config": 2, "value": 1.0, "unit": "s/day", "backend": "tpu"},
+        {"config": 5, "value": 2.0, "unit": "s/day", "backend": "tpu"},
+        {"config": 6, "value": None, "unit": "s/step", "backend": "tpu"},
+        {"value": 9.9},  # no config number: skipped, never a crash
+    ]}
+    b = {"configs": [
+        {"config": 1, "value": 1.5, "unit": "s", "backend": "tpu"},
+        {"config": 2, "value": 2.0, "unit": "s/day", "backend": "tpu"},
+        {"config": 3, "value": 0.2, "unit": "s/day", "backend": "tpu"},
+        {"config": 5, "value": 0.1, "unit": "s/pipeline-day", "backend": "tpu"},
+        {"config": 6, "value": 0.004, "unit": "s/step", "backend": "tpu"},
+    ]}
+    pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+    pa.write_text(_json.dumps(a))
+    pb.write_text(_json.dumps(b))
+    lines = bench.diff_captures(str(pa), str(pb))
+    text = "\n".join(lines)
+    assert "config 1: 3.0 -> 1.5 s (B 2.00x faster, cpu->tpu)" in text
+    assert "config 2: 1.0 -> 2.0 s/day (B 2.00x slower" in text
+    assert "config 3: only in B" in text
+    # changed units never produce a speedup verdict
+    assert "config 5" in text and "units differ" in text
+    assert "config 6" in text and "anomalous" in text
+    assert "9.9" not in text  # config-less entry skipped
+
+
 def test_finalize_wide_anomalies_mixed_cases():
     """One policy for every taint combination: clean flagship + tainted
     sweep still nulls the headline; both tainted keeps both messages."""
