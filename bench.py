@@ -1252,10 +1252,17 @@ def diff_captures(path_a: str, path_b: str) -> list[str]:
                 f"  config {n}: {va} -> {vb} {ub} ({verdict}, {backends})"
             )
         else:
-            lines.append(
-                f"  config {n}: {va} -> {vb} ({backends}; "
-                f"non-numeric or anomalous on one side)"
-            )
+            # distinguish a crashed config from an anomaly-nulled one —
+            # the operator shouldn't have to open the raw captures
+            notes = [
+                f"{side} {field}: {str(r[field])[:80]}"
+                for side, r in (("A", ra), ("B", rb))
+                for field in ("error", "timing_anomaly")
+                if r.get(field)
+            ]
+            detail = "; ".join(notes) if notes else \
+                "non-numeric value on one side"
+            lines.append(f"  config {n}: {va} -> {vb} ({backends}; {detail})")
     return lines
 
 

@@ -328,7 +328,10 @@ def test_diff_captures(tmp_path):
         {"config": 1, "value": 3.0, "unit": "s", "backend": "cpu"},
         {"config": 2, "value": 1.0, "unit": "s/day", "backend": "tpu"},
         {"config": 5, "value": 2.0, "unit": "s/day", "backend": "tpu"},
-        {"config": 6, "value": None, "unit": "s/step", "backend": "tpu"},
+        {"config": 6, "value": None, "unit": "s/step", "backend": "tpu",
+         "timing_anomaly": "sync did not wait"},
+        {"config": 7, "unit": "s", "backend": "tpu",
+         "error": "XlaRuntimeError: boom"},
         {"value": 9.9},  # no config number: skipped, never a crash
     ]}
     b = {"configs": [
@@ -337,6 +340,7 @@ def test_diff_captures(tmp_path):
         {"config": 3, "value": 0.2, "unit": "s/day", "backend": "tpu"},
         {"config": 5, "value": 0.1, "unit": "s/pipeline-day", "backend": "tpu"},
         {"config": 6, "value": 0.004, "unit": "s/step", "backend": "tpu"},
+        {"config": 7, "value": 0.02, "unit": "s", "backend": "tpu"},
     ]}
     pa, pb = tmp_path / "a.json", tmp_path / "b.json"
     pa.write_text(_json.dumps(a))
@@ -348,7 +352,9 @@ def test_diff_captures(tmp_path):
     assert "config 3: only in B" in text
     # changed units never produce a speedup verdict
     assert "config 5" in text and "units differ" in text
-    assert "config 6" in text and "anomalous" in text
+    # a crashed config and an anomaly-nulled one are distinguishable
+    assert "A timing_anomaly: sync did not wait" in text
+    assert "A error: XlaRuntimeError: boom" in text
     assert "9.9" not in text  # config-less entry skipped
 
 
