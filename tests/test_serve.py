@@ -321,6 +321,66 @@ def test_serve_latest_model_watches_over_http(store):
         handle.stop()
 
 
+def test_hot_reload_atomic_under_concurrent_traffic(store):
+    """The swap's atomicity claim under real load: several client threads
+    hammer the service over HTTP while the watcher swaps in day 2's
+    checkpoint. Every response must be a coherent 200 — predictions from
+    EITHER model generation, never an error or a half-swapped state
+    (prediction from one model labeled with the other's date)."""
+    import threading
+    import time
+
+    import requests
+
+    from bodywork_tpu.serve import serve_latest_model
+
+    _save_model_for_day(store, 1, slope=0.5)   # predict(10) ~= 6
+    handle = serve_latest_model(
+        store, host="127.0.0.1", port=0, block=False, watch_interval_s=0.05
+    )
+    failures, results = [], []
+    stop = threading.Event()
+
+    def hammer():
+        s = requests.Session()
+        while not stop.is_set():
+            try:
+                r = s.post(handle.url, json={"X": 10}, timeout=10)
+                if r.status_code != 200:
+                    failures.append(f"HTTP {r.status_code}")
+                    continue
+                body = r.json()
+                results.append((body["model_date"], body["prediction"]))
+            except Exception as exc:
+                failures.append(repr(exc))
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        _save_model_for_day(store, 2, slope=2.0)  # predict(10) ~= 21
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if any(d == "2026-07-02" for d, _ in results[-8:]):
+                break
+            time.sleep(0.05)
+        time.sleep(0.3)  # keep hammering past the swap
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        handle.stop()
+
+    assert not failures, failures[:5]
+    dates = {d for d, _ in results}
+    assert dates == {"2026-07-01", "2026-07-02"}, dates  # swap happened
+    for d, pred in results:
+        # a torn response would pair day-2's date with day-1's prediction
+        want = 6.0 if d == "2026-07-01" else 21.0
+        assert abs(pred - want) < 2.5, (d, pred)
+
+
 def test_reference_golden_scoring_example():
     """The reference documents its recorded golden exchange
     (``stage_2_serve_model.py:11-21``): POST {"X": 50} -> prediction
