@@ -452,13 +452,13 @@ MXU_SWEEP_POINTS = (
 
 
 def bench_wide(
-    steps: int = WIDE_STEPS,
-    serve_iters: int = 20,
-    serve_repeats: int = 10,
-    mfu_steps: int = MFU_STEPS,
-    mfu_groups: int = 3,
-    mfu_runs_per_group: int = 2,
-    include_f32: bool = True,
+    steps: int | None = None,
+    serve_iters: int | None = None,
+    serve_repeats: int | None = None,
+    mfu_steps: int | None = None,
+    mfu_groups: int | None = None,
+    mfu_runs_per_group: int | None = None,
+    include_f32: bool | None = None,
     sweep_points: tuple = MXU_SWEEP_POINTS,
     sweep_steps: int = 100,
     force_sweep: bool = False,
@@ -493,8 +493,34 @@ def bench_wide(
 
     from bodywork_tpu.utils.sync import fence
 
-    on_tpu = jax.devices()[0].platform == "tpu"
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
     peak = PEAK_FLOPS_V5E if on_tpu else None
+    # Unset lengths resolve per backend: full protocol on any accelerator;
+    # on CPU specifically, the full MFU protocol (6+ dispatches of a
+    # 200-step, ~105-GFLOP/step scan plus the f32 comparison) is hours of
+    # host BLAS that would blow the child timeout — scale the timed
+    # lengths down and say so in the record. Explicit arguments (tests,
+    # callers) always win.
+    on_cpu = platform == "cpu"
+    scaled_defaults_used = False
+
+    def _default(value, cpu_value, full_value):
+        nonlocal scaled_defaults_used
+        if value is not None:
+            return value
+        if on_cpu:
+            scaled_defaults_used = True
+            return cpu_value
+        return full_value
+
+    steps = _default(steps, 10, WIDE_STEPS)
+    mfu_steps = _default(mfu_steps, 5, MFU_STEPS)
+    mfu_groups = _default(mfu_groups, 1, 3)
+    mfu_runs_per_group = _default(mfu_runs_per_group, 1, 2)
+    include_f32 = _default(include_f32, False, True)
+    serve_iters = _default(serve_iters, 3, 20)
+    serve_repeats = _default(serve_repeats, 2, 10)
     X, y = _wide_data()
     flops_per_step = wide_train_flops_per_step()
     sizes = (WIDE_FEATURES, *WIDE_HIDDEN, 1)
@@ -608,6 +634,12 @@ def bench_wide(
             "sync_overhead_s": round(sync_overhead_s, 6),
         },
     }
+    if scaled_defaults_used:
+        record["cpu_scaled_protocol"] = (
+            "timed lengths scaled down on the CPU backend (full protocol "
+            "would be hours of host BLAS); structural record, not a "
+            "throughput claim"
+        )
 
     record["train_xla_single"] = _single_device_record("bfloat16")
     if include_f32:
