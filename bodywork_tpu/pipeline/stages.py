@@ -164,6 +164,7 @@ def serve_stage(
     buckets: tuple[int, ...] | None = None,
     replicas: int = 1,
     watch_interval_s: float | None = None,
+    engine: str = "auto",
 ) -> ServiceHandle:
     """Load the latest model into device HBM and start the scoring service
     on a background thread (reference stage 2). Returns the handle; the
@@ -179,7 +180,14 @@ def serve_stage(
     a round-robin front, so multi-replica semantics are exercised locally,
     not just in emitted Deployment YAML. Replicas share the HBM-resident
     params (read-only), like the reference's replicas share the S3
-    artefact."""
+    artefact.
+
+    ``engine`` selects the prediction engine exactly as ``cli serve
+    --engine`` does ("auto" picks the Pallas kernel only in its winning
+    regime and resolves to the plain XLA apply everywhere else, so the
+    parity workloads are unchanged); a non-default predictor instance is
+    shared read-only across the replicas, the same sharing the hot-reload
+    watcher applies on swap."""
     # Load the artefact WITHOUT the host->device transfer first: if the
     # in-process train stage produced this exact checkpoint this day, its
     # params are already resident in HBM — verify the artefact bytes match
@@ -207,6 +215,12 @@ def serve_stage(
         import jax
 
         model.params = jax.device_put(model.params)
+    from bodywork_tpu.serve.server import build_predictor
+
+    predictor = build_predictor(  # mesh_data=None: single-device serving
+        model, None, engine,
+        buckets=tuple(buckets) if buckets else None,
+    )
     # warmup itself skips shapes already dispatched this process, and only
     # syncs when something new was dispatched — so the persistent day-loop
     # pays the error-surfacing sync exactly once (day 1), one-shot pods
@@ -216,6 +230,7 @@ def serve_stage(
             model,
             model_date,
             buckets=tuple(buckets) if buckets else None,
+            predictor=predictor,
         )
         for _ in range(max(replicas, 1))
     ]
@@ -231,7 +246,7 @@ def serve_stage(
 
         watcher = CheckpointWatcher(
             apps, ctx.store, poll_interval_s=watch_interval_s,
-            served_key=served_key,
+            served_key=served_key, engine=engine,
         )
         watcher.start()
         handle.add_cleanup(watcher.stop)

@@ -79,13 +79,17 @@ class CheckpointWatcher:
             model, model_date = load_model(self.store, key)
             from bodywork_tpu.serve.server import build_predictor
 
-            predictor = build_predictor(model, self.mesh_data, self.engine)
+            # the swapped-in predictor keeps the booted service's bucket
+            # set whatever engine is active — a reload must not widen the
+            # compiled-shape set the spec narrowed
+            buckets = self.apps[0].predictor.buckets
+            predictor = build_predictor(
+                model, self.mesh_data, self.engine, buckets=buckets
+            )
             if predictor is None:
                 from bodywork_tpu.serve.predictor import PaddedPredictor
 
-                predictor = PaddedPredictor(
-                    model, self.apps[0].predictor.buckets
-                )
+                predictor = PaddedPredictor(model, buckets)
             # warm every bucket BEFORE the swap: the first request after
             # reload must not pay the new model's compiles
             predictor.warmup()

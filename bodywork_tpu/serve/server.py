@@ -150,11 +150,18 @@ def resolve_engine(
     return "xla"
 
 
-def build_predictor(model, mesh_data: int | None = None, engine: str = "xla"):
+def build_predictor(model, mesh_data: int | None = None, engine: str = "xla",
+                    buckets: tuple[int, ...] | None = None):
     """The predictor for a (resolved) engine choice, or ``None`` for the
     app's single-device bucketed default. Shared by boot-time serving and
     the hot-reload watcher so a swapped-in model goes through exactly the
-    engine selection the booted one did."""
+    engine selection the booted one did.
+
+    ``buckets`` narrows the compiled shape set for the bucketed engines —
+    the same knob the app's default predictor honours, threaded here so a
+    pipeline spec's explicit bucket list is never silently ignored when a
+    non-default engine is selected (each engine keeps its own default
+    bucket policy when unset)."""
     engine = resolve_engine(engine, model, mesh_data)
     predictor = None
     if engine in ("pallas", "pallas-bf16"):
@@ -179,7 +186,7 @@ def build_predictor(model, mesh_data: int | None = None, engine: str = "xla"):
                 "unless you are testing the kernel itself"
             )
         predictor = PallasMLPPredictor(
-            model, interpret=interpret,
+            model, buckets=buckets, interpret=interpret,
             compute_dtype="bfloat16" if engine == "pallas-bf16" else None,
         )
     elif engine == "xla-bf16":
@@ -191,7 +198,9 @@ def build_predictor(model, mesh_data: int | None = None, engine: str = "xla"):
             )
         # never chosen by "auto": trading prediction precision (bf16's ~3
         # significant digits) for throughput is an explicit caller decision
-        predictor = BF16MLPPredictor(model)
+        from bodywork_tpu.serve.predictor import DEFAULT_BUCKETS
+
+        predictor = BF16MLPPredictor(model, buckets or DEFAULT_BUCKETS)
     elif engine != "xla":
         raise ValueError(f"unknown serving engine {engine!r}")
     if mesh_data and mesh_data > 1:
@@ -206,7 +215,10 @@ def build_predictor(model, mesh_data: int | None = None, engine: str = "xla"):
                 f"available device(s)"
             )
         mesh = make_mesh(data=mesh_data, devices=devices[:mesh_data])
-        predictor = DataParallelPredictor(model, mesh)
+        predictor = (
+            DataParallelPredictor(model, mesh, buckets=buckets)
+            if buckets else DataParallelPredictor(model, mesh)
+        )
     return predictor
 
 

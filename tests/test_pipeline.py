@@ -77,6 +77,29 @@ def test_run_day_end_to_end(store):
         requests.get(handle.url.replace("/score/v1", "/healthz"), timeout=2)
 
 
+def test_serve_stage_engine_selection_from_spec(store):
+    """The spec's serve-stage args thread an engine choice into the day
+    loop exactly as `cli serve --engine` does: an MLP pipeline day served
+    through xla-bf16 completes with live metrics persisted, and the
+    replicas share the one bf16 predictor instance."""
+    from bodywork_tpu.serve.predictor import BF16MLPPredictor
+
+    spec = default_pipeline(model_type="mlp", scoring_mode="batch")
+    spec.stages["stage-1-train-model"].args.update(
+        {"hidden": [16, 16], "n_steps": 50}
+    )
+    spec.stages["stage-2-serve-model"].args["engine"] = "xla-bf16"
+    runner = LocalRunner(spec, store)
+    start = date(2026, 1, 1)
+    runner.bootstrap(start)
+    result = runner.run_day(start)
+    handle = result.stage_results["stage-2-serve-model"]
+    predictors = {id(app.predictor) for app in handle.replica_apps}
+    assert len(predictors) == 1  # one shared instance across replicas
+    assert isinstance(handle.replica_apps[0].predictor, BF16MLPPredictor)
+    assert store.history(TEST_METRICS_PREFIX)  # live test ran through it
+
+
 def test_run_simulation_three_days_shows_drift_history(store):
     runner = LocalRunner(default_pipeline(scoring_mode="batch"), store)
     results = runner.run_simulation(date(2026, 1, 1), 3)

@@ -229,6 +229,14 @@ def test_bf16_engine_serves_close_to_f32(fitted_model):
         build_predictor(mlp, mesh_data=2, engine="xla-bf16")
     # the engine's warmup key is disjoint from the f32 predictor's
     assert p16._warm_key_extra()[0] == "xla-bf16"
+    # an explicit bucket list is honoured by every engine, never silently
+    # replaced by the engine's default shape set
+    narrowed = build_predictor(mlp, engine="xla-bf16", buckets=(2048,))
+    assert narrowed.buckets == (2048,)
+    pallas_narrowed = build_predictor(mlp, engine="pallas", buckets=(512,))
+    assert pallas_narrowed.buckets == (512,)
+    dp = build_predictor(mlp, mesh_data=4, engine="xla", buckets=(2048,))
+    assert dp.buckets == (2048,)  # 2048 % 4 == 0: kept as-is
 
 
 def _save_model_for_day(store, day, slope):
