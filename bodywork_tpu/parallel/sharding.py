@@ -109,7 +109,9 @@ class DataParallelPredictor(PaddedPredictor):
     padded-batch execution differs."""
 
     def __init__(self, model: Regressor, mesh: Mesh,
-                 buckets: tuple[int, ...] = (64, 512, 4096)):
+                 buckets: tuple[int, ...] | None = None):
+        if buckets is None:
+            buckets = (64, 512, 4096)
         n_data = mesh.shape["data"]
         # round each bucket up to a multiple of the data-axis size so every
         # padded batch splits evenly across the mesh (stable XLA shapes)

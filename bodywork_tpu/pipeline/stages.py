@@ -251,7 +251,6 @@ def serve_stage(
         watcher.start()
         handle.add_cleanup(watcher.stop)
     handle.start()
-    handle.app = front
     handle.replica_apps = apps
     return handle
 

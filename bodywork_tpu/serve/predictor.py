@@ -185,14 +185,14 @@ class BF16MLPPredictor(PaddedPredictor):
     reproduce bit-for-bit.
     """
 
-    def __init__(self, model, buckets: tuple[int, ...] = DEFAULT_BUCKETS):
+    def __init__(self, model, buckets: tuple[int, ...] | None = None):
         from bodywork_tpu.models.mlp import MLPRegressor
 
         if not isinstance(model, MLPRegressor):
             raise ValueError(
                 f"engine='xla-bf16' serves MLP models; got {model.info}"
             )
-        super().__init__(model, buckets)
+        super().__init__(model, buckets if buckets else DEFAULT_BUCKETS)
         self._apply = bf16_mlp_apply()
 
     def _dispatch_padded(self, Xp: np.ndarray):

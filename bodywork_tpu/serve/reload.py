@@ -81,15 +81,13 @@ class CheckpointWatcher:
 
             # the swapped-in predictor keeps the booted service's bucket
             # set whatever engine is active — a reload must not widen the
-            # compiled-shape set the spec narrowed
-            buckets = self.apps[0].predictor.buckets
+            # compiled-shape set the spec narrowed. buckets is always a
+            # non-empty tuple here, so build_predictor never returns None
+            # (the plain engine materialises a bucketed predictor too).
             predictor = build_predictor(
-                model, self.mesh_data, self.engine, buckets=buckets
+                model, self.mesh_data, self.engine,
+                buckets=self.apps[0].predictor.buckets,
             )
-            if predictor is None:
-                from bodywork_tpu.serve.predictor import PaddedPredictor
-
-                predictor = PaddedPredictor(model, buckets)
             # warm every bucket BEFORE the swap: the first request after
             # reload must not pay the new model's compiles
             predictor.warmup()

@@ -58,6 +58,7 @@ class ServiceHandle:
     def __init__(self, app, host: str = "127.0.0.1", port: int = 5000):
         # port=0 lets the OS pick a free port (tests / concurrent pipelines)
         self._server = make_server(host, port, app, threaded=True)
+        self.app = app  # the served WSGI app (round-robin front or single)
         self.host = host
         self.port = self._server.server_port
         self._cleanups: list = []
@@ -198,10 +199,16 @@ def build_predictor(model, mesh_data: int | None = None, engine: str = "xla",
             )
         # never chosen by "auto": trading prediction precision (bf16's ~3
         # significant digits) for throughput is an explicit caller decision
-        from bodywork_tpu.serve.predictor import DEFAULT_BUCKETS
+        predictor = BF16MLPPredictor(model, buckets=buckets)
+    elif engine == "xla":
+        if buckets and not (mesh_data and mesh_data > 1):
+            # an explicit bucket list must never be silently ignored, so
+            # the plain engine materialises the bucketed default here
+            # rather than returning None and hoping the caller re-applies
+            from bodywork_tpu.serve.predictor import PaddedPredictor
 
-        predictor = BF16MLPPredictor(model, buckets or DEFAULT_BUCKETS)
-    elif engine != "xla":
+            predictor = PaddedPredictor(model, buckets)
+    else:
         raise ValueError(f"unknown serving engine {engine!r}")
     if mesh_data and mesh_data > 1:
         import jax
@@ -215,10 +222,7 @@ def build_predictor(model, mesh_data: int | None = None, engine: str = "xla",
                 f"available device(s)"
             )
         mesh = make_mesh(data=mesh_data, devices=devices[:mesh_data])
-        predictor = (
-            DataParallelPredictor(model, mesh, buckets=buckets)
-            if buckets else DataParallelPredictor(model, mesh)
-        )
+        predictor = DataParallelPredictor(model, mesh, buckets=buckets)
     return predictor
 
 

@@ -329,6 +329,46 @@ def test_serve_latest_model_watches_over_http(store):
         handle.stop()
 
 
+def test_hot_reload_under_data_parallel_serving(store):
+    """The watcher rebuilds a DATA-PARALLEL predictor on swap (mesh_data
+    threads through build_predictor), keeping the booted service's bucket
+    set — the mesh serving path must hot-reload like the single-device
+    one."""
+    import time
+
+    import requests
+
+    from bodywork_tpu.parallel.sharding import DataParallelPredictor
+    from bodywork_tpu.serve import serve_latest_model
+
+    _save_model_for_day(store, 1, slope=0.5)
+    handle = serve_latest_model(
+        store, host="127.0.0.1", port=0, block=False,
+        mesh_data=4, watch_interval_s=0.05,
+    )
+    try:
+        app = handle.app
+        assert isinstance(app.predictor, DataParallelPredictor)
+        booted_buckets = app.predictor.buckets
+        _save_model_for_day(store, 2, slope=2.0)
+        deadline = time.monotonic() + 20
+        got = None
+        while time.monotonic() < deadline:
+            body = requests.post(
+                handle.url, json={"X": 10}, timeout=10
+            ).json()
+            got = body["model_date"]
+            if got == "2026-07-02":
+                break
+            time.sleep(0.05)
+        assert got == "2026-07-02"
+        assert abs(body["prediction"] - 21.0) < 1.0  # the NEW model answers
+        assert isinstance(app.predictor, DataParallelPredictor)
+        assert app.predictor.buckets == booted_buckets
+    finally:
+        handle.stop()
+
+
 def test_hot_reload_atomic_under_concurrent_traffic(store):
     """The swap's atomicity claim under real load: several client threads
     hammer the service over HTTP while the watcher swaps in day 2's
