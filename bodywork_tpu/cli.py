@@ -81,6 +81,23 @@ def cmd_train(args) -> int:
     return 0
 
 
+def _bucket_list(raw: str) -> tuple[int, ...]:
+    """argparse type for --buckets: comma-separated POSITIVE ints (a zero
+    or negative bucket would fail warmup or 500 every request at runtime
+    — reject it at the parser with a clear message instead)."""
+    try:
+        buckets = tuple(int(b) for b in raw.split(",") if b.strip())
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"buckets must be comma-separated integers, got {raw!r}"
+        )
+    if not buckets or any(b <= 0 for b in buckets):
+        raise argparse.ArgumentTypeError(
+            f"buckets must be positive integers, got {raw!r}"
+        )
+    return buckets
+
+
 def cmd_serve(args) -> int:
     from bodywork_tpu.serve import serve_latest_model
 
@@ -92,6 +109,7 @@ def cmd_serve(args) -> int:
         mesh_data=args.mesh_data,
         engine=args.engine,
         watch_interval_s=args.reload_interval if args.reload_interval > 0 else None,
+        buckets=args.buckets,
     )
     return 0
 
@@ -341,6 +359,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="poll the store every N seconds and hot-swap newer model "
              "checkpoints into the running service (0 disables; the "
              "service then serves its boot-time model until restart)",
+    )
+    p.add_argument(
+        "--buckets", default=None, metavar="N[,N...]", type=_bucket_list,
+        help="comma-separated request-size buckets to compile and warm "
+             "(positive integers; narrows startup cost when request "
+             "sizes are known; default: each engine's own bucket set)",
     )
 
     p = add("test", cmd_test, help="test a live scoring service")

@@ -234,6 +234,7 @@ def serve_latest_model(
     mesh_data: int | None = None,
     engine: str = "xla",
     watch_interval_s: float | None = None,
+    buckets: tuple[int, ...] | None = None,
 ):
     """Load latest model -> HBM, warm up, serve (reference ``stage_2`` main).
 
@@ -251,7 +252,9 @@ def serve_latest_model(
 
     served_key, _ = store.latest(MODELS_PREFIX)
     model, model_date = load_model(store, served_key)
-    predictor = build_predictor(model, mesh_data, engine)
+    # with buckets set, build_predictor always returns a predictor (every
+    # engine honours the list), so create_app never needs the knob here
+    predictor = build_predictor(model, mesh_data, engine, buckets=buckets)
     app = create_app(model, model_date, predictor=predictor)
     handle = ServiceHandle(app, host, port)
     if watch_interval_s:

@@ -73,11 +73,18 @@ def test_serve_subcommand_over_http(tmp_path):
     assert main(["train", "--store", store]) == 0
     with serve_subprocess(
         ["-m", "bodywork_tpu.cli", "serve", "--store", store,
-         "--host", "127.0.0.1", "--port", "0"]
+         "--host", "127.0.0.1", "--port", "0", "--buckets", "1,64"]
     ) as url:
         assert requests.get(url + "/healthz", timeout=5).ok
         body = requests.post(url + "/score/v1", json={"X": 50}, timeout=5).json()
         assert "prediction" in body and "model_info" in body
+        # the bucket list reached the predictor: a 100-row request still
+        # answers (chunked through the largest compiled bucket, 64)
+        rows = [float(v) for v in range(100)]
+        batch = requests.post(
+            url + "/score/v1/batch", json={"X": rows}, timeout=10
+        ).json()
+        assert batch["n"] == 100
 
 
 def test_test_subcommand_against_live_service(tmp_path, capsys):
