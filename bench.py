@@ -1228,9 +1228,11 @@ def compact_output(records: list[dict], backend: str,
             # one config would push this line past the driver's tail and
             # recreate the parsed-as-null failure (full text is in the
             # full record)
-            k: (r[k][:160] if k == "error" else r[k])
+            k: (r[k][:160] if k in ("error", "cpu_scaled_protocol",
+                                    "timing_anomaly") else r[k])
             for k in ("config", "metric", "value", "unit", "vs_baseline",
-                      "backend", "elapsed_s", "resumed", "error")
+                      "backend", "elapsed_s", "resumed", "error",
+                      "cpu_scaled_protocol", "timing_anomaly")
             if k in r
         }
         for r in records

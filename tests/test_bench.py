@@ -245,6 +245,15 @@ def test_compact_output_fits_driver_tail():
     assert len(out["configs"][1]["error"]) <= 160
     assert len(_json.dumps(out)) < 1800
 
+    # the scaled-protocol and anomaly markers ride the compact line too
+    # (truncated), so the driver's archived tail is self-describing
+    records[5]["cpu_scaled_protocol"] = "scaled " * 60
+    records[5]["timing_anomaly"] = "impossible " * 40
+    out = bench.compact_output(records, "mixed", "bench_full.json")
+    assert len(out["configs"][5]["cpu_scaled_protocol"]) <= 160
+    assert len(out["configs"][5]["timing_anomaly"]) <= 160
+    assert len(_json.dumps(out)) < 2000
+
 
 def test_bench_wide_record_shape():
     """Config 6's record: device-isolated throughput at the explicit bf16
