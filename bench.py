@@ -377,16 +377,27 @@ def bench_batched_scoring(rows: int = 1000, requests: int = 20) -> dict:
             # the bf16 engines in the narrow regime — device-side views
             # only (the HTTP path is transport-bound and measured twice
             # above), each in its OWN guard so a bf16 compile failure
-            # cannot discard the f32 records already attached
-            from bodywork_tpu.serve.predictor import bf16_mlp_apply
+            # cannot discard the f32 records already attached. The import
+            # + dispatch construction get their own guard too: a failure
+            # there must degrade to "bf16 skipped", not bubble to the
+            # outer except and mislabel the whole engine sub-bench failed
+            try:
+                from bodywork_tpu.serve.predictor import bf16_mlp_apply
 
-            bf16_dispatches = {
-                "xla_bf16": lambda: partial(bf16_mlp_apply(),
-                                            mlp_model.params),
-                "pallas_bf16": lambda: make_pallas_mlp_apply(
-                    mlp_model.params, compute_dtype="bfloat16"
-                ),
-            }
+                bf16_dispatches = {
+                    "xla_bf16": lambda: partial(bf16_mlp_apply(),
+                                                mlp_model.params),
+                    "pallas_bf16": lambda: make_pallas_mlp_apply(
+                        mlp_model.params, compute_dtype="bfloat16"
+                    ),
+                }
+            except Exception as exc:
+                bf16_dispatches = {}
+                record["bf16_engines"] = {
+                    "error": f"{type(exc).__name__}: {exc}"
+                }
+                print(f"bench: bf16 engine setup FAILED: {exc!r}",
+                      file=sys.stderr)
             for engine, make_dispatch in bf16_dispatches.items():
                 try:
                     record[f"{engine}_engine_mlp"] = {

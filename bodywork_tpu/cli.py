@@ -98,6 +98,20 @@ def _bucket_list(raw: str) -> tuple[int, ...]:
     return buckets
 
 
+def _positive_int(raw: str) -> int:
+    """argparse type for --window: a zero window silently disables the
+    drift gate (tail(0) is empty -> never drifted) and a negative one
+    means "all but the first N" — reject both at the parser (exit 2,
+    usage error) instead of at the rule (exit 1)."""
+    try:
+        value = int(raw)
+    except ValueError:
+        raise argparse.ArgumentTypeError(f"must be an integer, got {raw!r}")
+    if value < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {raw}")
+    return value
+
+
 def cmd_serve(args) -> int:
     from bodywork_tpu.serve import serve_latest_model
 
@@ -269,14 +283,21 @@ def cmd_report(args) -> int:
         # catch-all: logged error + exit 1, never an uncaught traceback
         print(render_drift_dashboard(store, args.plot, report=report))
     verdict = detect_drift(
-        report, mape_ratio=args.mape_ratio, corr_floor=args.corr_floor
+        report, mape_ratio=args.mape_ratio, corr_floor=args.corr_floor,
+        window=args.window,
     )
     if verdict["drifted"]:
+        # stderr, not stdout: the report command's stdout contract is the
+        # report table (parseable); the verdict is operator/gate signal
+        scope = (f"last {args.window} day(s)" if args.window is not None
+                 else "all history")
         print(
             f"DRIFT: {len(verdict['flagged_dates'])}/{verdict['n_days']} "
-            f"day(s) flagged, first {verdict['first_flagged_date']} "
+            f"day(s) flagged over {scope}, first "
+            f"{verdict['first_flagged_date']} "
             f"(MAPE_live > {args.mape_ratio} x MAPE_train or corr < "
-            f"{args.corr_floor})"
+            f"{args.corr_floor})",
+            file=sys.stderr,
         )
         if args.fail_on_drift:
             return DRIFT_EXIT
@@ -435,6 +456,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--corr-floor", type=float, default=0.5,
                    help="flag a day when the live score/label correlation "
                         "falls below this (default 0.5)")
+    p.add_argument("--window", type=_positive_int, default=None, metavar="N",
+                   help="evaluate the drift rule over the last N days only "
+                        "(default: all history). Use with --fail-on-drift "
+                        "so the gate reflects CURRENT drift instead of "
+                        "latching forever on one past flagged day")
 
     p = add("deploy", cmd_deploy, help="write GKE TPU manifests")
     p.add_argument("--spec", default=None, help="pipeline spec YAML (overrides --model/--mode)")

@@ -65,6 +65,7 @@ def detect_drift(
     report: pd.DataFrame,
     mape_ratio: float = 1.5,
     corr_floor: float = 0.5,
+    window: int | None = None,
 ) -> dict:
     """Turn the longitudinal report into an actionable drift verdict.
 
@@ -84,16 +85,35 @@ def detect_drift(
       Needs only the live side: a collapsed service is evidence by
       itself, train history or not.
 
+    ``window`` restricts evaluation to the LAST ``window`` days of the
+    report. Without it a gate keyed on the verdict (CronJob/CI running
+    ``report --fail-on-drift``) latches permanently once any historical
+    day was ever flagged, even after retraining recovers; with
+    ``window=1`` the verdict is "is the service drifted *now*". ``None``
+    (default) keeps the all-time behaviour for longitudinal analysis.
+
     Returns ``{drifted, first_flagged_date, flagged_dates, n_days,
     thresholds}``. A day missing the inputs a rule needs is not flagged
     by that rule (no evidence is not drift).
     """
+    if window is not None and int(window) < 1:
+        # tail(0) would silently disable the gate (empty frame -> never
+        # drifted); negative windows mean "all but the first N" in pandas.
+        # Either way the caller asked for a range no reading of "last N
+        # days" covers — fail loud.
+        raise ValueError(f"window must be >= 1, got {window}")
+    if report is not None and not report.empty and window is not None:
+        report = report.sort_values("date").tail(int(window))
     out = {
         "drifted": False,
         "first_flagged_date": None,
         "flagged_dates": [],
         "n_days": 0 if report is None or report.empty else len(report),
-        "thresholds": {"mape_ratio": mape_ratio, "corr_floor": corr_floor},
+        "thresholds": {
+            "mape_ratio": mape_ratio,
+            "corr_floor": corr_floor,
+            "window": window,
+        },
     }
     if report is None or report.empty:
         return out

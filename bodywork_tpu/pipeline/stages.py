@@ -247,6 +247,10 @@ def serve_stage(
         watcher = CheckpointWatcher(
             apps, ctx.store, poll_interval_s=watch_interval_s,
             served_key=served_key, engine=engine,
+            # the spec's explicit narrowing must survive engine-changing
+            # swaps (the watcher only re-applies engine default buckets
+            # when the caller never narrowed them)
+            buckets=tuple(buckets) if buckets else None,
         )
         watcher.start()
         handle.add_cleanup(watcher.stop)

@@ -36,6 +36,7 @@ class CheckpointWatcher:
         mesh_data: int | None = None,
         engine: str = "xla",
         served_key: str | None = None,
+        buckets: tuple[int, ...] | None = None,
     ):
         # one watcher drives every replica app: replicas share read-only
         # model state by design, so one load+warm serves them all
@@ -44,6 +45,11 @@ class CheckpointWatcher:
         self.poll_interval_s = poll_interval_s
         self.mesh_data = mesh_data
         self.engine = engine
+        # the caller's EXPLICIT bucket narrowing (pipeline spec), if any.
+        # Distinct from the booted predictor's buckets, which may just be
+        # an engine's default policy that should not survive an
+        # engine-changing swap (see check_once).
+        self.buckets = tuple(buckets) if buckets else None
         # what the app serves now: (key, version token). ``served_key``
         # should be the key the caller actually LOADED — snapshotting
         # latest() here instead would mark a checkpoint published during
@@ -77,17 +83,40 @@ class CheckpointWatcher:
             return False
         try:
             model, model_date = load_model(self.store, key)
-            from bodywork_tpu.serve.server import build_predictor
+            from bodywork_tpu.serve.server import build_predictor, resolve_engine
 
-            # the swapped-in predictor keeps the booted service's bucket
-            # set whatever engine is active — a reload must not widen the
-            # compiled-shape set the spec narrowed. buckets is always a
-            # non-empty tuple here, so build_predictor never returns None
-            # (the plain engine materialises a bucketed predictor too).
-            predictor = build_predictor(
-                model, self.mesh_data, self.engine,
-                buckets=self.apps[0].predictor.buckets,
+            # Bucket policy for the swapped-in predictor, in priority order:
+            # 1. the caller's explicit list (a reload must not widen the
+            #    compiled-shape set the spec narrowed);
+            # 2. same resolved engine as currently served -> keep the
+            #    current bucket set (shape-set stability across swaps);
+            # 3. engine CHANGED across the swap (engine='auto' resolving
+            #    differently for the new checkpoint, e.g. narrow->wide MLP
+            #    flipping xla->pallas) -> let the new engine apply its own
+            #    default policy. Inheriting the old engine's buckets here
+            #    would e.g. hand the Pallas kernel sub-ROW_TILE buckets
+            #    that all pad to the same program — several duplicate
+            #    compiles per warmup for nothing.
+            current = self.apps[0].predictor
+            old_resolved = resolve_engine(
+                self.engine, current.model, self.mesh_data
             )
+            new_resolved = resolve_engine(self.engine, model, self.mesh_data)
+            if self.buckets is not None:
+                swap_buckets = self.buckets
+            elif new_resolved == old_resolved:
+                swap_buckets = current.buckets
+            else:
+                swap_buckets = None
+            predictor = build_predictor(
+                model, self.mesh_data, new_resolved, buckets=swap_buckets,
+            )
+            if predictor is None:
+                # plain xla engine with no bucket narrowing: the app-level
+                # default predictor (its own default bucket policy)
+                from bodywork_tpu.serve.predictor import PaddedPredictor
+
+                predictor = PaddedPredictor(model)
             # warm every bucket BEFORE the swap: the first request after
             # reload must not pay the new model's compiles
             predictor.warmup()

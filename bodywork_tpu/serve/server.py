@@ -263,6 +263,7 @@ def serve_latest_model(
         watcher = CheckpointWatcher(
             app, store, poll_interval_s=watch_interval_s,
             mesh_data=mesh_data, engine=engine, served_key=served_key,
+            buckets=buckets,
         )
         watcher.start()
         handle.add_cleanup(watcher.stop)

@@ -10,10 +10,16 @@ it). Fetching a result-derived scalar IS reliable — the transfer cannot
 complete until the producing computation has.
 
 ``fence`` therefore synchronises by ``jax.device_get`` of one scalar per
-array leaf (4 bytes + one round-trip each). Because a TPU device executes
-programs in dispatch order, fencing an output also fences everything
-queued before it on that device, so fencing a *list* of results from
-back-to-back dispatches costs one round-trip per leaf but is never wrong.
+**addressable shard** of each array leaf (4 bytes + one round-trip each).
+A fetch only proves completion on the device that owns the fetched
+element, so for sharded outputs (mesh-parallel training, data-parallel
+serving warmup) every shard is fetched — fencing element 0 alone would
+leave the other mesh devices' queues unfenced, letting device-side errors
+(e.g. HBM OOM on another shard) slip past and sharded timings
+under-measure. Because a TPU device executes programs in dispatch order,
+fencing an output also fences everything queued before it on that device,
+so fencing a *list* of results from back-to-back dispatches costs one
+round-trip per shard but is never wrong.
 """
 from __future__ import annotations
 
@@ -35,5 +41,15 @@ def fence(out):
         size = getattr(leaf, "size", None)
         if not size:  # non-arrays and empty arrays have nothing to fence
             continue
-        jax.device_get(leaf.ravel()[0])
+        # jax.Array: fetch one scalar from EVERY addressable shard — each
+        # fetch fences exactly one device's queue. numpy/other leaves have
+        # no shards; a single fetch (host data, already complete) suffices.
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            for shard in shards:
+                data = shard.data
+                if getattr(data, "size", 0):
+                    jax.device_get(data.ravel()[0])
+        else:
+            jax.device_get(leaf.ravel()[0])
     return out

@@ -25,14 +25,26 @@ def test_report_fail_on_drift_exit_code(tmp_path, capsys):
     # absurd thresholds nothing real trips -> clean exit
     assert main(["report", "--store", store, "--fail-on-drift",
                  "--mape-ratio", "1000", "--corr-floor", "-10"]) == 0
-    assert "DRIFT" not in capsys.readouterr().out
-    # a correlation floor above any achievable corr -> flagged, exit 4
+    captured = capsys.readouterr()
+    assert "DRIFT" not in captured.out + captured.err
+    # a correlation floor above any achievable corr -> flagged, exit 4.
+    # The verdict goes to stderr: stdout is the parseable report table
+    # (the stdout contract), the verdict is operator/gate signal.
     assert main(["report", "--store", store, "--fail-on-drift",
                  "--corr-floor", "2.0"]) == DRIFT_EXIT == 4
-    assert "DRIFT:" in capsys.readouterr().out
+    captured = capsys.readouterr()
+    assert "DRIFT:" in captured.err
+    assert "DRIFT:" not in captured.out
     # without --fail-on-drift the verdict prints but the exit stays 0
     assert main(["report", "--store", store, "--corr-floor", "2.0"]) == 0
-    assert "DRIFT:" in capsys.readouterr().out
+    assert "DRIFT:" in capsys.readouterr().err
+    # --window wiring: the last (live-metric) day trips the corr rule, so
+    # a 1-day window still gates; the release-after-recovery semantics is
+    # unit-tested in test_monitor.py::test_detect_drift_window_releases
+    assert main(["report", "--store", store, "--fail-on-drift",
+                 "--corr-floor", "2.0", "--window", "1",
+                 "--mape-ratio", "1000"]) == DRIFT_EXIT
+    capsys.readouterr()
 
 
 def test_run_day_smoke(tmp_path, capsys):

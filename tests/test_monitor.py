@@ -183,6 +183,42 @@ def test_detect_drift_rules_and_edges():
     assert detect_drift(None)["drifted"] is False
 
 
+def test_detect_drift_window_releases():
+    """``window=N`` evaluates only the last N days, so a gate keyed on the
+    verdict releases after retraining recovers instead of latching forever
+    on one historical flagged day (ADVICE r4)."""
+    import pandas as pd
+
+    from bodywork_tpu.monitor import detect_drift
+
+    # day 2 drifted (corr collapse); days 3-4 recovered after retraining
+    report = pd.DataFrame(
+        {
+            "date": [date(2026, 1, d) for d in (1, 2, 3, 4)],
+            "MAPE_train": [0.8, 0.8, 0.8, 0.8],
+            "MAPE_live": [0.9, 0.9, 0.9, 0.9],
+            "r_squared_live": [0.8, 0.1, 0.8, 0.8],
+        }
+    )
+    # all-time view keeps the historical record
+    assert detect_drift(report)["flagged_dates"] == ["2026-01-02"]
+    # the current-state gate: last 2 days clean -> released
+    recent = detect_drift(report, window=2)
+    assert recent["drifted"] is False
+    assert recent["n_days"] == 2
+    assert recent["thresholds"]["window"] == 2
+    # a window that still covers the bad day keeps gating
+    assert detect_drift(report, window=3)["drifted"] is True
+    # rows arriving unsorted must not change which days "last N" means
+    shuffled = report.sample(frac=1.0, random_state=0)
+    assert detect_drift(shuffled, window=2)["drifted"] is False
+    # window=0 would silently disable the gate; negative means a range no
+    # reading of "last N days" covers — both fail loud
+    for bad in (0, -2):
+        with pytest.raises(ValueError):
+            detect_drift(report, window=bad)
+
+
 def test_scoring_endpoint_normalisation():
     from bodywork_tpu.monitor import scoring_endpoint
 

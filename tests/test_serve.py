@@ -277,6 +277,65 @@ def test_checkpoint_watcher_hot_swaps_newer_model(store):
     assert watcher.check_once() is False  # steady again
 
 
+def test_checkpoint_watcher_engine_change_uses_new_default_buckets(
+    store, monkeypatch
+):
+    """When ``engine='auto'`` resolves differently for the swapped-in
+    checkpoint (e.g. narrow->wide MLP flipping xla->pallas on TPU), the
+    new engine applies its OWN default bucket policy instead of
+    inheriting the booted engine's buckets (ADVICE r4: inherited
+    sub-ROW_TILE buckets all pad to one kernel program — duplicate
+    compiles per warmup). Same-engine swaps keep the current bucket set;
+    an explicit spec list always wins. Resolution is monkeypatched (the
+    watcher resolves old-model-first, then new) because on the CPU test
+    backend 'auto' never really resolves away from xla."""
+    from bodywork_tpu.serve import CheckpointWatcher, create_app
+    from bodywork_tpu.serve import server as server_mod
+    from bodywork_tpu.serve.predictor import DEFAULT_BUCKETS
+    from bodywork_tpu.models import load_model
+
+    _save_model_for_day(store, 1, slope=0.5)
+    model, model_date = load_model(store)
+    booted_buckets = (1, 8)
+    app = create_app(model, model_date, buckets=booted_buckets, warmup=True)
+
+    # same resolved engine -> bucket set is stable across the swap
+    watcher = CheckpointWatcher(app, store, poll_interval_s=3600,
+                                engine="auto")
+    _save_model_for_day(store, 2, slope=1.0)
+    assert watcher.check_once() is True
+    assert app.predictor.buckets == booted_buckets
+
+    # engine change: old model resolves 'pallas', new resolves 'xla' ->
+    # the swap drops the inherited narrowing and lands the xla path's
+    # default bucket policy (check_once resolves old first, then new)
+    # build_predictor re-resolves the (already concrete) engine name, so
+    # the fake only consumes the iterator for 'auto' lookups
+    calls = iter(["pallas", "xla"])
+    monkeypatch.setattr(
+        server_mod, "resolve_engine",
+        lambda engine, m, mesh_data=None, platform=None:
+        next(calls) if engine == "auto" else engine,
+    )
+    _save_model_for_day(store, 3, slope=1.5)
+    assert watcher.check_once() is True
+    assert tuple(sorted(app.predictor.buckets)) == tuple(sorted(DEFAULT_BUCKETS))
+    monkeypatch.undo()
+
+    # explicit spec buckets always win, engine change or not
+    calls2 = iter(["pallas", "xla"])
+    monkeypatch.setattr(
+        server_mod, "resolve_engine",
+        lambda engine, m, mesh_data=None, platform=None:
+        next(calls2) if engine == "auto" else engine,
+    )
+    explicit = CheckpointWatcher(app, store, poll_interval_s=3600,
+                                 engine="auto", buckets=(4, 16))
+    _save_model_for_day(store, 4, slope=2.0)
+    assert explicit.check_once() is True
+    assert tuple(sorted(app.predictor.buckets)) == (4, 16)
+
+
 def test_checkpoint_watcher_survives_bad_checkpoint(store):
     """A half-written/corrupt checkpoint must not take the service down:
     the watcher logs, keeps serving the current model, and recovers when
