@@ -137,6 +137,55 @@ def bench_day_loop(model_type: str, days: int, model_kwargs=None) -> dict:
     }
 
 
+def bench_scale_proof(days: int = 90, model_type: str = "mlp",
+                      model_kwargs=None) -> dict:
+    """VERDICT r4 item 10: the 90-day horizon proof that per-day cost is
+    FLAT as history grows — the fix for the reference's O(days) hot loop
+    (one S3 round-trip per historical file, every day —
+    ``stage_1_train_model.py:68-71``) proven at 3x the demonstrated
+    horizon. Two flatness views, both over steady days (day 1 carries the
+    XLA compiles): the least-squares slope of wall-clock vs day index,
+    and the last-third/first-third mean ratio (robust to one outlier
+    day). A linear O(days) loop would show ratio ~2.3 over 90 days
+    (mean history length 75 vs 15 days); the version-token parse cache
+    (``data/io.py``) should hold it at ~1."""
+    if model_kwargs is None and model_type == "mlp":
+        model_kwargs = {"hidden": [64, 64, 64]}
+    results = _run_sim(model_type, days, model_kwargs)
+    per_day = [round(r.wall_clock_s, 4) for r in results]
+    steady = per_day[1:] if len(per_day) > 1 else per_day
+    n = len(steady)
+    xs = range(n)
+    mean_x = sum(xs) / n
+    mean_y = sum(steady) / n
+    var_x = sum((x - mean_x) ** 2 for x in xs)
+    slope = (
+        sum((x - mean_x) * (y - mean_y) for x, y in zip(xs, steady)) / var_x
+        if var_x else 0.0
+    )
+    third = max(n // 3, 1)
+    first_third = sum(steady[:third]) / third
+    last_third = sum(steady[-third:]) / third
+    return {
+        "metric": "day_wallclock_flatness",
+        # headline: growth over the whole horizon as a fraction of the
+        # mean day — ~0 is the done-criterion, ~1.3 would be the O(days)
+        # signature at this horizon
+        "value": round(slope * (n - 1) / mean_y, 4) if mean_y else None,
+        "unit": "fractional growth over horizon",
+        "days": days,
+        "model": model_type,
+        "steady_mean_s": round(mean_y, 4),
+        "slope_s_per_day": round(slope, 6),
+        "last_third_over_first_third": round(last_third / first_third, 4)
+        if first_third else None,
+        "per_day_s": per_day,
+        "vs_baseline": None,
+        "baseline_note": "flatness proof, not a speed headline; the "
+                         "reference's loop is O(days) by construction",
+    }
+
+
 def bench_single_day() -> dict:
     results = _run_sim("linear", 1)
     value = results[0].wall_clock_s
@@ -461,6 +510,37 @@ MXU_SWEEP_POINTS = (
     (8192, (2048, 2048, 2048)),
 )
 
+#: serving-regime engine-crossover sweep (VERDICT r4 item 3): hidden
+#: widths bracketing PALLAS_AUTO_MIN_WIDTH, each a 3-layer 1-feature MLP
+#: serving one bucket-padded 1k-row request — the exact regime
+#: ``resolve_engine("auto")`` decides in
+SERVE_CROSSOVER_WIDTHS = (64, 128, 256, 512, 1024)
+
+
+def serve_crossover_width(points: list) -> int | None:
+    """The measured Pallas/XLA crossover: the smallest width from which
+    the fused kernel's pipelined batch latency beats the XLA apply at
+    EVERY larger measured width (a monotone winning suffix — one noisy
+    mid-sweep win must not set the auto-engine cut). ``None`` when the
+    kernel never sustains a win. Shared with the test that pins
+    ``PALLAS_AUTO_MIN_WIDTH`` to the committed capture."""
+    valid = []
+    for p in points:
+        if "error" in p:
+            continue
+        x = p.get("xla", {}).get("device_pipelined_s")
+        k = p.get("pallas", {}).get("device_pipelined_s")
+        if x and k and x > 0 and k > 0:
+            valid.append((p["width"], x, k))
+    valid.sort()
+    crossover = None
+    for w, x, k in reversed(valid):
+        if k < x:
+            crossover = w
+        else:
+            break
+    return crossover
+
 
 def bench_wide(
     steps: int | None = None,
@@ -473,6 +553,9 @@ def bench_wide(
     sweep_points: tuple = MXU_SWEEP_POINTS,
     sweep_steps: int = 100,
     force_sweep: bool = False,
+    crossover_widths: tuple = SERVE_CROSSOVER_WIDTHS,
+    crossover_batch: int = 1024,
+    force_crossover: bool = False,
 ) -> dict:
     """Config 6: the wide MLP through (a) single-device training throughput
     at an explicit bf16 mixed-precision policy (with an f32 comparison
@@ -821,6 +904,73 @@ def bench_wide(
         record["serve_fastest_engine"] = best_engine
     else:
         record["serve_rows_per_s"] = None
+
+    # the serving-regime engine-crossover sweep (VERDICT r4 item 3): the
+    # auto-engine cut PALLAS_AUTO_MIN_WIDTH previously interpolated
+    # between two data points (width 64 and 1024); this measures every
+    # bracketing width in the regime the cut actually decides in —
+    # 1-feature 3-layer MLP, one bucket-padded 1k-row request — so the
+    # constant can be pinned to a recorded crossover. Params are
+    # He-initialised, not trained: batch latency depends on shapes, not
+    # weight values, and skipping 5 train-program compiles keeps the
+    # sweep inside the config budget.
+    if (on_tpu or force_crossover) and crossover_widths:
+        rng_c = np.random.default_rng(11)
+        Xreq = rng_c.uniform(0.0, 100.0, (crossover_batch, 1)).astype(
+            np.float32
+        )
+        identity_scaler = {
+            "x_mean": jnp.zeros((1,), jnp.float32),
+            "x_std": jnp.ones((1,), jnp.float32),
+            "y_mean": jnp.asarray(0.0, jnp.float32),
+            "y_std": jnp.asarray(1.0, jnp.float32),
+        }
+        cpts = []
+        for wdt in crossover_widths:
+            try:
+                net_c = jax.jit(init_mlp_params, static_argnums=(1,))(
+                    jax.random.PRNGKey(wdt), (1, wdt, wdt, wdt, 1)
+                )
+                m_c = MLPRegressor(
+                    MLPConfig(hidden=(wdt, wdt, wdt)),
+                    jax.device_put(
+                        {"net": net_c, "scaler": identity_scaler}
+                    ),
+                )
+                xla_view = time_device_batch(
+                    partial(jax.jit(type(m_c).apply), m_c.params), Xreq,
+                    iters=serve_iters, repeats=serve_repeats,
+                    sync_overhead_s=sync_overhead_s,
+                )
+                pal_view = time_device_batch(
+                    make_pallas_mlp_apply(m_c.params, interpret=not on_tpu),
+                    Xreq,
+                    iters=serve_iters, repeats=serve_repeats,
+                    sync_overhead_s=sync_overhead_s,
+                )
+                cpts.append(
+                    {"width": wdt, "xla": xla_view, "pallas": pal_view}
+                )
+            except Exception as exc:  # one width must not void the sweep
+                cpts.append(
+                    {"width": wdt, "error": f"{type(exc).__name__}: {exc}"}
+                )
+                print(f"bench: crossover width {wdt} FAILED: {exc!r}",
+                      file=sys.stderr)
+        record["serve_crossover"] = {
+            "batch": crossover_batch,
+            "points": cpts,
+            "crossover_width": serve_crossover_width(cpts),
+            "note": "pipelined per-batch device latency, XLA apply vs "
+                    "fused Pallas kernel, per hidden width; "
+                    "crossover_width = smallest width with a monotone "
+                    "Pallas winning suffix — the measured source for "
+                    "serve.server.PALLAS_AUTO_MIN_WIDTH",
+        }
+    else:
+        record["serve_crossover"] = {
+            "skipped": "non-tpu backend" if not on_tpu else "disabled"
+        }
     _finalize_wide_anomalies(record)
     record["unit"] = "s/step"
     record["vs_baseline"] = None
@@ -1405,6 +1555,11 @@ def main() -> int:
              "flaky relay across the whole run",
     )
     parser.add_argument(
+        "--scale-proof", type=int, default=None, metavar="DAYS",
+        help="run the day-loop flatness proof at this horizon (e.g. 90) "
+             "instead of the 6-config capture; writes to --json-out",
+    )
+    parser.add_argument(
         "--diff", nargs=2, metavar=("A.json", "B.json"), default=None,
         help="compare two capture files per-config (no benching): "
              "value A -> B, speedup, backend changes",
@@ -1414,6 +1569,29 @@ def main() -> int:
     if args.diff:
         for line in diff_captures(*args.diff):
             print(line)
+        return 0
+
+    if args.scale_proof:
+        # the 90-day flatness proof (VERDICT r4 item 10) — separate from
+        # the 6-config capture so it never eats the config budget. Probe
+        # the relay first: a wedge must degrade to a CPU-structural
+        # record, not a hang (env must change BEFORE jax imports — this
+        # process has not imported jax yet).
+        if args.backend_timeout > 0 and not probe_backend(args.backend_timeout):
+            print("bench: relay down; scale proof on CPU (structural)",
+                  file=sys.stderr)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            os.environ["PALLAS_AXON_POOL_IPS"] = ""
+        record = bench_scale_proof(args.scale_proof)
+        import jax
+
+        record["backend"] = jax.devices()[0].platform
+        out_line = json.dumps(record)
+        if args.json_out:
+            from pathlib import Path
+
+            Path(args.json_out).write_text(json.dumps(record, indent=1))
+        print(out_line)
         return 0
 
     if args.config is not None:

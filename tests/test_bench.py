@@ -284,6 +284,7 @@ def test_bench_wide_record_shape():
     assert dev["device_pipelined_s"] == min(dev["device_pipelined_passes"])
     assert "skipped" in record["serve_pallas"]  # interpreter off-TPU
     assert "skipped" in record["mxu_sweep"]  # TPU-only scaling curve
+    assert "skipped" in record["serve_crossover"]  # TPU-only crossover
     assert record["serve_xla_bf16"]["device_sync_s"] > 0
     assert record["serve_rows_per_s"] > 0
     assert record["serve_fastest_engine"] in ("xla", "xla-bf16")
@@ -307,6 +308,117 @@ def test_bench_wide_mxu_sweep_loop():
         assert p["compute_dtype"] == "bfloat16"
     # batch threads through to each point's record (not the flagship's)
     assert pts[0]["batch"] == 64 and pts[1]["batch"] == 128
+
+
+def test_bench_scale_proof_record_shape():
+    """The flatness-proof record (tiny horizon, linear model on CPU):
+    per-day series, steady-day slope, third-ratio, and a headline that is
+    fractional growth — so the 90-day TPU run is not this code's first
+    execution."""
+    record = bench.bench_scale_proof(days=4, model_type="linear")
+    assert record["metric"] == "day_wallclock_flatness"
+    assert record["days"] == 4
+    assert len(record["per_day_s"]) == 4
+    assert all(d > 0 for d in record["per_day_s"])
+    assert record["steady_mean_s"] > 0
+    assert record["value"] is not None
+    assert record["last_third_over_first_third"] > 0
+    assert record["vs_baseline"] is None and "baseline_note" in record
+
+
+def test_serve_crossover_width_monotone_suffix():
+    """The derived crossover is the smallest width with a MONOTONE Pallas
+    winning suffix: one noisy mid-sweep win must not set the auto-engine
+    cut, error points are skipped, and a kernel that never sustains a win
+    yields None."""
+    def pt(w, xla_s, pal_s):
+        return {"width": w, "xla": {"device_pipelined_s": xla_s},
+                "pallas": {"device_pipelined_s": pal_s}}
+
+    # clean crossover at 256
+    pts = [pt(64, 1.0, 2.0), pt(128, 1.0, 1.5), pt(256, 1.0, 0.8),
+           pt(512, 1.0, 0.6), pt(1024, 1.0, 0.4)]
+    assert bench.serve_crossover_width(pts) == 256
+    # a noisy win at 128 that does NOT hold at 256 is ignored
+    noisy = [pt(64, 1.0, 2.0), pt(128, 1.0, 0.9), pt(256, 1.0, 1.1),
+             pt(512, 1.0, 0.6), pt(1024, 1.0, 0.4)]
+    assert bench.serve_crossover_width(noisy) == 512
+    # kernel wins everywhere -> the smallest measured width
+    assert bench.serve_crossover_width(
+        [pt(64, 1.0, 0.5), pt(128, 1.0, 0.5)]) == 64
+    # kernel never wins -> None
+    assert bench.serve_crossover_width(
+        [pt(64, 1.0, 2.0), pt(1024, 1.0, 1.5)]) is None
+    # error / degenerate points are skipped, order does not matter
+    mixed = [pt(1024, 1.0, 0.4), {"width": 512, "error": "OOM"},
+             pt(64, 1.0, 2.0), pt(256, 0.0, 0.0)]
+    assert bench.serve_crossover_width(mixed) == 1024
+    assert bench.serve_crossover_width([]) is None
+
+
+def test_bench_wide_serve_crossover_loop():
+    """The crossover sweep loop (force-driven on CPU, interpreter kernel,
+    one tiny width): per-width xla/pallas views share time_device_batch's
+    record shape and the derived crossover lands in the record — so the
+    TPU capture is not the first time this code runs."""
+    record = bench.bench_wide(
+        steps=2, serve_iters=1, serve_repeats=1,
+        mfu_steps=2, mfu_groups=1, mfu_runs_per_group=1, include_f32=False,
+        sweep_points=(), crossover_widths=(8,), crossover_batch=64,
+        force_crossover=True,
+    )
+    cx = record["serve_crossover"]
+    assert cx["batch"] == 64
+    (p,) = cx["points"]
+    assert p["width"] == 8 and "error" not in p
+    assert p["xla"]["device_pipelined_s"] > 0
+    assert p["pallas"]["device_pipelined_s"] > 0
+    assert cx["crossover_width"] in (8, None)
+
+
+def test_pallas_auto_min_width_pinned_to_capture():
+    """VERDICT r4 item 3 done-criterion: PALLAS_AUTO_MIN_WIDTH is pinned
+    to the measured crossover in the committed TPU capture, not an
+    interpolation. Skips until a capture with a TPU serve_crossover
+    record exists; once one is committed, the constant must match it."""
+    import json
+    from pathlib import Path
+
+    import pytest
+
+    from bodywork_tpu.serve.server import PALLAS_AUTO_MIN_WIDTH
+
+    root = Path(__file__).resolve().parent.parent
+    capture = None
+    for name in ("BENCH_DEV_r05.json", "BENCH_r05.json"):
+        path = root / name
+        if not path.exists():
+            continue
+        data = json.loads(path.read_text())
+        for cfg_rec in data.get("configs", []):
+            if (cfg_rec.get("config") == 6
+                    and cfg_rec.get("backend") == "tpu"
+                    and "points" in cfg_rec.get("serve_crossover", {})):
+                capture = cfg_rec
+                break
+        if capture:
+            break
+    if capture is None:
+        pytest.skip("no committed TPU capture with a serve_crossover "
+                    "record yet (relay-gated)")
+    points = capture["serve_crossover"]["points"]
+    measured = bench.serve_crossover_width(points)
+    widths = [p["width"] for p in points if "error" not in p]
+    if measured is None:
+        # kernel never sustained a win: the cut must sit above every
+        # measured width so auto never picks the loser
+        assert PALLAS_AUTO_MIN_WIDTH > max(widths)
+    else:
+        assert PALLAS_AUTO_MIN_WIDTH == measured, (
+            f"PALLAS_AUTO_MIN_WIDTH={PALLAS_AUTO_MIN_WIDTH} but the "
+            f"committed capture's crossover is {measured} — update the "
+            "constant (serve/server.py) to cite the record"
+        )
 
 
 def test_bench_wide_anomaly_hoists_and_blocks_resume(monkeypatch, tmp_path):
