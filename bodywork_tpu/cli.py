@@ -284,19 +284,24 @@ def cmd_report(args) -> int:
         print(render_drift_dashboard(store, args.plot, report=report))
     verdict = detect_drift(
         report, mape_ratio=args.mape_ratio, corr_floor=args.corr_floor,
-        window=args.window,
+        window=args.window, bias_z=args.bias_z,
+        bias_window=args.bias_window, bias_baseline=args.bias_baseline,
     )
     if verdict["drifted"]:
         # stderr, not stdout: the report command's stdout contract is the
         # report table (parseable); the verdict is operator/gate signal
         scope = (f"last {args.window} day(s)" if args.window is not None
                  else "all history")
+        rules = (
+            f"bias |z| > {args.bias_z} over {args.bias_window}d or "
+            f"corr < {args.corr_floor}"
+        )
+        if args.mape_ratio is not None:
+            rules += f" or MAPE_live > {args.mape_ratio} x MAPE_train"
         print(
             f"DRIFT: {len(verdict['flagged_dates'])}/{verdict['n_days']} "
             f"day(s) flagged over {scope}, first "
-            f"{verdict['first_flagged_date']} "
-            f"(MAPE_live > {args.mape_ratio} x MAPE_train or corr < "
-            f"{args.corr_floor})",
+            f"{verdict['first_flagged_date']} ({rules})",
             file=sys.stderr,
         )
         if args.fail_on_drift:
@@ -450,12 +455,35 @@ def build_parser() -> argparse.ArgumentParser:
                         "a CronJob/CI gate react to drift instead of an "
                         "analyst eyeballing the table (4 is unambiguous: "
                         "1=error, 2=usage, 3=backend unreachable)")
-    p.add_argument("--mape-ratio", type=float, default=1.5,
-                   help="flag a day when MAPE_live exceeds this multiple "
-                        "of MAPE_train (default 1.5)")
+    p.add_argument("--mape-ratio", type=float, default=None,
+                   help="OPT-IN: flag a day when MAPE_live exceeds this "
+                        "multiple of MAPE_train. Disabled by default — "
+                        "calibration against the generator showed the "
+                        "day-level mean-APE ratio has an unbounded "
+                        "false-positive rate when labels touch zero (one "
+                        "tiny label made a no-drift day 156x its train "
+                        "MAPE). Use only for label distributions bounded "
+                        "away from zero; the calibrated drift detector "
+                        "is the bias rule")
     p.add_argument("--corr-floor", type=float, default=0.5,
                    help="flag a day when the live score/label correlation "
                         "falls below this (default 0.5)")
+    p.add_argument("--bias-z", type=float, default=4.0,
+                   help="flag a day when the trailing-window live "
+                        "residual-mean statistic exceeds this many "
+                        "standard errors (default 4.0; the calibrated "
+                        "drift detector — see monitor.detect_drift)")
+    p.add_argument("--bias-window", type=_positive_int, default=7,
+                   metavar="N",
+                   help="trailing days accumulated by the bias rule "
+                        "(default 7: one week clears z=4 at the "
+                        "generator's own drift amplitude)")
+    p.add_argument("--bias-baseline", type=_positive_int, default=14,
+                   metavar="N",
+                   help="first N days of the report used as the bias "
+                        "rule's deployment-time yardstick (default 14; "
+                        "a frozen model's constant estimation error "
+                        "cancels against it, so only CHANGE flags)")
     p.add_argument("--window", type=_positive_int, default=None, metavar="N",
                    help="evaluate the drift rule over the last N days only "
                         "(default: all history). Use with --fail-on-drift "

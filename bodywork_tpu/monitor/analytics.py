@@ -63,9 +63,12 @@ def drift_report(store: ArtefactStore) -> pd.DataFrame:
 
 def detect_drift(
     report: pd.DataFrame,
-    mape_ratio: float = 1.5,
+    mape_ratio: float | None = None,
     corr_floor: float = 0.5,
     window: int | None = None,
+    bias_z: float = 4.0,
+    bias_window: int = 7,
+    bias_baseline: int = 14,
 ) -> dict:
     """Turn the longitudinal report into an actionable drift verdict.
 
@@ -73,24 +76,58 @@ def detect_drift(
     joined tables — ``model-performance-analytics.ipynb`` cells 7-8);
     this adds the decision rule so the pipeline itself can react (the
     CLI's ``report --fail-on-drift`` exit code feeds a k8s CronJob or CI
-    gate). A day is flagged when either:
+    gate). Three rules, each calibrated against the generator's own
+    alpha-sinusoid (``tests/test_monitor.py::test_detect_drift_calibrated
+    _against_generator_sinusoid``):
 
-    - ``MAPE_live > mape_ratio * MAPE_train`` — the live error has pulled
-      away from what the model showed at train time (the drift signature:
-      trained through yesterday, scored on today). Needs BOTH sides of
-      the join; a perfect train fit (``MAPE_train == 0``) with any
-      positive live MAPE flags (the ratio is infinite), or
-    - ``r_squared_live < corr_floor`` — the score/label correlation (the
-      reference's "r_squared", ``stage_4:103``) has collapsed outright.
+    - **Bias rule (the calibrated drift detector).** A CHANGE detector
+      on the live residual mean: the trailing ``bias_window``-day pooled
+      ``mean_error_live`` is compared against the report's FIRST
+      ``bias_baseline`` days (the deployment-time yardstick), in
+      combined standard errors (per-day SE = ``error_std_live /
+      sqrt(n_scored_live)``); a day is flagged when |z| exceeds
+      ``bias_z``. Baseline-relative is the load-bearing choice: a
+      frozen model carries a persistent estimation-error bias (~N(0,
+      intercept-SE) of its own fit) that an absolute rule eventually
+      flags on any threshold — calibration showed exactly that (one
+      no-drift seed in five crossed even |z|>5.5 absolute). Against the
+      baseline that constant cancels, leaving only what CHANGED since
+      deployment. Calibration on the generator (sigma=10, ~1300
+      rows/day, the reference's own +/-0.5 intercept swing = a ~1.8
+      SE/day signal at its extremes): baseline 14 days, trailing week,
+      z=4 gives ZERO false positives on flat-alpha controls over 5x60
+      seed-days while every drift seed fires within ~10 days of the
+      swing's extreme
+      (``test_detect_drift_calibrated_against_generator_sinusoid``).
+      The baseline days themselves cannot flag by construction. Needs
+      the bias-channel columns
+      (``monitor.tester.compute_test_metrics``); reports without them
+      simply skip this rule.
+    - ``MAPE_live > mape_ratio * MAPE_train`` — OPT-IN only
+      (``mape_ratio=None`` default disables it). Calibration against
+      the reference's own generator showed this statistic has an
+      UNBOUNDED false-positive rate there: APE divides by the label
+      (``stage_4:90``) and the ``y >= 0`` filter (``stage_3:43``)
+      admits labels arbitrarily close to zero, so a single tiny label
+      can make one no-drift day's mean APE 156x the train MAPE while a
+      genuinely drifted day sits at 0.6x. No fixed ratio separates
+      those. Set a ratio explicitly only for label distributions
+      bounded away from zero. When enabled, a perfect train fit
+      (``MAPE_train == 0``) with any positive live MAPE flags
+      (infinite ratio).
+    - ``r_squared_live < corr_floor`` — score/label correlation (the
+      reference's "r_squared", ``stage_4:103``) collapsed outright.
       Needs only the live side: a collapsed service is evidence by
       itself, train history or not.
 
-    ``window`` restricts evaluation to the LAST ``window`` days of the
-    report. Without it a gate keyed on the verdict (CronJob/CI running
+    ``window`` restricts the VERDICT to the last ``window`` days.
+    Without it a gate keyed on the verdict (CronJob/CI running
     ``report --fail-on-drift``) latches permanently once any historical
     day was ever flagged, even after retraining recovers; with
-    ``window=1`` the verdict is "is the service drifted *now*". ``None``
-    (default) keeps the all-time behaviour for longitudinal analysis.
+    ``window=1`` the verdict is "is the service drifted *now*". The
+    bias rule's trailing windows are computed over the FULL report
+    before the verdict window is applied, so gating on recent days
+    never weakens the accumulated evidence behind them.
 
     Returns ``{drifted, first_flagged_date, flagged_dates, n_days,
     thresholds}``. A day missing the inputs a rule needs is not flagged
@@ -102,28 +139,72 @@ def detect_drift(
         # Either way the caller asked for a range no reading of "last N
         # days" covers — fail loud.
         raise ValueError(f"window must be >= 1, got {window}")
-    if report is not None and not report.empty and window is not None:
-        report = report.sort_values("date").tail(int(window))
     out = {
         "drifted": False,
         "first_flagged_date": None,
         "flagged_dates": [],
-        "n_days": 0 if report is None or report.empty else len(report),
+        "n_days": 0,
         "thresholds": {
             "mape_ratio": mape_ratio,
             "corr_floor": corr_floor,
             "window": window,
+            "bias_z": bias_z,
+            "bias_window": bias_window,
+            "bias_baseline": bias_baseline,
         },
     }
     if report is None or report.empty:
         return out
+    full = report.sort_values("date")
+
+    # bias rule, over the full history (see docstring): trailing-window
+    # pooled residual mean vs the deployment-time baseline (the first
+    # bias_window days), in combined standard errors. Persistent model
+    # miscalibration cancels; only change since deployment flags.
+    import numpy as np
+
+    bias_hit = pd.Series(False, index=full.index)
+    needed = {"mean_error_live", "error_std_live", "n_scored_live"}
+    if needed <= set(full.columns):
+        se2 = (
+            full["error_std_live"]
+            / np.sqrt(full["n_scored_live"].clip(lower=1))
+        ) ** 2
+        me = full["mean_error_live"].where(
+            np.isfinite(full["mean_error_live"]) & np.isfinite(se2)
+        )
+        se2 = se2.where(me.notna())
+        valid = me.notna()
+        base_idx = full.index[valid][: int(bias_baseline)]
+        if len(base_idx) > 0:
+            base_mean = float(me.loc[base_idx].mean())
+            # SE of the baseline mean-of-day-means
+            base_var = float(se2.loc[base_idx].mean()) / len(base_idx)
+            cnt = valid.astype(float).rolling(
+                bias_window, min_periods=1
+            ).sum()
+            trail_mean = me.fillna(0.0).rolling(
+                bias_window, min_periods=1
+            ).sum() / cnt.clip(lower=1.0)
+            trail_var = (
+                se2.fillna(0.0).rolling(bias_window, min_periods=1).sum()
+                / cnt.clip(lower=1.0) ** 2
+            )
+            z = (trail_mean - base_mean) / np.sqrt(trail_var + base_var)
+            bias_hit = (z.abs() > bias_z) & (cnt > 0) & valid
+            # the baseline days are the yardstick, not evidence
+            bias_hit.loc[base_idx] = False
+
+    evaluated = full.tail(int(window)) if window is not None else full
+    out["n_days"] = len(evaluated)
     flagged = []
-    for _, row in report.iterrows():
+    for idx, row in evaluated.iterrows():
         mape_t = row.get("MAPE_train")
         mape_l = row.get("MAPE_live")
         corr_l = row.get("r_squared_live")
-        hit = False
-        if pd.notna(mape_t) and pd.notna(mape_l):
+        hit = bool(bias_hit.loc[idx])
+        if (not hit and mape_ratio is not None
+                and pd.notna(mape_t) and pd.notna(mape_l)):
             # mape_t == 0 (perfect train fit): any positive live error is
             # an infinite ratio — textbook drift, not a skipped rule
             hit = (mape_l > mape_ratio * mape_t) if mape_t > 0 else mape_l > 0

@@ -173,15 +173,33 @@ def score_dataset(
 
 def compute_test_metrics(results: pd.DataFrame, results_date: date) -> pd.DataFrame:
     """One-row metrics record; columns extend the reference schema
-    (``stage_4:101-113``) with an explicit ``n_failures`` count."""
+    (``stage_4:101-113``) with an explicit ``n_failures`` count and a
+    BIAS CHANNEL (``mean_error``, ``error_std``, ``n_scored``).
+
+    Why the bias channel: calibrating the drift verdict against the
+    generator's own sinusoid (``tests/test_monitor.py``) showed the
+    reference's MAPE cannot see the reference's drift — mean APE divides
+    by the label (``stage_4:90``), so a handful of near-zero labels
+    dominate the day's mean and the statistic is day-to-day tail noise
+    (flat-alpha control days exceed 8x their train-time MAPE with no
+    drift at all), while the +/-0.5 intercept swing moves it by well
+    under its own noise floor. The signed residual mean has none of
+    that: per-day SE = error_std/sqrt(n_scored) ~ 0.28 at the
+    generator's sigma=10, n~1300, so the 0.5-amplitude swing is a ~1.8
+    SE/day signal a windowed rule accumulates reliably
+    (``analytics.detect_drift``'s bias rule)."""
     ok = results[results["ok"]]
     n_failures = int((~results["ok"]).sum())
     if len(ok) == 0:
         mape = r_squared = max_residual = float("nan")
+        mean_error = error_std = float("nan")
     else:
         mape = float(ok["APE"].mean())
         r_squared = float(ok["score"].corr(ok["label"]))
         max_residual = float(ok["APE"].max())
+        err = ok["score"] - ok["label"]
+        mean_error = float(err.mean())
+        error_std = float(err.std(ddof=1)) if len(ok) > 1 else float("nan")
     mean_response_time = float(results["response_time"].mean())
     return pd.DataFrame(
         {
@@ -191,6 +209,9 @@ def compute_test_metrics(results: pd.DataFrame, results_date: date) -> pd.DataFr
             "max_residual": [max_residual],
             "mean_response_time": [mean_response_time],
             "n_failures": [n_failures],
+            "mean_error": [mean_error],
+            "error_std": [error_std],
+            "n_scored": [len(ok)],
         }
     )
 
