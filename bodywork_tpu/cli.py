@@ -113,6 +113,30 @@ def _positive_int(raw: str) -> int:
 
 
 def cmd_serve(args) -> int:
+    watch = args.reload_interval if args.reload_interval > 0 else None
+    if args.workers and args.workers > 1:
+        # real OS-process replicas on one SO_REUSEPORT port (the local
+        # materialisation of the reference's `replicas: 2` Deployment);
+        # single-device engines only — each worker owns its own params
+        if args.mesh_data and args.mesh_data > 1:
+            log.error("--workers is per-process serving; drop --mesh-data")
+            return 1
+        from bodywork_tpu.serve import MultiProcessService
+
+        import time
+
+        svc = MultiProcessService(
+            args.store, host=args.host, port=args.port,
+            workers=args.workers, engine=args.engine,
+            watch_interval_s=watch,
+        ).start()
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            return 0
+        finally:
+            svc.stop()
     from bodywork_tpu.serve import serve_latest_model
 
     serve_latest_model(
@@ -122,7 +146,7 @@ def cmd_serve(args) -> int:
         block=True,
         mesh_data=args.mesh_data,
         engine=args.engine,
-        watch_interval_s=args.reload_interval if args.reload_interval > 0 else None,
+        watch_interval_s=watch,
         buckets=args.buckets,
     )
     return 0
@@ -385,6 +409,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="poll the store every N seconds and hot-swap newer model "
              "checkpoints into the running service (0 disables; the "
              "service then serves its boot-time model until restart)",
+    )
+    p.add_argument(
+        "--workers", type=int, default=1, metavar="N",
+        help="serve through N OS-process replicas sharing this port via "
+             "SO_REUSEPORT, supervised and respawned on death — the real "
+             "local analogue of the reference's `replicas: 2` Deployment "
+             "(default 1: single process, in-process serving)",
     )
     p.add_argument(
         "--buckets", default=None, metavar="N[,N...]", type=_bucket_list,

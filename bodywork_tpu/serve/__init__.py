@@ -1,5 +1,6 @@
 from bodywork_tpu.serve.predictor import BF16MLPPredictor, PaddedPredictor
 from bodywork_tpu.serve.app import create_app
+from bodywork_tpu.serve.multiproc import MultiProcessService
 from bodywork_tpu.serve.reload import CheckpointWatcher
 from bodywork_tpu.serve.server import (
     RoundRobinApp,
@@ -12,6 +13,7 @@ from bodywork_tpu.serve.server import (
 __all__ = [
     "BF16MLPPredictor",
     "CheckpointWatcher",
+    "MultiProcessService",
     "PaddedPredictor",
     "RoundRobinApp",
     "build_predictor",

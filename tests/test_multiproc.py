@@ -1,0 +1,110 @@
+"""Real multi-process serving replicas (VERDICT r4 item 6).
+
+The reference's service is 2 independent OS processes behind a k8s
+Service (``bodywork.yaml:40-42``); ``serve.multiproc`` materialises that
+locally with SO_REUSEPORT workers. These tests prove the properties the
+in-process round-robin front could only simulate: genuine process
+isolation (a SIGKILLed replica takes no one with it), kernel
+load-balancing across listeners, and supervised respawn.
+
+Workers are SPAWNED JAX processes (~several seconds each to import and
+warm), so the whole file shares one service via a module fixture.
+"""
+import os
+import time
+from datetime import date
+
+import numpy as np
+import pytest
+import requests
+from requests.adapters import HTTPAdapter, Retry
+
+from bodywork_tpu.models import LinearRegressor
+from bodywork_tpu.models.checkpoint import save_model
+from bodywork_tpu.store import FilesystemStore
+
+
+@pytest.fixture(scope="module")
+def mp_service(tmp_path_factory):
+    from bodywork_tpu.serve import MultiProcessService
+
+    root = tmp_path_factory.mktemp("mp-store")
+    store = FilesystemStore(root)
+    rng = np.random.default_rng(3)
+    X = rng.uniform(0, 100, 500).astype(np.float32)
+    y = (1.0 + 0.5 * X).astype(np.float32)
+    save_model(store, LinearRegressor().fit(X, y), date(2026, 7, 1))
+
+    # the spawned workers re-run sitecustomize: the kernel-side guard
+    # keeps them hermetic whatever the relay is doing (same guard as the
+    # notebook kernels)
+    saved = {k: os.environ.get(k)
+             for k in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")}
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    svc = MultiProcessService(str(root), workers=2, engine="xla").start()
+    try:
+        yield svc
+    finally:
+        svc.stop()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _session() -> requests.Session:
+    """Client with connection retries — the same resilience the tester's
+    HttpScoringClient carries (reference ``stage_4:73-74``): a connection
+    that lands on a just-killed listener is retried, not failed."""
+    s = requests.Session()
+    retry = Retry(total=5, connect=5, read=0, backoff_factor=0.05,
+                  allowed_methods=None)
+    s.mount("http://", HTTPAdapter(max_retries=retry))
+    return s
+
+
+def test_two_real_processes_serve_one_port(mp_service):
+    pids = mp_service.worker_pids
+    assert len(pids) == 2
+    assert len(set(pids)) == 2
+    assert all(pid != os.getpid() for pid in pids)  # real OS processes
+    s = _session()
+    r = s.post(mp_service.url, json={"X": 50}, timeout=30)
+    assert r.ok
+    assert abs(r.json()["prediction"] - 26.0) < 2.0
+
+
+def test_kill_one_worker_mid_traffic_zero_failed_scores(mp_service):
+    """The done-criterion: SIGKILL one replica while traffic flows and
+    observe zero failed scores — the surviving listener takes every new
+    connection (kernel removes the dead socket from the REUSEPORT set)
+    and the connect-retry absorbs the kill race."""
+    s = _session()
+    victim = mp_service.worker_pids[0]
+    answers = []
+    for i in range(40):
+        if i == 10:
+            mp_service.kill_worker(victim)
+        r = s.post(mp_service.url, json={"X": 10}, timeout=30)
+        answers.append(r.ok)
+    assert all(answers), f"failed scores at {[i for i, a in enumerate(answers) if not a]}"
+    assert victim not in mp_service.worker_pids
+
+
+def test_supervisor_respawns_killed_worker(mp_service):
+    """Replica recovery: the supervisor restores the declared replica
+    count after a kill (the Deployment-restarts-pod analogue)."""
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if len(mp_service.worker_pids) == 2:
+            break
+        time.sleep(0.5)
+    assert len(mp_service.worker_pids) == 2
+    # and the respawned replica actually serves
+    s = _session()
+    assert all(
+        s.post(mp_service.url, json={"X": 5}, timeout=30).ok
+        for _ in range(8)
+    )
