@@ -430,10 +430,22 @@ def validate_manifest(doc: dict, origin: str = "<manifest>") -> list[str]:
 
 def validate_manifests(docs: dict[str, dict]) -> None:
     """Validate every generated manifest; raise :class:`ManifestError`
-    listing ALL problems (not just the first) on any failure."""
+    listing ALL problems (not just the first) on any failure.
+
+    Two independent layers run on every emit: this module's fast
+    whitelist (unknown-field typo class) AND the vendored upstream-API
+    schemas (``pipeline.k8s_schema`` — types, required fields, enums,
+    and the cross-field rules the API server enforces). The second layer
+    exists because the whitelist shares its author's mental model with
+    the generator; the schemas are transcribed from the Kubernetes API
+    types instead (VERDICT r4 item 7)."""
+    from bodywork_tpu.pipeline.k8s_schema import validate_against_k8s_schema
+
     errors: list[str] = []
     for filename, doc in docs.items():
         errors.extend(validate_manifest(doc, filename))
+        if isinstance(doc, dict):
+            errors.extend(validate_against_k8s_schema(doc, filename))
     if errors:
         raise ManifestError(
             "invalid generated manifests:\n  " + "\n  ".join(errors)
