@@ -90,6 +90,10 @@ class LocalRunner:
 
     # -- single stages -----------------------------------------------------
     def _run_batch_stage(self, stage: StageSpec, ctx: StageContext):
+        import dataclasses as _dc
+
+        from bodywork_tpu.store.epoch import EpochGuardedStore
+
         fn = resolve_executable(stage.executable)
         last_exc: BaseException | None = None
         for attempt in range(1 + stage.retries):
@@ -100,11 +104,18 @@ class LocalRunner:
             # activeDeadlineSeconds — and cannot block interpreter exit via
             # concurrent.futures' atexit join.
             box: dict[str, object] = {}
+            # each ATTEMPT writes through its own store epoch: when the
+            # runner abandons a timed-out worker below, revoking the
+            # epoch guarantees the zombie thread's late writes never land
+            # in the shared store (k8s kills the pod; in-process this is
+            # the equivalent fence)
+            epoch = EpochGuardedStore(ctx.store, label=stage.name)
+            attempt_ctx = _dc.replace(ctx, store=epoch)
 
-            def _target():
+            def _target(attempt_ctx=attempt_ctx):
                 try:
                     with _device_ctx(self.device):
-                        box["result"] = fn(ctx, **stage.args)
+                        box["result"] = fn(attempt_ctx, **stage.args)
                 except BaseException as exc:  # noqa: BLE001 — reported below
                     box["exc"] = exc
 
@@ -116,8 +127,10 @@ class LocalRunner:
             if worker.is_alive():
                 # A timed-out worker cannot be killed and may still be
                 # writing to the shared store; retrying alongside it would
-                # run two attempts concurrently. Fail the stage immediately
-                # (the k8s materialisation kills the whole pod instead).
+                # run two attempts concurrently. Revoke its write epoch
+                # and fail the stage immediately (the k8s materialisation
+                # kills the whole pod instead).
+                epoch.revoke()
                 last_exc = TimeoutError(
                     f"exceeded max_completion_time_seconds="
                     f"{stage.max_completion_time_s}"

@@ -1,0 +1,80 @@
+"""Write-epoch guard for abandoned stage attempts (VERDICT r4 item 9).
+
+The local runner cannot kill a timed-out batch-stage thread (Python has
+no thread kill; k8s kills the whole pod instead — ``runner.py``). It
+abandons the daemon thread and fails the stage, but the abandoned thread
+kept a live reference to the shared store: a slow write landing AFTER
+the day was failed leaves ``run_simulation`` in an unspecified state —
+a later day (or a retry) could read a half-day's artefact written by a
+stage the orchestrator already declared dead.
+
+:class:`EpochGuardedStore` closes that hole. Each stage ATTEMPT gets its
+own guard wrapping the real store; when the runner abandons the attempt
+it revokes the epoch, after which every WRITE through the guard raises
+:class:`WriteEpochRevoked` — the late write never lands. Reads stay
+allowed: an abandoned reader is harmless, and failing it would only
+change which exception the dead thread swallows.
+
+The guard composes with any backend (filesystem, GCS, in-memory fakes)
+because it delegates the four primitive ops and inherits every
+convenience method from :class:`ArtefactStore`.
+"""
+from __future__ import annotations
+
+import threading
+
+from bodywork_tpu.store.base import ArtefactStore
+
+__all__ = ["EpochGuardedStore", "WriteEpochRevoked"]
+
+
+class WriteEpochRevoked(RuntimeError):
+    """A write arrived through a store epoch the orchestrator revoked
+    (the writing stage attempt was timed out and abandoned)."""
+
+
+class EpochGuardedStore(ArtefactStore):
+    def __init__(self, inner: ArtefactStore, label: str = "stage"):
+        self._inner = inner
+        self._label = label
+        self._revoked = threading.Event()
+
+    def revoke(self) -> None:
+        """Reject all future writes through this epoch (idempotent)."""
+        self._revoked.set()
+
+    @property
+    def revoked(self) -> bool:
+        return self._revoked.is_set()
+
+    def _check_writable(self, key: str) -> None:
+        if self._revoked.is_set():
+            raise WriteEpochRevoked(
+                f"write of {key!r} rejected: the {self._label} attempt "
+                "holding this store epoch was timed out and abandoned"
+            )
+
+    # -- primitives (delegated; writes epoch-checked) ----------------------
+
+    def put_bytes(self, key: str, data: bytes) -> None:
+        self._check_writable(key)
+        self._inner.put_bytes(key, data)
+
+    def delete(self, key: str) -> None:
+        self._check_writable(key)
+        self._inner.delete(key)
+
+    def get_bytes(self, key: str) -> bytes:
+        return self._inner.get_bytes(key)
+
+    def list_keys(self, prefix: str = "") -> list[str]:
+        return self._inner.list_keys(prefix)
+
+    def exists(self, key: str) -> bool:
+        return self._inner.exists(key)
+
+    def version_token(self, key: str):
+        return self._inner.version_token(key)
+
+    def version_tokens(self, keys: list[str]) -> dict[str, object]:
+        return self._inner.version_tokens(keys)

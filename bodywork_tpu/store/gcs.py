@@ -4,13 +4,49 @@ The GKE-deployed pipeline can use a GCS bucket exactly as the reference uses
 S3 (SURVEY.md C7). Requires ``google-cloud-storage``, which is not a hard
 dependency — the backend raises a clear error at construction if missing, and
 the rest of the framework runs on :class:`FilesystemStore`.
+
+Listings iterate the client's paged iterator to exhaustion, so prefixes
+with more than one page of blobs (1000/page on real GCS) are handled; the
+contract suite drives this against a paginating fake. Transient service
+errors (429/5xx classes) are retried with short exponential backoff at
+THIS layer: the real client retries some idempotent calls internally, but
+its policy is invisible to tests and does not cover iteration of an
+already-started listing — an explicit, test-exercised policy beats an
+assumed one.
 """
 from __future__ import annotations
 
+import time
+
 from bodywork_tpu.store.base import ArtefactNotFound, ArtefactStore
+
+#: exception type names treated as transient (google.api_core classes are
+#: matched by NAME because google-cloud-storage is an optional dependency
+#: this module must import without)
+_TRANSIENT_ERROR_NAMES = frozenset({
+    "ServiceUnavailable",      # 503
+    "TooManyRequests",         # 429
+    "InternalServerError",     # 500
+    "BadGateway",              # 502
+    "GatewayTimeout",          # 504
+    "DeadlineExceeded",
+    "RetryError",
+    "ConnectionError",
+    "ConnectionResetError",
+})
+
+
+def _is_transient(exc: BaseException) -> bool:
+    return any(
+        t.__name__ in _TRANSIENT_ERROR_NAMES for t in type(exc).__mro__
+    )
 
 
 class GCSStore(ArtefactStore):
+    #: transient-retry policy: attempts include the first try
+    RETRY_ATTEMPTS = 3
+    RETRY_BASE_DELAY_S = 0.1
+
     def __init__(self, bucket: str, prefix: str = ""):
         try:
             from google.cloud import storage  # type: ignore
@@ -23,6 +59,21 @@ class GCSStore(ArtefactStore):
         self._bucket = self._client.bucket(bucket)
         self._prefix = prefix.strip("/")
 
+    def _with_retries(self, op):
+        """Run ``op`` (a thunk that fully materialises its result — paged
+        iteration included, so a mid-listing drop retries the WHOLE
+        listing, never splices two inconsistent pages), retrying
+        transient errors with exponential backoff."""
+        delay = self.RETRY_BASE_DELAY_S
+        for attempt in range(self.RETRY_ATTEMPTS):
+            try:
+                return op()
+            except Exception as exc:
+                if not _is_transient(exc) or attempt == self.RETRY_ATTEMPTS - 1:
+                    raise
+                time.sleep(delay)
+                delay *= 2
+
     @classmethod
     def from_url(cls, url: str) -> "GCSStore":
         assert url.startswith("gs://"), url
@@ -34,35 +85,56 @@ class GCSStore(ArtefactStore):
         return f"{self._prefix}/{key}" if self._prefix else key
 
     def exists(self, key: str) -> bool:
-        return self._bucket.blob(self._blob_name(key)).exists()
+        name = self._blob_name(key)
+        return self._with_retries(
+            lambda: self._bucket.blob(name).exists()
+        )
 
     def put_bytes(self, key: str, data: bytes) -> None:
-        self._bucket.blob(self._blob_name(key)).upload_from_string(data)
+        name = self._blob_name(key)
+        self._with_retries(
+            lambda: self._bucket.blob(name).upload_from_string(data)
+        )
 
     def get_bytes(self, key: str) -> bytes:
-        blob = self._bucket.blob(self._blob_name(key))
-        if not blob.exists():
-            raise ArtefactNotFound(key)
-        return blob.download_as_bytes()
+        name = self._blob_name(key)
+
+        def _get():
+            blob = self._bucket.blob(name)
+            if not blob.exists():
+                raise ArtefactNotFound(key)
+            return blob.download_as_bytes()
+
+        return self._with_retries(_get)
 
     def list_keys(self, prefix: str = "") -> list[str]:
         # a prefix is not a key (may legitimately be empty) — no validation
         full = f"{self._prefix}/{prefix}" if self._prefix else prefix
         strip = len(self._prefix) + 1 if self._prefix else 0
-        return sorted(b.name[strip:] for b in self._client.list_blobs(self._bucket, prefix=full))
+        return self._with_retries(lambda: sorted(
+            b.name[strip:]
+            for b in self._client.list_blobs(self._bucket, prefix=full)
+        ))
 
     def delete(self, key: str) -> None:
-        blob = self._bucket.blob(self._blob_name(key))
-        if not blob.exists():
-            raise ArtefactNotFound(key)
-        blob.delete()
+        name = self._blob_name(key)
+
+        def _delete():
+            blob = self._bucket.blob(name)
+            if not blob.exists():
+                raise ArtefactNotFound(key)
+            blob.delete()
+
+        self._with_retries(_delete)
 
     def version_token(self, key: str):
         # GCS object generation changes on every overwrite; invalid keys
         # report "no token" like the filesystem backend (contract: token
         # queries never raise)
         try:
-            blob = self._bucket.get_blob(self._blob_name(key))
+            blob = self._with_retries(
+                lambda: self._bucket.get_blob(self._blob_name(key))
+            )
         except ValueError:
             return None
         return None if blob is None else blob.generation
@@ -84,8 +156,14 @@ class GCSStore(ArtefactStore):
         dirs = {name.rsplit("/", 1)[0] + "/" if "/" in name else "" for name in wanted}
         out = {}
         for d in sorted(dirs):
-            for blob in self._client.list_blobs(self._bucket, prefix=d):
-                key = wanted.get(blob.name)
-                if key is not None and blob.generation is not None:
-                    out[key] = blob.generation
+
+            def _scan(d=d):
+                found = {}
+                for blob in self._client.list_blobs(self._bucket, prefix=d):
+                    key = wanted.get(blob.name)
+                    if key is not None and blob.generation is not None:
+                        found[key] = blob.generation
+                return found
+
+            out.update(self._with_retries(_scan))
         return out

@@ -11,6 +11,11 @@ import threading
 import types
 
 
+#: named like google.api_core's 503 class so GCSStore's name-based
+#: transient matching treats injected failures exactly like real ones
+ServiceUnavailable = type("ServiceUnavailable", (Exception,), {})
+
+
 class FakeBlob:
     """In-memory stand-in for google.cloud.storage.Blob (the subset the
     GCSStore backend touches). Objects carry (bytes, generation) so
@@ -21,18 +26,22 @@ class FakeBlob:
         self.name = name
 
     def exists(self):
+        self._bucket._maybe_fail("exists")
         return self.name in self._bucket._objects
 
     def upload_from_string(self, data):
+        self._bucket._maybe_fail("upload")
         if isinstance(data, str):
             data = data.encode()
         gen = self._bucket._objects.get(self.name, (None, 0))[1] + 1
         self._bucket._objects[self.name] = (data, gen)
 
     def download_as_bytes(self):
+        self._bucket._maybe_fail("download")
         return self._bucket._objects[self.name][0]
 
     def delete(self):
+        self._bucket._maybe_fail("delete")
         del self._bucket._objects[self.name]
 
     @property
@@ -45,26 +54,58 @@ class FakeBucket:
     def __init__(self, name):
         self.name = name
         self._objects = {}
+        #: op-name -> remaining injected transient failures
+        self.failures: dict = {}
+        #: pages served by list_blobs (pagination observability)
+        self.page_fetches = 0
+
+    def inject_failures(self, op: str, count: int):
+        """Arm ``count`` transient (503-class) failures on ``op`` — one
+        of exists/upload/download/delete/list."""
+        self.failures[op] = count
+
+    def _maybe_fail(self, op: str):
+        if self.failures.get(op, 0) > 0:
+            self.failures[op] -= 1
+            raise ServiceUnavailable(f"injected transient {op} failure")
 
     def blob(self, name):
         return FakeBlob(self, name)
 
     def get_blob(self, name):
+        self._maybe_fail("list")
         return FakeBlob(self, name) if name in self._objects else None
 
 
 class FakeClient:
     _buckets: dict = {}
+    #: real GCS serves 1000 blobs/page; tests shrink this to force
+    #: multi-page listings without creating thousands of objects
+    page_size = 1000
 
     def bucket(self, name):
         return self._buckets.setdefault(name, FakeBucket(name))
 
     def list_blobs(self, bucket, prefix=""):
-        return [
-            FakeBlob(bucket, name)
-            for name in sorted(bucket._objects)
-            if name.startswith(prefix)
-        ]
+        """Paged iterator, like the real client: results stream page by
+        page (consumers must iterate to exhaustion, not take one page),
+        and a transient drop can happen at any page boundary."""
+        names = sorted(
+            n for n in bucket._objects if n.startswith(prefix)
+        )
+
+        def _pages():
+            i = 0
+            while True:  # always >= 1 page request, like the real API
+                bucket._maybe_fail("list")
+                bucket.page_fetches += 1
+                for n in names[i:i + self.page_size]:
+                    yield FakeBlob(bucket, n)
+                i += self.page_size
+                if i >= len(names):
+                    return
+
+        return _pages()
 
 
 def install_fake_gcs(monkeypatch):

@@ -72,3 +72,84 @@ def test_delete(gcs_store):
     gcs_store.put_text("models/regressor-2026-01-01.npz", "x")
     gcs_store.delete("models/regressor-2026-01-01.npz")
     assert not gcs_store.exists("models/regressor-2026-01-01.npz")
+
+
+# --- pagination + transient errors (VERDICT r4 item 8) --------------------
+
+
+def test_list_keys_spans_multiple_pages(gcs_store, monkeypatch):
+    """A prefix with more blobs than one page (1000 on real GCS; shrunk
+    here) must list completely — the paged iterator is consumed to
+    exhaustion, not truncated at page 1."""
+    from tests.helpers import FakeClient
+
+    monkeypatch.setattr(FakeClient, "page_size", 40)
+    keys = [f"datasets/regression-dataset-2026-01-01.csv.part{i:04d}"
+            for i in range(101)]
+    for k in keys:
+        gcs_store.put_text(k, "x")
+    bucket = gcs_store._bucket
+    bucket.page_fetches = 0
+    listed = gcs_store.list_keys("datasets/")
+    assert listed == sorted(keys)
+    assert bucket.page_fetches >= 3  # 101 blobs / 40 per page
+
+
+def test_version_tokens_span_multiple_pages(gcs_store, monkeypatch):
+    from tests.helpers import FakeClient
+
+    monkeypatch.setattr(FakeClient, "page_size", 16)
+    keys = [f"models/regressor-2026-01-{d:02d}.npz" for d in range(1, 29)]
+    for k in keys:
+        gcs_store.put_text(k, "x")
+    bucket = gcs_store._bucket
+    bucket.page_fetches = 0
+    tokens = gcs_store.version_tokens(keys)
+    assert set(tokens) == set(keys)
+    assert bucket.page_fetches >= 2
+
+
+def test_transient_listing_failure_is_retried(gcs_store):
+    """A 503-class drop mid-listing retries the WHOLE listing (never
+    splices two inconsistent pages) and succeeds within the policy's
+    attempt budget."""
+    gcs_store.put_text("datasets/regression-dataset-2026-01-01.csv", "x")
+    bucket = gcs_store._bucket
+    bucket.inject_failures("list", 2)  # attempts = 3 -> succeeds on last
+    assert gcs_store.list_keys("datasets/") == [
+        "datasets/regression-dataset-2026-01-01.csv"
+    ]
+    assert bucket.failures["list"] == 0
+
+
+def test_transient_download_and_exists_retry(gcs_store):
+    key = "models/regressor-2026-01-01.npz"
+    gcs_store.put_bytes(key, b"abc")
+    bucket = gcs_store._bucket
+    bucket.inject_failures("download", 1)
+    assert gcs_store.get_bytes(key) == b"abc"
+    bucket.inject_failures("exists", 2)
+    assert gcs_store.exists(key)
+
+
+def test_persistent_transient_failure_raises_after_budget(gcs_store):
+    """More consecutive failures than RETRY_ATTEMPTS: the error
+    propagates — the retry policy is bounded, not a hang."""
+    from tests.helpers import ServiceUnavailable
+
+    gcs_store.put_text("datasets/regression-dataset-2026-01-01.csv", "x")
+    bucket = gcs_store._bucket
+    bucket.inject_failures("list", gcs_store.RETRY_ATTEMPTS)
+    with pytest.raises(ServiceUnavailable):
+        gcs_store.list_keys("datasets/")
+
+
+def test_non_transient_errors_are_not_retried(gcs_store):
+    """ArtefactNotFound (and any non-503-class error) must surface
+    immediately — retrying a deterministic failure would just burn the
+    backoff budget."""
+    bucket = gcs_store._bucket
+    before = dict(bucket.failures)
+    with pytest.raises(ArtefactNotFound):
+        gcs_store.get_bytes("models/nope.npz")
+    assert bucket.failures == before

@@ -137,6 +137,16 @@ def _slow_stage(ctx, **kwargs):
     time.sleep(5)
 
 
+def _slow_writing_stage(ctx, **kwargs):
+    """Writes once before its deadline, then again long after it — the
+    zombie-writer hazard the per-attempt store epoch closes."""
+    import time
+
+    ctx.store.put_text("datasets/regression-dataset-2026-01-01.csv", "early")
+    time.sleep(1.0)
+    ctx.store.put_text("models/regressor-2026-01-01.npz", "late")
+
+
 def _make_single_stage_spec(executable, **stage_kwargs):
     stage = StageSpec(
         name="s", kind="batch", executable=executable, **stage_kwargs
@@ -243,6 +253,48 @@ def test_batch_stage_timeout_does_not_block_on_worker(store):
     with pytest.raises(StageFailure):
         LocalRunner(spec, store).run_day(date(2026, 1, 1))
     assert time.perf_counter() - t0 < 3.0  # _slow_stage sleeps 5s
+
+
+def test_timed_out_stage_late_write_never_lands(store):
+    """VERDICT r4 item 9 done-criterion: a stage timed out and abandoned
+    by the runner cannot write to the shared store afterwards — its
+    attempt's write epoch is revoked, so the zombie thread's late write
+    raises instead of landing, and the day's store state is exactly what
+    the orchestrator believes it is."""
+    import time
+
+    spec = _make_single_stage_spec(
+        "tests.test_pipeline:_slow_writing_stage",
+        retries=0, max_completion_time_s=0.3,
+    )
+    with pytest.raises(StageFailure, match="max_completion_time"):
+        LocalRunner(spec, store).run_day(date(2026, 1, 1))
+    # pre-deadline write landed (revocation is a fence, not a rollback)
+    assert store.exists("datasets/regression-dataset-2026-01-01.csv")
+    # let the abandoned thread reach its late write, then prove it was
+    # rejected by the revoked epoch
+    time.sleep(1.2)
+    assert not store.exists("models/regressor-2026-01-01.npz")
+
+
+def test_epoch_guard_semantics(store):
+    from bodywork_tpu.store.epoch import EpochGuardedStore, WriteEpochRevoked
+
+    guard = EpochGuardedStore(store, label="stage-x")
+    guard.put_text("datasets/regression-dataset-2026-01-01.csv", "ok")
+    guard.revoke()
+    with pytest.raises(WriteEpochRevoked):
+        guard.put_text("datasets/regression-dataset-2026-01-02.csv", "no")
+    with pytest.raises(WriteEpochRevoked):
+        guard.delete("datasets/regression-dataset-2026-01-01.csv")
+    # reads stay allowed — an abandoned reader is harmless
+    assert guard.get_text(
+        "datasets/regression-dataset-2026-01-01.csv"
+    ) == "ok"
+    assert guard.exists("datasets/regression-dataset-2026-01-01.csv")
+    assert guard.list_keys("datasets/")
+    # the underlying store never saw the rejected write
+    assert not store.exists("datasets/regression-dataset-2026-01-02.csv")
 
 
 def test_spec_file_round_trips_nondefault_choices(tmp_path):
