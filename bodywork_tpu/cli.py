@@ -348,6 +348,13 @@ def cmd_deploy(args) -> int:
     )
     for path in written:
         print(path)
+    if args.emit_images:
+        from bodywork_tpu.pipeline.images import write_stage_images
+
+        for path in write_stage_images(
+            spec, args.emit_images, image=args.image
+        ):
+            print(path)
     return 0
 
 
@@ -538,6 +545,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "Filestore's RWX class; pass '' for the cluster "
                         "default, which must support ReadWriteMany)")
     p.add_argument("--pvc-size", default="10Gi")
+    p.add_argument(
+        "--emit-images", default=None, metavar="DIR",
+        help="also write per-stage image build contexts (Dockerfile + "
+             "pinned requirements.txt + build.sh) to DIR — the buildable "
+             "source of the per-stage image tags the manifests reference "
+             "(reference parity: per-stage dependency isolation)",
+    )
     p.add_argument("--model", default="linear", choices=["linear", "mlp"])
     p.add_argument("--mode", default="batch", choices=["single", "batch"])
 

@@ -39,6 +39,7 @@ from pathlib import Path
 
 import yaml
 
+from bodywork_tpu.pipeline.images import stage_image_tag
 from bodywork_tpu.pipeline.spec import PipelineSpec, StageSpec
 from bodywork_tpu.utils.logging import get_logger
 
@@ -184,7 +185,7 @@ def _container(
     ]
     container = {
         "name": stage.name,
-        "image": stage.image or image,
+        "image": stage_image_tag(stage, image) or image,
         "command": command,
         "volumeMounts": [m for m in (mount, spec_mount) if m],
         "resources": resources,
@@ -273,7 +274,7 @@ def _init_containers(
             "name": "wait-for-deps",
             # the stage's own image (when overridden): the gate must run
             # in the same dependency set the stage was pinned to
-            "image": stage.image or image,
+            "image": stage_image_tag(stage, image) or image,
             "command": [
                 "python", "-m", "bodywork_tpu.cli", "wait-for",
                 "--store", store.store_path, *conditions,

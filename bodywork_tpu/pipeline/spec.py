@@ -84,6 +84,13 @@ class StageSpec:
     #: per-stage dependency isolation, bodywork.yaml:10-16 pins each
     #: stage's own requirements); None = the pipeline-wide image
     image: str | None = None
+    #: THIS stage's pinned pip requirements (reference
+    #: bodywork.yaml:10-16,29-35,50-54,67-72: each stage installs its own
+    #: pin set so stages deploy and upgrade independently). When set and
+    #: ``image`` is not, the manifest generator derives a per-stage image
+    #: tag from these pins, and ``pipeline.images`` emits the build
+    #: context (Dockerfile + requirements.txt) that produces it.
+    requirements: list[str] = dataclasses.field(default_factory=list)
     resources: ResourceSpec = dataclasses.field(default_factory=ResourceSpec)
 
     def __post_init__(self):
@@ -167,6 +174,8 @@ def _stage_to_doc(stage: StageSpec) -> dict:
         doc["optional_secrets"] = list(stage.optional_secrets)
     if stage.image:
         doc["image"] = stage.image
+    if stage.requirements:
+        doc["requirements"] = list(stage.requirements)
     return doc
 
 
@@ -201,8 +210,45 @@ def _stage_from_doc(name: str, doc: dict) -> StageSpec:
         secrets=secrets,
         optional_secrets=optional_secrets,
         image=doc.get("image"),
+        requirements=list(doc.get("requirements", [])),
         resources=resources,
     )
+
+
+#: Per-stage pinned requirement sets (reference parity:
+#: ``bodywork.yaml:10-16,29-35,50-54,67-72`` gives each stage its own pip
+#: pins so stages deploy and upgrade independently — and drift apart only
+#: deliberately, unlike the reference's accidental numpy 1.19.5-vs-1.19.4
+#: skew, SURVEY.md §2 known-bugs). One shared pin table + per-stage
+#: SELECTIONS keeps versions consistent where stages overlap while each
+#: stage still installs only what it imports.
+_PINS = {
+    "jax": "jax[tpu]==0.9.0",
+    "numpy": "numpy==2.0.2",
+    "pandas": "pandas==3.0.3",
+    "werkzeug": "werkzeug==3.1.5",
+    "requests": "requests==2.32.5",
+    "optax": "optax==0.2.6",
+    "pyyaml": "pyyaml==6.0.3",
+}
+
+STAGE_REQUIREMENTS = {
+    # train: device compute + history loading + metrics persistence
+    "stage-1-train-model": ["jax", "optax", "numpy", "pandas", "pyyaml"],
+    # serve: device compute + the WSGI service (no pandas on the hot path)
+    "stage-2-serve-model": ["jax", "optax", "numpy", "werkzeug", "pyyaml"],
+    # generate: the fused sampler + CSV persistence
+    "stage-3-generate-next-dataset": ["jax", "numpy", "pandas", "pyyaml"],
+    # test: HTTP client + metric frames; no accelerator runtime at all
+    "stage-4-test-model-scoring-service": [
+        "numpy", "pandas", "requests", "pyyaml",
+    ],
+}
+
+
+def stage_requirements(stage_name: str) -> list[str]:
+    """The pinned requirement lines for one canonical stage."""
+    return [_PINS[p] for p in STAGE_REQUIREMENTS[stage_name]]
 
 
 def default_pipeline(
@@ -239,6 +285,7 @@ def default_pipeline(
     stages = {
         "stage-1-train-model": StageSpec(
             name="stage-1-train-model",
+            requirements=stage_requirements("stage-1-train-model"),
             kind="batch",
             executable="bodywork_tpu.pipeline.stages:train_stage",
             args={"model_type": model_type},
@@ -247,6 +294,7 @@ def default_pipeline(
         ),
         "stage-2-serve-model": StageSpec(
             name="stage-2-serve-model",
+            requirements=stage_requirements("stage-2-serve-model"),
             kind="service",
             executable="bodywork_tpu.pipeline.stages:serve_stage",
             # compile only the buckets the tester's request sizes need
@@ -260,6 +308,7 @@ def default_pipeline(
         ),
         "stage-3-generate-next-dataset": StageSpec(
             name="stage-3-generate-next-dataset",
+            requirements=stage_requirements("stage-3-generate-next-dataset"),
             kind="batch",
             executable="bodywork_tpu.pipeline.stages:generate_stage",
             optional_secrets=list(secrets),
@@ -267,6 +316,7 @@ def default_pipeline(
         ),
         "stage-4-test-model-scoring-service": StageSpec(
             name="stage-4-test-model-scoring-service",
+            requirements=stage_requirements("stage-4-test-model-scoring-service"),
             kind="batch",
             executable="bodywork_tpu.pipeline.stages:test_stage",
             # one full simulated day (<=1440 rows) scores in a single padded

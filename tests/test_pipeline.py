@@ -255,6 +255,82 @@ def test_batch_stage_timeout_does_not_block_on_worker(store):
     assert time.perf_counter() - t0 < 3.0  # _slow_stage sleeps 5s
 
 
+def test_per_stage_requirements_isolation(tmp_path):
+    """Reference parity (bodywork.yaml:10-16,29-35,50-54,67-72): each
+    stage carries its OWN pinned requirements, stages' manifests
+    reference content-addressed per-stage image tags derived from those
+    pins, and the emitted build contexts are the buildable source of
+    exactly those tags. Bumping one stage's pins rolls only that
+    stage's tag."""
+    import yaml
+
+    from bodywork_tpu.pipeline import default_pipeline
+    from bodywork_tpu.pipeline.images import (
+        stage_image_tag,
+        write_stage_images,
+    )
+    from bodywork_tpu.pipeline.k8s import generate_manifests
+    from bodywork_tpu.pipeline.spec import PipelineSpec
+
+    spec = default_pipeline()
+    # every canonical stage is pinned, and pin sets genuinely differ
+    # (serve has no pandas; test has no jax) while overlapping pins
+    # agree on versions (no accidental numpy-skew, SURVEY.md §2)
+    req = {n: set(s.requirements) for n, s in spec.stages.items()}
+    assert all(req.values())
+    assert not any(r.startswith("pandas") for r in req["stage-2-serve-model"])
+    assert not any(r.startswith("jax") for r in
+                   req["stage-4-test-model-scoring-service"])
+    pins_by_pkg: dict = {}
+    for reqs in req.values():
+        for line in reqs:
+            pkg = line.split("=")[0]
+            assert "==" in line, f"unpinned requirement {line}"
+            assert pins_by_pkg.setdefault(pkg, line) == line
+
+    # requirements round-trip through the spec YAML
+    loaded = PipelineSpec.from_yaml(spec.to_yaml())
+    assert {n: s.requirements for n, s in loaded.stages.items()} == {
+        n: s.requirements for n, s in spec.stages.items()
+    }
+
+    # manifests reference the derived tags; tags are deterministic and
+    # roll when (and only when) a stage's pins change
+    image = "registry/bodywork-tpu:v1"
+    docs = generate_manifests(spec, store_path="/mnt/s", store_volume="pvc",
+                              image=image)
+    train = spec.stages["stage-1-train-model"]
+    tag = stage_image_tag(train, image)
+    assert tag and tag.startswith("registry/bodywork-tpu-stage-1-train-model:")
+    job = next(d for name, d in docs.items()
+               if d["kind"] == "Job" and "stage-1" in name)
+    assert job["spec"]["template"]["spec"]["containers"][0]["image"] == tag
+    assert stage_image_tag(train, image) == tag  # deterministic
+    import dataclasses as dc
+
+    bumped = dc.replace(train, requirements=[*train.requirements, "x==1"])
+    assert stage_image_tag(bumped, image) != tag
+    # explicit stage.image override still wins
+    pinned = dc.replace(train, image="custom:1")
+    assert stage_image_tag(pinned, image) == "custom:1"
+
+    # emitted build contexts cover every pinned stage and cite the tags
+    out = tmp_path / "images"
+    written = write_stage_images(spec, out, image=image)
+    assert (out / "build.sh").exists()
+    for name, stage in spec.stages.items():
+        ctx = out / name
+        assert (ctx / "requirements.txt").read_text().splitlines() == (
+            stage.requirements
+        )
+        assert stage_image_tag(stage, image) in (
+            ctx / "Dockerfile"
+        ).read_text()
+    assert stage_image_tag(train, image) in (out / "build.sh").read_text()
+    # the validator layers accept the per-stage-image manifests
+    assert all(yaml.safe_load(yaml.safe_dump(d)) for d in docs.values())
+
+
 def test_timed_out_stage_late_write_never_lands(store):
     """VERDICT r4 item 9 done-criterion: a stage timed out and abandoned
     by the runner cannot write to the shared store afterwards — its
@@ -537,10 +613,27 @@ def test_per_stage_image_override(tmp_path):
     # the DAG gate runs in the stage's own pinned image too
     for init in pod.get("initContainers", []):
         assert init["image"] == "registry.example/train-stage:1.2.3"
-    # un-overridden stages keep the pipeline-wide image
+    # un-overridden stages with pinned requirements get the derived
+    # content-addressed per-stage tag (see
+    # test_per_stage_requirements_isolation)...
     serve = next(d for n, d in docs.items() if d["kind"] == "Deployment")
+    serve_image = serve["spec"]["template"]["spec"]["containers"][0]["image"]
+    assert serve_image.startswith("global/runtime-stage-2-serve-model:")
+    # ...and a stage with neither an override nor requirements falls back
+    # to the pipeline-wide image
+    import dataclasses as dc
+
+    bare = dc.replace(spec.stages["stage-3-generate-next-dataset"],
+                      requirements=[])
+    spec.stages["stage-3-generate-next-dataset"] = bare
+    docs2 = generate_manifests(spec, store_path="/mnt/store",
+                               image="global/runtime:latest")
+    gen_job = next(
+        d for n, d in docs2.items()
+        if d["kind"] == "Job" and "generate" in n
+    )
     assert (
-        serve["spec"]["template"]["spec"]["containers"][0]["image"]
+        gen_job["spec"]["template"]["spec"]["containers"][0]["image"]
         == "global/runtime:latest"
     )
 
