@@ -128,7 +128,7 @@ def cmd_serve(args) -> int:
         svc = MultiProcessService(
             args.store, host=args.host, port=args.port,
             workers=args.workers, engine=args.engine,
-            watch_interval_s=watch,
+            watch_interval_s=watch, buckets=args.buckets,
         ).start()
         try:
             while True:

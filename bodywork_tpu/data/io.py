@@ -95,7 +95,7 @@ def load_all_datasets(store: ArtefactStore) -> Dataset:
         from bodywork_tpu.store.base import ArtefactNotFound
 
         raise ArtefactNotFound(f"no datasets under '{DATASETS_PREFIX}'")
-    cache: dict = store.__dict__.setdefault("_parsed_dataset_cache", {})
+    cache: dict = store.mutable_cache("_parsed_dataset_cache")
     tokens = store.version_tokens([key for key, _ in hist])
     parts, n_parsed = [], 0
     for key, _ in hist:

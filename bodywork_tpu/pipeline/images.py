@@ -39,7 +39,10 @@ def stage_image_tag(stage: StageSpec, image: str,
         return stage.image
     if not stage.requirements:
         return None
-    repo = image.rsplit(":", 1)[0]
+    # strip only a TAG — a ':' after the last '/'. "localhost:5000/app"
+    # is an untagged registry:port reference whose ':' must survive.
+    head, sep, tail = image.rpartition(":")
+    repo = head if sep and "/" not in tail else image
     digest = hashlib.sha256(
         "\n".join([base_image, *sorted(stage.requirements)]).encode()
     ).hexdigest()[:12]
@@ -57,8 +60,20 @@ def write_stage_images(
     out = Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
     written: list[Path] = []
-    build_lines = ["#!/bin/sh", "# build every per-stage image", "set -eu",
-                   'cd "$(dirname "$0")"']
+    build_lines = [
+        "#!/bin/sh",
+        "# build every per-stage image. The docker build CONTEXT must be",
+        "# the framework repo root (the Dockerfiles COPY the package in):",
+        "# pass it as $1 or set BODYWORK_TPU_ROOT; never assumed from the",
+        "# emit directory's location.",
+        "set -eu",
+        'cd "$(dirname "$0")"',
+        'ROOT="${1:-${BODYWORK_TPU_ROOT:-}}"',
+        'if [ -z "$ROOT" ]; then',
+        '  echo "usage: $0 <repo-root> (or set BODYWORK_TPU_ROOT)" >&2',
+        "  exit 2",
+        "fi",
+    ]
     for name, stage in spec.stages.items():
         if not stage.requirements or stage.image:
             continue  # nothing to build: shared image or explicit override
@@ -80,7 +95,7 @@ def write_stage_images(
             'ENTRYPOINT ["python", "-m", "bodywork_tpu.cli"]\n'
         )
         build_lines.append(
-            f"docker build -f {name}/Dockerfile -t {tag} ../.."
+            f'docker build -f {name}/Dockerfile -t {tag} "$ROOT"'
         )
         written += [reqs, dockerfile]
     script = out / "build.sh"

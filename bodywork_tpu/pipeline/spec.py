@@ -220,8 +220,7 @@ def _stage_from_doc(name: str, doc: dict) -> StageSpec:
 #: pins so stages deploy and upgrade independently — and drift apart only
 #: deliberately, unlike the reference's accidental numpy 1.19.5-vs-1.19.4
 #: skew, SURVEY.md §2 known-bugs). One shared pin table + per-stage
-#: SELECTIONS keeps versions consistent where stages overlap while each
-#: stage still installs only what it imports.
+#: SELECTIONS keeps versions consistent where stages overlap.
 _PINS = {
     "jax": "jax[tpu]==0.9.0",
     "numpy": "numpy==2.0.2",
@@ -232,17 +231,24 @@ _PINS = {
     "pyyaml": "pyyaml==6.0.3",
 }
 
+#: every stage pod runs ``python -m bodywork_tpu.cli run-stage``, whose
+#: module import closure (cli -> runner -> stages -> data/serve/monitor)
+#: currently pulls ALL of these before the stage body executes — so each
+#: stage's pin set is the full closure today, and a test pins the
+#: "closure is covered" invariant (tests/test_pipeline.py). Shrinking a
+#: stage's set (e.g. dropping jax from the test stage) first requires
+#: making the stage-module imports lazy; the per-stage machinery
+#: (content-addressed tags, emitted build contexts) already supports
+#: divergence the moment the closure does.
+_ENTRYPOINT_CLOSURE = [
+    "jax", "optax", "numpy", "pandas", "werkzeug", "requests", "pyyaml",
+]
+
 STAGE_REQUIREMENTS = {
-    # train: device compute + history loading + metrics persistence
-    "stage-1-train-model": ["jax", "optax", "numpy", "pandas", "pyyaml"],
-    # serve: device compute + the WSGI service (no pandas on the hot path)
-    "stage-2-serve-model": ["jax", "optax", "numpy", "werkzeug", "pyyaml"],
-    # generate: the fused sampler + CSV persistence
-    "stage-3-generate-next-dataset": ["jax", "numpy", "pandas", "pyyaml"],
-    # test: HTTP client + metric frames; no accelerator runtime at all
-    "stage-4-test-model-scoring-service": [
-        "numpy", "pandas", "requests", "pyyaml",
-    ],
+    "stage-1-train-model": list(_ENTRYPOINT_CLOSURE),
+    "stage-2-serve-model": list(_ENTRYPOINT_CLOSURE),
+    "stage-3-generate-next-dataset": list(_ENTRYPOINT_CLOSURE),
+    "stage-4-test-model-scoring-service": list(_ENTRYPOINT_CLOSURE),
 }
 
 

@@ -43,7 +43,7 @@ def _reuseport_socket(host: str, port: int) -> socket.socket:
 
 
 def _worker_main(store_path: str, host: str, port: int, engine: str,
-                 watch_interval_s: float | None, ready):
+                 watch_interval_s: float | None, buckets, ready):
     """One serving replica: load latest checkpoint -> predictor -> listen
     on the shared port. Runs in a SPAWNED process (a fork would inherit
     the parent's initialized XLA runtime threads — undefined behavior)."""
@@ -58,8 +58,9 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
     store = open_store(store_path)
     served_key, _ = store.latest(MODELS_PREFIX)
     model, model_date = load_model(store, served_key)
-    predictor = build_predictor(model, None, engine)
-    app = create_app(model, model_date, predictor=predictor)
+    predictor = build_predictor(model, None, engine, buckets=buckets)
+    app = create_app(model, model_date, predictor=predictor,
+                     buckets=buckets)
 
     sock = _reuseport_socket(host, port)
     sock.listen(128)
@@ -71,7 +72,7 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
         # each replica polls independently, like each k8s pod would
         watcher = CheckpointWatcher(
             app, store, poll_interval_s=watch_interval_s,
-            engine=engine, served_key=served_key,
+            engine=engine, served_key=served_key, buckets=buckets,
         ).start()
     ready.put(os.getpid())
     try:
@@ -104,6 +105,7 @@ class MultiProcessService:
         workers: int = 2,
         engine: str = "xla",
         watch_interval_s: float | None = None,
+        buckets: tuple[int, ...] | None = None,
         restart: bool = True,
         startup_timeout_s: float = 120.0,
     ):
@@ -113,6 +115,7 @@ class MultiProcessService:
         self.workers = workers
         self.engine = engine
         self.watch_interval_s = watch_interval_s
+        self.buckets = tuple(buckets) if buckets else None
         self.restart = restart
         self.startup_timeout_s = startup_timeout_s
         self._ctx = multiprocessing.get_context("spawn")
@@ -137,7 +140,7 @@ class MultiProcessService:
         proc = self._ctx.Process(
             target=_worker_main,
             args=(self.store_path, self.host, self.port, self.engine,
-                  self.watch_interval_s, ready),
+                  self.watch_interval_s, self.buckets, ready),
             daemon=True,
         )
         proc.start()

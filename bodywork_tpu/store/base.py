@@ -87,6 +87,15 @@ class ArtefactStore(abc.ABC):
                 out[key] = token
         return out
 
+    def mutable_cache(self, name: str) -> dict:
+        """A named per-store mutable cache dict (e.g. the parsed-dataset
+        cache in ``data.io``). Defined as a METHOD so wrapping stores
+        (``store.epoch.EpochGuardedStore``) can delegate to the store
+        they wrap — a cache attached to a throwaway per-attempt wrapper
+        would be discarded with it, silently restoring the O(days)
+        re-parse the cache exists to eliminate."""
+        return self.__dict__.setdefault(name, {})
+
     # -- text convenience --------------------------------------------------
     def put_text(self, key: str, text: str) -> None:
         self.put_bytes(key, text.encode("utf-8"))

@@ -78,3 +78,9 @@ class EpochGuardedStore(ArtefactStore):
 
     def version_tokens(self, keys: list[str]) -> dict[str, object]:
         return self._inner.version_tokens(keys)
+
+    def mutable_cache(self, name: str) -> dict:
+        # caches must live on the REAL store: this wrapper is one stage
+        # attempt's throwaway epoch, and a cache dying with it would
+        # silently restore the O(days) history re-parse
+        return self._inner.mutable_cache(name)

@@ -118,10 +118,18 @@ class GCSStore(ArtefactStore):
 
     def delete(self, key: str) -> None:
         name = self._blob_name(key)
+        attempt = {"n": 0}
 
         def _delete():
+            attempt["n"] += 1
             blob = self._bucket.blob(name)
             if not blob.exists():
+                if attempt["n"] > 1:
+                    # a retry after a transient error: the first try's
+                    # delete may have applied server-side before the
+                    # response was lost — absence now IS success, not a
+                    # missing artefact
+                    return
                 raise ArtefactNotFound(key)
             blob.delete()
 

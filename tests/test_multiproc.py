@@ -55,11 +55,14 @@ def mp_service(tmp_path_factory):
 
 
 def _session() -> requests.Session:
-    """Client with connection retries — the same resilience the tester's
-    HttpScoringClient carries (reference ``stage_4:73-74``): a connection
-    that lands on a just-killed listener is retried, not failed."""
+    """Client with connection AND read retries — the resilience the
+    tester's HttpScoringClient carries (reference ``stage_4:73-74``). A
+    connection that lands on a just-killed listener is refused (connect
+    retry), and one the victim had already accepted dies mid-exchange
+    with a reset (read retry). Scoring is stateless and idempotent, so
+    retrying a POST whose response was lost is safe by construction."""
     s = requests.Session()
-    retry = Retry(total=5, connect=5, read=0, backoff_factor=0.05,
+    retry = Retry(total=6, connect=5, read=5, backoff_factor=0.05,
                   allowed_methods=None)
     s.mount("http://", HTTPAdapter(max_retries=retry))
     return s

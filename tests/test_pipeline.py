@@ -1,5 +1,6 @@
 """Pipeline layer: spec round-trip, DAG parsing, local day-loop runner,
 retry/timeout semantics, manifest golden properties."""
+import os
 from datetime import date
 
 import pytest
@@ -273,14 +274,11 @@ def test_per_stage_requirements_isolation(tmp_path):
     from bodywork_tpu.pipeline.spec import PipelineSpec
 
     spec = default_pipeline()
-    # every canonical stage is pinned, and pin sets genuinely differ
-    # (serve has no pandas; test has no jax) while overlapping pins
-    # agree on versions (no accidental numpy-skew, SURVEY.md §2)
+    # every canonical stage is pinned, every pin is exact, and
+    # overlapping pins agree on versions (no accidental numpy-skew —
+    # the reference's 1.19.5-vs-1.19.4 bug, SURVEY.md §2)
     req = {n: set(s.requirements) for n, s in spec.stages.items()}
     assert all(req.values())
-    assert not any(r.startswith("pandas") for r in req["stage-2-serve-model"])
-    assert not any(r.startswith("jax") for r in
-                   req["stage-4-test-model-scoring-service"])
     pins_by_pkg: dict = {}
     for reqs in req.values():
         for line in reqs:
@@ -331,6 +329,50 @@ def test_per_stage_requirements_isolation(tmp_path):
     assert all(yaml.safe_load(yaml.safe_dump(d)) for d in docs.values())
 
 
+def test_stage_requirements_cover_entrypoint_import_closure():
+    """Every stage pod runs `python -m bodywork_tpu.cli run-stage`; any
+    managed third-party distribution that chain imports at module level
+    MUST appear in the stage's pin set, or the per-stage image crashes
+    with ModuleNotFoundError before the stage body runs. Spawns a clean
+    interpreter so lazily-imported packages don't leak in — if stage
+    imports later become lazy, this test is what lets the pin sets
+    shrink safely."""
+    import json
+    import subprocess
+    import sys
+
+    code = (
+        "import json, sys\n"
+        "import bodywork_tpu.cli\n"
+        "import bodywork_tpu.pipeline.runner\n"
+        "import bodywork_tpu.pipeline.stages\n"
+        "tops = {m.split('.')[0] for m in sys.modules}\n"
+        "print(json.dumps(sorted(tops)))\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env=env, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    imported_tops = set(json.loads(proc.stdout.strip().splitlines()[-1]))
+    # module-name -> pin-key for the distributions the pin table manages
+    managed = {"jax": "jax", "optax": "optax", "numpy": "numpy",
+               "pandas": "pandas", "werkzeug": "werkzeug",
+               "requests": "requests", "yaml": "pyyaml"}
+    needed = {pin for mod, pin in managed.items() if mod in imported_tops}
+    from bodywork_tpu.pipeline import default_pipeline
+
+    for name, stage in default_pipeline().stages.items():
+        pinned = {line.split("=")[0].split("[")[0]
+                  for line in stage.requirements}
+        missing = needed - pinned
+        assert not missing, (
+            f"{name}: entrypoint imports {sorted(missing)} but the pin "
+            "set omits them — the stage image would CrashLoopBackOff"
+        )
+
+
 def test_timed_out_stage_late_write_never_lands(store):
     """VERDICT r4 item 9 done-criterion: a stage timed out and abandoned
     by the runner cannot write to the shared store afterwards — its
@@ -371,6 +413,12 @@ def test_epoch_guard_semantics(store):
     assert guard.list_keys("datasets/")
     # the underlying store never saw the rejected write
     assert not store.exists("datasets/regression-dataset-2026-01-02.csv")
+    # per-store caches live on the REAL store, not the throwaway epoch:
+    # a cache attached to the wrapper would die with the attempt and
+    # silently restore the O(days) history re-parse per day
+    assert guard.mutable_cache("_parsed_dataset_cache") is (
+        store.mutable_cache("_parsed_dataset_cache")
+    )
 
 
 def test_spec_file_round_trips_nondefault_choices(tmp_path):
