@@ -1,25 +1,41 @@
-from bodywork_tpu.data.generator import (
-    DriftConfig,
-    alpha,
-    generate_day,
-    generate_dataframe,
-)
-from bodywork_tpu.data.io import (
-    Dataset,
-    load_all_datasets,
-    load_dataset,
-    load_latest_dataset,
-    persist_dataset,
-)
+"""Drift-data generation and dataset IO.
 
-__all__ = [
-    "DriftConfig",
-    "alpha",
-    "generate_day",
-    "generate_dataframe",
-    "Dataset",
-    "load_all_datasets",
-    "load_dataset",
-    "load_latest_dataset",
-    "persist_dataset",
-]
+Exports resolve LAZILY (PEP 562): ``data.generator`` imports jax at
+module level (the fused sampler is a jitted program), but ``data.io`` is
+plain numpy/pandas — and the live-service test stage (reference stage 4)
+needs only the IO half. Eager re-exports here would hand every stage pod
+the full accelerator runtime; lazy ones let per-stage dependency pin
+sets (``pipeline.spec.STAGE_REQUIREMENTS``) genuinely differ, like the
+reference's per-stage requirements blocks (``bodywork.yaml:67-72``: its
+stage 4 installs no sklearn either).
+"""
+from __future__ import annotations
+
+import importlib
+
+_EXPORTS = {
+    "DriftConfig": "bodywork_tpu.data.drift_config",
+    "alpha": "bodywork_tpu.data.generator",
+    "generate_day": "bodywork_tpu.data.generator",
+    "generate_dataframe": "bodywork_tpu.data.generator",
+    "Dataset": "bodywork_tpu.data.io",
+    "load_all_datasets": "bodywork_tpu.data.io",
+    "load_dataset": "bodywork_tpu.data.io",
+    "load_latest_dataset": "bodywork_tpu.data.io",
+    "persist_dataset": "bodywork_tpu.data.io",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    value = getattr(importlib.import_module(module), name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -1,0 +1,28 @@
+"""The generative-model parameter set, dependency-free.
+
+``DriftConfig`` is a plain frozen dataclass of floats (defaults =
+reference ``stage_3:19,36-38``). It lives apart from
+``data.generator`` — which imports jax for the fused sampler — so that
+processes that only CARRY the config (the runner constructing a
+``StageContext``, the jax-free test stage's pod) never pull the
+accelerator runtime. ``generator`` re-exports it; importing it from
+either module is equivalent.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class DriftConfig:
+    """Generative-model parameters (defaults = reference ``stage_3:19,36-38``)."""
+
+    n_samples: int = 24 * 60          # rows sampled per simulated day
+    beta: float = 0.5                 # slope
+    sigma: float = 10.0               # noise scale
+    freq: float = 6.0                 # intercept cycles per year
+    kappa: float = 1.0                # intercept mean
+    amplitude: float = 0.5            # intercept oscillation amplitude
+    x_low: float = 0.0
+    x_high: float = 100.0
+    seed: int = 42                    # global seed folded with the date

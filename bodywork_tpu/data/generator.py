@@ -26,7 +26,6 @@ TPU-native design differences from the reference (not bugs — upgrades):
 """
 from __future__ import annotations
 
-import dataclasses
 from datetime import date
 from functools import partial
 
@@ -34,22 +33,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bodywork_tpu.data.drift_config import DriftConfig
 from bodywork_tpu.utils.dates import day_of_year
 
-
-@dataclasses.dataclass(frozen=True)
-class DriftConfig:
-    """Generative-model parameters (defaults = reference ``stage_3:19,36-38``)."""
-
-    n_samples: int = 24 * 60          # rows sampled per simulated day
-    beta: float = 0.5                 # slope
-    sigma: float = 10.0               # noise scale
-    freq: float = 6.0                 # intercept cycles per year
-    kappa: float = 1.0                # intercept mean
-    amplitude: float = 0.5            # intercept oscillation amplitude
-    x_low: float = 0.0
-    x_high: float = 100.0
-    seed: int = 42                    # global seed folded with the date
+__all__ = [
+    "DriftConfig",  # re-export: defined dependency-free in drift_config
+    "alpha",
+    "key_for_date",
+    "generate_day",
+    "generate_dataframe",
+]
 
 
 def alpha(day: jax.Array | int, cfg: DriftConfig = DriftConfig()) -> jax.Array:

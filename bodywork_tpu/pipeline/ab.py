@@ -26,7 +26,6 @@ import threading
 from datetime import date
 from pathlib import Path
 
-from bodywork_tpu.data.generator import DriftConfig
 from bodywork_tpu.pipeline.runner import DayResult, LocalRunner
 from bodywork_tpu.pipeline.spec import PipelineSpec, default_pipeline
 from bodywork_tpu.store import ArtefactStore, FilesystemStore
@@ -41,7 +40,9 @@ class PipelineVariant:
 
     name: str
     spec: PipelineSpec
-    drift: DriftConfig | None = None
+    # lazy type (data.generator imports jax; a manifests-only or
+    # test-stage process must not pull the accelerator runtime)
+    drift: "DriftConfig | None" = None  # noqa: F821
 
 
 @dataclasses.dataclass

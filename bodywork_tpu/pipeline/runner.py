@@ -23,10 +23,8 @@ import threading
 import time
 from datetime import date, timedelta
 
-from bodywork_tpu.data.generator import DriftConfig
 from bodywork_tpu.pipeline.spec import PipelineSpec, StageSpec
 from bodywork_tpu.pipeline.stages import StageContext
-from bodywork_tpu.data import Dataset, generate_day, persist_dataset
 from bodywork_tpu.store.base import ArtefactStore
 from bodywork_tpu.store.schema import DATASETS_PREFIX
 from bodywork_tpu.utils.errors import StageError
@@ -69,10 +67,14 @@ def resolve_executable(path: str):
 
 class LocalRunner:
     def __init__(self, spec: PipelineSpec, store: ArtefactStore,
-                 drift: DriftConfig | None = None, device=None):
+                 drift: "DriftConfig | None" = None, device=None):  # noqa: F821
         self.spec = spec
         self.store = store
-        self.drift = drift or DriftConfig()
+        if drift is None:
+            from bodywork_tpu.data.drift_config import DriftConfig
+
+            drift = DriftConfig()
+        self.drift = drift
         #: pin ALL this runner's computations — including its own worker
         #: threads — to one jax device (device isolation for concurrent
         #: pipelines sharing a pool; jax.default_device alone is
@@ -281,6 +283,8 @@ class LocalRunner:
                     return
                 target, box = self._gen_queue.pop(0)
             try:
+                from bodywork_tpu.data.generator import generate_day
+
                 with _device_ctx(self.device):
                     X, y = generate_day(target, self.drift)
                 box["X"], box["y"] = X, y
@@ -411,6 +415,9 @@ class LocalRunner:
         """Seed day-0 data if the store has none (the reference bootstraps by
         hand-running the stage-3 notebook before the first deployment)."""
         if not self.store.history(DATASETS_PREFIX):
+            from bodywork_tpu.data.generator import generate_day
+            from bodywork_tpu.data.io import Dataset, persist_dataset
+
             with _device_ctx(self.device):
                 X, y = generate_day(start, self.drift)
             persist_dataset(self.store, Dataset(X, y, start))

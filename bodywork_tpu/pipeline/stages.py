@@ -18,20 +18,24 @@ from __future__ import annotations
 import dataclasses
 from datetime import date, timedelta
 
-from bodywork_tpu.data import Dataset, generate_day, persist_dataset
-from bodywork_tpu.data.generator import DriftConfig
-from bodywork_tpu.monitor import (
-    HttpScoringClient,
-    InProcessScoringClient,
-    run_service_test,
-    scoring_endpoint,
-)
-from bodywork_tpu.serve import ServiceHandle, create_app
-from bodywork_tpu.models.checkpoint import load_model
 from bodywork_tpu.store.base import ArtefactStore
 from bodywork_tpu.utils.logging import get_logger
 
+# Stage-body dependencies (data/serve/monitor/models) import LAZILY
+# inside each stage function: every stage pod runs this module, but each
+# stage should pull only its own dependency closure — that is what lets
+# the per-stage pin sets (``spec.STAGE_REQUIREMENTS``) genuinely differ,
+# e.g. the test stage running without the accelerator runtime at all
+# (reference parity: bodywork.yaml:67-72's stage 4 installs no sklearn).
+# tests/test_pipeline.py pins each stage's measured import closure.
+
 log = get_logger("pipeline.stages")
+
+
+def _default_drift():
+    from bodywork_tpu.data.drift_config import DriftConfig
+
+    return DriftConfig()
 
 
 def _params_equal(a, b) -> bool:
@@ -56,7 +60,7 @@ class StageContext:
     #: the simulated "today" (the reference uses wall-clock ``date.today()``;
     #: parameterising it lets simulations run faster than real time)
     today: date
-    drift: DriftConfig = dataclasses.field(default_factory=DriftConfig)
+    drift: "DriftConfig" = dataclasses.field(default_factory=_default_drift)  # noqa: F821
     #: service handles started earlier in the DAG, keyed by stage name
     services: dict = dataclasses.field(default_factory=dict)
     #: URL of the scoring service for cross-process testing (cluster DNS in
@@ -97,6 +101,8 @@ def generate_stage(ctx: StageContext, offset_days: int = 1) -> str:
     the device work is already done and only the persist remains. The
     dataset is NOT persisted before this stage's DAG position either way —
     stage-1's "all data to date" must never see tomorrow's file early."""
+    from bodywork_tpu.data.io import Dataset, persist_dataset
+
     target = ctx.today + timedelta(days=offset_days)
     box = ctx.prefetched_datasets.pop(target, None)
     if box is not None:
@@ -104,8 +110,12 @@ def generate_stage(ctx: StageContext, offset_days: int = 1) -> str:
         if "X" in box:
             X, y = box["X"], box["y"]
         else:  # prefetch failed; fall back to computing inline
+            from bodywork_tpu.data.generator import generate_day
+
             X, y = generate_day(target, ctx.drift)
     else:
+        from bodywork_tpu.data.generator import generate_day
+
         X, y = generate_day(target, ctx.drift)
     key = persist_dataset(ctx.store, Dataset(X, y, target))
     return key
@@ -165,7 +175,7 @@ def serve_stage(
     replicas: int = 1,
     watch_interval_s: float | None = None,
     engine: str = "auto",
-) -> ServiceHandle:
+) -> "ServiceHandle":  # noqa: F821
     """Load the latest model into device HBM and start the scoring service
     on a background thread (reference stage 2). Returns the handle; the
     runner keeps it alive for the rest of the day and tears it down at
@@ -188,6 +198,9 @@ def serve_stage(
     parity workloads are unchanged); a non-default predictor instance is
     shared read-only across the replicas, the same sharing the hot-reload
     watcher applies on swap."""
+    from bodywork_tpu.models.checkpoint import load_model
+    from bodywork_tpu.serve import ServiceHandle, create_app
+
     # Load the artefact WITHOUT the host->device transfer first: if the
     # in-process train stage produced this exact checkpoint this day, its
     # params are already resident in HBM — verify the artefact bytes match
@@ -268,6 +281,13 @@ def test_stage(
 ):
     """Score the latest dataset through the live service and persist drift
     metrics (reference stage 4)."""
+    from bodywork_tpu.monitor import (
+        HttpScoringClient,
+        InProcessScoringClient,
+        run_service_test,
+        scoring_endpoint,
+    )
+
     if ctx.scoring_url is not None:
         client = HttpScoringClient(scoring_endpoint(ctx.scoring_url, mode))
     elif service_stage in ctx.services:

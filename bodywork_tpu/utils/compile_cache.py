@@ -43,9 +43,15 @@ def enable_compile_cache(
 
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", str(path))
-    jax.config.update(
-        "jax_persistent_cache_min_compile_time_secs", float(min_compile_time_s)
-    )
+    # an EXPLICIT operator choice via JAX's own env var wins over our
+    # default floor — clobbering it made "persist everything" requests
+    # silently flaky around the threshold (compiles hovering near 0.5 s
+    # landed or vanished with machine load)
+    if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(min_compile_time_s),
+        )
     # the default cache policy skips "uninteresting" backends/programs;
     # the daily pods want every program cached, CPU CI included
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
