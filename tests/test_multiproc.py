@@ -96,6 +96,54 @@ def test_kill_one_worker_mid_traffic_zero_failed_scores(mp_service):
     assert victim not in mp_service.worker_pids
 
 
+def test_hot_reload_reaches_every_replica_process(tmp_path):
+    """Each replica polls the store independently (like each k8s pod
+    would): a newer checkpoint lands in BOTH worker processes without a
+    restart. Fresh connections per request defeat keep-alive stickiness
+    so the kernel spreads them across listeners."""
+    from bodywork_tpu.serve import MultiProcessService
+
+    store = FilesystemStore(tmp_path / "store")
+    rng = np.random.default_rng(5)
+    X = rng.uniform(0, 100, 400).astype(np.float32)
+    save_model(store, LinearRegressor().fit(X, (1.0 + 0.5 * X)),
+               date(2026, 7, 1))
+    saved = {k: os.environ.get(k)
+             for k in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")}
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    try:
+        with MultiProcessService(str(tmp_path / "store"), workers=2,
+                                 engine="xla",
+                                 watch_interval_s=0.5) as svc:
+            s = _session()
+            r = s.post(svc.url, json={"X": 50}, timeout=30,
+                       headers={"Connection": "close"})
+            assert r.json()["model_date"] == "2026-07-01"
+            save_model(store, LinearRegressor().fit(X, (2.0 + 2.0 * X)),
+                       date(2026, 7, 2))
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                dates = {
+                    s.post(svc.url, json={"X": 50}, timeout=30,
+                           headers={"Connection": "close"}).json()[
+                        "model_date"]
+                    for _ in range(8)
+                }
+                if dates == {"2026-07-02"}:
+                    break
+                time.sleep(0.5)
+            assert dates == {"2026-07-02"}, (
+                f"replicas still serving {dates} after 60s"
+            )
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def test_supervisor_respawns_killed_worker(mp_service):
     """Replica recovery: the supervisor restores the declared replica
     count after a kill (the Deployment-restarts-pod analogue)."""
