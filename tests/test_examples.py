@@ -167,3 +167,23 @@ def test_example_07_wide_model(tmp_path, monkeypatch, capsys):
     for line in ("xla-bf16    max rel delta", "pallas-bf16 max rel delta"):
         rel = float(out.rsplit(line + " vs f32: ", 1)[1].split()[0])
         assert rel < 0.05
+
+
+def test_example_08_drift_gate(tmp_path, monkeypatch, capsys):
+    """The calibrated-gate story end-to-end: a frozen model under the
+    reference's own alpha swing is flagged by the bias rule within the
+    swing window; the reference's MAPE channel stays silent; the windowed
+    gate reflects current state."""
+    _run_example(monkeypatch, "08_drift_gate",
+                 "--store", str(tmp_path / "store"))
+    out = capsys.readouterr().out
+    assert "retraining now STOPS" in out
+    m = re.search(r"DRIFT detected: (\d+)/(\d+) day\(s\) flagged, first "
+                  r"(\S+) \(live day (\d+)\)", out)
+    assert m, out
+    live_day = int(m.group(4))
+    # calibration (tests/test_monitor.py): detection lands within the
+    # swing window around the trough
+    assert 35 <= live_day <= 53
+    assert "drifted=False" in out        # the MAPE/corr-only verdict
+    assert "last 7 days: drifted=True" in out
