@@ -261,6 +261,21 @@ from IPython.display import Image
 
 png = render_drift_dashboard(store, STORE_DIR + "/drift-dashboard.png", report=report)
 Image(filename=str(png))"""),
+    ("md", """\
+Where the reference stops — an analyst eyeballing this dashboard — the
+framework adds a decision rule calibrated against the generator itself
+(`monitor.detect_drift`; the load-bearing channel is the live residual
+mean vs its deployment-time baseline, because mean APE provably cannot
+see this generator's drift). This pipeline retrains daily, so the
+verdict stays green; freeze the model and it fires within days of the
+alpha swing (`examples/08_drift_gate.py`, and
+`cli report --fail-on-drift --window 7` as a CronJob/CI gate)."""),
+    ("code", """\
+from bodywork_tpu.monitor import detect_drift
+
+verdict = detect_drift(report)
+print("drifted:", verdict["drifted"], "(daily retraining keeps the gate green)")
+verdict["thresholds"]"""),
 ]
 
 
