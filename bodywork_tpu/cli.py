@@ -123,16 +123,14 @@ def cmd_serve(args) -> int:
             return 1
         from bodywork_tpu.serve import MultiProcessService
 
-        import time
-
         svc = MultiProcessService(
             args.store, host=args.host, port=args.port,
             workers=args.workers, engine=args.engine,
             watch_interval_s=watch, buckets=args.buckets,
         ).start()
         try:
-            while True:
-                time.sleep(3600)
+            svc.wait()
+            return 0
         except KeyboardInterrupt:
             return 0
         finally:

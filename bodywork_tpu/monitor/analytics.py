@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import io
 
+import numpy as np
 import pandas as pd
 
 from bodywork_tpu.store.base import ArtefactStore
@@ -161,8 +162,6 @@ def detect_drift(
     # pooled residual mean vs the deployment-time baseline (the first
     # bias_window days), in combined standard errors. Persistent model
     # miscalibration cancels; only change since deployment flags.
-    import numpy as np
-
     bias_hit = pd.Series(False, index=full.index)
     needed = {"mean_error_live", "error_std_live", "n_scored_live"}
     if needed <= set(full.columns):

@@ -202,6 +202,11 @@ class MultiProcessService:
         """SIGKILL one replica (fault-injection hook for tests/drills)."""
         os.kill(pid, signal.SIGKILL)
 
+    def wait(self) -> None:
+        """Block until :meth:`stop` is called from another thread or the
+        process is signalled — the pod-entrypoint serve loop."""
+        self._stopping.wait()
+
     def stop(self) -> None:
         self._stopping.set()
         for proc in self._procs:

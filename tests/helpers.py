@@ -125,6 +125,25 @@ def install_fake_gcs(monkeypatch):
 
     return GCSStore
 
+@contextlib.contextmanager
+def hermetic_env(**extra):
+    """Temporarily force the relay-proof env in ``os.environ`` for code
+    that LAUNCHES subprocesses (notebook kernels, spawned serving
+    workers — they re-run sitecustomize, so the in-process conftest pin
+    cannot reach them). Restores prior values on exit."""
+    names = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "", **extra}
+    saved = {k: os.environ.get(k) for k in names}
+    os.environ.update(names)
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 _LISTEN_RE = re.compile(r"listening on (http://\S+)/score/v1")
 
 

@@ -10,6 +10,7 @@ API-server cross-field rules) must fail the schema layer even where the
 whitelist's mental model might admit them.
 """
 import copy
+import functools
 
 import pytest
 
@@ -22,6 +23,7 @@ from bodywork_tpu.pipeline.k8s_schema import (
 from bodywork_tpu.pipeline.k8s_validate import validate_manifest
 
 
+@functools.lru_cache(maxsize=1)  # 16 mutation cases share one emission
 def _all_docs():
     docs = {}
     for mode, path in (

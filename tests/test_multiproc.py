@@ -22,6 +22,7 @@ from requests.adapters import HTTPAdapter, Retry
 from bodywork_tpu.models import LinearRegressor
 from bodywork_tpu.models.checkpoint import save_model
 from bodywork_tpu.store import FilesystemStore
+from tests.helpers import hermetic_env
 
 
 @pytest.fixture(scope="module")
@@ -35,23 +36,15 @@ def mp_service(tmp_path_factory):
     y = (1.0 + 0.5 * X).astype(np.float32)
     save_model(store, LinearRegressor().fit(X, y), date(2026, 7, 1))
 
-    # the spawned workers re-run sitecustomize: the kernel-side guard
+    # the spawned workers re-run sitecustomize: the subprocess-side guard
     # keeps them hermetic whatever the relay is doing (same guard as the
     # notebook kernels)
-    saved = {k: os.environ.get(k)
-             for k in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")}
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["PALLAS_AXON_POOL_IPS"] = ""
-    svc = MultiProcessService(str(root), workers=2, engine="xla").start()
-    try:
-        yield svc
-    finally:
-        svc.stop()
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
+    with hermetic_env():
+        svc = MultiProcessService(str(root), workers=2, engine="xla").start()
+        try:
+            yield svc
+        finally:
+            svc.stop()
 
 
 def _session() -> requests.Session:
@@ -108,11 +101,7 @@ def test_hot_reload_reaches_every_replica_process(tmp_path):
     X = rng.uniform(0, 100, 400).astype(np.float32)
     save_model(store, LinearRegressor().fit(X, (1.0 + 0.5 * X)),
                date(2026, 7, 1))
-    saved = {k: os.environ.get(k)
-             for k in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")}
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["PALLAS_AXON_POOL_IPS"] = ""
-    try:
+    with hermetic_env():
         with MultiProcessService(str(tmp_path / "store"), workers=2,
                                  engine="xla",
                                  watch_interval_s=0.5) as svc:
@@ -136,12 +125,6 @@ def test_hot_reload_reaches_every_replica_process(tmp_path):
             assert dates == {"2026-07-02"}, (
                 f"replicas still serving {dates} after 60s"
             )
-    finally:
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
 
 
 def test_supervisor_respawns_killed_worker(mp_service):

@@ -71,19 +71,14 @@ def reexecuted(tmp_path_factory):
     """Run all five notebooks in order against one fresh store, once —
     with the kernel env guarded so a wedged TPU relay cannot hang the
     suite (the kernel subprocess inherits ``os.environ``)."""
-    import os
-
     from nbclient import NotebookClient
 
+    from tests.helpers import hermetic_env
+
     store_dir = str(tmp_path_factory.mktemp("nb-store"))
-    saved = {
-        k: os.environ.get(k)
-        for k in ("BODYWORK_TPU_NB_STORE", *HERMETIC_KERNEL_ENV)
-    }
     out = {}
-    try:
-        os.environ["BODYWORK_TPU_NB_STORE"] = store_dir
-        os.environ.update(HERMETIC_KERNEL_ENV)
+    with hermetic_env(**HERMETIC_KERNEL_ENV,
+                      BODYWORK_TPU_NB_STORE=store_dir):
         for name in NB_ORDER:
             nb = nbformat.read(NB_DIR / name, as_version=4)
             client = NotebookClient(
@@ -92,50 +87,31 @@ def reexecuted(tmp_path_factory):
             )
             client.execute()
             out[name] = nb
-    finally:
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
     return out
 
 
 def test_notebook_kernel_survives_wedged_relay(tmp_path):
-    """Regression for the round-4 judging failure: with the relay
-    pointing at a black hole (simulating a wedged pool), a notebook
-    kernel launched with the fixture's guard env must still come up on
-    CPU and finish — proving ``pytest tests`` cannot hang at this layer
-    again. Without the guard the kernel blocks at jax backend init and
-    nbclient times out."""
-    import os
-
+    """Regression for the round-4 judging failure: a kernel launched
+    with the fixture's guard env comes up on CPU with the relay plugin's
+    pool list EMPTIED — it cannot consult a wedged relay at backend init
+    no matter what the inherited environment pointed at (the guard
+    overwrites it), so ``pytest tests`` cannot hang at this layer again.
+    Without the guard the kernel blocks at jax backend init and nbclient
+    times out at 600 s."""
     from nbclient import NotebookClient
 
-    saved = {
-        k: os.environ.get(k)
-        for k in ("PALLAS_AXON_POOL_IPS", *HERMETIC_KERNEL_ENV)
-    }
+    from tests.helpers import hermetic_env
+
     nb = nbformat.v4.new_notebook()
     nb.cells = [nbformat.v4.new_code_cell(
         "import jax\nprint('PLATFORM', jax.devices()[0].platform)"
     )]
-    try:
-        # a non-routable pool address: any kernel that consults the relay
-        # plugin's pool blocks here — the guard must prevent that
-        os.environ["PALLAS_AXON_POOL_IPS"] = "10.255.255.1"
-        os.environ.update(HERMETIC_KERNEL_ENV)
+    with hermetic_env(**HERMETIC_KERNEL_ENV):
         client = NotebookClient(
             nb, timeout=120, kernel_name="python3",
             resources={"metadata": {"path": str(tmp_path)}},
         )
         client.execute()
-    finally:
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
     assert "PLATFORM cpu" in _cell_text(nb)
 
 
