@@ -58,6 +58,22 @@ STORE_VOLUME_MODES = ("auto", "pvc", "hostpath", "gcs")
 DEFAULT_DAILY_SCHEDULE = "0 6 * * *"
 
 
+def _offset_schedule(schedule: str, minutes: int) -> str:
+    """Shift a simple 5-field cron line's minute field by ``minutes``
+    (mod 60, bumping a numeric hour field when it wraps) — used to run
+    the drift gate after the day loop it audits. Non-numeric fields
+    (``*``, lists, steps) keep the hour untouched: a wrapped minute
+    under ``*`` hours still runs hourly, just offset."""
+    fields = schedule.split()
+    if len(fields) != 5 or not fields[0].isdigit():
+        return schedule  # macro or complex minute: run at the same time
+    minute = int(fields[0]) + minutes
+    if minute >= 60 and fields[1].isdigit():
+        fields[1] = str((int(fields[1]) + minute // 60) % 24)
+    fields[0] = str(minute % 60)
+    return " ".join(fields)
+
+
 @dataclasses.dataclass(frozen=True)
 class _StoreMedium:
     """How pods reach the shared artefact store (see module docstring)."""
@@ -553,6 +569,42 @@ def generate_manifests(
                                 gate_on_deps=False,  # run-day sequences and
                                 # bootstraps internally; a dataset gate here
                                 # would deadlock a fresh store
+                            )
+                        }
+                    }
+                },
+            },
+        }
+        # the drift GATE the verdict rule exists to feed (calibrated bias
+        # rule, monitor.detect_drift): runs after each day loop, exits 4
+        # on current-state drift — the failed Job is the k8s-native alarm
+        # an operator or alerting stack watches. --window keeps the gate
+        # on the last week instead of latching on history.
+        docs["99-drift-gate-cronjob.yaml"] = {
+            "apiVersion": "batch/v1",
+            "kind": "CronJob",
+            "metadata": {
+                "name": f"{spec.name}--drift-gate",
+                "namespace": namespace,
+                "labels": labels_base,
+            },
+            "spec": {
+                "schedule": _offset_schedule(daily_schedule, minutes=30),
+                "concurrencyPolicy": "Forbid",
+                "jobTemplate": {
+                    "spec": {
+                        "template": {
+                            "spec": _pod_spec(
+                                spec,
+                                next(iter(spec.stages.values())),
+                                store,
+                                image,
+                                ["python", "-m", "bodywork_tpu.cli",
+                                 "report", "--store", store_path,
+                                 "--fail-on-drift", "--window", "7"],
+                                "Never",
+                                gate_on_deps=False,  # an empty store just
+                                # prints "no metric history yet", exit 0
                             )
                         }
                     }

@@ -195,8 +195,17 @@ def test_manifests_structure(tmp_path):
         kinds[doc["kind"]] += 1
     assert kinds == {
         "Namespace": 1, "ConfigMap": 1, "PersistentVolumeClaim": 1,
-        "Job": 3, "Deployment": 1, "Service": 1, "CronJob": 1,
+        "Job": 3, "Deployment": 1, "Service": 1, "CronJob": 2,
     }
+    # the second CronJob is the drift GATE: audits each day loop 30 min
+    # after it, exits 4 (failed Job = the k8s-native alarm) on
+    # current-state drift via the calibrated verdict rule
+    gate = docs["99-drift-gate-cronjob.yaml"]
+    cmd = gate["spec"]["jobTemplate"]["spec"]["template"]["spec"][
+        "containers"][0]["command"]
+    assert cmd[3:] == ["report", "--store", "/mnt/store",
+                       "--fail-on-drift", "--window", "7"]
+    assert gate["spec"]["schedule"] == "30 6 * * *"  # day loop + 30 min
     # default store medium is a ReadWriteMany PVC (multi-node safe): every
     # pod mounts the claim, nothing references the node's own filesystem
     pvc = docs["00-store-pvc.yaml"]
@@ -254,6 +263,16 @@ def test_batch_stage_timeout_does_not_block_on_worker(store):
     with pytest.raises(StageFailure):
         LocalRunner(spec, store).run_day(date(2026, 1, 1))
     assert time.perf_counter() - t0 < 3.0  # _slow_stage sleeps 5s
+
+
+def test_offset_schedule_wraps_cleanly():
+    from bodywork_tpu.pipeline.k8s import _offset_schedule
+
+    assert _offset_schedule("0 6 * * *", 30) == "30 6 * * *"
+    assert _offset_schedule("45 23 * * *", 30) == "15 0 * * *"  # wraps day
+    assert _offset_schedule("50 * * * *", 30) == "20 * * * *"  # hourly stays
+    assert _offset_schedule("@daily", 30) == "@daily"  # macros untouched
+    assert _offset_schedule("*/5 6 * * *", 30) == "*/5 6 * * *"
 
 
 def test_per_stage_requirements_isolation(tmp_path):
