@@ -22,6 +22,7 @@ exit-code contract the reference implements per-script
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from datetime import date
 
@@ -112,8 +113,37 @@ def _positive_int(raw: str) -> int:
     return value
 
 
+def _env_number(name: str, cast, minimum):
+    """Parser-build-time env default: a malformed or out-of-range value
+    is IGNORED with a stderr note rather than crashing every subcommand
+    at build_parser() (these env vars only concern `serve`). The flag's
+    own argparse type still validates explicit command-line values."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return None
+    try:
+        value = cast(raw)
+    except ValueError:
+        value = None
+    if value is None or value < minimum:
+        print(
+            f"warning: ignoring {name}={raw!r} (need a number >= {minimum})",
+            file=sys.stderr,
+        )
+        return None
+    return value
+
+
 def cmd_serve(args) -> int:
     watch = args.reload_interval if args.reload_interval > 0 else None
+    batch_window = args.batch_window_ms if args.batch_window_ms > 0 else None
+    if args.batch_max_rows and batch_window is None:
+        # max-rows alone would silently serve unbatched — the window is
+        # the coalescer's on-switch
+        log.warning(
+            "--batch-max-rows has no effect without --batch-window-ms; "
+            "request coalescing stays OFF"
+        )
     if args.workers and args.workers > 1:
         # real OS-process replicas on one SO_REUSEPORT port (the local
         # materialisation of the reference's `replicas: 2` Deployment);
@@ -127,6 +157,8 @@ def cmd_serve(args) -> int:
             args.store, host=args.host, port=args.port,
             workers=args.workers, engine=args.engine,
             watch_interval_s=watch, buckets=args.buckets,
+            batch_window_ms=batch_window,
+            batch_max_rows=args.batch_max_rows,
         ).start()
         try:
             svc.wait()
@@ -146,6 +178,8 @@ def cmd_serve(args) -> int:
         engine=args.engine,
         watch_interval_s=watch,
         buckets=args.buckets,
+        batch_window_ms=batch_window,
+        batch_max_rows=args.batch_max_rows,
     )
     return 0
 
@@ -427,6 +461,26 @@ def build_parser() -> argparse.ArgumentParser:
         help="comma-separated request-size buckets to compile and warm "
              "(positive integers; narrows startup cost when request "
              "sizes are known; default: each engine's own bucket set)",
+    )
+    p.add_argument(
+        "--batch-window-ms", type=float, metavar="MS",
+        default=_env_number("BODYWORK_TPU_BATCH_WINDOW_MS", float, 0.0)
+        or 0.0,
+        help="coalesce concurrent single-row /score/v1 requests into "
+             "shared padded device calls, flushing each batch after at "
+             "most this many milliseconds (serve.batcher; ~1-2 ms is a "
+             "good start). 0 disables (default; env "
+             "BODYWORK_TPU_BATCH_WINDOW_MS overrides). Adds at most one "
+             "window of latency per request; under concurrency, device "
+             "dispatches scale with bucket size instead of request count",
+    )
+    p.add_argument(
+        "--batch-max-rows", type=_positive_int, metavar="N",
+        default=_env_number("BODYWORK_TPU_BATCH_MAX_ROWS", int, 1),
+        help="flush a coalesced batch as soon as it reaches N rows, "
+             "before the window elapses (default 64, or env "
+             "BODYWORK_TPU_BATCH_MAX_ROWS; align with a predictor "
+             "bucket so a full flush pads to one compiled shape)",
     )
 
     p = add("test", cmd_test, help="test a live scoring service")

@@ -40,7 +40,7 @@ from pathlib import Path
 import yaml
 
 from bodywork_tpu.pipeline.images import stage_image_tag
-from bodywork_tpu.pipeline.spec import PipelineSpec, StageSpec
+from bodywork_tpu.pipeline.spec import PipelineSpec, ResourceSpec, StageSpec
 from bodywork_tpu.utils.logging import get_logger
 
 log = get_logger("pipeline.k8s")
@@ -63,13 +63,23 @@ def _offset_schedule(schedule: str, minutes: int) -> str:
     (mod 60, bumping a numeric hour field when it wraps) — used to run
     the drift gate after the day loop it audits. Non-numeric fields
     (``*``, lists, steps) keep the hour untouched: a wrapped minute
-    under ``*`` hours still runs hourly, just offset."""
+    under ``*`` hours still runs hourly, just offset. When the HOUR
+    wraps past midnight and the schedule pins a day-of-month,
+    day-of-week, or month, the shift is abandoned entirely: cron has no
+    carry into the day/month fields, so ``45 23 * * 1`` shifted to
+    ``15 0 * * 1`` would fire ~23h45m EARLY (Monday 00:15) instead of
+    30 min late (and a pinned month's last day would shift clean out of
+    the month) — running the gate at the unshifted time is the lesser
+    error."""
     fields = schedule.split()
     if len(fields) != 5 or not fields[0].isdigit():
         return schedule  # macro or complex minute: run at the same time
     minute = int(fields[0]) + minutes
     if minute >= 60 and fields[1].isdigit():
-        fields[1] = str((int(fields[1]) + minute // 60) % 24)
+        hour = int(fields[1]) + minute // 60
+        if hour >= 24 and fields[2:5] != ["*", "*", "*"]:
+            return schedule  # day would be wrong: keep the original time
+        fields[1] = str(hour % 24)
     fields[0] = str(minute % 60)
     return " ".join(fields)
 
@@ -543,6 +553,17 @@ def generate_manifests(
             "Jobs instead"
         )
     elif daily_schedule:
+        first_stage = next(iter(spec.stages.values()))
+        # run-day executes ALL four stages in-process, so its pod needs
+        # every stage's import closure: it must run the PIPELINE-WIDE
+        # image, never a per-stage image whose pins cover only stage-1
+        # (a stage-1 image lacks e.g. werkzeug and the deployed loop
+        # would crash at stage-2 with ModuleNotFoundError). Keep
+        # stage-1's TPU resources — run-day trains on-device — but drop
+        # the image/requirements overrides and use an honest name.
+        run_day_stage = dataclasses.replace(
+            first_stage, name="daily-loop", image=None, requirements=[],
+        )
         docs["99-daily-loop-cronjob.yaml"] = {
             "apiVersion": "batch/v1",
             "kind": "CronJob",
@@ -559,7 +580,7 @@ def generate_manifests(
                         "template": {
                             "spec": _pod_spec(
                                 spec,
-                                next(iter(spec.stages.values())),
+                                run_day_stage,
                                 store,
                                 image,
                                 ["python", "-m", "bodywork_tpu.cli", "run-day",
@@ -579,7 +600,16 @@ def generate_manifests(
         # rule, monitor.detect_drift): runs after each day loop, exits 4
         # on current-state drift — the failed Job is the k8s-native alarm
         # an operator or alerting stack watches. --window keeps the gate
-        # on the last week instead of latching on history.
+        # on the last week instead of latching on history. `report` is a
+        # pure host-side pandas job: a plain CPU ResourceSpec, never
+        # stage-1's TPU chips/nodeSelectors (which would park the gate on
+        # a TPU node and burn a chip on reading CSVs) — and the
+        # pipeline-wide image, since the report path isn't in stage-1's
+        # pin set either.
+        drift_gate_stage = dataclasses.replace(
+            first_stage, name="drift-gate", image=None, requirements=[],
+            resources=ResourceSpec(cpu_request=0.25, memory_mb=512),
+        )
         docs["99-drift-gate-cronjob.yaml"] = {
             "apiVersion": "batch/v1",
             "kind": "CronJob",
@@ -596,7 +626,7 @@ def generate_manifests(
                         "template": {
                             "spec": _pod_spec(
                                 spec,
-                                next(iter(spec.stages.values())),
+                                drift_gate_stage,
                                 store,
                                 image,
                                 ["python", "-m", "bodywork_tpu.cli",

@@ -1,5 +1,6 @@
 from bodywork_tpu.serve.predictor import BF16MLPPredictor, PaddedPredictor
 from bodywork_tpu.serve.app import create_app
+from bodywork_tpu.serve.batcher import CoalescerSaturated, RequestCoalescer
 from bodywork_tpu.serve.multiproc import MultiProcessService
 from bodywork_tpu.serve.reload import CheckpointWatcher
 from bodywork_tpu.serve.server import (
@@ -13,6 +14,8 @@ from bodywork_tpu.serve.server import (
 __all__ = [
     "BF16MLPPredictor",
     "CheckpointWatcher",
+    "CoalescerSaturated",
+    "RequestCoalescer",
     "MultiProcessService",
     "PaddedPredictor",
     "RoundRobinApp",

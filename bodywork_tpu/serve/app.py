@@ -21,9 +21,14 @@ TPU-native additions beyond parity:
   shape-bucketed predictor (BASELINE.json config 4: 1k-row predict requests).
 - ``GET /healthz`` — readiness probe for the orchestrator (the reference
   relies on k8s TCP probes only).
+- opt-in cross-request micro-batching (``serve.batcher``): concurrent
+  single-row ``/score/v1`` requests coalesce into shared padded device
+  calls, so per-worker throughput under load scales with bucket size
+  instead of request count. Off by default; responses are byte-identical
+  either way (each output row depends only on its own input row).
 
 Params live in TPU HBM from model load; per-request work is one padded
-device call.
+device call (shared across requests when the coalescer is on).
 """
 from __future__ import annotations
 
@@ -35,6 +40,7 @@ from werkzeug.exceptions import HTTPException, MethodNotAllowed, NotFound
 from werkzeug.wrappers import Request, Response
 
 from bodywork_tpu.models.base import Regressor
+from bodywork_tpu.serve.batcher import CoalescerSaturated
 from bodywork_tpu.serve.predictor import PaddedPredictor
 from bodywork_tpu.utils.logging import get_logger
 
@@ -73,6 +79,7 @@ class ScoringApp:
         model_date: date | None = None,
         buckets: tuple[int, ...] | None = None,
         predictor=None,
+        batcher=None,
     ):
         # a custom predictor (e.g. parallel.DataParallelPredictor over a
         # device mesh) replaces the single-device bucketed default
@@ -82,6 +89,9 @@ class ScoringApp:
         self._served = _Served(
             predictor, model.info, str(model_date) if model_date else None
         )
+        # opt-in request coalescer (serve.batcher.RequestCoalescer);
+        # None = every request dispatches its own padded device call
+        self.batcher = batcher
         self._routes = {
             ("POST", "/score/v1"): self.score_data_instance,
             ("POST", "/score/v1/batch"): self.score_batch,
@@ -116,7 +126,30 @@ class ScoringApp:
         self._served = _Served(
             predictor, model.info, str(model_date) if model_date else None
         )
+        if self.batcher is not None:
+            # the coalescer's bundle-grouping already guarantees no batch
+            # mixes generations; draining here additionally flushes every
+            # ALREADY-ENQUEUED old-model row before the swap returns.
+            # (Request threads that read the old bundle but have not yet
+            # enqueued finish on the model they started with — the same
+            # in-flight semantics as the unbatched app above.)
+            if not self.batcher.drain():
+                # correctness is unaffected (queued old-bundle rows still
+                # score on their own generation) — but the prompt-flush
+                # promise did not hold, and silence would hide a wedged
+                # dispatcher
+                log.warning(
+                    "hot-swap proceeded before the request coalescer "
+                    "fully drained; old-model rows may still be in flight"
+                )
         log.info(f"hot-swapped served model -> {model.info} ({model_date})")
+
+    def close(self) -> None:
+        """Release app-owned background resources (the coalescer's
+        dispatcher thread). Idempotent; the app still serves afterwards,
+        just without coalescing."""
+        if self.batcher is not None:
+            self.batcher.stop()
 
     # -- WSGI plumbing -----------------------------------------------------
     def __call__(self, environ, start_response):
@@ -167,10 +200,21 @@ class ScoringApp:
             return err
         served = self._served  # one read: stable across a hot swap
         X = np.array(X, ndmin=2)  # scalar -> (1, 1), as the reference
-        prediction = served.predictor.predict(X)
+        prediction0 = None
+        if self.batcher is not None and X.shape[0] == 1:
+            try:
+                # the submission carries ITS served bundle: the batch it
+                # lands in is built from one model generation only, and
+                # the response pairs that generation's prediction with
+                # that generation's identity fields below
+                prediction0 = self.batcher.submit(served, X[0])
+            except CoalescerSaturated:
+                pass  # overload/shutdown: degrade to a direct dispatch
+        if prediction0 is None:
+            prediction0 = float(served.predictor.predict(X)[0])
         return _json_response(
             {
-                "prediction": float(prediction[0]),
+                "prediction": prediction0,
                 "model_info": served.model_info,
                 "model_date": served.model_date,
             }
@@ -212,8 +256,23 @@ def create_app(
     warmup: bool = True,
     warmup_sync: bool = True,
     predictor=None,
+    batch_window_ms: float | None = None,
+    batch_max_rows: int | None = None,
 ) -> ScoringApp:
-    app = ScoringApp(model, model_date, buckets, predictor=predictor)
+    """``batch_window_ms`` > 0 opts into cross-request micro-batching
+    (``serve.batcher``): concurrent single-row ``/score/v1`` requests
+    coalesce into one padded device call, flushed when ``batch_max_rows``
+    accumulate or the window elapses, whichever first."""
+    batcher = None
+    if batch_window_ms and batch_window_ms > 0:
+        from bodywork_tpu.serve.batcher import DEFAULT_MAX_ROWS, RequestCoalescer
+
+        batcher = RequestCoalescer(
+            window_ms=batch_window_ms,
+            max_rows=batch_max_rows or DEFAULT_MAX_ROWS,
+        ).start()
+    app = ScoringApp(model, model_date, buckets, predictor=predictor,
+                     batcher=batcher)
     if warmup:
         app.predictor.warmup(sync=warmup_sync)
     return app

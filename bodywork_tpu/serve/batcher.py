@@ -1,0 +1,275 @@
+"""Cross-request micro-batching for the scoring service.
+
+Without it, every concurrent ``/score/v1`` request executes its OWN
+bucket-padded device call: N threads of single-row traffic become N
+serialized one-row dispatches, so per-worker throughput is bounded by
+dispatch rate instead of the accelerator's batch dimension. The standard
+accelerator-serving answer is request coalescing — hold a single-row
+request for a tiny window, stack it with its concurrent neighbours, issue
+ONE padded device call, scatter results back — trading a bounded latency
+cost (at most the flush window) for throughput that scales with bucket
+size under load.
+
+Design:
+
+- :class:`RequestCoalescer` owns a bounded pending list and one
+  dispatcher thread. ``submit()`` blocks the calling request thread until
+  its row's prediction is back.
+- **Flush policy** (adaptive): the dispatcher flushes as soon as a batch
+  reaches ``max_rows`` OR ``window_ms`` has elapsed since it started
+  assembling one, whichever happens first. An idle service therefore pays
+  at most one window of extra latency per request; a saturated one
+  flushes full buckets back-to-back with no window wait at all.
+- **Hot-swap safety**: every submission captures the app's served-model
+  bundle (predictor + identity) at enqueue time, and a flush only takes
+  the queue's leading run of submissions that share ONE bundle. A
+  checkpoint swap landing mid-queue splits the queue into an old-model
+  batch and a new-model batch — two device calls, each internally
+  consistent — so a batch can never mix parameters from two model
+  generations. ``drain()`` additionally lets the hot-swap path block
+  until everything enqueued before the swap has been dispatched.
+- **Overload**: when the pending list is full, ``submit()`` raises
+  :class:`CoalescerSaturated` and the caller falls back to a direct
+  per-request dispatch — backpressure degrades to the uncoalesced
+  behaviour instead of dropping or deadlocking requests.
+- A batch whose device call raises fails ONLY that batch: the error is
+  scattered to its submitters (each request 500s) and the dispatcher
+  keeps serving.
+
+The coalescer is deliberately ignorant of HTTP and of predictor
+internals: it stacks rows, calls ``served.predictor.predict`` once, and
+indexes the result. The existing shape-bucket/pad/chunk algebra
+(``serve.predictor``) is reused untouched, which is also why responses
+are byte-identical with the batcher on or off — each output row of the
+padded apply depends only on its own input row.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("serve.batcher")
+
+#: default flush window: ~1-2 ms captures concurrent arrivals under load
+#: while staying negligible next to the reference's 8.22 ms/score
+DEFAULT_WINDOW_MS = 2.0
+#: default batch cap; aligned with a mid-size predictor bucket so a full
+#: flush pads to exactly one compiled shape
+DEFAULT_MAX_ROWS = 64
+
+
+class CoalescerSaturated(RuntimeError):
+    """The pending queue is full (or the coalescer is stopped); the
+    caller should fall back to a direct per-request dispatch."""
+
+
+class _Submission:
+    """One enqueued row: the input, the served bundle it must be scored
+    by, and the rendezvous the request thread waits on."""
+
+    __slots__ = ("row", "served", "event", "result", "error", "enqueued_at")
+
+    def __init__(self, row: np.ndarray, served):
+        self.row = row
+        self.served = served
+        self.event = threading.Event()
+        self.result: float | None = None
+        self.error: BaseException | None = None
+        self.enqueued_at = time.monotonic()
+
+
+class RequestCoalescer:
+    """Batches concurrent single-row predictions into shared device calls.
+
+    Thread-safe; one dispatcher thread per instance (one instance per
+    worker process — replicas never share one, exactly as they never
+    share a predictor).
+    """
+
+    def __init__(
+        self,
+        window_ms: float = DEFAULT_WINDOW_MS,
+        max_rows: int = DEFAULT_MAX_ROWS,
+        max_pending: int = 4096,
+    ):
+        if window_ms <= 0:
+            raise ValueError(f"window_ms must be > 0, got {window_ms}")
+        if max_rows < 1:
+            raise ValueError(f"max_rows must be >= 1, got {max_rows}")
+        self.window_s = window_ms / 1000.0
+        self.max_rows = max_rows
+        self.max_pending = max_pending
+        self._cond = threading.Condition()
+        self._pending: list[_Submission] = []
+        #: submissions taken by the dispatcher but not yet scattered —
+        #: kept as objects (not a count) so drain() can wait on exactly
+        #: the submissions that existed when it was called
+        self._inflight: list[_Submission] = []
+        self._stopped = False
+        self._started = False
+        # observability: the dispatches-vs-requests ratio IS the payoff
+        self.rows_submitted = 0
+        self.batches_dispatched = 0
+        self.rows_dispatched = 0
+        self.max_batch_rows = 0
+        self._thread = threading.Thread(
+            target=self._run, name="request-coalescer", daemon=True
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "RequestCoalescer":
+        with self._cond:
+            if self._started:
+                return self
+            self._started = True
+        self._thread.start()
+        log.info(
+            f"request coalescer on: window={self.window_s * 1e3:.1f}ms "
+            f"max_rows={self.max_rows}"
+        )
+        return self
+
+    def stop(self) -> None:
+        """Flush everything already enqueued, then stop the dispatcher.
+        Late ``submit()`` calls raise :class:`CoalescerSaturated` (the
+        caller's direct-dispatch fallback), so shutdown never strands a
+        request thread."""
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        if self._thread.ident is not None:
+            self._thread.join(timeout=10)
+
+    # -- request path ------------------------------------------------------
+    def submit(self, served, row: np.ndarray, timeout_s: float = 60.0) -> float:
+        """Enqueue one ``(1, n_features)``-shaped row against ``served``
+        (the app's immutable served-model bundle) and block until its
+        prediction returns. Raises :class:`CoalescerSaturated` when the
+        queue is full/stopped, or the batch's own error if the device
+        call failed."""
+        sub = _Submission(np.asarray(row, dtype=np.float32), served)
+        with self._cond:
+            if self._stopped or not self._started:
+                raise CoalescerSaturated("coalescer is not running")
+            if len(self._pending) >= self.max_pending:
+                raise CoalescerSaturated(
+                    f"{len(self._pending)} requests already pending"
+                )
+            self._pending.append(sub)
+            self.rows_submitted += 1
+            self._cond.notify_all()
+        if not sub.event.wait(timeout_s):
+            raise TimeoutError(
+                f"coalesced prediction not ready within {timeout_s:.0f}s"
+            )
+        if sub.error is not None:
+            raise sub.error
+        return sub.result
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Block until every submission enqueued before this call has
+        been dispatched and scattered — the hot-swap path calls this
+        after an atomic model swap so no ALREADY-ENQUEUED old-model row
+        is still queued when the swap returns. (A request thread that
+        snapshotted the old bundle but has not yet enqueued is the same
+        in-flight case as the unbatched app: it finishes on the model it
+        started with — the swap bounds, it does not eliminate, the old
+        generation's lifetime.) Only the submissions present at call
+        time are waited on (their completion events fire on scatter,
+        success or error): new traffic arriving mid-drain never extends
+        the wait, so a swap under sustained load still returns promptly.
+        Returns False on timeout."""
+        with self._cond:
+            targets = self._pending + self._inflight
+        deadline = time.monotonic() + timeout_s
+        for sub in targets:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not sub.event.wait(remaining):
+                return False
+        return True
+
+    # -- dispatcher --------------------------------------------------------
+    def _take_batch_locked(self) -> list[_Submission]:
+        """The queue's leading run of submissions sharing one served
+        bundle AND one row shape, up to ``max_rows``. Grouping by bundle
+        identity is the hot-swap guarantee (a batch can never span a
+        model swap); grouping by shape keeps a concurrent odd-width row
+        (e.g. a multi-feature payload scored for its first row) from
+        failing the whole stack for its neighbours."""
+        head = self._pending[0]
+        n = 1
+        while (
+            n < len(self._pending)
+            and n < self.max_rows
+            and self._pending[n].served is head.served
+            and self._pending[n].row.shape == head.row.shape
+        ):
+            n += 1
+        batch, self._pending = self._pending[:n], self._pending[n:]
+        self._inflight.extend(batch)
+        return batch
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._stopped:
+                    self._cond.wait()
+                if not self._pending and self._stopped:
+                    return
+                # assemble: wait out the window for neighbours unless the
+                # batch fills (or a swap boundary caps it) first. The
+                # deadline is anchored to the HEAD's enqueue time, not
+                # this loop iteration: a row left behind by a previous
+                # partial take (shape/bundle split, max_rows cap) has
+                # already aged and flushes the moment its own window is
+                # up — "at most one window of extra latency" holds for
+                # every request, not just batch heads. A stopping
+                # coalescer flushes immediately.
+                deadline = self._pending[0].enqueued_at + self.window_s
+                while not self._stopped and len(self._pending) < self.max_rows:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                batch = self._take_batch_locked()
+            self._execute(batch)
+            with self._cond:
+                # single dispatcher: the in-flight set IS this batch
+                self._inflight.clear()
+
+    def _execute(self, batch: list[_Submission]) -> None:
+        served = batch[0].served
+        try:
+            X = np.vstack([sub.row for sub in batch])
+            predictions = served.predictor.predict(X)
+            for i, sub in enumerate(batch):
+                sub.result = float(predictions[i])
+        except BaseException as exc:  # scatter, don't kill the dispatcher
+            log.error(
+                f"coalesced batch of {len(batch)} failed: {exc!r}"
+            )
+            for sub in batch:
+                sub.error = exc
+        finally:
+            self.batches_dispatched += 1
+            self.rows_dispatched += len(batch)
+            self.max_batch_rows = max(self.max_batch_rows, len(batch))
+            for sub in batch:
+                sub.event.set()
+
+    def stats(self) -> dict:
+        """Dispatch accounting: ``rows_dispatched / batches_dispatched``
+        is the realised mean batch size — the amortisation factor."""
+        with self._cond:
+            return {
+                "rows_submitted": self.rows_submitted,
+                "batches_dispatched": self.batches_dispatched,
+                "rows_dispatched": self.rows_dispatched,
+                "max_batch_rows": self.max_batch_rows,
+                "window_ms": round(self.window_s * 1e3, 3),
+                "max_rows": self.max_rows,
+            }

@@ -43,7 +43,9 @@ def _reuseport_socket(host: str, port: int) -> socket.socket:
 
 
 def _worker_main(store_path: str, host: str, port: int, engine: str,
-                 watch_interval_s: float | None, buckets, ready):
+                 watch_interval_s: float | None, buckets, ready,
+                 batch_window_ms: float | None = None,
+                 batch_max_rows: int | None = None):
     """One serving replica: load latest checkpoint -> predictor -> listen
     on the shared port. Runs in a SPAWNED process (a fork would inherit
     the parent's initialized XLA runtime threads — undefined behavior)."""
@@ -59,8 +61,13 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
     served_key, _ = store.latest(MODELS_PREFIX)
     model, model_date = load_model(store, served_key)
     predictor = build_predictor(model, None, engine, buckets=buckets)
+    # one coalescer PER WORKER PROCESS: replicas never share a dispatcher
+    # (they never share a predictor either), so each worker amortises its
+    # own connection share across its own padded device calls
     app = create_app(model, model_date, predictor=predictor,
-                     buckets=buckets)
+                     buckets=buckets,
+                     batch_window_ms=batch_window_ms,
+                     batch_max_rows=batch_max_rows)
 
     sock = _reuseport_socket(host, port)
     sock.listen(128)
@@ -80,6 +87,7 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
     finally:  # pragma: no cover - only on signal teardown
         if watcher is not None:
             watcher.stop()
+        app.close()  # flush + stop the worker's coalescer
 
 
 class MultiProcessService:
@@ -108,6 +116,8 @@ class MultiProcessService:
         buckets: tuple[int, ...] | None = None,
         restart: bool = True,
         startup_timeout_s: float = 120.0,
+        batch_window_ms: float | None = None,
+        batch_max_rows: int | None = None,
     ):
         assert workers >= 1, "need at least one replica"
         self.store_path = str(store_path)
@@ -116,6 +126,10 @@ class MultiProcessService:
         self.engine = engine
         self.watch_interval_s = watch_interval_s
         self.buckets = tuple(buckets) if buckets else None
+        # opt-in per-worker request coalescing (serve.batcher); respawned
+        # replicas inherit the same policy
+        self.batch_window_ms = batch_window_ms
+        self.batch_max_rows = batch_max_rows
         self.restart = restart
         self.startup_timeout_s = startup_timeout_s
         self._ctx = multiprocessing.get_context("spawn")
@@ -140,7 +154,8 @@ class MultiProcessService:
         proc = self._ctx.Process(
             target=_worker_main,
             args=(self.store_path, self.host, self.port, self.engine,
-                  self.watch_interval_s, self.buckets, ready),
+                  self.watch_interval_s, self.buckets, ready,
+                  self.batch_window_ms, self.batch_max_rows),
             daemon=True,
         )
         proc.start()

@@ -123,6 +123,14 @@ class CheckpointWatcher:
         except Exception as exc:
             log.error(f"hot reload of {key} failed (will retry): {exc!r}")
             return False
+        # swap_model is an atomic bundle swap; for apps with a request
+        # coalescer it ALSO drains the batch queue before returning.
+        # Mid-flight batched traffic stays consistent either way: every
+        # coalesced submission carries the served bundle it was enqueued
+        # against, and a batch only ever groups one bundle's submissions
+        # (serve.batcher._take_batch_locked) — a swap landing mid-queue
+        # splits old-model and new-model rows into separate device calls,
+        # never one mixed batch.
         for app in self.apps:
             app.swap_model(model, model_date, predictor)
         self._current = candidate

@@ -235,6 +235,8 @@ def serve_latest_model(
     engine: str = "xla",
     watch_interval_s: float | None = None,
     buckets: tuple[int, ...] | None = None,
+    batch_window_ms: float | None = None,
+    batch_max_rows: int | None = None,
 ):
     """Load latest model -> HBM, warm up, serve (reference ``stage_2`` main).
 
@@ -255,8 +257,13 @@ def serve_latest_model(
     # with buckets set, build_predictor always returns a predictor (every
     # engine honours the list), so create_app never needs the knob here
     predictor = build_predictor(model, mesh_data, engine, buckets=buckets)
-    app = create_app(model, model_date, predictor=predictor)
+    app = create_app(
+        model, model_date, predictor=predictor,
+        batch_window_ms=batch_window_ms, batch_max_rows=batch_max_rows,
+    )
     handle = ServiceHandle(app, host, port)
+    # the coalescer's dispatcher stops (after flushing) with the service
+    handle.add_cleanup(app.close)
     if watch_interval_s:
         from bodywork_tpu.serve.reload import CheckpointWatcher
 

@@ -1,0 +1,455 @@
+"""Cross-request micro-batching (serve.batcher): contract freeze,
+dispatch amortisation under concurrency, overload fallback, and the
+hot-swap no-mixed-batch guarantee."""
+import json
+import threading
+import time
+from datetime import date
+
+import numpy as np
+import pytest
+
+from bodywork_tpu.models import LinearRegressor
+from bodywork_tpu.serve import CoalescerSaturated, RequestCoalescer, create_app
+
+
+@pytest.fixture(scope="module")
+def fitted_model():
+    rng = np.random.default_rng(1)
+    X = rng.uniform(0, 100, 600).astype(np.float32)
+    y = (1.0 + 0.5 * X).astype(np.float32)
+    return LinearRegressor().fit(X, y)
+
+
+def _batched_app(fitted_model, window_ms=20.0, max_rows=64):
+    return create_app(
+        fitted_model, date(2026, 7, 1), buckets=(1, 8, 64), warmup=True,
+        batch_window_ms=window_ms, batch_max_rows=max_rows,
+    )
+
+
+def test_response_bytes_identical_with_batcher_on(fitted_model):
+    """The frozen /score/v1 contract survives coalescing BYTE-for-byte:
+    each output row of the padded apply depends only on its own input
+    row, so stacking neighbours must not perturb anything — value,
+    field order, or serialisation."""
+    plain = create_app(fitted_model, date(2026, 7, 1), buckets=(1, 8, 64),
+                       warmup=True)
+    batched = _batched_app(fitted_model)
+    try:
+        for payload in ({"X": 50}, {"X": [[60.0]]}, {"X": 0.0}):
+            r_plain = plain.test_client().post("/score/v1", json=payload)
+            r_batch = batched.test_client().post("/score/v1", json=payload)
+            assert r_plain.status_code == r_batch.status_code == 200
+            assert r_plain.data == r_batch.data
+        # error paths bypass the batcher identically
+        assert batched.test_client().post(
+            "/score/v1", json={"Y": 1}
+        ).status_code == 400
+        # multi-row /score/v1 and the batch endpoint stay direct-dispatch
+        r = batched.test_client().post("/score/v1/batch",
+                                       json={"X": [1.0, 2.0]})
+        assert r.status_code == 200 and r.get_json()["n"] == 2
+    finally:
+        batched.close()
+
+
+def test_concurrent_requests_coalesce_into_fewer_dispatches(fitted_model):
+    """The tentpole claim: >= 16 threads of single-row requests through
+    the WSGI app issue strictly fewer device dispatches than requests,
+    while every row still gets ITS OWN correct prediction."""
+    app = _batched_app(fitted_model, window_ms=25.0)
+    client_errors = []
+    results = []
+    n_threads = 24
+    start = threading.Barrier(n_threads)
+
+    def hit(v: float):
+        try:
+            client = app.test_client()  # werkzeug clients are not thread-safe
+            start.wait()
+            r = client.post("/score/v1", json={"X": v})
+            assert r.status_code == 200
+            results.append((v, r.get_json()["prediction"]))
+        except Exception as exc:
+            client_errors.append(repr(exc))
+
+    threads = [
+        threading.Thread(target=hit, args=(float(i),)) for i in range(n_threads)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not client_errors, client_errors[:3]
+        stats = app.batcher.stats()
+        assert stats["rows_submitted"] == n_threads
+        assert stats["rows_dispatched"] == n_threads
+        # STRICTLY fewer device calls than requests — the amortisation
+        assert stats["batches_dispatched"] < n_threads, stats
+        assert stats["max_batch_rows"] >= 2
+        # per-row correctness: each caller got its own row's prediction,
+        # not a neighbour's (the scatter indexes the stacked result)
+        for v, pred in results:
+            assert pred == pytest.approx(1.0 + 0.5 * v, abs=0.2), (v, pred)
+        assert len({round(p, 3) for _, p in results}) == n_threads
+    finally:
+        app.close()
+
+
+def test_mixed_row_shapes_never_share_a_batch():
+    """A concurrent odd-width row (a multi-feature payload scored for
+    its first row) must not fail its neighbours' stack: batches group by
+    row shape as well as bundle, so every caller still gets a correct
+    200."""
+    rng = np.random.default_rng(4)
+    X3 = rng.uniform(0, 1, (300, 3)).astype(np.float32)
+    model3 = LinearRegressor().fit(X3, X3.sum(axis=1).astype(np.float32))
+    app = create_app(model3, date(2026, 7, 1), buckets=(1, 8), warmup=True,
+                     batch_window_ms=25.0)
+    errors, results = [], []
+    start = threading.Barrier(16)
+
+    def hit(payload, want):
+        try:
+            client = app.test_client()
+            start.wait()
+            r = client.post("/score/v1", json=payload)
+            assert r.status_code == 200, r.data
+            results.append((r.get_json()["prediction"], want))
+        except Exception as exc:
+            errors.append(repr(exc))
+
+    threads = []
+    for i in range(16):
+        if i % 2:  # full-width rows: (3,) after ndmin=2 row extraction
+            payload = {"X": [[0.1 * i, 0.2, 0.3]]}
+            want = 0.1 * i + 0.5
+        else:  # scalar -> (1,) row; a different shape in the same window
+            payload = {"X": 0.1 * i}
+            want = None  # scoring a 1-feature row on a 3-feature model:
+            # whatever the model does, the OTHER callers must not 500
+        threads.append(threading.Thread(target=hit, args=(payload, want)))
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        # width-3 requests all answered correctly despite the concurrent
+        # width-1 traffic sharing the coalescer window
+        full = [(p, w) for p, w in results if w is not None]
+        assert len(full) == 8, (errors, len(results))
+        for pred, want in full:
+            assert pred == pytest.approx(want, abs=0.05), (pred, want)
+    finally:
+        app.close()
+
+
+def test_batch_flushes_at_max_rows_before_window(fitted_model):
+    """A filling batch must not wait out the window: max_rows caps the
+    batch and flushes immediately (saturation serves full buckets
+    back-to-back)."""
+    coalescer = RequestCoalescer(window_ms=10_000.0, max_rows=4).start()
+    app = create_app(fitted_model, date(2026, 7, 1), buckets=(1, 4, 8),
+                     warmup=False)
+    bundle = app._served
+    results = []
+
+    def submit(v):
+        results.append(
+            (v, coalescer.submit(bundle, np.asarray([v], np.float32)))
+        )
+
+    threads = [threading.Thread(target=submit, args=(float(i),))
+               for i in range(4)]
+    t0 = time.monotonic()
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        # a 10 s window would have blown this bound; max_rows flushed it
+        assert time.monotonic() - t0 < 5.0
+        assert coalescer.stats()["max_batch_rows"] == 4
+        for v, pred in results:
+            assert pred == pytest.approx(1.0 + 0.5 * v, abs=0.2)
+    finally:
+        coalescer.stop()
+
+
+def test_saturated_coalescer_raises_and_request_path_degrades(fitted_model):
+    """A full queue (or a stopped coalescer) raises CoalescerSaturated
+    from submit(); through the app the request silently degrades to a
+    direct dispatch instead of failing."""
+    app = create_app(fitted_model, date(2026, 7, 1), buckets=(1, 8),
+                     warmup=False)
+    bundle = app._served
+    stopped = RequestCoalescer(window_ms=1.0)
+    with pytest.raises(CoalescerSaturated):  # never started
+        stopped.submit(bundle, np.asarray([1.0], np.float32))
+    stopped.start()
+    stopped.stop()
+    with pytest.raises(CoalescerSaturated):  # stopped
+        stopped.submit(bundle, np.asarray([1.0], np.float32))
+
+    # the app path: a stopped batcher still answers 200 via fallback
+    app2 = _batched_app(fitted_model, window_ms=5.0)
+    app2.batcher.stop()
+    r = app2.test_client().post("/score/v1", json={"X": 50})
+    assert r.status_code == 200
+    assert r.get_json()["prediction"] == pytest.approx(26.0, abs=2.0)
+    assert app2.batcher.stats()["batches_dispatched"] == 0
+
+
+def test_failed_batch_scatters_error_and_dispatcher_survives(fitted_model):
+    """A device-call failure 500s exactly the requests in that batch and
+    the dispatcher keeps serving the next ones."""
+    app = _batched_app(fitted_model, window_ms=5.0)
+
+    class _Boom:
+        buckets = (1,)
+
+        def predict(self, X):
+            raise RuntimeError("injected device fault")
+
+    class _BadBundle:
+        predictor = _Boom()
+        model_info = "broken"
+        model_date = None
+
+    try:
+        with pytest.raises(RuntimeError, match="injected device fault"):
+            app.batcher.submit(_BadBundle(), np.asarray([1.0], np.float32))
+        # dispatcher thread survived: a normal request still answers
+        r = app.test_client().post("/score/v1", json={"X": 50})
+        assert r.status_code == 200
+    finally:
+        app.close()
+
+
+def test_hot_swap_never_mixes_models_within_a_batch(fitted_model):
+    """The regression test for the swap guarantee: submissions against
+    two model generations sitting in ONE queue flush as SEPARATE device
+    calls — each batch's rows all belong to one generation — and every
+    caller gets the prediction of the generation it enqueued against."""
+    calls = []
+
+    class _RecordingPredictor:
+        """Predict stub tagging each dispatch with its generation."""
+
+        buckets = (64,)
+
+        def __init__(self, gen: str, slope: float):
+            self.gen = gen
+            self.slope = slope
+
+        def predict(self, X):
+            calls.append((self.gen, X.shape[0]))
+            return (self.slope * X[:, 0]).astype(np.float32)
+
+    class _Bundle:
+        def __init__(self, gen, slope):
+            self.predictor = _RecordingPredictor(gen, slope)
+            self.model_info = gen
+            self.model_date = None
+
+    old, new = _Bundle("old", 1.0), _Bundle("new", 10.0)
+    coalescer = RequestCoalescer(window_ms=200.0, max_rows=64).start()
+    results = []
+    entered = threading.Barrier(9)
+
+    def submit(bundle, v):
+        entered.wait()
+        results.append(
+            (bundle.model_info, v,
+             coalescer.submit(bundle, np.asarray([v], np.float32)))
+        )
+
+    # 4 old-generation and 4 new-generation submissions interleave into
+    # the same 200 ms window — the exact mid-swap shape
+    threads = [
+        threading.Thread(target=submit, args=(old, float(i)))
+        for i in range(4)
+    ] + [
+        threading.Thread(target=submit, args=(new, float(i)))
+        for i in range(4)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        entered.wait()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        coalescer.stop()
+
+    # every dispatched batch belonged to exactly one generation, and both
+    # generations' rows were dispatched (the queue was split, not merged)
+    assert sum(n for _, n in calls) == 8
+    assert {g for g, _ in calls} == {"old", "new"}
+    # rows never crossed generations: old rows scored by slope 1, new by
+    # slope 10 — a mixed batch would hand one generation's params to the
+    # other's rows
+    for gen, v, pred in results:
+        want = v * (1.0 if gen == "old" else 10.0)
+        assert pred == pytest.approx(want, abs=1e-5), (gen, v, pred)
+
+
+def test_swap_model_drains_batcher(fitted_model):
+    """app.swap_model on a batched app returns only after the queue has
+    drained — callers can release the old params knowing no queued row
+    still references them."""
+    app = _batched_app(fitted_model, window_ms=30.0)
+    try:
+        holder = []
+
+        def one_request():
+            r = app.test_client().post("/score/v1", json={"X": 50})
+            holder.append(r.get_json())
+
+        t = threading.Thread(target=one_request)
+        t.start()
+        time.sleep(0.005)  # let the submission enqueue into the window
+        rng = np.random.default_rng(2)
+        X = rng.uniform(0, 100, 200).astype(np.float32)
+        new_model = LinearRegressor().fit(X, (2.0 * X).astype(np.float32))
+        app.swap_model(new_model, date(2026, 7, 2))
+        # post-swap: the queue is empty the moment swap_model returns
+        assert app.batcher.drain(timeout_s=0.5) is True
+        t.join(timeout=10)
+        assert holder and holder[0]["prediction"] == pytest.approx(
+            26.0, abs=2.0
+        )  # the in-flight request finished on the model it started with
+        after = app.test_client().post("/score/v1", json={"X": 50}).get_json()
+        assert after["model_date"] == "2026-07-02"
+        assert after["prediction"] == pytest.approx(100.0, abs=2.0)
+    finally:
+        app.close()
+
+
+def test_hot_swap_under_batched_http_traffic(store):
+    """End-to-end over real HTTP with the coalescer ON: hammer the
+    service from many threads while the checkpoint watcher swaps in a
+    visibly different model. Every response must pair a prediction with
+    the generation that produced it — a torn pair would mean a mixed
+    batch or a torn swap."""
+    from bodywork_tpu.models import save_model
+    from bodywork_tpu.serve import serve_latest_model
+
+    def save_for_day(day, slope):
+        rng = np.random.default_rng(day)
+        X = rng.uniform(0, 100, 400).astype(np.float32)
+        y = (slope * X).astype(np.float32)
+        save_model(store, LinearRegressor().fit(X, y), date(2026, 7, day))
+
+    import requests
+
+    save_for_day(1, 0.5)  # predict(10) ~= 5
+    handle = serve_latest_model(
+        store, host="127.0.0.1", port=0, block=False, watch_interval_s=0.05,
+        batch_window_ms=3.0, batch_max_rows=32,
+    )
+    failures, results = [], []
+    stop = threading.Event()
+
+    def hammer():
+        s = requests.Session()
+        while not stop.is_set():
+            try:
+                r = s.post(handle.url, json={"X": 10}, timeout=10)
+                if r.status_code != 200:
+                    failures.append(f"HTTP {r.status_code}")
+                    continue
+                body = r.json()
+                results.append((body["model_date"], body["prediction"]))
+            except Exception as exc:
+                failures.append(repr(exc))
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        save_for_day(2, 2.0)  # predict(10) ~= 20
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if any(d == "2026-07-02" for d, _ in results[-8:]):
+                break
+            time.sleep(0.05)
+        time.sleep(0.3)  # keep hammering past the swap
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        handle.stop()
+
+    assert not failures, failures[:5]
+    assert {d for d, _ in results} == {"2026-07-01", "2026-07-02"}
+    for d, pred in results:
+        want = 5.0 if d == "2026-07-01" else 20.0
+        assert abs(pred - want) < 2.5, (d, pred)
+    # the coalescer actually carried traffic in this test (not a bypass)
+    stats = handle.app.batcher.stats()
+    assert stats["rows_dispatched"] == stats["rows_submitted"] > 0
+
+
+def test_multiproc_worker_threads_coalescer_args(store):
+    """serve --workers plumbing: the per-worker batch knobs ride the
+    spawn args so each replica process builds its own coalescer."""
+    from bodywork_tpu.serve.multiproc import MultiProcessService
+
+    svc = MultiProcessService(
+        str(store.root), workers=1, batch_window_ms=1.5, batch_max_rows=16
+    )
+    try:
+        assert svc.batch_window_ms == 1.5
+        assert svc.batch_max_rows == 16
+    finally:
+        svc._reserved.close()
+
+
+def test_cli_serve_batch_flags_parse(monkeypatch):
+    """The opt-in surface: flags parse, env vars supply defaults, and a
+    non-positive --batch-max-rows is a usage error."""
+    from bodywork_tpu import cli
+
+    parser = cli.build_parser()
+    args = parser.parse_args(
+        ["serve", "--store", "/tmp/s", "--batch-window-ms", "1.5",
+         "--batch-max-rows", "32"]
+    )
+    assert args.batch_window_ms == 1.5
+    assert args.batch_max_rows == 32
+    # default: off
+    args = parser.parse_args(["serve", "--store", "/tmp/s"])
+    assert args.batch_window_ms == 0.0
+    assert args.batch_max_rows is None
+    with pytest.raises(SystemExit):
+        parser.parse_args(["serve", "--store", "/tmp/s",
+                           "--batch-max-rows", "0"])
+    # env opt-in (parser defaults are read at build time)
+    monkeypatch.setenv("BODYWORK_TPU_BATCH_WINDOW_MS", "2.5")
+    monkeypatch.setenv("BODYWORK_TPU_BATCH_MAX_ROWS", "48")
+    env_parser = cli.build_parser()
+    args = env_parser.parse_args(["serve", "--store", "/tmp/s"])
+    assert args.batch_window_ms == 2.5
+    assert args.batch_max_rows == 48
+    # a malformed/out-of-range env value must not crash EVERY subcommand
+    # at parser build — it is ignored (with a stderr note), not fatal
+    monkeypatch.setenv("BODYWORK_TPU_BATCH_WINDOW_MS", "2ms")
+    monkeypatch.setenv("BODYWORK_TPU_BATCH_MAX_ROWS", "-5")
+    args = cli.build_parser().parse_args(["serve", "--store", "/tmp/s"])
+    assert args.batch_window_ms == 0.0
+    assert args.batch_max_rows is None
+
+
+def test_stats_json_serialisable(fitted_model):
+    app = _batched_app(fitted_model, window_ms=5.0)
+    try:
+        app.test_client().post("/score/v1", json={"X": 50})
+        stats = app.batcher.stats()
+        assert json.loads(json.dumps(stats)) == stats
+        assert stats["rows_submitted"] == 1
+    finally:
+        app.close()

@@ -275,6 +275,27 @@ def test_offset_schedule_wraps_cleanly():
     assert _offset_schedule("*/5 6 * * *", 30) == "*/5 6 * * *"
 
 
+def test_offset_schedule_never_shifts_across_a_pinned_day():
+    """ADVICE low: cron has no carry into the day fields, so wrapping
+    23:45 -> 00:15 on a schedule pinned to a day-of-week (or
+    day-of-month) would fire ~23h45m EARLY on that day. The shift is
+    abandoned — same day, unshifted time — rather than landing on the
+    wrong day."""
+    from bodywork_tpu.pipeline.k8s import _offset_schedule
+
+    # pinned day-of-week: Monday 23:45 must NOT become Monday 00:15
+    assert _offset_schedule("45 23 * * 1", 30) == "45 23 * * 1"
+    # pinned day-of-month: the 15th at 23:45 must not become the 15th 00:15
+    assert _offset_schedule("45 23 15 * *", 30) == "45 23 15 * *"
+    # pinned month: June 30 23:45 + 30min would leave June entirely
+    assert _offset_schedule("45 23 * 6 *", 30) == "45 23 * 6 *"
+    # both-wildcard days still wrap (every day: the next day IS correct)
+    assert _offset_schedule("45 23 * * *", 30) == "15 0 * * *"
+    # no hour wrap: pinned days shift normally within the same day
+    assert _offset_schedule("0 6 * * 1", 30) == "30 6 * * 1"
+    assert _offset_schedule("45 22 * * 1", 30) == "15 23 * * 1"
+
+
 def test_per_stage_requirements_isolation(tmp_path):
     """Reference parity (bodywork.yaml:10-16,29-35,50-54,67-72): each
     stage carries its OWN pinned requirements, stages' manifests
@@ -427,6 +448,80 @@ def test_stage_requirements_cover_each_stage_execution_closure(tmp_path):
     # and the generate stage needed no HTTP/WSGI stack
     assert not ({"requests", "werkzeug"}
                 & closures["stage-3-generate-next-dataset"])
+
+
+def test_run_day_closure_needs_the_pipeline_wide_image(tmp_path):
+    """ADVICE high: the daily-loop CronJob runs `cli run-day`, which
+    imports ALL four stages in-process — its measured execution closure
+    must exceed any single stage's pin set (so building its pod from
+    stage-1's per-stage image would ModuleNotFoundError at stage-2) and
+    be covered by the union of every stage's pins (what the
+    pipeline-wide image installs). Measured, not asserted from the
+    table — same protocol as the per-stage closure test above."""
+    from bodywork_tpu.pipeline import default_pipeline
+
+    spec = default_pipeline()
+    pins = {
+        name: {line.split("=")[0].split("[")[0]
+               for line in stage.requirements}
+        for name, stage in spec.stages.items()
+    }
+    closure = _managed_closure(
+        ["run-day", "--store", str(tmp_path / "store"),
+         "--date", "2026-01-01"])
+    # the crash the fix prevents: run-day needs distributions stage-1's
+    # pin set does not install (the serve stage's WSGI stack and the
+    # test stage's HTTP client at minimum)
+    beyond_stage1 = closure - pins["stage-1-train-model"]
+    assert beyond_stage1, "run-day closure no longer exceeds stage-1's " \
+        "pins — revisit whether per-stage cron images are safe now"
+    assert "werkzeug" in closure  # the observed stage-2 crash
+    # and the pipeline-wide image (union of all stage pins) covers it
+    union = set().union(*pins.values())
+    missing = closure - union
+    assert not missing, (
+        f"run-day imports {sorted(missing)} that no stage pins — the "
+        "pipeline-wide image would crash the daily loop"
+    )
+
+
+def test_cron_pods_image_and_resources(tmp_path):
+    """The daily-loop and drift-gate CronJob pods are built from the
+    PIPELINE-WIDE image (never stage-1's per-stage image, whose pins
+    cover only the train closure), under their own container names.
+    run-day keeps stage-1's TPU placement (it trains on-device); the
+    drift gate is a host-side pandas job and gets a plain CPU pod — no
+    TPU chips, no TPU nodeSelectors."""
+    from bodywork_tpu.pipeline.images import stage_image_tag
+
+    spec = default_pipeline()
+    image = "registry.example.com/bodywork-tpu:v9"
+    docs = generate_manifests(spec, store_path="/mnt/store", image=image)
+    stage1 = spec.stages["stage-1-train-model"]
+    stage1_image = stage_image_tag(stage1, image)
+    assert stage1_image and stage1_image != image  # per-stage tag exists
+
+    day_pod = docs["99-daily-loop-cronjob.yaml"]["spec"]["jobTemplate"][
+        "spec"]["template"]["spec"]
+    day_c = day_pod["containers"][0]
+    assert day_c["image"] == image  # pipeline-wide, NOT stage-1's tag
+    assert day_c["name"] == "daily-loop"
+    # run-day trains in-process: TPU placement preserved
+    assert "nodeSelector" in day_pod
+    assert day_c["resources"]["limits"]["google.com/tpu"] == 1
+
+    gate_pod = docs["99-drift-gate-cronjob.yaml"]["spec"]["jobTemplate"][
+        "spec"]["template"]["spec"]
+    gate_c = gate_pod["containers"][0]
+    assert gate_c["image"] == image
+    assert gate_c["name"] == "drift-gate"
+    # a CPU-only report job must not park on (and burn) a TPU node
+    assert "nodeSelector" not in gate_pod
+    assert "limits" not in gate_c["resources"]
+    # ...while the per-stage Jobs keep their per-stage images
+    job = docs["01-stage-1-train-model-job.yaml"]
+    assert job["spec"]["template"]["spec"]["containers"][0][
+        "image"] == stage1_image
 
 
 def test_timed_out_stage_late_write_never_lands(store):

@@ -9,6 +9,13 @@ through. Example-based tests pin known cases; these pin the laws.
 from datetime import date
 
 import numpy as np
+import pytest
+
+# the suite must COLLECT cleanly without the property-testing extra:
+# hard-importing hypothesis fails the whole `pytest tests/` collection
+# on a bare install instead of skipping this module (`pip install
+# .[dev]` provides it)
+pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 # test_metrics_key must be aliased or pytest collects it as a test
