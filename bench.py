@@ -489,7 +489,15 @@ def _percentile(sorted_vals: list, q: float) -> float:
     return sorted_vals[idx]
 
 
-def _time_single_row_latencies(url: str, n: int, warm: int = 20) -> list:
+#: untimed warm-up requests before the sequential latency loop; also the
+#: reconciliation term published as server_side.warmup_requests_included
+#: (each closed-loop client adds one more), so the server-vs-client
+#: cross-check stays exact if this is ever tuned
+WARMUP_REQUESTS = 20
+
+
+def _time_single_row_latencies(url: str, n: int,
+                               warm: int = WARMUP_REQUESTS) -> list:
     """Per-request seconds of ``n`` sequential single-row ``/score/v1``
     posts over one keep-alive session (after ``warm`` untimed ones) —
     the closest HTTP analogue of the reference's recorded 8.22 ms/score
@@ -570,6 +578,34 @@ def _closed_loop_throughput(url: str, clients: int,
     }
 
 
+def _server_side_phase_summary() -> dict:
+    """count/sum/mean of the serving phase histograms
+    (``bodywork_tpu.obs``) for the requests observed since the last
+    registry reset — the server's own view of the latencies the bench's
+    clients measure from outside."""
+    from bodywork_tpu.obs import get_registry
+
+    snap = get_registry().snapshot()
+
+    def _hist(name):
+        entry = snap.get(name)
+        if not entry or not entry["samples"]:
+            return None
+        sample = entry["samples"][0]
+        count = sample["count"]
+        return {
+            "count": count,
+            "sum_s": round(sample["sum"], 6),
+            "mean_s": round(sample["sum"] / count, 6) if count else None,
+        }
+
+    return {
+        "scoring_latency": _hist("bodywork_tpu_scoring_latency_seconds"),
+        "queue_wait": _hist("bodywork_tpu_queue_wait_seconds"),
+        "device_dispatch": _hist("bodywork_tpu_device_dispatch_seconds"),
+    }
+
+
 def bench_single_row_scoring(
     latency_requests: int = 300,
     concurrency: int = 16,
@@ -632,6 +668,12 @@ def bench_single_row_scoring(
         },
     }
     for name, kwargs in variants.items():
+        # fresh registry per variant, so the server-side histograms below
+        # cover exactly THIS variant's requests (the registry is
+        # process-global and both variants run in this child process)
+        from bodywork_tpu.obs import get_registry
+
+        get_registry().reset()
         handle = serve_latest_model(
             store, host="127.0.0.1", port=0, block=False,
             buckets=buckets, **kwargs,
@@ -648,6 +690,20 @@ def bench_single_row_scoring(
                     handle.url, concurrency, requests_per_client
                 ),
             }
+            # Server-side phase histograms (obs.registry) next to the
+            # client-measured numbers: scoring_latency.count must equal
+            # every request that hit the service — the client-counted
+            # ones PLUS the untimed warm-ups (20 sequential + 1 per
+            # closed-loop client), recorded explicitly so the published
+            # cross-check is exact — and the server-side mean bounds the
+            # client p50 from below (the gap is HTTP + kernel time).
+            sub["server_side"] = _server_side_phase_summary()
+            sub["server_side"]["warmup_requests_included"] = (
+                WARMUP_REQUESTS + concurrency
+            )
+            sub["server_side"]["client_counted_requests"] = (
+                len(lat) + sub["concurrent"]["requests"]
+            )
             batcher = handle.app.batcher
             if batcher is not None:
                 stats = batcher.stats()

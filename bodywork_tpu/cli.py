@@ -68,13 +68,15 @@ def cmd_generate(args) -> int:
 
 def cmd_train(args) -> int:
     from bodywork_tpu.train import train_on_history
+    from bodywork_tpu.utils.profiling import maybe_trace
 
-    result = train_on_history(
-        _store(args),
-        args.model,
-        mesh_data=args.mesh_data,
-        mesh_model=args.mesh_model,
-    )
+    with maybe_trace(args.profile_dir, label="train"):
+        result = train_on_history(
+            _store(args),
+            args.model,
+            mesh_data=args.mesh_data,
+            mesh_model=args.mesh_model,
+        )
     print(
         f"{result.model_artefact_key} MAPE={result.metrics['MAPE']:.4f} "
         f"r2={result.metrics['r_squared']:.4f}"
@@ -159,7 +161,10 @@ def cmd_serve(args) -> int:
             watch_interval_s=watch, buckets=args.buckets,
             batch_window_ms=batch_window,
             batch_max_rows=args.batch_max_rows,
+            metrics=args.metrics,
         ).start()
+        if svc.metrics_url:
+            log.info(f"aggregated metrics at {svc.metrics_url}")
         try:
             svc.wait()
             return 0
@@ -197,6 +202,38 @@ def cmd_test(args) -> int:
     return 0
 
 
+def _derived_report_path(trace_out: str) -> str:
+    """``day.trace.json`` -> ``day.report.json`` (or ``<path>.report.json``
+    when the trace path has no ``.trace.json`` suffix)."""
+    if trace_out.endswith(".trace.json"):
+        return trace_out[: -len(".trace.json")] + ".report.json"
+    return trace_out + ".report.json"
+
+
+#: date-keyed trace/report files kept per {date} template (the daily-loop
+#: CronJob writes one pair per day onto the shared store volume; without
+#: a cap nothing in the system would ever prune them)
+TRACE_RETENTION = 30
+
+
+def _prune_templated(template: str, keep: int = TRACE_RETENTION) -> None:
+    """Drop all but the newest ``keep`` files matching a ``{date}``
+    template. ISO dates sort lexicographically, so the glob's sorted
+    order IS chronological. Only templated paths are pruned — an
+    explicit one-off path is the operator's to manage."""
+    import glob
+
+    # escape the operator-controlled path FIRST: a '[v2]' or '*' in the
+    # store path must stay literal, or retention silently never fires
+    # (or worse, matches files outside the intended set)
+    files = sorted(glob.glob(glob.escape(template).replace("{date}", "*")))
+    for old in files[:-keep]:
+        try:
+            os.remove(old)
+        except OSError:  # concurrent pruner / already gone: not our problem
+            pass
+
+
 def cmd_run_day(args) -> int:
     from bodywork_tpu.pipeline import LocalRunner
 
@@ -207,6 +244,44 @@ def cmd_run_day(args) -> int:
     print(f"day {d}: {result.wall_clock_s:.3f}s")
     for name, secs in result.stage_seconds.items():
         print(f"  {name}: {secs:.3f}s")
+    if args.trace_out or args.report_out:
+        from bodywork_tpu.obs.spans import (
+            day_report,
+            write_chrome_trace,
+            write_day_report,
+        )
+
+        # a literal "{date}" in either path becomes the simulated day —
+        # lets the (date-agnostic) daily-loop CronJob command emit
+        # date-keyed trace artefacts on the shared store
+        trace_out = (
+            args.trace_out.replace("{date}", str(d))
+            if args.trace_out else None
+        )
+        report_out = (
+            args.report_out.replace("{date}", str(d))
+            if args.report_out else None
+        )
+        if trace_out:
+            # the FULL runner timeline (bootstrap + every span), so the
+            # Perfetto view shows setup cost next to the day's stages
+            path = write_chrome_trace(
+                trace_out, runner.recorder.spans(),
+                process_name=f"run-day {d}",
+            )
+            print(f"trace: {path}")
+        report_path = report_out or _derived_report_path(trace_out)
+        path = write_day_report(report_path, day_report(result))
+        print(f"report: {path}")
+        # retention for date-templated outputs (the daily CronJob path):
+        # keep the newest TRACE_RETENTION days, so the shared store
+        # volume never grows unboundedly under the dotted .traces dir
+        if args.trace_out and "{date}" in args.trace_out:
+            _prune_templated(args.trace_out)
+            if not args.report_out:
+                _prune_templated(_derived_report_path(args.trace_out))
+        if args.report_out and "{date}" in args.report_out:
+            _prune_templated(args.report_out)
     return 0
 
 
@@ -222,6 +297,14 @@ def cmd_run_sim(args) -> int:
         print(f"day {r.day}: {r.wall_clock_s:.3f}s")
     print(f"total {total:.3f}s over {args.days} day(s), "
           f"mean {total / max(args.days, 1):.3f}s/day")
+    if args.trace_out:
+        from bodywork_tpu.obs.spans import write_chrome_trace
+
+        path = write_chrome_trace(
+            args.trace_out, runner.recorder.spans(),
+            process_name=f"run-sim {args.days}d",
+        )
+        print(f"trace: {path}")
     return 0
 
 
@@ -369,6 +452,28 @@ def cmd_deploy(args) -> int:
     from bodywork_tpu.pipeline import write_manifests
 
     spec = _pipeline_spec(args)
+    # Derived per-stage image tags (content-addressed from each stage's
+    # requirements pins) exist only after `--emit-images` + build.sh.
+    # Emitting manifests that reference them without emitting the build
+    # contexts ships guaranteed ImagePullBackOff pods (ADVICE.md medium,
+    # k8s.py:204) — refuse unless the operator forces it.
+    from bodywork_tpu.pipeline.images import uses_derived_tag
+
+    derived = [s.name for s in spec.stages.values() if uses_derived_tag(s)]
+    if derived and not args.emit_images:
+        if not args.force:
+            log.error(
+                f"stages {derived} reference derived per-stage image tags "
+                "that nothing builds: pass --emit-images DIR to emit "
+                "their build contexts (then run build.sh), or --force to "
+                "write the manifests anyway"
+            )
+            return 1
+        log.warning(
+            f"--force: manifests reference derived per-stage image tags "
+            f"for {derived} with no build contexts emitted; pods will "
+            "ImagePullBackOff until the images are built and pushed"
+        )
     written = write_manifests(
         spec,
         args.out,
@@ -424,6 +529,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--mesh-model", type=int, default=1,
         help="tensor-parallel mesh axis for sharded training (mlp only)",
+    )
+    p.add_argument(
+        "--profile-dir", default=None, metavar="DIR",
+        help="wrap the training run in a jax.profiler trace written "
+             "here (device-side op view; open in TensorBoard/Perfetto)",
     )
 
     p = add("serve", cmd_serve, help="serve the latest model over HTTP")
@@ -482,6 +592,14 @@ def build_parser() -> argparse.ArgumentParser:
              "BODYWORK_TPU_BATCH_MAX_ROWS; align with a predictor "
              "bucket so a full flush pads to one compiled shape)",
     )
+    p.add_argument(
+        "--metrics", action="store_true",
+        help="with --workers N: aggregate every replica's metrics into "
+             "one coherent GET /metrics view (Prometheus text format) "
+             "on the serving port. Single-process serving exposes "
+             "/metrics unconditionally; this flag is the multi-worker "
+             "aggregation switch (docs/OBSERVABILITY.md)",
+    )
 
     p = add("test", cmd_test, help="test a live scoring service")
     p.add_argument("--store", **common_store)
@@ -494,6 +612,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--date", default=None)
     p.add_argument("--model", default="linear", choices=["linear", "mlp"])
     p.add_argument("--mode", default="batch", choices=["single", "batch"])
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="write the day's stage spans as a Chrome "
+                        "trace-event file (open in Perfetto or "
+                        "chrome://tracing); also writes the structured "
+                        "JSON run report next to it")
+    p.add_argument("--report-out", default=None, metavar="PATH",
+                   help="write the structured per-day run report (JSON: "
+                        "stage seconds + spans) here; defaults to "
+                        "<trace-out stem>.report.json when --trace-out "
+                        "is given")
 
     p = add("run-sim", cmd_run_sim, help="run an N-day drift simulation")
     p.add_argument("--spec", default=None, help="pipeline spec YAML (overrides --model/--mode)")
@@ -504,6 +632,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mode", default="batch", choices=["single", "batch"])
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of the whole loop here")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="write the whole simulation's stage spans "
+                        "(stages, lookahead-train overlap, prefetch, "
+                        "prewarm) as one Chrome trace-event file")
 
     p = add("run-ab", cmd_run_ab,
             help="concurrent A/B model pipelines on one device pool")
@@ -603,6 +735,13 @@ def build_parser() -> argparse.ArgumentParser:
              "pinned requirements.txt + build.sh) to DIR — the buildable "
              "source of the per-stage image tags the manifests reference "
              "(reference parity: per-stage dependency isolation)",
+    )
+    p.add_argument(
+        "--force", action="store_true",
+        help="write manifests referencing derived per-stage image tags "
+             "even when --emit-images is not given (the tags will not "
+             "exist until their build contexts are generated and built "
+             "— pods ImagePullBackOff until then)",
     )
     p.add_argument("--model", default="linear", choices=["linear", "mlp"])
     p.add_argument("--mode", default="batch", choices=["single", "batch"])

@@ -229,6 +229,40 @@ def persist_test_metrics(
     return key
 
 
+def _record_live_metrics(rec) -> None:
+    """Export the day's live-test drift channel through the shared obs
+    registry (:mod:`bodywork_tpu.obs`): the same numbers persisted to the
+    date-keyed CSV become scrapeable gauges/counters, so an alerting
+    stack can watch drift without polling the artefact store."""
+    from bodywork_tpu.obs import get_registry
+
+    reg = get_registry()
+    reg.counter(
+        "bodywork_tpu_live_test_runs_total", "Completed live-service tests"
+    ).inc()
+    reg.counter(
+        "bodywork_tpu_live_test_rows_total",
+        "Rows successfully scored by live-service tests",
+    ).inc(float(rec.n_scored))
+    reg.counter(
+        "bodywork_tpu_live_test_failures_total",
+        "Rows whose live scoring request failed",
+    ).inc(float(rec.n_failures))
+    gauges = (
+        ("bodywork_tpu_live_mape_ratio",
+         "Live MAPE of the latest service test", rec.MAPE),
+        ("bodywork_tpu_live_score_label_corr_ratio",
+         "Live score/label correlation of the latest service test",
+         rec.r_squared),
+        ("bodywork_tpu_live_response_mean_seconds",
+         "Mean scoring-request round-trip of the latest service test",
+         rec.mean_response_time),
+    )
+    for name, help_, value in gauges:
+        if pd.notna(value):  # an all-failures day has no quality signal
+            reg.gauge(name, help_).set(float(value))
+
+
 def run_service_test(
     store: ArtefactStore,
     client,
@@ -252,6 +286,7 @@ def run_service_test(
     metrics = compute_test_metrics(results, ds.date)
     persist_test_metrics(store, metrics, ds.date)
     rec = metrics.iloc[0]
+    _record_live_metrics(rec)
     log.info(
         f"live test on {len(results)} rows ({ds.date}): MAPE={rec.MAPE:.4f} "
         f"corr={rec.r_squared:.4f} maxAPE={rec.max_residual:.2f} "

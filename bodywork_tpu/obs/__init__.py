@@ -1,0 +1,61 @@
+"""Unified observability subsystem: metrics registry + stage spans.
+
+The reference pipeline's only signals are print statements and an
+end-of-day drift test; this package gives the running system itself a
+telemetry surface (ISSUE 2):
+
+- :mod:`~bodywork_tpu.obs.registry` — a dependency-free metrics registry
+  (counters, gauges, fixed-bucket histograms) with Prometheus
+  text-exposition rendering and a metric-name lint.
+- :mod:`~bodywork_tpu.obs.multiproc` — snapshot files + merge so
+  ``serve --workers N`` exposes ONE coherent ``/metrics`` view across
+  OS-process replicas.
+- :mod:`~bodywork_tpu.obs.spans` — stage spans for the pipeline runner:
+  per-day structured run reports (JSON) and Chrome trace-event files
+  loadable in Perfetto.
+
+Everything here is stdlib-only on purpose: the hot serving path and the
+per-stage pods must be able to import it without pulling the accelerator
+runtime (or anything else) into their dependency closure.
+"""
+from bodywork_tpu.obs.registry import (
+    DEFAULT_LATENCY_BUCKETS,
+    METRIC_NAME_RE,
+    UNIT_SUFFIXES,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    get_registry,
+    merge_snapshots,
+    render_snapshot,
+    validate_metric_name,
+)
+from bodywork_tpu.obs.spans import (
+    Span,
+    SpanRecorder,
+    chrome_trace,
+    day_report,
+    write_chrome_trace,
+    write_day_report,
+)
+
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS",
+    "METRIC_NAME_RE",
+    "UNIT_SUFFIXES",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "Span",
+    "SpanRecorder",
+    "chrome_trace",
+    "day_report",
+    "get_registry",
+    "merge_snapshots",
+    "render_snapshot",
+    "validate_metric_name",
+    "write_chrome_trace",
+    "write_day_report",
+]

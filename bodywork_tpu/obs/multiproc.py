@@ -1,0 +1,162 @@
+"""Multiprocess metrics aggregation for ``serve --workers N``.
+
+The multi-process service (:mod:`bodywork_tpu.serve.multiproc`) runs N
+OS-process replicas behind one ``SO_REUSEPORT`` port; a ``GET /metrics``
+scrape lands on ONE of them, chosen by the kernel. For the scrape to be
+a coherent service-wide view, every worker periodically flushes its
+registry snapshot to a shared directory (atomic tmp+rename, one file per
+pid), and whichever worker answers the scrape merges its own LIVE
+registry with its siblings' latest flushed snapshots.
+
+Properties of this scheme (the same trade prometheus_client's
+multiprocess mode makes, minus the mmap machinery):
+
+- the answering worker's own numbers are exact (live registry, not its
+  file — its own file is excluded from the merge to avoid double
+  counting);
+- sibling numbers lag by at most one flush interval, and a scrape loop
+  converges as flushes land (counters only grow);
+- a worker that died keeps contributing its last flushed snapshot — its
+  already-served requests must not vanish from service totals, exactly
+  as a restarted pod's Prometheus counters persist in recording rules.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from pathlib import Path
+
+from bodywork_tpu.obs.registry import (
+    Registry,
+    merge_snapshots,
+    render_snapshot,
+)
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("obs.multiproc")
+
+__all__ = [
+    "SNAPSHOT_PREFIX",
+    "DEFAULT_FLUSH_INTERVAL_S",
+    "MetricsFlusher",
+    "read_sibling_snapshots",
+    "aggregated_snapshot",
+    "aggregated_render",
+]
+
+SNAPSHOT_PREFIX = "obs-metrics-"
+DEFAULT_FLUSH_INTERVAL_S = 0.25
+
+
+def _snapshot_path(directory: str | Path, pid: int) -> Path:
+    return Path(directory) / f"{SNAPSHOT_PREFIX}{pid}.json"
+
+
+def write_snapshot(registry: Registry, directory: str | Path,
+                   pid: int | None = None) -> Path:
+    """Atomically persist one process's snapshot (tmp file + rename, so a
+    concurrent reader never sees a torn write)."""
+    pid = os.getpid() if pid is None else pid
+    directory = Path(directory)
+    # deliberately NO mkdir: the service owner creates the directory and
+    # may delete it at teardown — a worker's final flush racing that
+    # deletion must fail (caught by the flusher) rather than resurrect
+    # the directory and leak it
+    payload = json.dumps({"pid": pid, "snapshot": registry.snapshot()})
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".obs-tmp-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(payload)
+        final = _snapshot_path(directory, pid)
+        os.replace(tmp, final)
+        return final
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def read_sibling_snapshots(
+    directory: str | Path, exclude_pid: int | None = None
+) -> list[dict]:
+    """Every flushed snapshot in ``directory`` except ``exclude_pid``'s
+    own file. Unreadable/torn files are skipped (a worker mid-first-flush
+    must not fail the whole scrape)."""
+    directory = Path(directory)
+    if not directory.is_dir():
+        return []
+    snaps = []
+    for path in sorted(directory.glob(f"{SNAPSHOT_PREFIX}*.json")):
+        if exclude_pid is not None and path.name == (
+            f"{SNAPSHOT_PREFIX}{exclude_pid}.json"
+        ):
+            continue
+        try:
+            payload = json.loads(path.read_text())
+            snaps.append(payload["snapshot"])
+        except (OSError, ValueError, KeyError):
+            continue
+    return snaps
+
+
+def aggregated_snapshot(
+    registry: Registry, directory: str | Path | None
+) -> dict:
+    """This process's LIVE snapshot merged with its siblings' flushed
+    ones — the service-wide view a ``/metrics`` scrape should return.
+    With no directory (single-process serving) it is just the registry."""
+    own = registry.snapshot()
+    if directory is None:
+        return own
+    siblings = read_sibling_snapshots(directory, exclude_pid=os.getpid())
+    if not siblings:
+        return own
+    return merge_snapshots([own, *siblings])
+
+
+def aggregated_render(registry: Registry, directory: str | Path | None) -> str:
+    return render_snapshot(aggregated_snapshot(registry, directory))
+
+
+class MetricsFlusher:
+    """Background thread flushing this process's registry snapshot to the
+    shared directory every ``interval_s`` (plus once on ``stop``, so a
+    cleanly-exiting worker's final counts always land)."""
+
+    def __init__(
+        self,
+        registry: Registry,
+        directory: str | Path,
+        interval_s: float = DEFAULT_FLUSH_INTERVAL_S,
+    ):
+        self.registry = registry
+        self.directory = Path(directory)
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="obs-metrics-flusher", daemon=True
+        )
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.flush()
+        self.flush()  # final flush on stop
+
+    def flush(self) -> None:
+        try:
+            write_snapshot(self.registry, self.directory)
+        except OSError as exc:  # never take the serving path down
+            log.warning(f"metrics snapshot flush failed: {exc!r}")
+
+    def start(self) -> "MetricsFlusher":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.ident is not None:
+            self._thread.join(timeout=5)

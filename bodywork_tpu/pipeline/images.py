@@ -23,9 +23,20 @@ from pathlib import Path
 
 from bodywork_tpu.pipeline.spec import PipelineSpec, StageSpec
 
-__all__ = ["stage_image_tag", "write_stage_images"]
+__all__ = ["stage_image_tag", "uses_derived_tag", "write_stage_images"]
 
 _DEFAULT_BASE = "python:3.12-slim"
+
+
+def uses_derived_tag(stage: StageSpec) -> bool:
+    """True when manifests for ``stage`` reference a DERIVED
+    content-addressed image tag — one that exists only after its build
+    context is emitted and built. The single source of truth for
+    "deploy must refuse without build contexts" (``cli deploy``): an
+    explicit ``stage.image`` override is the operator's own tag and is
+    never second-guessed. Must stay in lockstep with
+    :func:`stage_image_tag`'s priority rule."""
+    return bool(stage.requirements) and not stage.image
 
 
 def stage_image_tag(stage: StageSpec, image: str,

@@ -221,7 +221,10 @@ def _container(
     if env_from:
         container["envFrom"] = env_from
     if stage.kind == "service" and stage.port:
-        container["ports"] = [{"containerPort": stage.port}]
+        # one named port serves scoring AND the GET /metrics Prometheus
+        # exposition (serve.app registers the route unconditionally); the
+        # name is what the pod-template scrape annotations point at
+        container["ports"] = [{"containerPort": stage.port, "name": "http"}]
         container["readinessProbe"] = {
             "httpGet": {"path": "/healthz", "port": stage.port},
             "initialDelaySeconds": 2,
@@ -467,7 +470,20 @@ def generate_manifests(
                         "replicas": stage.replicas,
                         "selector": {"matchLabels": {"app": labels["app"]}},
                         "template": {
-                            "metadata": {"labels": labels},
+                            "metadata": {
+                                "labels": labels,
+                                # standard Prometheus pod discovery: every
+                                # serving replica exposes GET /metrics on
+                                # its serving port (serve.app); scraping
+                                # per POD keeps per-replica visibility —
+                                # the Service would collapse replicas
+                                # into whichever endpoint answered
+                                "annotations": {
+                                    "prometheus.io/scrape": "true",
+                                    "prometheus.io/port": str(stage.port),
+                                    "prometheus.io/path": "/metrics",
+                                },
+                            },
                             "spec": _pod_spec(
                                 spec, stage, store, image, command,
                                 "Always",
@@ -481,7 +497,9 @@ def generate_manifests(
                     "metadata": meta,
                     "spec": {
                         "selector": {"app": labels["app"]},
-                        "ports": [{"port": stage.port, "targetPort": stage.port}],
+                        "ports": [{"port": stage.port,
+                                   "targetPort": stage.port,
+                                   "name": "http"}],
                         "type": "ClusterIP",
                     },
                 }
@@ -564,6 +582,21 @@ def generate_manifests(
         run_day_stage = dataclasses.replace(
             first_stage, name="daily-loop", image=None, requirements=[],
         )
+        run_day_command = [
+            "python", "-m", "bodywork_tpu.cli", "run-day",
+            "--store", store_path,
+            "--spec", f"{_SPEC_MOUNT}/{_SPEC_FILE}",
+        ]
+        if store.mode != "gcs":
+            # per-day run report + Chrome trace on the shared store
+            # volume ({date} substituted by cmd_run_day at run time).
+            # Dotted dir: invisible to the store's prefix/date-key
+            # listing protocol, like .xla-cache. gcs mode skipped — the
+            # trace writer targets a filesystem path.
+            run_day_command += [
+                "--trace-out",
+                f"{store_path}/.traces/day-{{date}}.trace.json",
+            ]
         docs["99-daily-loop-cronjob.yaml"] = {
             "apiVersion": "batch/v1",
             "kind": "CronJob",
@@ -583,9 +616,7 @@ def generate_manifests(
                                 run_day_stage,
                                 store,
                                 image,
-                                ["python", "-m", "bodywork_tpu.cli", "run-day",
-                                 "--store", store_path,
-                                 "--spec", f"{_SPEC_MOUNT}/{_SPEC_FILE}"],
+                                run_day_command,
                                 "Never",
                                 gate_on_deps=False,  # run-day sequences and
                                 # bootstraps internally; a dataset gate here

@@ -23,6 +23,7 @@ import threading
 import time
 from datetime import date, timedelta
 
+from bodywork_tpu.obs.spans import Span, SpanRecorder
 from bodywork_tpu.pipeline.spec import PipelineSpec, StageSpec
 from bodywork_tpu.pipeline.stages import StageContext
 from bodywork_tpu.store.base import ArtefactStore
@@ -54,6 +55,10 @@ class DayResult:
     wall_clock_s: float
     stage_seconds: dict[str, float]
     stage_results: dict[str, object]
+    #: spans recorded during this day's run_day window (stage spans plus
+    #: any overlap/prefetch work that completed inside it) — the input to
+    #: obs.spans.day_report / chrome_trace
+    spans: list[Span] = dataclasses.field(default_factory=list)
 
 
 def resolve_executable(path: str):
@@ -88,6 +93,10 @@ class LocalRunner:
         self._gen_queue: list[tuple[date, dict]] = []
         self._gen_worker: threading.Thread | None = None
         self._gen_lock = threading.Lock()
+        #: one span timeline for this runner's lifetime: stages AND the
+        #: background overlaps (prefetch, lookahead train, prewarm) land
+        #: on it, so a trace shows the overlap actually overlapping
+        self.recorder = SpanRecorder(label=spec.name)
         configure_logger(spec.log_level)
 
     # -- single stages -----------------------------------------------------
@@ -222,6 +231,7 @@ class LocalRunner:
         from bodywork_tpu.utils.profiling import annotate
 
         stage = self.spec.stages[stage_name]
+        start_rel = self.recorder.now()
         t0 = time.perf_counter()
         try:
             with annotate(stage_name):  # named span in an active trace
@@ -231,6 +241,11 @@ class LocalRunner:
                     result = self._run_batch_stage(stage, ctx)
         except BaseException as exc:
             stage_seconds[stage_name] = time.perf_counter() - t0
+            # the span duration IS stage_seconds (one measurement, two
+            # views), so trace durations sum-check against DayResult
+            self.recorder.add(stage_name, "stage", start_rel,
+                              stage_seconds[stage_name], day=str(today),
+                              failed=True)
             if not concurrent:
                 raise
             if not isinstance(exc, StageFailure):
@@ -238,6 +253,8 @@ class LocalRunner:
             ctx.failures[stage_name] = exc
             return
         stage_seconds[stage_name] = time.perf_counter() - t0
+        self.recorder.add(stage_name, "stage", start_rel,
+                          stage_seconds[stage_name], day=str(today))
         stage_results[stage_name] = result
         log.info(
             f"[{today}] {stage_name} done in {stage_seconds[stage_name]:.3f}s"
@@ -285,8 +302,11 @@ class LocalRunner:
             try:
                 from bodywork_tpu.data.generator import generate_day
 
-                with _device_ctx(self.device):
-                    X, y = generate_day(target, self.drift)
+                with self.recorder.span(
+                    f"prefetch-dataset-{target}", "prefetch"
+                ):
+                    with _device_ctx(self.device):
+                        X, y = generate_day(target, self.drift)
                 box["X"], box["y"] = X, y
             except Exception as exc:  # stage falls back to inline
                 log.warning(f"dataset prefetch failed (non-fatal): {exc!r}")
@@ -323,8 +343,11 @@ class LocalRunner:
 
         def _work():
             try:
-                with _device_ctx(self.device):
-                    box["result"] = fn(ctx_next, **train_spec.args)
+                with self.recorder.span(
+                    f"lookahead-train-{tomorrow}", "overlap"
+                ):
+                    with _device_ctx(self.device):
+                        box["result"] = fn(ctx_next, **train_spec.args)
             except BaseException as exc:  # tomorrow's stage retrains inline
                 box["exc"] = exc
 
@@ -364,6 +387,8 @@ class LocalRunner:
         }
         stage_seconds: dict[str, float] = {}
         stage_results = ctx.stage_results
+        span_mark = self.recorder.mark()
+        day_start_rel = self.recorder.now()
         day_start = time.perf_counter()
         try:
             for step in self.spec.dag:
@@ -403,11 +428,17 @@ class LocalRunner:
         finally:
             for name, handle in ctx.services.items():
                 handle.stop()
+        wall_clock_s = time.perf_counter() - day_start
+        # the day envelope, then the window slice: stage spans plus any
+        # overlap/prefetch spans that completed inside this day
+        self.recorder.add(f"run-day-{today}", "day", day_start_rel,
+                          wall_clock_s)
         return DayResult(
             day=today,
-            wall_clock_s=time.perf_counter() - day_start,
+            wall_clock_s=wall_clock_s,
             stage_seconds=stage_seconds,
             stage_results=stage_results,
+            spans=self.recorder.since(span_mark),
         )
 
     # -- multi-day simulation ----------------------------------------------
@@ -418,9 +449,10 @@ class LocalRunner:
             from bodywork_tpu.data.generator import generate_day
             from bodywork_tpu.data.io import Dataset, persist_dataset
 
-            with _device_ctx(self.device):
-                X, y = generate_day(start, self.drift)
-            persist_dataset(self.store, Dataset(X, y, start))
+            with self.recorder.span(f"bootstrap-{start}", "setup"):
+                with _device_ctx(self.device):
+                    X, y = generate_day(start, self.drift)
+                persist_dataset(self.store, Dataset(X, y, start))
             log.info(f"bootstrapped day-0 dataset for {start}")
 
     def _prewarm_horizon(self, days: int) -> None:
@@ -461,11 +493,12 @@ class LocalRunner:
 
         n_now = len(load_all_datasets(self.store))
         per_day = self.drift.n_samples
-        for i in range(days):
-            prewarm_async(model_type, model_kwargs, n_now + i * per_day)
-            prewarm_async(
-                model_type, model_kwargs, n_now + int(i * per_day * 0.85)
-            )
+        with self.recorder.span("prewarm-enqueue", "prewarm", days=days):
+            for i in range(days):
+                prewarm_async(model_type, model_kwargs, n_now + i * per_day)
+                prewarm_async(
+                    model_type, model_kwargs, n_now + int(i * per_day * 0.85)
+                )
 
     def run_simulation(
         self, start: date, days: int, profile_dir: str | None = None
@@ -498,7 +531,8 @@ class LocalRunner:
             from bodywork_tpu.train.prewarm import wait_idle
 
             t0 = time.perf_counter()
-            wait_idle()
+            with self.recorder.span("prewarm-drain", "prewarm"):
+                wait_idle()
             log.info(
                 f"horizon bucket compiles drained in "
                 f"{time.perf_counter() - t0:.2f}s (bootstrap cost)"

@@ -33,6 +33,7 @@ device call (shared across requests when the coalescer is on).
 from __future__ import annotations
 
 import json
+import time
 from datetime import date
 
 import numpy as np
@@ -40,11 +41,22 @@ from werkzeug.exceptions import HTTPException, MethodNotAllowed, NotFound
 from werkzeug.wrappers import Request, Response
 
 from bodywork_tpu.models.base import Regressor
+from bodywork_tpu.obs import get_registry
 from bodywork_tpu.serve.batcher import CoalescerSaturated
 from bodywork_tpu.serve.predictor import PaddedPredictor
 from bodywork_tpu.utils.logging import get_logger
 
 log = get_logger("serve.app")
+
+#: parse/serialize are µs-scale host work — the default latency buckets
+#: would dump them all into the first bucket
+_FAST_PHASE_BUCKETS = (
+    0.00001, 0.00005, 0.0001, 0.0005, 0.001, 0.005, 0.025, 0.1,
+)
+
+#: routes whose successful requests count into the scoring-latency
+#: histogram (the "requests scored" accounting the bench cross-checks)
+_SCORING_ROUTES = ("/score/v1", "/score/v1/batch")
 
 
 def _json_response(payload: dict, status: int = 200) -> Response:
@@ -80,6 +92,7 @@ class ScoringApp:
         buckets: tuple[int, ...] | None = None,
         predictor=None,
         batcher=None,
+        metrics_dir: str | None = None,
     ):
         # a custom predictor (e.g. parallel.DataParallelPredictor over a
         # device mesh) replaces the single-device bucketed default
@@ -92,10 +105,48 @@ class ScoringApp:
         # opt-in request coalescer (serve.batcher.RequestCoalescer);
         # None = every request dispatches its own padded device call
         self.batcher = batcher
+        #: shared snapshot dir for multi-worker /metrics aggregation
+        #: (serve.multiproc); None = this process's registry alone
+        self.metrics_dir = metrics_dir
+        # hot-path phase instrumentation (obs.registry; the registry is
+        # process-global, so replica apps in one process share metrics —
+        # exactly as one k8s pod exposes one scrape target)
+        reg = get_registry()
+        self._m_requests = reg.counter(
+            "bodywork_tpu_http_requests_total",
+            "HTTP requests served, by route and status",
+        )
+        self._m_latency = reg.histogram(
+            "bodywork_tpu_scoring_latency_seconds",
+            "End-to-end handler time of successful scoring requests",
+        )
+        self._m_parse = reg.histogram(
+            "bodywork_tpu_request_parse_seconds",
+            "Request-parse phase: JSON body -> validated feature array",
+            buckets=_FAST_PHASE_BUCKETS,
+        )
+        self._m_dispatch = reg.histogram(
+            "bodywork_tpu_device_dispatch_seconds",
+            "Device-dispatch phase: one padded predictor call",
+        )
+        self._m_serialize = reg.histogram(
+            "bodywork_tpu_response_serialize_seconds",
+            "Serialization phase: prediction -> JSON response",
+            buckets=_FAST_PHASE_BUCKETS,
+        )
+        self._m_swaps = reg.counter(
+            "bodywork_tpu_model_hot_swaps_total",
+            "Served-model hot swaps (serve.reload checkpoint watcher)",
+        )
+        self._m_fallbacks = reg.counter(
+            "bodywork_tpu_coalescer_fallback_total",
+            "Requests degraded to a direct dispatch (coalescer saturated)",
+        )
         self._routes = {
             ("POST", "/score/v1"): self.score_data_instance,
             ("POST", "/score/v1/batch"): self.score_batch,
             ("GET", "/healthz"): self.healthz,
+            ("GET", "/metrics"): self.metrics_endpoint,
         }
 
     # -- served-model access (single read point for atomic swaps) ----------
@@ -142,6 +193,7 @@ class ScoringApp:
                     "hot-swap proceeded before the request coalescer "
                     "fully drained; old-model rows may still be in flight"
                 )
+        self._m_swaps.inc()
         log.info(f"hot-swapped served model -> {model.info} ({model_date})")
 
     def close(self) -> None:
@@ -154,6 +206,7 @@ class ScoringApp:
     # -- WSGI plumbing -----------------------------------------------------
     def __call__(self, environ, start_response):
         request = Request(environ)
+        t0 = time.perf_counter()
         try:
             handler = self._routes.get((request.method, request.path))
             if handler is None:
@@ -166,6 +219,16 @@ class ScoringApp:
         except Exception as exc:  # don't leak tracebacks to clients
             log.error(f"unhandled error serving {request.path}: {exc!r}")
             response = _json_response({"error": "internal server error"}, 500)
+        route = (
+            request.path
+            if any(path == request.path for _m, path in self._routes)
+            else "unknown"
+        )
+        self._m_requests.inc(route=route, status=str(response.status_code))
+        if request.path in _SCORING_ROUTES and response.status_code == 200:
+            # count == requests successfully scored (the invariant the
+            # bench cross-checks against client-side latencies)
+            self._m_latency.observe(time.perf_counter() - t0)
         return response(environ, start_response)
 
     def test_client(self):
@@ -175,6 +238,13 @@ class ScoringApp:
 
     # -- shared parsing ----------------------------------------------------
     def _features_from(self, request: Request):
+        t0 = time.perf_counter()
+        try:
+            return self._parse_features(request)
+        finally:
+            self._m_parse.observe(time.perf_counter() - t0)
+
+    def _parse_features(self, request: Request):
         payload = request.get_json(silent=True)
         if not isinstance(payload, dict) or "X" not in payload:
             return None, _json_response(
@@ -206,19 +276,26 @@ class ScoringApp:
                 # the submission carries ITS served bundle: the batch it
                 # lands in is built from one model generation only, and
                 # the response pairs that generation's prediction with
-                # that generation's identity fields below
+                # that generation's identity fields below. Queue-wait and
+                # device-dispatch phases are recorded by the coalescer.
                 prediction0 = self.batcher.submit(served, X[0])
             except CoalescerSaturated:
-                pass  # overload/shutdown: degrade to a direct dispatch
+                # overload/shutdown: degrade to a direct dispatch
+                self._m_fallbacks.inc()
         if prediction0 is None:
+            t0 = time.perf_counter()
             prediction0 = float(served.predictor.predict(X)[0])
-        return _json_response(
+            self._m_dispatch.observe(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        response = _json_response(
             {
                 "prediction": prediction0,
                 "model_info": served.model_info,
                 "model_date": served.model_date,
             }
         )
+        self._m_serialize.observe(time.perf_counter() - t0)
+        return response
 
     def score_batch(self, request: Request) -> Response:
         """Batched scoring: one padded device call for up to bucket-size rows."""
@@ -228,8 +305,11 @@ class ScoringApp:
         served = self._served  # one read: stable across a hot swap
         if X.ndim == 0:
             X = X[None]
+        t0 = time.perf_counter()
         predictions = served.predictor.predict(X)
-        return _json_response(
+        self._m_dispatch.observe(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        response = _json_response(
             {
                 "predictions": [float(p) for p in predictions],
                 "n": int(len(predictions)),
@@ -237,6 +317,8 @@ class ScoringApp:
                 "model_date": served.model_date,
             }
         )
+        self._m_serialize.observe(time.perf_counter() - t0)
+        return response
 
     def healthz(self, request: Request) -> Response:
         served = self._served  # one read: stable across a hot swap
@@ -246,6 +328,18 @@ class ScoringApp:
                 "model_info": served.model_info,
                 "model_date": served.model_date,
             }
+        )
+
+    def metrics_endpoint(self, request: Request) -> Response:
+        """Prometheus text exposition of this process's registry, merged
+        with sibling workers' flushed snapshots when ``metrics_dir`` is
+        set (``serve --workers N --metrics`` exposes ONE coherent view
+        regardless of which replica the kernel hands the scrape to)."""
+        from bodywork_tpu.obs.multiproc import aggregated_render
+
+        return Response(
+            aggregated_render(get_registry(), self.metrics_dir),
+            content_type="text/plain; version=0.0.4; charset=utf-8",
         )
 
 
@@ -258,11 +352,17 @@ def create_app(
     predictor=None,
     batch_window_ms: float | None = None,
     batch_max_rows: int | None = None,
+    metrics_dir: str | None = None,
 ) -> ScoringApp:
     """``batch_window_ms`` > 0 opts into cross-request micro-batching
     (``serve.batcher``): concurrent single-row ``/score/v1`` requests
     coalesce into one padded device call, flushed when ``batch_max_rows``
-    accumulate or the window elapses, whichever first."""
+    accumulate or the window elapses, whichever first.
+
+    ``metrics_dir`` points ``GET /metrics`` at a shared snapshot
+    directory so multi-process replicas expose one aggregated view
+    (``serve.multiproc`` wires it; single-process serving needs nothing —
+    the endpoint always exposes this process's registry)."""
     batcher = None
     if batch_window_ms and batch_window_ms > 0:
         from bodywork_tpu.serve.batcher import DEFAULT_MAX_ROWS, RequestCoalescer
@@ -272,7 +372,7 @@ def create_app(
             max_rows=batch_max_rows or DEFAULT_MAX_ROWS,
         ).start()
     app = ScoringApp(model, model_date, buckets, predictor=predictor,
-                     batcher=batcher)
+                     batcher=batcher, metrics_dir=metrics_dir)
     if warmup:
         app.predictor.warmup(sync=warmup_sync)
     return app

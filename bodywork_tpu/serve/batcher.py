@@ -50,6 +50,7 @@ import time
 
 import numpy as np
 
+from bodywork_tpu.obs import get_registry
 from bodywork_tpu.utils.logging import get_logger
 
 log = get_logger("serve.batcher")
@@ -116,6 +117,28 @@ class RequestCoalescer:
         self.batches_dispatched = 0
         self.rows_dispatched = 0
         self.max_batch_rows = 0
+        # phase histograms (obs.registry): queue wait is the latency the
+        # coalescer COSTS, device dispatch the work it AMORTISES — the
+        # same bodywork_tpu_device_dispatch_seconds the app's direct
+        # (uncoalesced) path observes into, so the two paths compare
+        reg = get_registry()
+        self._m_queue_wait = reg.histogram(
+            "bodywork_tpu_queue_wait_seconds",
+            "Coalescer queue wait: row enqueue -> batch execution start",
+        )
+        self._m_dispatch = reg.histogram(
+            "bodywork_tpu_device_dispatch_seconds",
+            "Device-dispatch phase: one padded predictor call",
+        )
+        self._m_batch_rows = reg.histogram(
+            "bodywork_tpu_coalesced_batch_rows",
+            "Rows per coalesced device dispatch (amortisation factor)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512),
+        )
+        self._m_saturated = reg.counter(
+            "bodywork_tpu_coalescer_saturated_total",
+            "submit() rejections: pending queue full or coalescer stopped",
+        )
         self._thread = threading.Thread(
             target=self._run, name="request-coalescer", daemon=True
         )
@@ -154,8 +177,10 @@ class RequestCoalescer:
         sub = _Submission(np.asarray(row, dtype=np.float32), served)
         with self._cond:
             if self._stopped or not self._started:
+                self._m_saturated.inc()
                 raise CoalescerSaturated("coalescer is not running")
             if len(self._pending) >= self.max_pending:
+                self._m_saturated.inc()
                 raise CoalescerSaturated(
                     f"{len(self._pending)} requests already pending"
                 )
@@ -243,9 +268,15 @@ class RequestCoalescer:
 
     def _execute(self, batch: list[_Submission]) -> None:
         served = batch[0].served
+        now = time.monotonic()
+        for sub in batch:
+            self._m_queue_wait.observe(now - sub.enqueued_at)
+        self._m_batch_rows.observe(len(batch))
         try:
             X = np.vstack([sub.row for sub in batch])
+            t0 = time.perf_counter()
             predictions = served.predictor.predict(X)
+            self._m_dispatch.observe(time.perf_counter() - t0)
             for i, sub in enumerate(batch):
                 sub.result = float(predictions[i])
         except BaseException as exc:  # scatter, don't kill the dispatcher

@@ -24,8 +24,11 @@ from __future__ import annotations
 
 import multiprocessing
 import os
+import shutil
 import signal
 import socket
+import sys
+import tempfile
 import threading
 import time
 
@@ -45,7 +48,8 @@ def _reuseport_socket(host: str, port: int) -> socket.socket:
 def _worker_main(store_path: str, host: str, port: int, engine: str,
                  watch_interval_s: float | None, buckets, ready,
                  batch_window_ms: float | None = None,
-                 batch_max_rows: int | None = None):
+                 batch_max_rows: int | None = None,
+                 metrics_dir: str | None = None):
     """One serving replica: load latest checkpoint -> predictor -> listen
     on the shared port. Runs in a SPAWNED process (a fork would inherit
     the parent's initialized XLA runtime threads — undefined behavior)."""
@@ -67,11 +71,27 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
     app = create_app(model, model_date, predictor=predictor,
                      buckets=buckets,
                      batch_window_ms=batch_window_ms,
-                     batch_max_rows=batch_max_rows)
+                     batch_max_rows=batch_max_rows,
+                     metrics_dir=metrics_dir)
+    flusher = None
+    if metrics_dir is not None:
+        # each replica flushes its registry snapshot to the shared dir;
+        # whichever replica answers a /metrics scrape merges all of them
+        # (obs.multiproc) — one coherent service-wide view on one port
+        from bodywork_tpu.obs import get_registry
+        from bodywork_tpu.obs.multiproc import MetricsFlusher
+
+        flusher = MetricsFlusher(get_registry(), metrics_dir).start()
 
     sock = _reuseport_socket(host, port)
     sock.listen(128)
     server = make_server(host, port, app, threaded=True, fd=sock.fileno())
+
+    # the supervisor stops workers with terminate() (SIGTERM); without a
+    # handler the default disposition kills the process mid-stack and the
+    # finally below (watcher/flusher/coalescer teardown, the flusher's
+    # final snapshot) never runs — convert to a clean unwind instead
+    signal.signal(signal.SIGTERM, lambda signum, frame: sys.exit(0))
     watcher = None
     if watch_interval_s:
         from bodywork_tpu.serve.reload import CheckpointWatcher
@@ -87,6 +107,8 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
     finally:  # pragma: no cover - only on signal teardown
         if watcher is not None:
             watcher.stop()
+        if flusher is not None:
+            flusher.stop()  # final snapshot flush
         app.close()  # flush + stop the worker's coalescer
 
 
@@ -118,6 +140,7 @@ class MultiProcessService:
         startup_timeout_s: float = 120.0,
         batch_window_ms: float | None = None,
         batch_max_rows: int | None = None,
+        metrics: bool = False,
     ):
         assert workers >= 1, "need at least one replica"
         self.store_path = str(store_path)
@@ -130,6 +153,11 @@ class MultiProcessService:
         # replicas inherit the same policy
         self.batch_window_ms = batch_window_ms
         self.batch_max_rows = batch_max_rows
+        # opt-in aggregated /metrics: a shared snapshot dir every worker
+        # flushes into, so any replica can answer for the whole service.
+        # Created lazily in start() so a failed startup never leaks it.
+        self._metrics_enabled = metrics
+        self.metrics_dir: str | None = None
         self.restart = restart
         self.startup_timeout_s = startup_timeout_s
         self._ctx = multiprocessing.get_context("spawn")
@@ -146,6 +174,13 @@ class MultiProcessService:
         return f"http://{self.host}:{self.port}/score/v1"
 
     @property
+    def metrics_url(self) -> str | None:
+        """The aggregated Prometheus endpoint (None when metrics are off)."""
+        if self.metrics_dir is None:
+            return None
+        return f"http://{self.host}:{self.port}/metrics"
+
+    @property
     def worker_pids(self) -> list[int]:
         return [p.pid for p in self._procs if p.is_alive()]
 
@@ -155,7 +190,8 @@ class MultiProcessService:
             target=_worker_main,
             args=(self.store_path, self.host, self.port, self.engine,
                   self.watch_interval_s, self.buckets, ready,
-                  self.batch_window_ms, self.batch_max_rows),
+                  self.batch_window_ms, self.batch_max_rows,
+                  self.metrics_dir),
             daemon=True,
         )
         proc.start()
@@ -181,9 +217,28 @@ class MultiProcessService:
                     )
 
     def start(self) -> "MultiProcessService":
-        spawned = [self._spawn_one() for _ in range(self.workers)]
-        for proc, ready in spawned:
-            self._wait_ready(ready, proc)
+        if self._metrics_enabled and self.metrics_dir is None:
+            self.metrics_dir = tempfile.mkdtemp(prefix="bodywork-tpu-obs-")
+        spawned: list = []
+        try:
+            for _ in range(self.workers):
+                spawned.append(self._spawn_one())
+            for proc, ready in spawned:
+                self._wait_ready(ready, proc)
+        except BaseException:
+            # a replica that died/timed out during startup propagates
+            # without stop() ever running — don't leak the snapshot dir
+            # (or the already-spawned siblings). Join before rmtree so a
+            # terminating worker's final flush cannot race the removal.
+            for proc, _ready in spawned:
+                if proc.is_alive():
+                    proc.terminate()
+            for proc, _ready in spawned:
+                proc.join(timeout=10)
+            if self.metrics_dir is not None:
+                shutil.rmtree(self.metrics_dir, ignore_errors=True)
+                self.metrics_dir = None
+            raise
         self._procs = [p for p, _ in spawned]
         self._supervisor.start()
         log.info(
@@ -232,6 +287,8 @@ class MultiProcessService:
         if self._supervisor.ident is not None:
             self._supervisor.join(timeout=5)
         self._reserved.close()
+        if self.metrics_dir is not None:
+            shutil.rmtree(self.metrics_dir, ignore_errors=True)
         log.info("multi-process scoring service stopped")
 
     def __enter__(self) -> "MultiProcessService":

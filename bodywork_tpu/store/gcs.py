@@ -118,19 +118,21 @@ class GCSStore(ArtefactStore):
 
     def delete(self, key: str) -> None:
         name = self._blob_name(key)
-        attempt = {"n": 0}
+        # Absence-on-retry means success ONLY if a delete RPC was actually
+        # issued: the earlier attempt's delete may have applied server-side
+        # before its response was lost. A transient error BEFORE the
+        # existence check (e.g. a 503 from exists() itself) proves nothing
+        # about the blob — retrying into absence there must still raise
+        # ArtefactNotFound for a key that never existed.
+        state = {"delete_attempted": False}
 
         def _delete():
-            attempt["n"] += 1
             blob = self._bucket.blob(name)
             if not blob.exists():
-                if attempt["n"] > 1:
-                    # a retry after a transient error: the first try's
-                    # delete may have applied server-side before the
-                    # response was lost — absence now IS success, not a
-                    # missing artefact
-                    return
+                if state["delete_attempted"]:
+                    return  # our own delete (probably) landed: success
                 raise ArtefactNotFound(key)
+            state["delete_attempted"] = True
             blob.delete()
 
         self._with_retries(_delete)

@@ -11,6 +11,7 @@ from __future__ import annotations
 import dataclasses
 import io
 from datetime import date
+from time import perf_counter
 
 import pandas as pd
 
@@ -53,6 +54,51 @@ def persist_train_result(store: ArtefactStore, result: TrainResult) -> TrainResu
         model_artefact_key=model_key_,
         metrics_artefact_key=metrics_key,
     )
+
+
+def _record_train_metrics(
+    fitted, metrics: dict[str, float], fit_s: float, n_rows: int
+) -> None:
+    """Export training telemetry through the shared obs registry, so the
+    day loop's train signal and the serving hot path land on the same
+    ``/metrics`` surface (a run-day pod or in-process runner scrape shows
+    fit time, step time, loss, and held-out quality next to the serving
+    histograms)."""
+    from bodywork_tpu.obs import get_registry
+
+    reg = get_registry()
+    reg.counter(
+        "bodywork_tpu_train_runs_total", "Completed training runs"
+    ).inc()
+    reg.histogram(
+        "bodywork_tpu_train_fit_seconds",
+        "Fit + held-out eval wall-clock per training run",
+    ).observe(fit_s)
+    reg.gauge(
+        "bodywork_tpu_train_rows", "Rows in the latest training history"
+    ).set(n_rows)
+    reg.gauge(
+        "bodywork_tpu_train_mape_ratio", "Held-out MAPE of the latest fit"
+    ).set(metrics["MAPE"])
+    reg.gauge(
+        "bodywork_tpu_train_r2_ratio", "Held-out r_squared of the latest fit"
+    ).set(metrics["r_squared"])
+    final_loss = getattr(fitted, "final_loss", None)
+    if final_loss is not None:
+        reg.gauge(
+            "bodywork_tpu_train_final_loss",
+            "Training loss at the last optimisation step",
+        ).set(final_loss)
+    n_steps = getattr(getattr(fitted, "config", None), "n_steps", None)
+    if n_steps:
+        # the timed window is the fused fit+eval program (one dispatch),
+        # so this is an UPPER bound on true per-step time — say so
+        # rather than claiming a precision the measurement lacks
+        reg.gauge(
+            "bodywork_tpu_train_step_seconds",
+            "Fit+eval wall-clock / optimisation steps of the latest fit "
+            "(upper bound on per-step time)",
+        ).set(fit_s / n_steps)
 
 
 def make_model(model_type: str, **kwargs) -> Regressor:
@@ -163,6 +209,7 @@ def train_on_history(
     ds = load_all_datasets(store)
     split = train_test_split(ds.X, ds.y, test_size=test_size, seed=split_seed)
     model = make_model(model_type, **(model_kwargs or {}))
+    fit_t0 = perf_counter()
     if use_mesh:
         fitted, metrics = _fit_sharded(
             model, model_type, split, mesh_data, mesh_model, fit_seed
@@ -174,6 +221,7 @@ def train_on_history(
             split.X_train, split.y_train, split.X_test, split.y_test,
             seed=fit_seed,
         )
+    _record_train_metrics(fitted, metrics, perf_counter() - fit_t0, len(ds))
     log.info(
         f"trained {fitted.info} on {len(ds)} rows to {ds.date}: "
         f"MAPE={metrics['MAPE']:.4f} r2={metrics['r_squared']:.4f} "

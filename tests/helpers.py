@@ -43,6 +43,9 @@ class FakeBlob:
     def delete(self):
         self._bucket._maybe_fail("delete")
         del self._bucket._objects[self.name]
+        # applied-but-response-lost: the server removed the object, then
+        # the response was dropped (the case absence-on-retry exists for)
+        self._bucket._maybe_fail("delete_after_apply")
 
     @property
     def generation(self):

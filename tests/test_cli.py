@@ -1,4 +1,6 @@
 """CLI: subcommand wiring, exit-code contract, day-loop smoke."""
+import pytest
+
 from bodywork_tpu.cli import main
 
 
@@ -48,10 +50,31 @@ def test_report_fail_on_drift_exit_code(tmp_path, capsys):
 
 
 def test_run_day_smoke(tmp_path, capsys):
+    import json
+
     store = str(tmp_path / "artefacts")
-    assert main(["run-day", "--store", store, "--date", "2026-01-01"]) == 0
+    trace = tmp_path / "day-{date}.trace.json"
+    assert main(["run-day", "--store", store, "--date", "2026-01-01",
+                 "--trace-out", str(trace)]) == 0
     out = capsys.readouterr().out
     assert "stage-4-test-model-scoring-service" in out
+    # {date} placeholder substituted (the daily-loop CronJob's date-keyed
+    # trace artefacts); report written next to the trace
+    trace_path = tmp_path / "day-2026-01-01.trace.json"
+    report_path = tmp_path / "day-2026-01-01.report.json"
+    assert trace_path.exists() and report_path.exists()
+    doc = json.loads(trace_path.read_text())
+    stage_events = {
+        e["name"]: e for e in doc["traceEvents"]
+        if e.get("ph") == "X" and e.get("cat") == "stage"
+    }
+    report = json.loads(report_path.read_text())
+    assert report["schema"] == "bodywork_tpu.day_report/1"
+    # acceptance: one span per stage whose durations sum-check against
+    # the DayResult timings the report carries
+    assert set(stage_events) == set(report["stage_seconds"])
+    for name, secs in report["stage_seconds"].items():
+        assert stage_events[name]["dur"] == pytest.approx(secs * 1e6, rel=1e-3)
 
 
 def test_exit_code_contract_on_failure(tmp_path, capsys):
@@ -61,10 +84,26 @@ def test_exit_code_contract_on_failure(tmp_path, capsys):
 
 def test_deploy_writes_manifests(tmp_path, capsys):
     out_dir = tmp_path / "k8s"
-    assert main(["deploy", "--out", str(out_dir)]) == 0
+    # the default pipeline derives per-stage image tags from each stage's
+    # requirements pins; emitting the build contexts alongside keeps the
+    # manifests buildable (see test_deploy_refuses_unbuildable_tags)
+    assert main(["deploy", "--out", str(out_dir),
+                 "--emit-images", str(tmp_path / "images")]) == 0
     files = sorted(p.name for p in out_dir.iterdir())
     assert "00-namespace.yaml" in files
     assert any("cronjob" in f for f in files)
+
+
+def test_deploy_refuses_unbuildable_tags(tmp_path, capsys):
+    """ADVICE medium (k8s.py:204): manifests referencing derived
+    per-stage image tags WITHOUT emitting their build contexts are
+    guaranteed ImagePullBackOff — deploy must refuse unless forced."""
+    out_dir = tmp_path / "k8s"
+    assert main(["deploy", "--out", str(out_dir)]) == 1
+    assert not out_dir.exists()  # refused before writing anything
+    # --force writes anyway (operator owns the consequence)
+    assert main(["deploy", "--out", str(out_dir), "--force"]) == 0
+    assert (out_dir / "00-namespace.yaml").exists()
 
 
 def _seed(store, days=1):
@@ -177,7 +216,8 @@ def test_deploy_spec_file_precedence(tmp_path):
     spec_file.write_text(default_pipeline(model_type="mlp").to_yaml())
     out_dir = tmp_path / "k8s"
     assert main(["deploy", "--out", str(out_dir), "--spec", str(spec_file),
-                 "--model", "linear"]) == 0
+                 "--model", "linear",
+                 "--emit-images", str(tmp_path / "images")]) == 0
     cm = yaml.safe_load((out_dir / "00-pipeline-spec-configmap.yaml").read_text())
     assert "model_type: mlp" in cm["data"]["pipeline.yaml"]
 

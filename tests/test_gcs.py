@@ -132,6 +132,29 @@ def test_transient_download_and_exists_retry(gcs_store):
     assert gcs_store.exists(key)
 
 
+def test_delete_missing_key_under_transient_error_still_raises(gcs_store):
+    """ADVICE low (gcs.py:127): a transient 503 BEFORE any delete RPC
+    (here: from the existence check itself) must not convert a
+    never-existing key's absence into success on retry — no delete was
+    ever issued, so absence proves the artefact was missing all along."""
+    gcs_store._bucket.inject_failures("exists", 1)
+    with pytest.raises(ArtefactNotFound):
+        gcs_store.delete("models/never-existed.npz")
+
+
+def test_delete_lost_response_after_delete_rpc_is_success(gcs_store):
+    """The case absence-on-retry exists FOR: the delete RPC applied
+    server-side but its response was lost — the retry finds the blob
+    gone and must report success, not ArtefactNotFound."""
+    key = "models/regressor-2026-01-01.npz"
+    gcs_store.put_text(key, "x")
+    # the delete RPC itself fails transiently AFTER removing the object
+    # (applied-but-response-lost); the retry sees absence
+    gcs_store._bucket.inject_failures("delete_after_apply", 1)
+    gcs_store.delete(key)  # no raise: success
+    assert not gcs_store.exists(key)
+
+
 def test_persistent_transient_failure_raises_after_budget(gcs_store):
     """More consecutive failures than RETRY_ATTEMPTS: the error
     propagates — the retry policy is bounded, not a hang."""

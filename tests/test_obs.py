@@ -1,0 +1,470 @@
+"""Observability subsystem (ISSUE 2): registry semantics, metric-name
+lint, exposition golden format, multiprocess snapshot merge, the app's
+``GET /metrics`` endpoint, a live 2-worker aggregated-scrape smoke, and
+the runner's span/trace-report schema."""
+import json
+import time
+from datetime import date
+
+import numpy as np
+import pytest
+
+from bodywork_tpu.obs import (
+    Registry,
+    SpanRecorder,
+    chrome_trace,
+    day_report,
+    merge_snapshots,
+    render_snapshot,
+    validate_metric_name,
+)
+
+# --- registry semantics ----------------------------------------------------
+
+
+def test_counter_semantics():
+    reg = Registry()
+    c = reg.counter("bodywork_tpu_widget_total", "widgets")
+    c.inc()
+    c.inc(2.5)
+    assert c.value() == 3.5
+    # labelled children are independent samples
+    c.inc(route="/a")
+    c.inc(route="/a")
+    c.inc(route="/b")
+    assert c.value(route="/a") == 2
+    assert c.value(route="/b") == 1
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    # idempotent re-registration returns the same metric
+    assert reg.counter("bodywork_tpu_widget_total") is c
+    # ...but a type conflict fails loud
+    with pytest.raises(ValueError):
+        reg.gauge("bodywork_tpu_widget_total")
+
+
+def test_gauge_semantics():
+    reg = Registry()
+    g = reg.gauge("bodywork_tpu_depth_rows", "queue depth")
+    g.set(5)
+    g.inc(2)
+    g.dec()
+    assert g.value() == 6
+    with pytest.raises(ValueError):
+        Registry().gauge("bodywork_tpu_x_rows", aggregate="median")
+
+
+def test_histogram_semantics():
+    reg = Registry()
+    h = reg.histogram(
+        "bodywork_tpu_latency_seconds", "lat", buckets=(0.01, 0.1, 1.0)
+    )
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.count() == 4
+    assert h.sum() == pytest.approx(5.555)
+    snap = reg.snapshot()["bodywork_tpu_latency_seconds"]
+    sample = snap["samples"][0]
+    # non-cumulative per-bucket counts + the +Inf overflow slot
+    assert sample["buckets"] == [1, 1, 1, 1]
+    # a boundary value lands in its bucket (le semantics)
+    h.observe(0.01)
+    assert reg.snapshot()["bodywork_tpu_latency_seconds"]["samples"][0][
+        "buckets"] == [2, 1, 1, 1]
+    with pytest.raises(ValueError):
+        Registry().histogram("bodywork_tpu_x_seconds", buckets=(1.0, 0.5))
+
+
+def test_non_finite_values_render_as_prometheus_literals():
+    """One NaN/Inf observation must not 500 every subsequent /metrics
+    scrape — the text format has literals for them."""
+    reg = Registry()
+    reg.gauge("bodywork_tpu_train_mape_ratio").set(float("nan"))
+    reg.gauge("bodywork_tpu_peak_rows").set(float("inf"))
+    reg.histogram("bodywork_tpu_x_seconds", buckets=(1.0,)).observe(
+        float("inf")
+    )
+    text = reg.render()
+    assert "bodywork_tpu_train_mape_ratio NaN" in text
+    assert "bodywork_tpu_peak_rows +Inf" in text
+    assert "bodywork_tpu_x_seconds_sum +Inf" in text
+
+
+def test_read_accessors_never_create_phantom_series():
+    """Probing a never-observed label set is a READ: it must not inject a
+    zero-valued series into the exposition or snapshot files."""
+    reg = Registry()
+    c = reg.counter("bodywork_tpu_probe_total")
+    assert c.value(route="/never") == 0
+    g = reg.gauge("bodywork_tpu_probe_rows")
+    assert g.value(worker="9") == 0
+    h = reg.histogram("bodywork_tpu_probe_seconds")
+    assert h.count(phase="x") == 0 and h.sum(phase="x") == 0.0
+    snap = reg.snapshot()
+    assert all(not entry["samples"] for entry in snap.values())
+    sample_lines = [
+        line for line in render_snapshot(snap).splitlines()
+        if line and not line.startswith("#")
+    ]
+    assert sample_lines == []  # headers only, no phantom zero series
+
+
+def test_gauge_aggregate_conflict_raises():
+    reg = Registry()
+    reg.gauge("bodywork_tpu_inflight_rows", aggregate="sum")
+    # no-opinion re-registration returns the existing gauge
+    assert reg.gauge("bodywork_tpu_inflight_rows").aggregate == "sum"
+    # an explicit conflicting merge mode is a bug, not a preference
+    with pytest.raises(ValueError):
+        reg.gauge("bodywork_tpu_inflight_rows", aggregate="max")
+
+
+# --- metric-name lint ------------------------------------------------------
+
+
+def test_metric_name_lint():
+    # valid shapes pass
+    validate_metric_name("bodywork_tpu_http_requests_total", "counter")
+    validate_metric_name("bodywork_tpu_queue_wait_seconds", "histogram")
+    validate_metric_name("bodywork_tpu_train_rows", "gauge")
+    bad = [
+        ("widget_total", "counter"),           # missing namespace prefix
+        ("bodywork_tpu_Widget_total", "counter"),  # uppercase
+        ("bodywork_tpu_latency", "histogram"),  # no unit suffix
+        ("bodywork_tpu_requests_total", "gauge"),  # _total reserved
+        ("bodywork_tpu_requests", "counter"),   # counter needs _total
+    ]
+    for name, mtype in bad:
+        with pytest.raises(ValueError):
+            validate_metric_name(name, mtype)
+    # the registry enforces the lint at creation
+    reg = Registry()
+    with pytest.raises(ValueError):
+        reg.counter("bodywork_tpu_bad_name")
+    with pytest.raises(ValueError):
+        reg.histogram("not_our_namespace_seconds")
+
+
+# --- exposition format (golden) -------------------------------------------
+
+
+def test_prometheus_exposition_golden():
+    reg = Registry()
+    c = reg.counter("bodywork_tpu_scored_total", "Scored rows")
+    c.inc(3, route="/score/v1")
+    g = reg.gauge("bodywork_tpu_train_mape_ratio", "Held-out MAPE")
+    g.set(0.25)
+    h = reg.histogram(
+        "bodywork_tpu_wait_seconds", "Wait", buckets=(0.1, 1.0)
+    )
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(2.0)
+    assert reg.render() == (
+        "# HELP bodywork_tpu_scored_total Scored rows\n"
+        "# TYPE bodywork_tpu_scored_total counter\n"
+        'bodywork_tpu_scored_total{route="/score/v1"} 3\n'
+        "# HELP bodywork_tpu_train_mape_ratio Held-out MAPE\n"
+        "# TYPE bodywork_tpu_train_mape_ratio gauge\n"
+        "bodywork_tpu_train_mape_ratio 0.25\n"
+        "# HELP bodywork_tpu_wait_seconds Wait\n"
+        "# TYPE bodywork_tpu_wait_seconds histogram\n"
+        'bodywork_tpu_wait_seconds_bucket{le="0.1"} 1\n'
+        'bodywork_tpu_wait_seconds_bucket{le="1"} 2\n'
+        'bodywork_tpu_wait_seconds_bucket{le="+Inf"} 3\n'
+        "bodywork_tpu_wait_seconds_sum 2.55\n"
+        "bodywork_tpu_wait_seconds_count 3\n"
+    )
+
+
+# --- multiprocess aggregation ---------------------------------------------
+
+
+def _worker_registry(n_requests: int, latency: float) -> Registry:
+    reg = Registry()
+    reg.counter("bodywork_tpu_http_requests_total").inc(n_requests)
+    h = reg.histogram(
+        "bodywork_tpu_scoring_latency_seconds", buckets=(0.01, 0.1)
+    )
+    for _ in range(n_requests):
+        h.observe(latency)
+    reg.gauge("bodywork_tpu_inflight_rows", aggregate="sum").set(2)
+    reg.gauge("bodywork_tpu_peak_rows", aggregate="max").set(n_requests)
+    return reg
+
+
+def test_merge_snapshots_across_workers():
+    a = _worker_registry(3, 0.005)
+    b = _worker_registry(5, 0.05)
+    merged = merge_snapshots([a.snapshot(), b.snapshot()])
+    # counters sum
+    assert merged["bodywork_tpu_http_requests_total"]["samples"][0][
+        "value"] == 8
+    # histograms merge element-wise: counts and sums add
+    hist = merged["bodywork_tpu_scoring_latency_seconds"]["samples"][0]
+    assert hist["count"] == 8
+    assert hist["buckets"] == [3, 5, 0]
+    assert hist["sum"] == pytest.approx(3 * 0.005 + 5 * 0.05)
+    # gauges merge per their declared mode
+    assert merged["bodywork_tpu_inflight_rows"]["samples"][0]["value"] == 4
+    assert merged["bodywork_tpu_peak_rows"]["samples"][0]["value"] == 5
+    # the merged snapshot renders through the same exposition path
+    text = render_snapshot(merged)
+    assert "bodywork_tpu_scoring_latency_seconds_count 8" in text
+
+
+def test_snapshot_files_roundtrip(tmp_path):
+    from bodywork_tpu.obs.multiproc import (
+        aggregated_render,
+        read_sibling_snapshots,
+        write_snapshot,
+    )
+
+    a = _worker_registry(2, 0.005)
+    b = _worker_registry(4, 0.05)
+    write_snapshot(a, tmp_path, pid=111)
+    write_snapshot(b, tmp_path, pid=222)
+    # exclusion keeps the answering worker from double-counting itself
+    assert len(read_sibling_snapshots(tmp_path)) == 2
+    assert len(read_sibling_snapshots(tmp_path, exclude_pid=111)) == 1
+    # a torn/garbage file is skipped, not fatal
+    (tmp_path / "obs-metrics-999.json").write_text("{not json")
+    assert len(read_sibling_snapshots(tmp_path)) == 2
+    # live registry (a) + sibling files other than a's own pid... here
+    # the live process is neither 111 nor 222, so all three merge
+    text = aggregated_render(a, tmp_path)
+    assert "bodywork_tpu_http_requests_total 8" in text
+
+
+# --- the app's /metrics endpoint ------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def obs_app():
+    from bodywork_tpu.models import LinearRegressor
+    from bodywork_tpu.serve import create_app
+
+    rng = np.random.default_rng(5)
+    X = rng.uniform(0, 100, 400).astype(np.float32)
+    y = (1.0 + 0.5 * X).astype(np.float32)
+    model = LinearRegressor().fit(X, y)
+    return create_app(model, date(2026, 7, 1), buckets=(1, 64), warmup=False)
+
+
+def test_metrics_endpoint_exposes_scoring_histograms(obs_app):
+    from bodywork_tpu.obs import get_registry
+
+    client = obs_app.test_client()
+    latency = get_registry().get("bodywork_tpu_scoring_latency_seconds")
+    dispatch = get_registry().get("bodywork_tpu_device_dispatch_seconds")
+    before, before_d = latency.count(), dispatch.count()
+    for _ in range(3):
+        assert client.post("/score/v1", json={"X": 50}).status_code == 200
+    assert client.post("/score/v1/batch", json={"X": [1, 2, 3]}).status_code == 200
+    # count == scored requests; a rejected request is not "scored"
+    assert client.post("/score/v1", json={"bad": 1}).status_code == 400
+    assert latency.count() - before == 4
+    assert dispatch.count() - before_d == 4
+    response = client.get("/metrics")
+    assert response.status_code == 200
+    assert response.headers["Content-Type"].startswith("text/plain")
+    text = response.get_data(as_text=True)
+    for name in (
+        "bodywork_tpu_scoring_latency_seconds_bucket",
+        "bodywork_tpu_request_parse_seconds_count",
+        "bodywork_tpu_device_dispatch_seconds_count",
+        "bodywork_tpu_response_serialize_seconds_count",
+        "bodywork_tpu_http_requests_total",
+    ):
+        assert name in text, name
+
+
+def test_hot_swap_counter(obs_app):
+    from bodywork_tpu.models import LinearRegressor
+    from bodywork_tpu.obs import get_registry
+
+    swaps = get_registry().get("bodywork_tpu_model_hot_swaps_total")
+    before = swaps.value()
+    rng = np.random.default_rng(6)
+    X = rng.uniform(0, 100, 200).astype(np.float32)
+    obs_app.swap_model(
+        LinearRegressor().fit(X, (2.0 + X).astype(np.float32)),
+        date(2026, 7, 2),
+    )
+    assert swaps.value() - before == 1
+
+
+# --- live multiproc aggregation smoke (the acceptance criterion) ----------
+
+
+def _metric_value(text: str, line_prefix: str) -> float:
+    for line in text.splitlines():
+        if line.startswith(line_prefix + " "):
+            return float(line.split()[-1])
+    return 0.0
+
+
+def test_two_worker_metrics_aggregate_to_one_view(tmp_path):
+    """``serve --workers 2 --metrics`` semantics: ONE /metrics endpoint
+    whose scoring-latency count equals the requests scored across BOTH
+    replicas, with queue-wait and device-dispatch phase histograms
+    populated (the coalescer is on)."""
+    import requests
+
+    from bodywork_tpu.models import LinearRegressor
+    from bodywork_tpu.models.checkpoint import save_model
+    from bodywork_tpu.serve import MultiProcessService
+    from bodywork_tpu.store import FilesystemStore
+    from tests.helpers import hermetic_env
+
+    store = FilesystemStore(tmp_path / "store")
+    rng = np.random.default_rng(3)
+    X = rng.uniform(0, 100, 400).astype(np.float32)
+    y = (1.0 + 0.5 * X).astype(np.float32)
+    save_model(store, LinearRegressor().fit(X, y), date(2026, 7, 1))
+
+    n_requests = 24
+    with hermetic_env():
+        svc = MultiProcessService(
+            str(tmp_path / "store"), workers=2, engine="xla",
+            metrics=True, batch_window_ms=1.0, batch_max_rows=8,
+        ).start()
+        try:
+            assert svc.metrics_url is not None
+            # one fresh connection per request: the kernel's REUSEPORT
+            # balancing is per-CONNECTION, so keep-alive would pin every
+            # request (and the scrape) to one worker and the aggregation
+            # would never be exercised
+            for _ in range(n_requests):
+                r = requests.post(svc.url, json={"X": 50}, timeout=30)
+                assert r.ok
+            # converge: the answering worker is exact for itself, its
+            # sibling's file lags by <= one flush interval
+            deadline = time.monotonic() + 30
+            count = -1.0
+            while time.monotonic() < deadline:
+                text = requests.get(svc.metrics_url, timeout=10).text
+                count = _metric_value(
+                    text, "bodywork_tpu_scoring_latency_seconds_count"
+                )
+                if count == n_requests:
+                    break
+                time.sleep(0.2)
+            assert count == n_requests, (
+                f"aggregated scoring count {count} != {n_requests}"
+            )
+            # phase histograms populated with the coalescer on
+            assert _metric_value(
+                text, "bodywork_tpu_queue_wait_seconds_count"
+            ) > 0
+            assert _metric_value(
+                text, "bodywork_tpu_device_dispatch_seconds_count"
+            ) > 0
+        finally:
+            svc.stop()
+
+
+# --- spans + trace/report schema ------------------------------------------
+
+
+def _stage_a(ctx, **kwargs):
+    time.sleep(0.01)
+    return "a"
+
+
+def _stage_b(ctx, **kwargs):
+    time.sleep(0.01)
+    return "b"
+
+
+def _tiny_spec():
+    from bodywork_tpu.pipeline.spec import PipelineSpec, StageSpec
+
+    stages = {
+        name: StageSpec(
+            name=name, kind="batch",
+            executable=f"tests.test_obs:_stage_{name[-1]}",
+            retries=0, max_completion_time_s=30,
+        )
+        for name in ("stage-a", "stage-b")
+    }
+    return PipelineSpec(name="tiny", dag=[["stage-a"], ["stage-b"]],
+                        stages=stages)
+
+
+def test_run_day_spans_sum_check_against_day_result(store):
+    from bodywork_tpu.pipeline import LocalRunner
+
+    runner = LocalRunner(_tiny_spec(), store)
+    result = runner.run_day(date(2026, 1, 1))
+    stage_spans = {s.name: s for s in result.spans if s.category == "stage"}
+    # one span per stage, duration EXACTLY the DayResult timing (one
+    # measurement, two views — the acceptance sum-check)
+    assert set(stage_spans) == set(result.stage_seconds)
+    for name, secs in result.stage_seconds.items():
+        assert stage_spans[name].duration_s == secs
+    day_spans = [s for s in result.spans if s.category == "day"]
+    assert len(day_spans) == 1
+    assert day_spans[0].duration_s == result.wall_clock_s
+    # spans nest inside the day envelope
+    for s in stage_spans.values():
+        assert s.start_s >= day_spans[0].start_s
+        assert s.end_s <= day_spans[0].end_s + 1e-6
+
+
+def test_day_report_schema_and_trace_events(store, tmp_path):
+    from bodywork_tpu.obs import write_chrome_trace, write_day_report
+    from bodywork_tpu.pipeline import LocalRunner
+
+    runner = LocalRunner(_tiny_spec(), store)
+    result = runner.run_day(date(2026, 1, 1))
+    report = day_report(result)
+    assert report["schema"] == "bodywork_tpu.day_report/1"
+    assert report["day"] == "2026-01-01"
+    assert set(report["stage_seconds"]) == {"stage-a", "stage-b"}
+    for span in report["spans"]:
+        assert {"name", "category", "start_s", "duration_s", "thread"} <= set(span)
+    # round-trips through JSON files
+    report_path = write_day_report(tmp_path / "day.report.json", report)
+    assert json.loads(report_path.read_text()) == report
+    trace_path = write_chrome_trace(
+        tmp_path / "day.trace.json", result.spans
+    )
+    doc = json.loads(trace_path.read_text())
+    events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert {e["name"] for e in events if e["cat"] == "stage"} == {
+        "stage-a", "stage-b",
+    }
+    for e in events:
+        assert e["ts"] >= 0 and e["dur"] >= 0  # microseconds
+    # thread-name metadata present (Perfetto track labels)
+    assert any(e.get("ph") == "M" and e["name"] == "thread_name"
+               for e in doc["traceEvents"])
+
+
+def test_recorder_background_spans():
+    rec = SpanRecorder()
+    with rec.span("prefetch-x", "prefetch", day="2026-01-01"):
+        time.sleep(0.002)
+    spans = rec.spans()
+    assert len(spans) == 1
+    assert spans[0].category == "prefetch"
+    assert spans[0].meta == {"day": "2026-01-01"}
+    assert spans[0].duration_s > 0
+    trace = chrome_trace(spans)
+    x = [e for e in trace["traceEvents"] if e.get("ph") == "X"][0]
+    assert x["args"] == {"day": "2026-01-01"}
+
+
+def test_simulation_records_overlap_spans(store):
+    """lookahead-train and prefetch spans land on the runner's timeline —
+    the overlap the trace exists to make visible."""
+    from bodywork_tpu.pipeline import LocalRunner, default_pipeline
+
+    runner = LocalRunner(default_pipeline(scoring_mode="batch"), store)
+    runner.run_simulation(date(2026, 1, 1), days=2)
+    cats = {s.category for s in runner.recorder.spans()}
+    assert {"stage", "day", "setup", "prefetch"} <= cats
+    names = [s.name for s in runner.recorder.spans()]
+    assert any(n.startswith("lookahead-train-") for n in names)
+    assert any(n.startswith("prefetch-dataset-") for n in names)

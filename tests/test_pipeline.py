@@ -578,7 +578,8 @@ def test_spec_file_round_trips_nondefault_choices(tmp_path):
 
     out = tmp_path / "k8s"
     assert main(["deploy", "--out", str(out), "--model", "mlp",
-                 "--mode", "single"]) == 0
+                 "--mode", "single",
+                 "--emit-images", str(tmp_path / "images")]) == 0
     import yaml as _yaml
 
     cm = _yaml.safe_load((out / "00-pipeline-spec-configmap.yaml").read_text())
