@@ -13,6 +13,7 @@ its own driver:
     python -m bodywork_tpu.cli run-ab    --store DIR --days N [--models a,b]
     python -m bodywork_tpu.cli run-stage --store DIR --stage NAME ...
     python -m bodywork_tpu.cli report    --store DIR
+    python -m bodywork_tpu.cli compact   --store DIR [--dry-run]
     python -m bodywork_tpu.cli deploy    --out DIR [--store-path P] [--image I]
 
 Every command exits 0 on success and 1 with a logged error otherwise — the
@@ -448,6 +449,52 @@ def cmd_report(args) -> int:
     return 0
 
 
+def cmd_compact(args) -> int:
+    """Consolidate dataset history into one ``snapshots/`` artefact, so
+    cold processes (per-day k8s Jobs, the daily-loop CronJob, plain
+    ``cli train``) load all history in O(1 + tail) store reads instead
+    of O(days). ``--dry-run`` prints what WOULD be consolidated — days
+    covered, rows, estimated bytes — without writing, so an operator can
+    size the compaction CronJob before enabling it."""
+    from bodywork_tpu.data.snapshot import plan_compaction, write_snapshot
+
+    store = _store(args)
+    plan = plan_compaction(store)
+    if plan["days"] == 0:
+        print("no datasets to consolidate")
+        return 0
+    if plan["days_without_tokens"]:
+        print(
+            f"warning: {plan['days_without_tokens']} day(s) have no "
+            "version token (backend cannot verify them) and will be "
+            "skipped",
+            file=sys.stderr,
+        )
+    if plan["would_write"] is None:
+        # nothing consolidatable: every day is token-less on this
+        # backend — exiting 0 would let a CronJob claim success forever
+        log.error("nothing consolidatable: backend reports no version "
+                  "tokens for any dataset day")
+        return 1
+    latest = plan["latest_snapshot"] or "none"
+    print(
+        f"{len(plan['covered_days'])} day(s) "
+        f"({plan['covered_days'][0]} .. {plan['covered_days'][-1]}), "
+        f"{plan['rows']} rows, ~{plan['estimated_bytes']} bytes; "
+        f"latest snapshot: {latest}"
+    )
+    if args.dry_run:
+        print(f"dry-run: would write {plan['would_write']}")
+        return 0
+    kwargs = {"keep": args.keep} if args.keep is not None else {}
+    key = write_snapshot(store, **kwargs)
+    if key is None:
+        log.error("compaction wrote nothing (store changed mid-run?)")
+        return 1
+    print(key)
+    return 0
+
+
 def cmd_deploy(args) -> int:
     from bodywork_tpu.pipeline import write_manifests
 
@@ -711,6 +758,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "(default: all history). Use with --fail-on-drift "
                         "so the gate reflects CURRENT drift instead of "
                         "latching forever on one past flagged day")
+
+    p = add("compact", cmd_compact,
+            help="consolidate dataset history into a snapshots/ artefact")
+    p.add_argument("--store", **common_store)
+    p.add_argument("--dry-run", action="store_true",
+                   help="print days covered, rows, and estimated bytes "
+                        "without writing anything — size the compaction "
+                        "CronJob before enabling it")
+    p.add_argument("--keep", type=_positive_int, default=None, metavar="N",
+                   help="snapshots to retain after writing (default: "
+                        "data.snapshot.SNAPSHOT_KEEP)")
 
     p = add("deploy", cmd_deploy, help="write GKE TPU manifests")
     p.add_argument("--spec", default=None, help="pipeline spec YAML (overrides --model/--mode)")

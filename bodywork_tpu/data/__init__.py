@@ -23,6 +23,10 @@ _EXPORTS = {
     "load_dataset": "bodywork_tpu.data.io",
     "load_latest_dataset": "bodywork_tpu.data.io",
     "persist_dataset": "bodywork_tpu.data.io",
+    "load_latest_snapshot": "bodywork_tpu.data.snapshot",
+    "plan_compaction": "bodywork_tpu.data.snapshot",
+    "refresh_due": "bodywork_tpu.data.snapshot",
+    "write_snapshot": "bodywork_tpu.data.snapshot",
 }
 
 __all__ = list(_EXPORTS)

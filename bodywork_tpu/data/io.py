@@ -68,11 +68,15 @@ def persist_dataset(store: ArtefactStore, ds: Dataset) -> str:
     return key
 
 
-def load_dataset(store: ArtefactStore, key: str) -> Dataset:
+def _parse_dataset_csv(data: bytes, key: str) -> Dataset:
     from bodywork_tpu.utils.dates import date_from_key
 
-    df = pd.read_csv(io.BytesIO(store.get_bytes(key)))
+    df = pd.read_csv(io.BytesIO(data))
     return Dataset.from_dataframe(df, date_from_key(key))
+
+
+def load_dataset(store: ArtefactStore, key: str) -> Dataset:
+    return _parse_dataset_csv(store.get_bytes(key), key)
 
 
 def load_latest_dataset(store: ArtefactStore) -> Dataset:
@@ -81,39 +85,161 @@ def load_latest_dataset(store: ArtefactStore) -> Dataset:
     return load_dataset(store, key)
 
 
+def load_history_parts(
+    store: ArtefactStore,
+    hist: list,
+    tokens: dict,
+    record_outcome: bool = True,
+) -> dict[str, Dataset]:
+    """Per-day parsed datasets for every ``hist`` entry, resolved through
+    three tiers, cheapest first:
+
+    1. the per-day parsed cache (keyed by the backend's ``version_token``);
+    2. the latest valid consolidated snapshot (``data.snapshot``) — a
+       covered day is trusted only while its recorded token equals the
+       store's current one, so an overwritten day degrades to tier 3 for
+       that day alone;
+    3. a batched ``store.get_many`` fetch + CSV parse of the remainder
+       (parallel round-trips on backends that support it).
+
+    Snapshot slices feed the per-day cache, so a cold process's first
+    load warms the same cache a long-lived one builds incrementally.
+    ``record_outcome=False`` keeps maintenance reads (the compactor's
+    own, via ``write_snapshot``/``plan_compaction``) out of the
+    hit/stale/miss loader counters operators alert on.
+    """
+    cache: dict = store.mutable_cache("_parsed_dataset_cache")
+    dates = dict(hist)
+    parts: dict[str, Dataset] = {}
+    missing: list[str] = []
+    for key, _ in hist:
+        token = tokens.get(key)
+        hit = cache.get(key) if token is not None else None
+        if hit is not None and hit[0] == token:
+            parts[key] = hit[1]
+        else:
+            missing.append(key)
+    n_from_snapshot = 0
+    if missing:
+        from bodywork_tpu.data import snapshot as snapshot_mod
+        from bodywork_tpu.store.schema import SNAPSHOTS_PREFIX
+
+        snaps = store.history(SNAPSHOTS_PREFIX)
+        snap = None
+        if not snaps:
+            if record_outcome:
+                snapshot_mod.record_load_outcome("miss")
+        elif any(dates[key] <= snaps[-1][1] for key in missing):
+            # the listing alone bounds what the snapshot can cover (its
+            # embedded date): only read the payload when some missing day
+            # could actually be in it. Without this cut the WARM daily
+            # loop — whose only missing day is the freshly generated one
+            # — would re-download the ever-growing snapshot artefact
+            # every day for nothing, and record a phantom "stale" in the
+            # healthy steady state.
+            snap = snapshot_mod.load_latest_snapshot(
+                store, hist=snaps, record_outcome=record_outcome
+            )
+        if snap is not None:
+            hist_keys = {key for key, _ in hist}
+            slices = snap.slices()
+            usable = {}
+            covered_mismatch = False
+            for entry in snap.entries:
+                key = entry["key"]
+                token = tokens.get(key)
+                if key in hist_keys and token is not None:
+                    if snapshot_mod.canon_token(token) == entry["token"]:
+                        usable[key] = token
+                    else:
+                        covered_mismatch = True
+            if covered_mismatch:
+                # a covered day was OVERWRITTEN since the snapshot (same
+                # date, new token): the date-only refresh_due check can't
+                # see this, so flag it for the in-process compactor —
+                # otherwise every cold reader pays that day's GET forever
+                store.mutable_cache("_snapshot_state")["repair_needed"] = True
+            still_missing = []
+            for key in missing:
+                token = usable.get(key)
+                if token is None:
+                    still_missing.append(key)
+                    continue
+                Xs, ys = slices[key]
+                ds = Dataset(Xs, ys, dates[key])
+                cache[key] = (token, ds)
+                parts[key] = ds
+                n_from_snapshot += 1
+            if record_outcome:
+                snapshot_mod.record_load_outcome(
+                    "hit" if not still_missing else "stale"
+                )
+            missing = still_missing
+    if missing:
+        blobs = store.get_many(missing)
+        for key in missing:
+            ds = _parse_dataset_csv(blobs[key], key)
+            token = tokens.get(key)
+            if token is not None:
+                cache[key] = (token, ds)
+            parts[key] = ds
+    log.info(
+        f"history parts: {len(hist)} day(s) — "
+        f"{len(hist) - n_from_snapshot - len(missing)} cached, "
+        f"{n_from_snapshot} from snapshot, {len(missing)} fetched+parsed"
+    )
+    return parts
+
+
 def load_all_datasets(store: ArtefactStore) -> Dataset:
     """All available history, oldest first, concatenated (``stage_1:39-76``).
 
     The reference re-downloads and re-parses every day's CSV on each
     training run — O(days) round-trips on a monotonically growing history
-    (``stage_1:68-71``; SURVEY.md hard part 2). Here each day's parsed
-    arrays are cached on the store instance keyed by the backend's
-    ``version_token``, so a daily retrain only parses the one new day.
+    (``stage_1:68-71``; SURVEY.md hard part 2). Here three layers
+    eliminate that, coldest to warmest:
+
+    - a cold process loads the latest consolidated snapshot plus only the
+      tail days written after it — O(1 + tail) store reads
+      (:mod:`bodywork_tpu.data.snapshot`);
+    - a warm process re-parses only days whose ``version_token`` changed
+      (the per-day parsed cache);
+    - a fully-warm reload whose exact ``(key, token)`` list is unchanged
+      skips even the O(total-rows) concatenation (the concat cache).
+
+    The returned ``Dataset`` is byte-identical across all paths —
+    snapshot present, stale, corrupt, or absent.
     """
     hist = store.history(DATASETS_PREFIX)
     if not hist:
         from bodywork_tpu.store.base import ArtefactNotFound
 
         raise ArtefactNotFound(f"no datasets under '{DATASETS_PREFIX}'")
-    cache: dict = store.mutable_cache("_parsed_dataset_cache")
-    tokens = store.version_tokens([key for key, _ in hist])
-    parts, n_parsed = [], 0
-    for key, _ in hist:
-        token = tokens.get(key)
-        hit = cache.get(key) if token is not None else None
-        if hit is not None and hit[0] == token:
-            parts.append(hit[1])
-            continue
-        ds = load_dataset(store, key)
-        n_parsed += 1
-        if token is not None:
-            cache[key] = (token, ds)
-        parts.append(ds)
-    X = np.concatenate([p.X for p in parts])
-    y = np.concatenate([p.y for p in parts])
+    keys = [key for key, _ in hist]
+    tokens = store.version_tokens(keys)
     most_recent = hist[-1][1]
+    concat_cache: dict = store.mutable_cache("_concat_history_cache")
+    concat_key = None
+    if len(tokens) == len(keys):  # every key verifiable
+        concat_key = tuple((k, repr(tokens[k])) for k in keys)
+        cached = concat_cache.get(concat_key)
+        if cached is not None:
+            X, y = cached
+            log.info(
+                f"loaded {len(keys)} day(s) (concatenation cache hit), "
+                f"{len(y)} rows, most recent {most_recent}"
+            )
+            return Dataset(X, y, most_recent)
+    parts = load_history_parts(store, hist, tokens)
+    X = np.concatenate([parts[k].X for k in keys])
+    y = np.concatenate([parts[k].y for k in keys])
+    if concat_key is not None:
+        # one entry only: histories are cumulative, so yesterday's concat
+        # can never hit again — keeping it would double peak memory
+        concat_cache.clear()
+        concat_cache[concat_key] = (X, y)
     log.info(
-        f"loaded {len(parts)} day(s) ({n_parsed} parsed, rest cached), "
-        f"{len(y)} rows, most recent {most_recent}"
+        f"loaded {len(parts)} day(s), {len(y)} rows, "
+        f"most recent {most_recent}"
     )
     return Dataset(X, y, most_recent)

@@ -1,0 +1,313 @@
+"""Consolidated-history snapshots: the cold-path data plane.
+
+The per-day parsed-dataset cache (``data.io``) only helps processes that
+LIVE across days; every cold process — a k8s per-day Job, the daily-loop
+CronJob, plain ``cli train`` — still reconstructed training history with
+O(days) store round-trips and O(days) CSV parses, the reference's
+re-download-everything pattern (``stage_1_train_model.py:68-71``, SURVEY
+hard part 2) paid again on every pod. On the measured transport
+(~67-200 ms per round-trip, PERF.md §1) that O(days) dominates cold
+train-stage wall time long before the fit does.
+
+A snapshot is one binary columnar artefact under ``snapshots/``
+(``schema.snapshot_key``) holding the float32 ``X``/``y`` arrays of
+every dataset day up to its embedded date, concatenated in history
+order, plus a JSON manifest of covered day-keys, per-key row counts, and
+per-key ``version_token``\\ s. The manifest makes staleness *detectable*:
+a reader trusts a covered day only when its recorded token still equals
+the store's current token, so an overwritten or deleted day degrades
+that one day to a per-day fetch — never a silently wrong training set.
+``load_all_datasets`` is byte-identical with the snapshot present,
+stale, corrupt, or absent.
+
+Format: ``numpy.savez`` (no new dependencies) with arrays ``X``, ``y``
+and a 0-d unicode ``manifest`` array carrying the JSON. Snapshots are
+derived artefacts — deleting the whole prefix is always safe.
+
+Refresh runs OFF the critical path: the persistent runner compacts on a
+background thread after each day persists, and the k8s materialisation
+runs ``cli compact`` as a CronJob after the daily loop (one-shot pods
+never pay the write; they only enjoy the read).
+"""
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import time
+
+import numpy as np
+
+from bodywork_tpu.store.base import ArtefactNotFound, ArtefactStore
+from bodywork_tpu.store.schema import (
+    DATASETS_PREFIX,
+    SNAPSHOTS_PREFIX,
+    snapshot_key,
+)
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("data.snapshot")
+
+SNAPSHOT_SCHEMA = "bodywork_tpu.history_snapshot/1"
+
+#: snapshots retained per store; older ones are pruned on each write
+#: (each snapshot is a full consolidation, so one valid file suffices —
+#: the second is race headroom for a reader mid-``latest`` during a write)
+SNAPSHOT_KEEP = 2
+
+
+def canon_token(token) -> object:
+    """A ``version_token`` in the form it round-trips through the JSON
+    manifest (tuples become lists), so recorded and current tokens
+    compare equal exactly when the backend would call them equal.
+    Non-JSON-able tokens canonicalise via ``repr`` — stable for the
+    value types real backends use, and at worst a false MISMATCH (a
+    per-day re-fetch), never a false match."""
+    try:
+        return json.loads(json.dumps(token))
+    except (TypeError, ValueError):
+        return repr(token)
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """A parsed snapshot artefact: the concatenated arrays plus the
+    manifest entries (``{"key", "rows", "token"}`` in history order)."""
+
+    key: str
+    X: np.ndarray
+    y: np.ndarray
+    entries: list[dict]
+
+    def slices(self) -> dict[str, tuple[np.ndarray, np.ndarray]]:
+        """Per-day ``(X, y)`` views into the columnar arrays, keyed by
+        the covered dataset key (no copies — readers concatenate)."""
+        out = {}
+        offset = 0
+        for entry in self.entries:
+            rows = entry["rows"]
+            out[entry["key"]] = (
+                self.X[offset:offset + rows],
+                self.y[offset:offset + rows],
+            )
+            offset += rows
+        return out
+
+
+def record_load_outcome(outcome: str) -> None:
+    from bodywork_tpu.obs import get_registry
+
+    get_registry().counter(
+        "bodywork_tpu_snapshot_loads_total",
+        "Snapshot consultations by the history loader, by outcome "
+        "(hit: covered everything; stale: used, but some days needed "
+        "per-day fetch; miss: no snapshot; corrupt: unreadable)",
+    ).inc(outcome=outcome)
+
+
+def load_latest_snapshot(
+    store: ArtefactStore,
+    hist: list | None = None,
+    record_outcome: bool = True,
+) -> Snapshot | None:
+    """The newest *parseable* snapshot, or None (none kept, or all
+    unreadable — the caller falls back to per-day loads either way).
+
+    Cost: one listing + one ``get_bytes`` — the O(1) read the whole
+    layer exists for. A corrupt newest snapshot falls back to the older
+    kept one (``SNAPSHOT_KEEP`` exists exactly for this) at one extra
+    GET, and flags ``repair_needed`` so the in-process compactor
+    rewrites it instead of every cold reader paying the degradation
+    until the next dataset day. Pass ``hist`` (a prior
+    ``history(SNAPSHOTS_PREFIX)`` result) to skip re-listing;
+    ``record_outcome=False`` keeps maintenance reads (the compactor's
+    own) out of the loader-outcome counters.
+    """
+    if hist is None:
+        hist = store.history(SNAPSHOTS_PREFIX)
+    if not hist:
+        if record_outcome:
+            record_load_outcome("miss")
+        return None
+    corrupt_seen = False
+    found = None
+    for key, _ in reversed(hist):
+        try:
+            raw = store.get_bytes(key)
+            with np.load(io.BytesIO(raw), allow_pickle=False) as npz:
+                manifest = json.loads(str(npz["manifest"][()]))
+                X = npz["X"]
+                y = npz["y"]
+            if manifest.get("schema") != SNAPSHOT_SCHEMA:
+                raise ValueError(
+                    f"unknown snapshot schema {manifest.get('schema')!r}"
+                )
+            entries = manifest["covered"]
+            n_rows = sum(e["rows"] for e in entries)
+            if X.shape[0] != n_rows or y.shape[0] != n_rows:
+                raise ValueError(
+                    f"manifest covers {n_rows} rows but arrays hold "
+                    f"{X.shape[0]}/{y.shape[0]}"
+                )
+        except ArtefactNotFound:
+            continue  # pruned between listing and read: try the older one
+        except Exception as exc:
+            # a torn/garbled artefact must degrade — to the older kept
+            # snapshot first, then to the per-day path — never crash
+            # training or serve a wrong dataset
+            log.warning(f"snapshot {key} unreadable ({exc!r}); ignoring it")
+            if record_outcome:
+                record_load_outcome("corrupt")
+            corrupt_seen = True
+            continue
+        found = Snapshot(key=key, X=X, y=y, entries=entries)
+        break
+    if corrupt_seen:
+        store.mutable_cache("_snapshot_state")["repair_needed"] = True
+    if found is None and not corrupt_seen and record_outcome:
+        record_load_outcome("miss")  # every kept snapshot was pruned away
+    return found
+
+
+def write_snapshot(store: ArtefactStore, keep: int = SNAPSHOT_KEEP) -> str | None:
+    """Consolidate every dataset day currently in the store into one
+    snapshot artefact; returns its key (None on an empty store).
+
+    Reads ride the same parsed-dataset cache as ``load_all_datasets``
+    (and the latest snapshot itself), so compacting from a warm process
+    parses nothing. Older snapshots beyond ``keep`` are pruned.
+    """
+    from bodywork_tpu.data.io import load_history_parts
+
+    t0 = time.perf_counter()
+    hist = store.history(DATASETS_PREFIX)
+    if not hist:
+        return None
+    tokens = store.version_tokens([k for k, _ in hist])
+    # filter BEFORE fetching: an unverifiable (token-less) day would be
+    # dead weight — readers only trust entries whose token still matches
+    # — so downloading it just to discard it wastes the whole read, and
+    # a fully token-less backend must bail here, not after O(days) GETs
+    consolidatable = []
+    for key, d in hist:
+        if tokens.get(key) is None:
+            log.warning(f"snapshot skips {key}: backend reports no version token")
+        else:
+            consolidatable.append((key, d))
+    if not consolidatable:
+        return None
+    # record_outcome=False: this is a MAINTENANCE read — a healthy daily
+    # compaction finding yesterday's snapshot "stale" is expected, and
+    # counting it would fire the operator alert the counter feeds
+    parts = load_history_parts(
+        store, consolidatable, tokens, record_outcome=False
+    )
+    covered = [
+        {"key": key, "rows": len(parts[key]), "token": canon_token(tokens[key])}
+        for key, _ in consolidatable
+    ]
+    X = np.concatenate([parts[e["key"]].X for e in covered])
+    y = np.concatenate([parts[e["key"]].y for e in covered])
+    most_recent = consolidatable[-1][1]  # hist (and this) sort oldest-first
+    manifest = {
+        "schema": SNAPSHOT_SCHEMA,
+        "covered": covered,
+        "n_rows": int(X.shape[0]),
+        "most_recent": str(most_recent),
+    }
+    buf = io.BytesIO()
+    np.savez(buf, X=X, y=y, manifest=np.array(json.dumps(manifest)))
+    key = snapshot_key(most_recent)
+    store.put_bytes(key, buf.getvalue())
+    _prune_snapshots(store, keep)
+    # the freshly written snapshot matches current tokens by construction
+    store.mutable_cache("_snapshot_state")["repair_needed"] = False
+    from bodywork_tpu.obs import get_registry
+
+    reg = get_registry()
+    reg.counter(
+        "bodywork_tpu_snapshot_writes_total", "Snapshot compactions written"
+    ).inc()
+    reg.gauge(
+        "bodywork_tpu_snapshot_rows",
+        "Rows covered by the most recently written snapshot",
+    ).set(X.shape[0])
+    log.info(
+        f"wrote snapshot {key}: {len(covered)} day(s), {X.shape[0]} rows "
+        f"in {time.perf_counter() - t0:.3f}s"
+    )
+    return key
+
+
+def _prune_snapshots(store: ArtefactStore, keep: int) -> None:
+    hist = store.history(SNAPSHOTS_PREFIX)
+    for key, _ in hist[:-keep] if keep > 0 else hist:
+        try:
+            store.delete(key)
+        except ArtefactNotFound:
+            pass  # concurrent compactor got there first
+
+
+def refresh_due(store: ArtefactStore) -> bool:
+    """True when the latest snapshot no longer covers the latest dataset
+    day (or none exists) — the cheap, listing-only trigger the runner's
+    background compactor polls after each day persists.
+
+    An overwritten day (same date, new token) and a corrupt snapshot
+    artefact are both invisible to the date comparison; the history
+    loader flags either case on the store's ``_snapshot_state`` cache
+    when it hits it, and the flag triggers a refresh here (cleared by
+    the next ``write_snapshot``).
+    """
+    try:
+        _, latest_day = store.latest(DATASETS_PREFIX)
+    except ArtefactNotFound:
+        return False
+    if store.mutable_cache("_snapshot_state").get("repair_needed"):
+        return True
+    snaps = store.history(SNAPSHOTS_PREFIX)
+    return not snaps or snaps[-1][1] < latest_day
+
+
+def plan_compaction(store: ArtefactStore) -> dict:
+    """What ``write_snapshot`` would consolidate, without writing — the
+    ``cli compact --dry-run`` payload operators size the CronJob with.
+
+    Parses the uncovered days (through the shared caches) to count rows;
+    the estimate is the uncompressed npz payload (4 bytes per float32
+    cell) plus the manifest.
+    """
+    hist = store.history(DATASETS_PREFIX)
+    snaps = store.history(SNAPSHOTS_PREFIX)
+    plan: dict = {
+        "days": len(hist),
+        "latest_snapshot": snaps[-1][0] if snaps else None,
+        "snapshots_kept": len(snaps),
+    }
+    if not hist:
+        plan.update(rows=0, estimated_bytes=0, covered_days=[],
+                    days_without_tokens=0, would_write=None)
+        return plan
+    tokens = store.version_tokens([k for k, _ in hist])
+    # apply write_snapshot's exact filter: token-less days are skipped by
+    # the writer, so the plan must not promise to consolidate them
+    consolidatable = [(k, d) for k, d in hist if tokens.get(k) is not None]
+    plan["days_without_tokens"] = len(hist) - len(consolidatable)
+    if not consolidatable:
+        plan.update(rows=0, estimated_bytes=0, covered_days=[],
+                    would_write=None)
+        return plan
+    from bodywork_tpu.data.io import load_history_parts
+
+    parts = load_history_parts(store, hist, tokens, record_outcome=False)
+    rows = sum(len(parts[k]) for k, _ in consolidatable)
+    n_features = next(iter(parts.values())).X.shape[1]
+    plan.update(
+        rows=rows,
+        estimated_bytes=rows * 4 * (n_features + 1),
+        covered_days=[str(d) for _, d in consolidatable],
+        would_write=str(snapshot_key(consolidatable[-1][1])),
+    )
+    return plan
+
+

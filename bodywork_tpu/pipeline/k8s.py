@@ -627,6 +627,47 @@ def generate_manifests(
                 },
             },
         }
+        # history COMPACTION for the one-shot-pod regime: the daily-loop
+        # pod is cold, so without a consolidated snapshot every day it
+        # re-reads O(days) dataset artefacts (data/snapshot.py). The
+        # persistent local runner compacts on a background thread; here
+        # the equivalent is a CronJob running `cli compact` 15 min after
+        # each day loop. Host-side numpy/pandas work: a plain CPU
+        # ResourceSpec and the pipeline-wide image, like the drift gate.
+        compact_stage = dataclasses.replace(
+            first_stage, name="compact-history", image=None, requirements=[],
+            resources=ResourceSpec(cpu_request=0.25, memory_mb=1024),
+        )
+        docs["99-compact-history-cronjob.yaml"] = {
+            "apiVersion": "batch/v1",
+            "kind": "CronJob",
+            "metadata": {
+                "name": f"{spec.name}--compact-history",
+                "namespace": namespace,
+                "labels": labels_base,
+            },
+            "spec": {
+                "schedule": _offset_schedule(daily_schedule, minutes=15),
+                "concurrencyPolicy": "Forbid",
+                "jobTemplate": {
+                    "spec": {
+                        "template": {
+                            "spec": _pod_spec(
+                                spec,
+                                compact_stage,
+                                store,
+                                image,
+                                ["python", "-m", "bodywork_tpu.cli",
+                                 "compact", "--store", store_path],
+                                "Never",
+                                gate_on_deps=False,  # an empty store is a
+                                # no-op print, exit 0
+                            )
+                        }
+                    }
+                },
+            },
+        }
         # the drift GATE the verdict rule exists to feed (calibrated bias
         # rule, monitor.detect_drift): runs after each day loop, exits 4
         # on current-state drift — the failed Job is the k8s-native alarm

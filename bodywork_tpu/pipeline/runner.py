@@ -87,6 +87,11 @@ class LocalRunner:
         self.device = device
         #: (date, box) handoff from a lookahead train to the next run_day
         self._pending_train: tuple | None = None
+        #: background history-snapshot compactor (data.snapshot): at most
+        #: one refresh in flight; day N+1's cold readers get day N's
+        #: consolidation without the day loop ever paying the write
+        self._compact_thread: threading.Thread | None = None
+        self._compact_lock = threading.Lock()
         #: dataset prefetch state: date -> {"ready": Event, "X", "y"},
         #: filled by a single background worker (see _enqueue_generate)
         self._dataset_boxes: dict[date, dict] = {}
@@ -313,6 +318,34 @@ class LocalRunner:
             finally:
                 box["ready"].set()
 
+    def _refresh_snapshot_async(self) -> None:
+        """Refresh the consolidated-history snapshot on a background
+        thread when the day that just ran made it stale. Off the
+        critical path by construction: the day's wall-clock is already
+        measured, and at most one refresh is in flight (a long write
+        simply skips a beat — the next day triggers again)."""
+        with self._compact_lock:
+            if self._compact_thread is not None and self._compact_thread.is_alive():
+                return
+
+            def _work():
+                try:
+                    from bodywork_tpu.data.snapshot import (
+                        refresh_due,
+                        write_snapshot,
+                    )
+
+                    if refresh_due(self.store):
+                        with self.recorder.span("snapshot-refresh", "compact"):
+                            write_snapshot(self.store)
+                except Exception as exc:  # cold readers keep the old snapshot
+                    log.warning(f"snapshot refresh failed (non-fatal): {exc!r}")
+
+            self._compact_thread = threading.Thread(
+                target=_work, name="snapshot-compactor", daemon=True
+            )
+            self._compact_thread.start()
+
     def _start_lookahead_train(self, tomorrow: date) -> None:
         """Train tomorrow's model NOW, on a background thread — tomorrow's
         training set is complete the moment today's generate stage persists
@@ -433,6 +466,10 @@ class LocalRunner:
         # overlap/prefetch spans that completed inside this day
         self.recorder.add(f"run-day-{today}", "day", day_start_rel,
                           wall_clock_s)
+        # consolidate history AFTER the clock stops: tomorrow's cold
+        # readers (and this process's own next train, via the caches) see
+        # today's days in one artefact without today paying the write
+        self._refresh_snapshot_async()
         return DayResult(
             day=today,
             wall_clock_s=wall_clock_s,
@@ -547,4 +584,29 @@ class LocalRunner:
                     f"simulated day {today}: "
                     f"{result.wall_clock_s:.2f}s wall-clock"
                 )
+        # Drain the background compactor and top up the final day's
+        # consolidation before returning (untimed — the day loop's clock
+        # already stopped): a process exiting right after run_simulation
+        # would otherwise kill the daemon thread mid-refresh, and a
+        # 1-day run would never produce a snapshot at all.
+        thread = self._compact_thread
+        if thread is not None:
+            thread.join(timeout=60.0)
+            if thread.is_alive():
+                # an unusually slow write is still in flight: starting a
+                # second full consolidation here would duplicate the
+                # whole O(history) write and race it on the same keys
+                log.warning(
+                    "background snapshot refresh still running after 60s; "
+                    "skipping the final top-up"
+                )
+                return results
+        try:
+            from bodywork_tpu.data.snapshot import refresh_due, write_snapshot
+
+            if refresh_due(self.store):
+                with self.recorder.span("snapshot-refresh", "compact"):
+                    write_snapshot(self.store)
+        except Exception as exc:  # cold readers keep the old snapshot
+            log.warning(f"final snapshot refresh failed (non-fatal): {exc!r}")
         return results

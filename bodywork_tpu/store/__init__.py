@@ -5,10 +5,12 @@ from bodywork_tpu.store.schema import (
     DATASETS_PREFIX,
     MODELS_PREFIX,
     MODEL_METRICS_PREFIX,
+    SNAPSHOTS_PREFIX,
     TEST_METRICS_PREFIX,
     dataset_key,
     model_key,
     model_metrics_key,
+    snapshot_key,
     test_metrics_key,
 )
 
@@ -20,10 +22,12 @@ __all__ = [
     "DATASETS_PREFIX",
     "MODELS_PREFIX",
     "MODEL_METRICS_PREFIX",
+    "SNAPSHOTS_PREFIX",
     "TEST_METRICS_PREFIX",
     "dataset_key",
     "model_key",
     "model_metrics_key",
+    "snapshot_key",
     "test_metrics_key",
 ]
 

@@ -10,10 +10,27 @@ embedded in the object key; "latest" = max embedded date.
 This module defines that contract *once* as an abstract byte store plus the
 date-key versioning helpers (``latest``/``history``). Backends: local/TPU-VM
 host filesystem (the BASELINE.json north-star transport) and GCS.
+
+Beyond the reference's four prefixes, a dedicated ``snapshots/`` prefix
+(``schema.SNAPSHOTS_PREFIX``) holds consolidated-history artefacts
+written by :mod:`bodywork_tpu.data.snapshot`: one date-keyed binary
+columnar file per compaction, carrying every dataset day up to its
+embedded date plus a manifest of covered keys, row counts, and
+``version_token``\\ s (staleness is detectable without re-reading the
+per-day CSVs). Snapshots are derived data — any backend may drop the
+prefix and readers fall back to the per-day artefacts.
+
+Backends that declare a ``backend_label`` class attribute get their
+primitive ops instrumented through the shared obs registry
+(``bodywork_tpu_store_ops_total{backend,op}`` + an op-latency
+histogram), so the data plane's round-trip count is a first-class
+observable next to the serving histograms.
 """
 from __future__ import annotations
 
 import abc
+import functools
+import time
 from datetime import date
 
 from bodywork_tpu.utils.dates import date_from_key
@@ -23,8 +40,74 @@ class ArtefactNotFound(KeyError):
     """No artefact exists at the requested key/prefix."""
 
 
+#: primitive + metadata ops wrapped with obs instrumentation when a
+#: backend declares ``backend_label`` (wrapper stores — epoch guards,
+#: counting fixtures — declare none and stay transparent, so delegated
+#: calls are counted exactly once, at the real backend)
+_INSTRUMENTED_OPS = (
+    "put_bytes",
+    "get_bytes",
+    "list_keys",
+    "delete",
+    "exists",
+    "version_token",
+    "version_tokens",
+    "get_many",
+)
+
+#: store-op latency ladder: local-filesystem stats (~µs) up through
+#: tunnel/GCS round-trips (~67-200 ms measured, PERF.md §1) and retries
+_STORE_OP_BUCKETS = (
+    0.0001, 0.0005, 0.001, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+)
+
+
+def _observe_store_op(backend: str, op: str, seconds: float) -> None:
+    from bodywork_tpu.obs import get_registry
+
+    reg = get_registry()
+    reg.counter(
+        "bodywork_tpu_store_ops_total",
+        "Artefact-store operations by backend and op",
+    ).inc(backend=backend, op=op)
+    reg.histogram(
+        "bodywork_tpu_store_op_seconds",
+        "Artefact-store operation latency by backend and op",
+        buckets=_STORE_OP_BUCKETS,
+    ).observe(seconds, backend=backend, op=op)
+
+
+def _timed_op(impl, backend: str, op: str):
+    @functools.wraps(impl)
+    def wrapper(self, *args, **kwargs):
+        t0 = time.perf_counter()
+        try:
+            return impl(self, *args, **kwargs)
+        finally:
+            _observe_store_op(backend, op, time.perf_counter() - t0)
+
+    wrapper.__wrapped_store_op__ = op
+    return wrapper
+
+
 class ArtefactStore(abc.ABC):
     """Flat byte store with ``/``-separated keys and date-key versioning."""
+
+    #: set by real backends (e.g. ``"filesystem"``, ``"gcs"``) to opt
+    #: their primitive ops into obs instrumentation; wrapper stores leave
+    #: it unset so a delegated call is counted once, at the backend
+    backend_label: str | None = None
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        label = cls.__dict__.get("backend_label")
+        if not label:
+            return
+        for op in _INSTRUMENTED_OPS:
+            impl = cls.__dict__.get(op)
+            if impl is not None and not hasattr(impl, "__wrapped_store_op__"):
+                setattr(cls, op, _timed_op(impl, label, op))
 
     @staticmethod
     def validate_key(key: str) -> str:
@@ -54,11 +137,35 @@ class ArtefactStore(abc.ABC):
     def delete(self, key: str) -> None: ...
 
     def exists(self, key: str) -> bool:
+        """True when ``key`` holds an artefact.
+
+        Consults ``version_token`` first: a non-None token proves
+        existence from metadata alone, so backends with tokens never
+        download a (possibly multi-MB) payload just to answer an
+        existence check. Only a None token — "no token support" OR
+        "missing key", indistinguishable here — falls back to the full
+        ``get_bytes`` probe. Backends with a native cheap check
+        (filesystem stat, GCS ``blob.exists``) override this anyway.
+        """
+        if self.version_token(key) is not None:
+            return True
         try:
             self.get_bytes(key)
             return True
         except ArtefactNotFound:
             return False
+
+    def get_many(self, keys: list[str]) -> dict[str, bytes]:
+        """Fetch many artefacts; returns ``{key: bytes}`` in input order.
+
+        Raises :class:`ArtefactNotFound` (naming the first missing key)
+        if any key is absent — callers batch keys they just listed, so a
+        miss is a torn read, not a soft condition. The default is
+        sequential; backends whose reads are independent round-trips
+        (GCS) override with a bounded thread pool so a cold reader's
+        tail fetch pays ~one round-trip, not O(keys).
+        """
+        return {key: self.get_bytes(key) for key in keys}
 
     def version_token(self, key: str):
         """Opaque token identifying the current content of ``key``, or None.

@@ -67,6 +67,11 @@ class EpochGuardedStore(ArtefactStore):
     def get_bytes(self, key: str) -> bytes:
         return self._inner.get_bytes(key)
 
+    def get_many(self, keys: list[str]) -> dict[str, bytes]:
+        # delegated (not inherited): the default would loop THIS class's
+        # get_bytes and lose the backend's parallel override
+        return self._inner.get_many(keys)
+
     def list_keys(self, prefix: str = "") -> list[str]:
         return self._inner.list_keys(prefix)
 

@@ -15,6 +15,8 @@ from bodywork_tpu.store.base import ArtefactNotFound, ArtefactStore
 
 
 class FilesystemStore(ArtefactStore):
+    backend_label = "filesystem"
+
     def __init__(self, root: str | os.PathLike):
         self.root = Path(root)
         self.root.mkdir(parents=True, exist_ok=True)

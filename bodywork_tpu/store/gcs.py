@@ -43,9 +43,14 @@ def _is_transient(exc: BaseException) -> bool:
 
 
 class GCSStore(ArtefactStore):
+    backend_label = "gcs"
+
     #: transient-retry policy: attempts include the first try
     RETRY_ATTEMPTS = 3
     RETRY_BASE_DELAY_S = 0.1
+    #: bounded fan-out for ``get_many`` — enough to overlap the ~67-200 ms
+    #: per-object round-trip (PERF.md §1) without stampeding the service
+    GET_MANY_MAX_THREADS = 8
 
     def __init__(self, bucket: str, prefix: str = ""):
         try:
@@ -106,6 +111,23 @@ class GCSStore(ArtefactStore):
             return blob.download_as_bytes()
 
         return self._with_retries(_get)
+
+    def get_many(self, keys: list[str]) -> dict[str, bytes]:
+        # Each object read is an independent round-trip, so a bounded
+        # thread pool overlaps them; every per-key fetch keeps the SAME
+        # retry policy as a single get_bytes (the thunk each worker runs
+        # IS get_bytes, wrapper and all). Results return in input order;
+        # the first missing key raises, like the sequential default.
+        if len(keys) <= 1:
+            return {key: self.get_bytes(key) for key in keys}
+        from concurrent.futures import ThreadPoolExecutor
+
+        workers = min(self.GET_MANY_MAX_THREADS, len(keys))
+        with ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="gcs-get-many"
+        ) as pool:
+            blobs = list(pool.map(self.get_bytes, keys))
+        return dict(zip(keys, blobs))
 
     def list_keys(self, prefix: str = "") -> list[str]:
         # a prefix is not a key (may legitimately be empty) — no validation

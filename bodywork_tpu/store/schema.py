@@ -7,6 +7,13 @@ Same layout as the reference bucket ``bodywork-mlops-project`` (SURVEY.md L2):
   reference uses ``.joblib`` — here models are JAX pytree checkpoints)
 - ``model-metrics/regressor-<date>.csv``            (``stage_1:130-138``)
 - ``test-metrics/regressor-test-results-<date>.csv``(``stage_4:122-130``)
+
+Beyond the reference's four prefixes, ``snapshots/`` holds consolidated
+history snapshots (``data/snapshot.py``): one binary columnar artefact
+per compaction covering every dataset day up to its embedded date, so a
+cold process loads all history in O(1 + tail) store reads instead of
+O(days). Snapshots are derived artefacts — deleting the prefix is always
+safe (readers fall back to the per-day CSVs).
 """
 from __future__ import annotations
 
@@ -16,12 +23,14 @@ DATASETS_PREFIX = "datasets/"
 MODELS_PREFIX = "models/"
 MODEL_METRICS_PREFIX = "model-metrics/"
 TEST_METRICS_PREFIX = "test-metrics/"
+SNAPSHOTS_PREFIX = "snapshots/"
 
 ALL_PREFIXES = (
     DATASETS_PREFIX,
     MODELS_PREFIX,
     MODEL_METRICS_PREFIX,
     TEST_METRICS_PREFIX,
+    SNAPSHOTS_PREFIX,
 )
 
 
@@ -39,3 +48,10 @@ def model_metrics_key(d: date) -> str:
 
 def test_metrics_key(d: date) -> str:
     return f"{TEST_METRICS_PREFIX}regressor-test-results-{d}.csv"
+
+
+def snapshot_key(d: date) -> str:
+    """Consolidated-history snapshot covering every dataset day <= ``d``
+    (the embedded date is the most recent covered day, so the standard
+    date-key protocol — ``history``/``latest`` — versions snapshots too)."""
+    return f"{SNAPSHOTS_PREFIX}history-snapshot-{d}.npz"

@@ -128,6 +128,112 @@ def install_fake_gcs(monkeypatch):
 
     return GCSStore
 
+def _make_memory_store_cls():
+    """Deferred class build: helpers must stay importable without the
+    package on sys.path yet (conftest inserts it)."""
+    from bodywork_tpu.store.base import ArtefactNotFound, ArtefactStore
+
+    class InMemoryStore(ArtefactStore):
+        """Dict-backed backend with generation-counter version tokens —
+        the fast substrate for data-plane tests (no tmp dirs, no stat
+        granularity concerns). Not part of the shipped backends."""
+
+        def __init__(self):
+            self._objects: dict[str, tuple[bytes, int]] = {}
+            self._generation = 0
+
+        def put_bytes(self, key, data):
+            self.validate_key(key)
+            self._generation += 1
+            self._objects[key] = (bytes(data), self._generation)
+
+        def get_bytes(self, key):
+            self.validate_key(key)
+            try:
+                return self._objects[key][0]
+            except KeyError:
+                raise ArtefactNotFound(key) from None
+
+        def list_keys(self, prefix=""):
+            return sorted(k for k in self._objects if k.startswith(prefix))
+
+        def delete(self, key):
+            self.validate_key(key)
+            if self._objects.pop(key, None) is None:
+                raise ArtefactNotFound(key)
+
+        def version_token(self, key):
+            entry = self._objects.get(key)
+            return None if entry is None else entry[1]
+
+    return InMemoryStore
+
+
+def make_memory_store():
+    return _make_memory_store_cls()()
+
+
+def _make_counting_store_cls():
+    from bodywork_tpu.store.base import ArtefactStore
+
+    class CountingStore(ArtefactStore):
+        """Wraps ANY backend and tallies store ops per op name and per
+        key, so data-plane tests assert EXACT store-op counts (a
+        round-trip regression fails loudly instead of showing up only in
+        bench). ``get_many`` is inherited from the base class, so each
+        constituent fetch is counted as one ``get_bytes`` — the honest
+        round-trip count on backends without a parallel override."""
+
+        def __init__(self, inner: ArtefactStore):
+            self.inner = inner
+            #: op name -> total calls
+            self.ops: dict = {}
+            #: (op, key) -> calls
+            self.by_key: dict = {}
+
+        def _count(self, op, key=None):
+            self.ops[op] = self.ops.get(op, 0) + 1
+            if key is not None:
+                self.by_key[(op, key)] = self.by_key.get((op, key), 0) + 1
+
+        def reset_counts(self):
+            self.ops.clear()
+            self.by_key.clear()
+
+        def put_bytes(self, key, data):
+            self._count("put_bytes", key)
+            self.inner.put_bytes(key, data)
+
+        def get_bytes(self, key):
+            self._count("get_bytes", key)
+            return self.inner.get_bytes(key)
+
+        def list_keys(self, prefix=""):
+            self._count("list_keys", prefix)
+            return self.inner.list_keys(prefix)
+
+        def delete(self, key):
+            self._count("delete", key)
+            self.inner.delete(key)
+
+        def version_token(self, key):
+            self._count("version_token", key)
+            return self.inner.version_token(key)
+
+        def version_tokens(self, keys):
+            self._count("version_tokens")
+            return self.inner.version_tokens(keys)
+
+        # exists() deliberately NOT delegated: the base (token-first)
+        # implementation runs so tests can prove it moves no payload
+
+    return CountingStore
+
+
+def make_counting_store(inner):
+    return _make_counting_store_cls()(inner)
+
+
 @contextlib.contextmanager
 def hermetic_env(**extra):
     """Temporarily force the relay-proof env in ``os.environ`` for code

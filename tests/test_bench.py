@@ -159,6 +159,50 @@ def test_bench_single_row_scoring_record_shape():
     assert "coalescer_stats" not in off
 
 
+def test_config_registry_sync():
+    """Satellite guard: the three config tables — the run list
+    (ALL_CONFIGS), the dispatch registry (CONFIG_BENCHES), and the child
+    timeout budgets (CONFIG_TIMEOUT_S) — must name exactly the same
+    configs. Config 7 was once wired by hand into each; a new config
+    missing any table would either never run, crash the orchestrator, or
+    silently inherit the generic 600 s timeout."""
+    assert set(bench.ALL_CONFIGS) == set(bench.CONFIG_BENCHES)
+    assert set(bench.ALL_CONFIGS) == set(bench.CONFIG_TIMEOUT_S)
+    assert bench.HEADLINE_CONFIG in bench.ALL_CONFIGS
+    assert all(t > 0 for t in bench.CONFIG_TIMEOUT_S.values())
+    assert all(callable(f) for f in bench.CONFIG_BENCHES.values())
+
+
+def test_bench_history_cold_start_record_shape(tmp_path):
+    """Config 8 (tiny sizes): snapshot off/on cold-load seconds, realized
+    GET counts (off = O(days), on <= 2 + tail = 1 here), the train-stage
+    pair, and the remote-transport projection — all in one CPU-safe,
+    self-describing record."""
+    record = bench.bench_history_cold_start(
+        days_series=(2, 4), rows_per_day=25
+    )
+    assert record["metric"] == "cold_history_load"
+    assert record["unit"] == "s"
+    assert record["vs_baseline"] is None and "baseline_note" in record
+    assert [p["days"] for p in record["points"]] == [2, 4]
+    for p in record["points"]:
+        off, on = p["snapshot_off"], p["snapshot_on"]
+        # realized GET counts: O(days) without the snapshot, exactly the
+        # one snapshot artefact with it (no tail days in this protocol)
+        assert off["cold_load_gets"] == p["days"]
+        assert on["cold_load_gets"] == 1
+        assert p["get_elimination"] == p["days"]
+        assert off["cold_load_s"] > 0 and on["cold_load_s"] > 0
+        assert off["train_stage_s"] > 0 and on["train_stage_s"] > 0
+        assert p["rows"] == p["days"] * 25
+        # the projection is pure arithmetic on the counts
+        assert off["projected_remote_load_s"] == pytest.approx(
+            off["cold_load_gets"] * bench.COLD_HISTORY_RTT_S, abs=1e-3
+        )
+    # headline = snapshot-ON cold load at the largest horizon
+    assert record["value"] == record["points"][-1]["snapshot_on"]["cold_load_s"]
+
+
 def test_percentile_nearest_rank():
     vals = [1.0, 2.0, 3.0, 4.0]
     assert bench._percentile(vals, 0) == 1.0
@@ -316,7 +360,7 @@ def test_compact_output_fits_driver_tail():
     out = bench.compact_output(records, "mixed", "bench_full.json")
     assert out["headline_fallback"].startswith("config 2 failed")
     assert out["configs"][1]["error"].startswith("boom")
-    assert len(out["configs"][1]["error"]) <= 160
+    assert len(out["configs"][1]["error"]) <= 120
     assert len(_json.dumps(out)) < 1800
 
     # the scaled-protocol and anomaly markers ride the compact line too
@@ -324,8 +368,8 @@ def test_compact_output_fits_driver_tail():
     records[5]["cpu_scaled_protocol"] = "scaled " * 60
     records[5]["timing_anomaly"] = "impossible " * 40
     out = bench.compact_output(records, "mixed", "bench_full.json")
-    assert len(out["configs"][5]["cpu_scaled_protocol"]) <= 160
-    assert len(out["configs"][5]["timing_anomaly"]) <= 160
+    assert len(out["configs"][5]["cpu_scaled_protocol"]) <= 120
+    assert len(out["configs"][5]["timing_anomaly"]) <= 120
     assert len(_json.dumps(out)) < 2000
 
 

@@ -176,3 +176,20 @@ def test_non_transient_errors_are_not_retried(gcs_store):
     with pytest.raises(ArtefactNotFound):
         gcs_store.get_bytes("models/nope.npz")
     assert bucket.failures == before
+
+
+def test_get_many_parallel_with_per_op_retry(gcs_store):
+    """get_many overlaps object reads on a bounded thread pool while each
+    per-key fetch keeps the single-get retry policy: injected transient
+    failures are absorbed per op, results come back in input order."""
+    keys = [f"datasets/regression-dataset-2026-01-0{i}.csv" for i in (1, 2, 3)]
+    for i, key in enumerate(keys):
+        gcs_store.put_bytes(key, bytes([i]) * 32)
+    # two transient 503s land somewhere in the fan-out; both are retried
+    gcs_store._bucket.inject_failures("download", 2)
+    out = gcs_store.get_many(keys)
+    assert list(out) == keys
+    assert all(out[k] == bytes([i]) * 32 for i, k in enumerate(keys))
+    # a missing key still surfaces ArtefactNotFound through the pool
+    with pytest.raises(ArtefactNotFound):
+        gcs_store.get_many([keys[0], "datasets/never.csv"])

@@ -195,7 +195,7 @@ def test_manifests_structure(tmp_path):
         kinds[doc["kind"]] += 1
     assert kinds == {
         "Namespace": 1, "ConfigMap": 1, "PersistentVolumeClaim": 1,
-        "Job": 3, "Deployment": 1, "Service": 1, "CronJob": 2,
+        "Job": 3, "Deployment": 1, "Service": 1, "CronJob": 3,
     }
     # the second CronJob is the drift GATE: audits each day loop 30 min
     # after it, exits 4 (failed Job = the k8s-native alarm) on
@@ -206,6 +206,15 @@ def test_manifests_structure(tmp_path):
     assert cmd[3:] == ["report", "--store", "/mnt/store",
                        "--fail-on-drift", "--window", "7"]
     assert gate["spec"]["schedule"] == "30 6 * * *"  # day loop + 30 min
+    # the third is history COMPACTION: consolidates the day's datasets
+    # into a snapshots/ artefact 15 min after each (cold, one-shot)
+    # daily-loop pod, so the NEXT day's pod loads history in O(1 + tail)
+    # store reads instead of O(days)
+    compact = docs["99-compact-history-cronjob.yaml"]
+    cmd = compact["spec"]["jobTemplate"]["spec"]["template"]["spec"][
+        "containers"][0]["command"]
+    assert cmd[3:] == ["compact", "--store", "/mnt/store"]
+    assert compact["spec"]["schedule"] == "15 6 * * *"  # day loop + 15 min
     # default store medium is a ReadWriteMany PVC (multi-node safe): every
     # pod mounts the claim, nothing references the node's own filesystem
     pvc = docs["00-store-pvc.yaml"]
@@ -518,6 +527,16 @@ def test_cron_pods_image_and_resources(tmp_path):
     # a CPU-only report job must not park on (and burn) a TPU node
     assert "nodeSelector" not in gate_pod
     assert "limits" not in gate_c["resources"]
+
+    # history compaction is host-side numpy/pandas: pipeline-wide image,
+    # own container name, plain CPU pod — same rationale as the gate
+    compact_pod = docs["99-compact-history-cronjob.yaml"]["spec"][
+        "jobTemplate"]["spec"]["template"]["spec"]
+    compact_c = compact_pod["containers"][0]
+    assert compact_c["image"] == image
+    assert compact_c["name"] == "compact-history"
+    assert "nodeSelector" not in compact_pod
+    assert "limits" not in compact_c["resources"]
     # ...while the per-stage Jobs keep their per-stage images
     job = docs["01-stage-1-train-model-job.yaml"]
     assert job["spec"]["template"]["spec"]["containers"][0][

@@ -1,0 +1,478 @@
+"""Cold-path data plane: consolidated history snapshots (data/snapshot.py).
+
+Two families of guarantees:
+
+- **Correctness**: ``load_all_datasets`` returns an identical ``Dataset``
+  whether the snapshot is present, stale (newer tail days), corrupt
+  (falls back + warns), or absent — pinned example-based here and as a
+  hypothesis property over history shapes.
+- **Store-op budgets**: the counting-store fixture asserts EXACT store-op
+  counts for the cold snapshot load (GETs drop from O(days) to
+  <= 2 + tail days), the stale-tail load, and the warm runner loop — so
+  a data-plane regression fails a test loudly instead of showing up only
+  in bench config 8.
+"""
+import numpy as np
+import pytest
+from datetime import date, timedelta
+
+from bodywork_tpu.data import snapshot as snapshot_mod
+from bodywork_tpu.data.io import Dataset, load_all_datasets, persist_dataset
+from bodywork_tpu.store import FilesystemStore, SNAPSHOTS_PREFIX, dataset_key
+from tests.helpers import make_counting_store, make_memory_store
+
+START = date(2026, 3, 1)
+
+
+def _seed_days(store, days, rows=20, seed=0, start=START):
+    rng = np.random.default_rng(seed)
+    for i in range(days):
+        d = start + timedelta(days=i)
+        X = rng.uniform(0, 100, rows).astype(np.float32)
+        y = (1.0 + 0.5 * X + rng.normal(0, 1, rows)).astype(np.float32)
+        persist_dataset(store, Dataset(X, y, d))
+
+
+def _assert_same_dataset(a: Dataset, b: Dataset):
+    np.testing.assert_array_equal(a.X, b.X)
+    np.testing.assert_array_equal(a.y, b.y)
+    assert a.date == b.date
+
+
+def _gets(counting, prefix=""):
+    return sum(
+        n for (op, key), n in counting.by_key.items()
+        if op == "get_bytes" and key.startswith(prefix)
+    )
+
+
+# -- store-op budgets (the counting-store fixture) ---------------------------
+
+
+def test_cold_load_without_snapshot_pays_o_days(tmp_path):
+    _seed_days(FilesystemStore(tmp_path), days=5)
+    cold = make_counting_store(FilesystemStore(tmp_path))
+    load_all_datasets(cold)
+    # the reference's O(days) pattern: one GET per day (nothing else)
+    assert cold.ops["get_bytes"] == 5
+    assert _gets(cold, "datasets/") == 5
+
+
+def test_cold_load_with_snapshot_get_budget(tmp_path):
+    _seed_days(FilesystemStore(tmp_path), days=8)
+    snapshot_mod.write_snapshot(FilesystemStore(tmp_path))
+
+    cold = make_counting_store(FilesystemStore(tmp_path))
+    ds = load_all_datasets(cold)
+    # acceptance: cold GETs drop from O(days) to <= 2 + tail (tail = 0):
+    # exactly ONE get — the snapshot artefact; no per-day CSV is read
+    assert cold.ops["get_bytes"] == 1
+    assert _gets(cold, SNAPSHOTS_PREFIX) == 1
+    assert _gets(cold, "datasets/") == 0
+    # and the metadata plane stays O(1): one datasets listing, one
+    # snapshots listing, one batched token call
+    assert cold.by_key[("list_keys", "datasets/")] == 1
+    assert cold.by_key[("list_keys", SNAPSHOTS_PREFIX)] == 1
+    assert cold.ops["version_tokens"] == 1
+    assert len(ds) == 8 * 20
+
+
+def test_stale_snapshot_loads_snapshot_plus_tail_only(tmp_path):
+    _seed_days(FilesystemStore(tmp_path), days=6)
+    snapshot_mod.write_snapshot(FilesystemStore(tmp_path))
+    # two tail days land AFTER the snapshot
+    _seed_days(FilesystemStore(tmp_path), days=2, seed=9,
+               start=START + timedelta(days=6))
+
+    cold = make_counting_store(FilesystemStore(tmp_path))
+    ds = load_all_datasets(cold)
+    # 1 snapshot GET + exactly the 2 tail-day GETs: 3 <= 2 + tail_days
+    assert cold.ops["get_bytes"] == 3
+    assert _gets(cold, SNAPSHOTS_PREFIX) == 1
+    tail_keys = {dataset_key(START + timedelta(days=6 + i)) for i in range(2)}
+    fetched = {key for (op, key) in cold.by_key
+               if op == "get_bytes" and key.startswith("datasets/")}
+    assert fetched == tail_keys
+    assert len(ds) == 8 * 20
+
+
+def test_warm_runner_loop_reloads_with_zero_gets(tmp_path):
+    _seed_days(FilesystemStore(tmp_path), days=4)
+    warm = make_counting_store(FilesystemStore(tmp_path))
+    first = load_all_datasets(warm)
+    warm.reset_counts()
+    second = load_all_datasets(warm)
+    # the persistent runner's daily reload: metadata only — one listing,
+    # one batched token call, ZERO payload reads (concat cache hit)
+    assert warm.ops.get("get_bytes", 0) == 0
+    assert warm.by_key[("list_keys", "datasets/")] == 1
+    assert warm.ops["version_tokens"] == 1
+    _assert_same_dataset(first, second)
+
+
+def test_warm_loop_never_redownloads_snapshot_for_pure_tail(tmp_path):
+    """A warm process whose only missing day postdates the latest
+    snapshot must not re-read the (ever-growing) snapshot payload: the
+    listing's embedded date already proves non-coverage. One GET — the
+    new day's CSV — and no phantom 'stale' outcome."""
+    from bodywork_tpu.obs import get_registry
+
+    _seed_days(FilesystemStore(tmp_path), days=3)
+    snapshot_mod.write_snapshot(FilesystemStore(tmp_path))
+    warm = make_counting_store(FilesystemStore(tmp_path))
+    load_all_datasets(warm)  # cold load: snapshot hit, caches warm
+
+    _seed_days(FilesystemStore(tmp_path), days=1, seed=4,
+               start=START + timedelta(days=3))
+    counter = get_registry().counter("bodywork_tpu_snapshot_loads_total")
+    stale_before = counter.value(outcome="stale")
+    warm.reset_counts()
+    load_all_datasets(warm)
+    assert _gets(warm, SNAPSHOTS_PREFIX) == 0  # payload never re-read
+    assert _gets(warm, "datasets/") == 1  # just the new day
+    assert counter.value(outcome="stale") == stale_before  # no phantom signal
+
+
+def test_fully_warm_reload_skips_reconcatenation(tmp_path, monkeypatch):
+    import bodywork_tpu.data.io as dio
+
+    _seed_days(FilesystemStore(tmp_path), days=3)
+    store = FilesystemStore(tmp_path)
+    first = load_all_datasets(store)
+    calls = []
+    monkeypatch.setattr(
+        dio, "load_history_parts",
+        lambda *a, **k: calls.append(1) or pytest.fail("parts re-loaded"),
+    )
+    second = dio.load_all_datasets(store)  # exact (key, token) list match
+    assert calls == []
+    _assert_same_dataset(first, second)
+    # arrays are the CACHED objects — O(1), no new concatenation
+    assert second.X is first.X and second.y is first.y
+
+
+def test_concat_cache_invalidates_on_any_token_change(tmp_path):
+    store = FilesystemStore(tmp_path)
+    _seed_days(store, days=2)
+    before = load_all_datasets(store)
+    # overwrite day 1 with different content
+    X = np.full(7, 5.0, np.float32)
+    persist_dataset(store, Dataset(X, 2 * X, START))
+    after = load_all_datasets(store)
+    assert len(after) == 7 + 20 and len(before) == 40
+
+
+# -- correctness across snapshot states --------------------------------------
+
+
+@pytest.fixture
+def seeded(tmp_path):
+    _seed_days(FilesystemStore(tmp_path), days=5)
+    reference = load_all_datasets(FilesystemStore(tmp_path))
+    return tmp_path, reference
+
+
+def test_identical_with_snapshot_present(seeded):
+    root, reference = seeded
+    snapshot_mod.write_snapshot(FilesystemStore(root))
+    _assert_same_dataset(load_all_datasets(FilesystemStore(root)), reference)
+
+
+def test_identical_with_snapshot_stale(seeded):
+    root, _ = seeded
+    snapshot_mod.write_snapshot(FilesystemStore(root))
+    _seed_days(FilesystemStore(root), days=2, seed=7,
+               start=START + timedelta(days=5))
+    via_snapshot = load_all_datasets(FilesystemStore(root))
+    # re-derive through the pure per-day path (snapshots removed)
+    plain = FilesystemStore(root)
+    for key, _ in plain.history(SNAPSHOTS_PREFIX):
+        plain.delete(key)
+    per_day = load_all_datasets(FilesystemStore(root))
+    _assert_same_dataset(via_snapshot, per_day)
+
+
+def test_identical_with_snapshot_corrupt_falls_back_and_warns(seeded, caplog):
+    root, reference = seeded
+    store = FilesystemStore(root)
+    key = snapshot_mod.write_snapshot(store)
+    store.put_bytes(key, b"\x00not-an-npz")
+    with caplog.at_level("WARNING"):
+        ds = load_all_datasets(FilesystemStore(root))
+    _assert_same_dataset(ds, reference)
+    assert any("unreadable" in r.message for r in caplog.records)
+
+
+def test_corrupt_latest_falls_back_to_older_kept_snapshot(tmp_path):
+    """SNAPSHOT_KEEP=2 exists for this: when the newest snapshot is
+    unreadable, the loader uses the older kept one (one extra GET, still
+    O(1 + tail) instead of O(days)) and flags repair_needed so the
+    in-process compactor rewrites — cold readers are degraded for one
+    load cycle, not until the next dataset day."""
+    store = FilesystemStore(tmp_path)
+    _seed_days(store, days=3)
+    snapshot_mod.write_snapshot(store)  # snapshot A covers days 1-3
+    _seed_days(store, days=1, seed=8, start=START + timedelta(days=3))
+    snapshot_mod.write_snapshot(store)  # snapshot B covers days 1-4
+    snaps = store.history(SNAPSHOTS_PREFIX)
+    assert len(snaps) == 2
+    store.put_bytes(snaps[-1][0], b"torn")  # corrupt the NEWEST
+
+    cold = make_counting_store(FilesystemStore(tmp_path))
+    ds = load_all_datasets(cold)
+    # corrupt B + valid A + day-4 tail: 3 GETs, never O(days)
+    assert _gets(cold, SNAPSHOTS_PREFIX) == 2
+    assert _gets(cold, "datasets/") == 1
+    assert len(ds) == 4 * 20
+    # the corruption marked the store for repair, and repair clears it
+    assert snapshot_mod.refresh_due(cold)
+    snapshot_mod.write_snapshot(cold)
+    assert not snapshot_mod.refresh_due(cold)
+
+
+def test_compactor_reads_never_touch_loader_outcome_counters(tmp_path):
+    """write_snapshot and plan_compaction consult the previous snapshot
+    too, but those are maintenance reads: a healthy daily compaction
+    finding yesterday's snapshot 'stale' must not increment the
+    hit/stale/miss counters OBSERVABILITY.md tells operators to alert
+    on."""
+    from bodywork_tpu.obs import get_registry
+
+    counter = get_registry().counter("bodywork_tpu_snapshot_loads_total")
+
+    def totals():
+        return {o: counter.value(outcome=o)
+                for o in ("hit", "stale", "miss", "corrupt")}
+
+    store = FilesystemStore(tmp_path)
+    _seed_days(store, days=2)
+    before = totals()
+    snapshot_mod.write_snapshot(store)  # cold maintenance read (miss)
+    _seed_days(store, days=1, seed=6, start=START + timedelta(days=2))
+    snapshot_mod.plan_compaction(FilesystemStore(tmp_path))  # stale-ish read
+    snapshot_mod.write_snapshot(FilesystemStore(tmp_path))
+    assert totals() == before
+
+
+def test_identical_with_covered_day_overwritten(seeded):
+    root, _ = seeded
+    snapshot_mod.write_snapshot(FilesystemStore(root))
+    # a covered day changes AFTER the snapshot: its token no longer
+    # matches, so that one day (and only it) is re-fetched per-day
+    X = np.full(9, 3.0, np.float32)
+    persist_dataset(FilesystemStore(root), Dataset(X, 4 * X, START))
+    counting = make_counting_store(FilesystemStore(root))
+    ds = load_all_datasets(counting)
+    assert _gets(counting, "datasets/") == 1  # just the overwritten day
+    plain = FilesystemStore(root)
+    for key, _ in plain.history(SNAPSHOTS_PREFIX):
+        plain.delete(key)
+    _assert_same_dataset(ds, load_all_datasets(FilesystemStore(root)))
+
+
+def test_property_identical_across_all_snapshot_states():
+    """Hypothesis property (acceptance): for any history shape and any
+    snapshot state — covering a prefix of the days, corrupt, or absent —
+    ``load_all_datasets`` equals the pure per-day load."""
+    pytest.importorskip("hypothesis")
+    from hypothesis import given, settings, strategies as st
+
+    @settings(max_examples=25, deadline=None)
+    @given(
+        days=st.integers(min_value=1, max_value=5),
+        covered=st.integers(min_value=0, max_value=5),
+        rows=st.integers(min_value=1, max_value=8),
+        corrupt=st.booleans(),
+    )
+    def check(days, covered, rows, corrupt):
+        covered = min(covered, days)
+        store = make_memory_store()
+        rng = np.random.default_rng(days * 100 + covered * 10 + rows)
+        for i in range(days):
+            X = rng.uniform(0, 50, rows).astype(np.float32)
+            persist_dataset(
+                store, Dataset(X, 3 * X, START + timedelta(days=i))
+            )
+        # ground truth BEFORE any snapshot exists, via a cache-free reader
+        reference = load_all_datasets(make_counting_store(store))
+        if covered:
+            # snapshot covering only the first `covered` days: write it
+            # from a store view where the tail days don't exist yet
+            tail = {}
+            for i in range(covered, days):
+                key = dataset_key(START + timedelta(days=i))
+                tail[key] = store.get_bytes(key)
+                store.delete(key)
+            snapshot_mod.write_snapshot(make_counting_store(store))
+            for key, data in tail.items():
+                store.put_bytes(key, data)
+        if corrupt:
+            for key in store.list_keys(SNAPSHOTS_PREFIX):
+                store.put_bytes(key, b"junk")
+        ds = load_all_datasets(make_counting_store(store))
+        _assert_same_dataset(ds, reference)
+
+    check()
+
+
+# -- snapshot lifecycle ------------------------------------------------------
+
+
+def test_write_snapshot_prunes_beyond_keep(tmp_path):
+    store = FilesystemStore(tmp_path)
+    for i in range(4):
+        _seed_days(store, days=1, seed=i, start=START + timedelta(days=i))
+        snapshot_mod.write_snapshot(store)
+    snaps = store.history(SNAPSHOTS_PREFIX)
+    assert len(snaps) == snapshot_mod.SNAPSHOT_KEEP
+    # the newest snapshot covers the newest day
+    assert snaps[-1][1] == START + timedelta(days=3)
+
+
+def test_write_snapshot_empty_store_is_noop(tmp_path):
+    assert snapshot_mod.write_snapshot(FilesystemStore(tmp_path)) is None
+
+
+def test_refresh_due(tmp_path):
+    store = FilesystemStore(tmp_path)
+    assert not snapshot_mod.refresh_due(store)  # nothing to consolidate
+    _seed_days(store, days=2)
+    assert snapshot_mod.refresh_due(store)  # no snapshot yet
+    snapshot_mod.write_snapshot(store)
+    assert not snapshot_mod.refresh_due(store)  # covers the latest day
+    _seed_days(store, days=1, seed=5, start=START + timedelta(days=2))
+    assert snapshot_mod.refresh_due(store)  # a newer day landed
+
+
+def test_refresh_due_sees_overwritten_covered_day(tmp_path):
+    """An overwrite changes a covered day's token but not the date, so
+    the date comparison alone misses it; the history loader flags the
+    mismatch on the store and refresh_due picks it up — the persistent
+    runner's compactor then repairs the snapshot instead of every cold
+    reader paying that day's GET forever."""
+    store = FilesystemStore(tmp_path)
+    _seed_days(store, days=3)
+    snapshot_mod.write_snapshot(store)
+    X = np.full(6, 2.0, np.float32)
+    persist_dataset(store, Dataset(X, 5 * X, START))  # same date, new token
+    assert not snapshot_mod.refresh_due(store)  # date check can't see it
+    load_all_datasets(store)  # the loader hits the mismatch and flags it
+    assert snapshot_mod.refresh_due(store)
+    snapshot_mod.write_snapshot(store)  # repair clears the flag
+    assert not snapshot_mod.refresh_due(store)
+
+
+def test_plan_compaction_applies_token_filter():
+    """plan_compaction must not promise days write_snapshot will skip:
+    on a token-less backend the plan reports zero consolidatable days
+    and would_write None (cmd_compact turns that into exit 1, so a
+    CronJob cannot claim success while writing nothing)."""
+    base = make_memory_store()
+
+    class NoTokens(type(base)):
+        def version_token(self, key):
+            return None
+
+    store = NoTokens()
+    rng = np.random.default_rng(0)
+    X = rng.uniform(0, 10, 5).astype(np.float32)
+    persist_dataset(store, Dataset(X, 2 * X, START))
+    plan = snapshot_mod.plan_compaction(store)
+    assert plan["days"] == 1
+    assert plan["days_without_tokens"] == 1
+    assert plan["would_write"] is None and plan["rows"] == 0
+    # the writer agrees — and bails BEFORE fetching anything, so a
+    # token-less backend under the daily compactor never re-downloads
+    # O(days) history just to write nothing
+    counting = make_counting_store(store)
+    assert snapshot_mod.write_snapshot(counting) is None
+    assert counting.ops.get("get_bytes", 0) == 0
+
+
+def test_one_day_simulation_still_produces_a_snapshot(store):
+    """run_simulation drains/tops-up the compactor before returning: a
+    1-day run (whose background thread would otherwise be killed at
+    process exit) must still leave a snapshot covering the latest day."""
+    from bodywork_tpu.pipeline import LocalRunner, default_pipeline
+
+    runner = LocalRunner(default_pipeline(), store)
+    runner.run_simulation(date(2026, 1, 1), days=1)
+    snaps = store.history(SNAPSHOTS_PREFIX)
+    assert snaps and snaps[-1][1] == store.latest("datasets/")[1]
+
+
+def test_snapshot_load_outcome_counters(tmp_path):
+    from bodywork_tpu.obs import get_registry
+
+    counter = get_registry().counter("bodywork_tpu_snapshot_loads_total")
+
+    def delta(outcome, before):
+        return counter.value(outcome=outcome) - before.get(outcome, 0)
+
+    before = {o: counter.value(outcome=o)
+              for o in ("hit", "stale", "miss", "corrupt")}
+    _seed_days(FilesystemStore(tmp_path), days=2)
+    load_all_datasets(FilesystemStore(tmp_path))
+    assert delta("miss", before) == 1
+    key = snapshot_mod.write_snapshot(FilesystemStore(tmp_path))
+    load_all_datasets(FilesystemStore(tmp_path))
+    assert delta("hit", before) == 1
+    _seed_days(FilesystemStore(tmp_path), days=1, seed=3,
+               start=START + timedelta(days=2))
+    load_all_datasets(FilesystemStore(tmp_path))
+    assert delta("stale", before) == 1
+    FilesystemStore(tmp_path).put_bytes(key, b"junk")
+    # drop the newer pruned-in sibling so the junk one is latest
+    plain = FilesystemStore(tmp_path)
+    for k, _ in plain.history(SNAPSHOTS_PREFIX):
+        if k != key:
+            plain.delete(k)
+    load_all_datasets(FilesystemStore(tmp_path))
+    assert delta("corrupt", before) == 1
+
+
+# -- runner + CLI integration ------------------------------------------------
+
+
+def test_runner_refreshes_snapshot_in_background(store):
+    from bodywork_tpu.pipeline import LocalRunner, default_pipeline
+
+    runner = LocalRunner(default_pipeline(), store)
+    d = date(2026, 1, 1)
+    runner.bootstrap(d)
+    runner.run_day(d)
+    thread = runner._compact_thread
+    assert thread is not None
+    thread.join(timeout=30)
+    snaps = store.history(SNAPSHOTS_PREFIX)
+    assert snaps, "background compactor wrote no snapshot"
+    # it consolidated through day 2 (the generate stage's offset day) or
+    # at least the day that ran; either way the latest dataset day
+    assert snaps[-1][1] == store.latest("datasets/")[1]
+    # and the refresh left a span on the runner's timeline
+    assert any(s.name == "snapshot-refresh" for s in runner.recorder.spans())
+
+
+def test_cli_compact_dry_run_and_write(tmp_path, capsys):
+    from bodywork_tpu.cli import main
+
+    root = str(tmp_path / "artefacts")
+    _seed_days(FilesystemStore(root), days=3, rows=10)
+
+    assert main(["compact", "--store", root, "--dry-run"]) == 0
+    out = capsys.readouterr().out
+    assert "3 day(s)" in out and "30 rows" in out
+    assert "dry-run: would write" in out
+    # dry-run wrote NOTHING
+    assert FilesystemStore(root).list_keys(SNAPSHOTS_PREFIX) == []
+
+    assert main(["compact", "--store", root]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    key = out[-1]
+    assert key.startswith(SNAPSHOTS_PREFIX)
+    assert FilesystemStore(root).exists(key)
+
+    # an empty store is a clean no-op (the CronJob contract)
+    empty = str(tmp_path / "empty")
+    assert main(["compact", "--store", empty, "--dry-run"]) == 0
+    assert "no datasets" in capsys.readouterr().out

@@ -70,7 +70,9 @@ def test_history_loader_caches_parsed_days(store):
 
     _seed_days(store, days=3)
     dio.load_all_datasets(store)  # warm the parse cache
-    with patch.object(dio, "load_dataset", wraps=dio.load_dataset) as spy:
+    with patch.object(
+        dio, "_parse_dataset_csv", wraps=dio._parse_dataset_csv
+    ) as spy:
         ds = dio.load_all_datasets(store)
         assert spy.call_count == 0  # all 3 days served from cache
         d4 = date(2026, 1, 4)  # one new day appears
