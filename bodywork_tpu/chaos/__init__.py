@@ -1,0 +1,38 @@
+"""Deterministic fault-injection harness (docs/RESILIENCE.md).
+
+Failure as a first-class, testable input: a seeded
+:class:`~bodywork_tpu.chaos.plan.FaultPlan` drives a transparent
+:class:`~bodywork_tpu.chaos.store.FaultInjectingStore` wrapper and a
+flaky scoring-service mode, and
+:func:`~bodywork_tpu.chaos.sim.run_chaos_sim` proves the resilience
+layer (``utils/retry.py`` + ``store/resilient.py`` + degraded-mode
+serving) by requiring a faulted multi-day simulation to produce final
+artefacts byte-identical to a fault-free twin. CLI:
+``python -m bodywork_tpu.cli chaos run-sim --seed N --days D --store DIR``.
+"""
+from bodywork_tpu.chaos.plan import (
+    FaultPlan,
+    InjectedFault,
+    activate,
+    get_active_plan,
+)
+from bodywork_tpu.chaos.store import FaultInjectingStore
+from bodywork_tpu.chaos.http import FlakyScoringMiddleware, flaky_serve_stage
+from bodywork_tpu.chaos.sim import (
+    chaos_pipeline_spec,
+    compare_stores,
+    run_chaos_sim,
+)
+
+__all__ = [
+    "FaultPlan",
+    "InjectedFault",
+    "activate",
+    "get_active_plan",
+    "FaultInjectingStore",
+    "FlakyScoringMiddleware",
+    "flaky_serve_stage",
+    "chaos_pipeline_spec",
+    "compare_stores",
+    "run_chaos_sim",
+]

@@ -1,0 +1,80 @@
+"""Flaky scoring-service mode: probabilistic 503/429/latency on /score/v1*.
+
+:class:`FlakyScoringMiddleware` is a WSGI middleware that consults the
+fault plan once per scoring request and either injects latency, answers
+with a deterministic 503/429 (plus a ``Retry-After`` header — the hint
+the tester's scoring client must honour), or passes through untouched.
+Health, metrics, and every non-scoring route always pass through: the
+harness breaks the data path, not the probes that operators (and the
+runner's health gate) rely on to see the breakage.
+
+:func:`flaky_serve_stage` is the chaos simulation's drop-in replacement
+for the canonical serve stage: it starts the real service, then wraps
+the handle's in-process app object — the object the test stage's
+``InProcessScoringClient`` scores through — in the middleware. The
+socket-facing server keeps serving the unwrapped app, so the runner's
+HTTP health gate sees the true service.
+"""
+from __future__ import annotations
+
+import json
+
+from bodywork_tpu.chaos.plan import FaultPlan, get_active_plan
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("chaos.http")
+
+_STATUS_LINES = {
+    503: "503 SERVICE UNAVAILABLE",
+    429: "429 TOO MANY REQUESTS",
+}
+
+
+class FlakyScoringMiddleware:
+    def __init__(self, app, plan: FaultPlan):
+        self._app = app
+        self.plan = plan
+
+    def __call__(self, environ, start_response):
+        path = environ.get("PATH_INFO", "")
+        if path.startswith("/score/v1"):
+            self.plan.http_latency(path)
+            status = self.plan.http_error(path)
+            if status is not None:
+                body = json.dumps(
+                    {"error": f"injected fault: HTTP {status}"}
+                ).encode()
+                start_response(
+                    _STATUS_LINES[status],
+                    [
+                        ("Content-Type", "application/json"),
+                        ("Content-Length", str(len(body))),
+                        ("Retry-After", str(self.plan.http_retry_after_s)),
+                    ],
+                )
+                return [body]
+        return self._app(environ, start_response)
+
+    def test_client(self):
+        """Same shape as ``ScoringApp.test_client`` — what the test
+        stage's ``InProcessScoringClient`` constructs its client from."""
+        from werkzeug.test import Client
+
+        return Client(self)
+
+
+def flaky_serve_stage(ctx, **args):
+    """The canonical serve stage with the active fault plan's flaky mode
+    layered over the in-process scoring path (used by
+    ``chaos.sim.chaos_pipeline_spec``)."""
+    from bodywork_tpu.pipeline.stages import serve_stage
+
+    handle = serve_stage(ctx, **args)
+    plan = get_active_plan()
+    if plan is not None:
+        handle.app = FlakyScoringMiddleware(handle.app, plan)
+        log.info(
+            f"flaky scoring mode armed (p_error={plan.http_error_p}, "
+            f"p_latency={plan.http_latency_p})"
+        )
+    return handle

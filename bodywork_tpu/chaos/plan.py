@@ -1,0 +1,312 @@
+"""Seeded, deterministic fault plans — the chaos subsystem's contract.
+
+A :class:`FaultPlan` holds per-op fault probabilities (transient store
+errors, injected latency, crash-after-partial-write, payload corruption,
+flaky HTTP 503/429 responses) plus the seed that makes every decision
+reproducible. Decisions are NOT drawn from one shared RNG stream —
+concurrent threads (the runner's prefetch/compactor workers) would make
+the draw order, and therefore the whole run, timing-dependent. Instead
+each decision is a pure function of ``(seed, kind, stream, n)`` where
+``stream`` identifies the op/key and ``n`` counts that stream's
+decisions: the fault sequence seen by any sequential op stream is
+byte-reproducible under the same seed regardless of what other threads
+are doing. (``random.Random`` seeds strings via SHA-512, so the mapping
+is stable across processes and Python's hash randomisation.)
+
+``max_consecutive`` caps how many times in a row one OP STREAM may fail
+(a forced-clean execution follows). The cap is enforced across every
+failing fault kind together — a put stream's transient and torn-write
+faults share one streak, and a ``get_many`` batch is a single failure
+unit with its own stream — because independent per-kind caps would
+compose: two capped transient faults followed by a first torn-write
+fault is three consecutive failures, one more than either cap admits.
+With the default cap of 2 — below the retry policy's 3 attempts —
+every retried op is GUARANTEED to succeed within its budget, which is
+what lets a seeded soak assert bit-exact final artefacts rather than
+"usually survives". Set it to 0 (unlimited) to drive breaker-opening
+scenarios.
+
+Fault injections are counted as
+``bodywork_tpu_chaos_faults_injected_total{kind}`` and appended to
+``plan.injected_log`` (the determinism tests' pinned sequence).
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import random
+import threading
+import time
+
+from bodywork_tpu.utils.retry import TransientError
+
+__all__ = [
+    "FaultPlan",
+    "InjectedFault",
+    "activate",
+    "get_active_plan",
+]
+
+
+class InjectedFault(TransientError):
+    """A chaos-injected transient failure. Subclasses
+    :class:`~bodywork_tpu.utils.retry.TransientError`, so the resilience
+    layer classifies it exactly like a real 503/connection drop."""
+
+
+_PROBABILITY_FIELDS = (
+    "store_transient_p",
+    "store_latency_p",
+    "torn_write_p",
+    "corrupt_read_p",
+    "http_error_p",
+    "http_latency_p",
+)
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """Per-op fault probabilities under one seed. All ``*_p`` fields are
+    probabilities in [0, 1]; latencies are seconds (kept small so soaks
+    stay fast)."""
+
+    seed: int = 0
+    #: store ops (put/get/list/delete/get_many): raise InjectedFault
+    store_transient_p: float = 0.0
+    #: store ops: sleep store_latency_s before the op
+    store_latency_p: float = 0.0
+    store_latency_s: float = 0.002
+    #: put_bytes: persist a PREFIX of the payload, then raise (the
+    #: crash-after-partial-write the retry must repair by rewriting)
+    torn_write_p: float = 0.0
+    #: get_bytes: return truncated payload — only for keys under
+    #: corrupt_prefixes, because corrupting a read whose consumer has no
+    #: integrity check silently changes results instead of testing
+    #: recovery. The snapshot loader validates and falls back, so
+    #: ``snapshots/`` is the default (and currently only safe) target.
+    corrupt_read_p: float = 0.0
+    corrupt_prefixes: tuple[str, ...] = ("snapshots/",)
+    #: scoring service /score/v1* requests: answer 503 or 429 (split
+    #: evenly, deterministically) with a Retry-After header
+    http_error_p: float = 0.0
+    http_retry_after_s: float = 0.0
+    #: scoring service: sleep http_latency_s before handling
+    http_latency_p: float = 0.0
+    http_latency_s: float = 0.002
+    #: max consecutive faults per (kind, stream) before a forced success;
+    #: 0 = unlimited (lets tests hold a backend down to open the breaker)
+    max_consecutive: int = 2
+
+    def __post_init__(self):
+        for field in _PROBABILITY_FIELDS:
+            p = getattr(self, field)
+            if not isinstance(p, (int, float)) or not 0.0 <= p <= 1.0:
+                raise ValueError(
+                    f"fault plan {field} must be a probability in [0, 1], "
+                    f"got {p!r}"
+                )
+        if self.max_consecutive < 0:
+            raise ValueError("max_consecutive must be >= 0 (0 = unlimited)")
+        self.corrupt_prefixes = tuple(self.corrupt_prefixes)
+        self._lock = threading.Lock()
+        #: decision count per (kind, stream)
+        self._draws: dict[tuple, int] = {}
+        #: consecutive-fault count per (kind, stream)
+        self._consecutive: dict[tuple, int] = {}
+        #: every injected fault, in decision order: (kind, stream, n)
+        self.injected_log: list[tuple[str, str, int]] = []
+
+    def reset(self) -> None:
+        """Clear all decision history (draw counters, streaks, the
+        injected-fault log). A reused plan object must start each run
+        from stream position 0 or the 'same seed => same adversity'
+        contract silently breaks; :func:`activate` resets on entry so
+        every activated run is a fresh replay."""
+        with self._lock:
+            self._draws.clear()
+            self._consecutive.clear()
+            self.injected_log.clear()
+
+    # -- (de)serialisation (CLI --plan files / env knobs) ------------------
+
+    def to_dict(self) -> dict:
+        return {
+            f.name: (
+                list(v) if isinstance(v := getattr(self, f.name), tuple) else v
+            )
+            for f in dataclasses.fields(self)
+        }
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "FaultPlan":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(doc) - known
+        if unknown:
+            raise ValueError(
+                f"unknown fault-plan field(s) {sorted(unknown)}; "
+                f"known: {sorted(known)}"
+            )
+        return cls(**doc)
+
+    @classmethod
+    def from_file(cls, path: str) -> "FaultPlan":
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            raise ValueError(f"fault plan {path} must be a JSON object")
+        return cls.from_dict(doc)
+
+    @classmethod
+    def default(cls, seed: int = 0) -> "FaultPlan":
+        """The stock soak plan: every fault kind armed, capped so the
+        retry budget always wins (docs/RESILIENCE.md §6)."""
+        return cls(
+            seed=seed,
+            store_transient_p=0.15,
+            store_latency_p=0.10,
+            torn_write_p=0.15,
+            corrupt_read_p=0.5,
+            http_error_p=0.4,
+            http_latency_p=0.2,
+        )
+
+    # -- the decision core -------------------------------------------------
+
+    def _decide(self, kind: str, stream: str, p: float) -> bool:
+        return self._decide_n(kind, stream, p)[0]
+
+    def _decide_n(
+        self, kind: str, stream: str, p: float, capped: bool = True
+    ) -> tuple[bool, int]:
+        """One seeded decision on the (kind, stream) draw stream. With
+        ``capped`` the per-(kind, stream) consecutive cap applies here;
+        store failure kinds pass ``capped=False`` because their cap is
+        enforced jointly per OP stream by :meth:`store_fault` (caps on
+        independent kinds would compose past the retry budget)."""
+        if p <= 0.0:
+            return False, 0
+        with self._lock:
+            key = (kind, stream)
+            n = self._draws.get(key, 0)
+            self._draws[key] = n + 1
+            if (
+                capped
+                and self.max_consecutive
+                and self._consecutive.get(key, 0) >= self.max_consecutive
+            ):
+                self._consecutive[key] = 0
+                return False, n  # forced success: the cap bounding adversity
+            hit = random.Random(f"{self.seed}|{kind}|{stream}|{n}").random() < p
+            self._consecutive[key] = self._consecutive.get(key, 0) + 1 if hit else 0
+            if hit:
+                self.injected_log.append((kind, stream, n))
+                if kind != "http_error":  # counted as http_503/http_429
+                    _count_fault(kind)
+            return hit, n
+
+    def store_fault(self, op: str, key: str) -> str | None:
+        """The ONE failure decision per store-op execution: ``None``
+        (clean), ``"transient"``, or (``put_bytes`` only)
+        ``"torn_write"``. All failing kinds share a single per-op-stream
+        consecutive streak, so ``max_consecutive`` bounds TOTAL
+        consecutive failures of the op — the property that keeps every
+        retried op inside its attempt budget."""
+        stream = f"store|{op}|{key}"
+        gate = ("fail", stream)
+        with self._lock:
+            if (
+                self.max_consecutive
+                and self._consecutive.get(gate, 0) >= self.max_consecutive
+            ):
+                self._consecutive[gate] = 0
+                return None  # forced-clean execution (no draws consumed)
+        hit_transient, _ = self._decide_n(
+            "transient", stream, self.store_transient_p, capped=False
+        )
+        hit_torn = False
+        if not hit_transient and op == "put_bytes":
+            hit_torn, _ = self._decide_n(
+                "torn_write", stream, self.torn_write_p, capped=False
+            )
+        with self._lock:
+            if hit_transient or hit_torn:
+                self._consecutive[gate] = self._consecutive.get(gate, 0) + 1
+            else:
+                self._consecutive[gate] = 0
+        if hit_transient:
+            return "transient"
+        return "torn_write" if hit_torn else None
+
+    # -- store-op hooks (FaultInjectingStore) ------------------------------
+
+    def store_latency(self, op: str, key: str) -> None:
+        if self._decide("latency", f"store|{op}|{key}", self.store_latency_p):
+            time.sleep(self.store_latency_s)
+
+    def corrupt_read(self, key: str, data: bytes) -> bytes:
+        if not key.startswith(tuple(self.corrupt_prefixes)):
+            return data
+        if self._decide("corrupt", f"store|get_bytes|{key}", self.corrupt_read_p):
+            return data[: max(1, len(data) // 2)]
+        return data
+
+    # -- HTTP hooks (FlakyScoringMiddleware) -------------------------------
+
+    def http_latency(self, path: str) -> None:
+        if self._decide("http_latency", f"http|{path}", self.http_latency_p):
+            time.sleep(self.http_latency_s)
+
+    def http_error(self, path: str) -> int | None:
+        """503, 429, or None — one decision per scoring request."""
+        stream = f"http|{path}"
+        hit, n = self._decide_n("http_error", stream, self.http_error_p)
+        if not hit:
+            return None
+        status = (
+            503
+            if random.Random(f"{self.seed}|http_status|{stream}|{n}").random()
+            < 0.5
+            else 429
+        )
+        _count_fault(f"http_{status}")
+        return status
+
+
+def _count_fault(kind: str) -> None:
+    from bodywork_tpu.obs import get_registry
+
+    get_registry().counter(
+        "bodywork_tpu_chaos_faults_injected_total",
+        "Chaos-injected faults by kind",
+    ).inc(kind=kind)
+
+
+#: the process-wide active plan (``activate``); read by the flaky serve
+#: stage so a chaos simulation's in-process service picks up the plan
+#: without threading it through the pipeline spec
+_ACTIVE: FaultPlan | None = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+@contextlib.contextmanager
+def activate(plan: FaultPlan):
+    """Install ``plan`` as the process-wide active plan for the duration
+    of a chaos run (``chaos.sim.run_chaos_sim`` wraps the faulted
+    simulation in this). Entry RESETS the plan's decision history, so a
+    reused plan object replays the same seeded adversity every run."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        if _ACTIVE is not None:
+            raise RuntimeError("a fault plan is already active")
+        plan.reset()
+        _ACTIVE = plan
+    try:
+        yield plan
+    finally:
+        with _ACTIVE_LOCK:
+            _ACTIVE = None
+
+
+def get_active_plan() -> FaultPlan | None:
+    return _ACTIVE

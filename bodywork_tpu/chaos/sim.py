@@ -1,0 +1,233 @@
+"""Chaos soak: a seeded faulted simulation proven against a clean twin.
+
+``run_chaos_sim`` runs the SAME N-day pipeline simulation twice under
+one roof:
+
+1. **baseline** — the canonical pipeline on a plain filesystem store;
+2. **faulted** — the same pipeline with the full resilience stack over
+   a fault-injecting store (``real <- FaultInjectingStore <-
+   ResilientStore``) and the scoring service in flaky mode
+   (``chaos.http.flaky_serve_stage``), all driven by one seeded
+   :class:`~bodywork_tpu.chaos.plan.FaultPlan`.
+
+then compares the two stores' FINAL artefacts:
+
+- ``datasets/``, ``models/``, ``model-metrics/`` must be
+  **byte-identical** — training, generation, and checkpointing are
+  deterministic, so any divergence means a fault leaked into results;
+- ``test-metrics/`` must be identical after dropping the
+  ``mean_response_time`` column (the one wall-clock-dependent field; in
+  particular ``n_failures`` must match the baseline's zeros — the
+  scoring client's status retries must have absorbed every injected
+  503/429);
+- the latest ``snapshots/`` artefact must be loadable (not torn) and
+  cover the same day keys and row counts (snapshot bytes embed backend
+  version tokens, which legitimately differ between stores);
+- no torn artefacts: no leftover atomic-write temp files, snapshot
+  validation passes.
+
+Passing proves the resilience layer end to end: every injected
+transient error, latency spike, crash-after-partial-write, corrupt
+snapshot read, and flaky scoring response was absorbed without touching
+the results. The fault plan's ``max_consecutive`` cap (kept below the
+retry policy's attempt budget) is what makes this a guarantee instead
+of a probability — see docs/RESILIENCE.md.
+"""
+from __future__ import annotations
+
+from datetime import date
+from pathlib import Path
+
+from bodywork_tpu.store.base import ArtefactStore
+from bodywork_tpu.store.filesystem import FilesystemStore
+from bodywork_tpu.store.resilient import ResilientStore
+from bodywork_tpu.store.schema import SNAPSHOTS_PREFIX, TEST_METRICS_PREFIX
+from bodywork_tpu.chaos.plan import FaultPlan, activate
+from bodywork_tpu.chaos.store import FaultInjectingStore
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("chaos.sim")
+
+__all__ = ["chaos_pipeline_spec", "compare_stores", "run_chaos_sim"]
+
+#: counters whose per-run delta the summary reports
+_FAULT_COUNTER = "bodywork_tpu_chaos_faults_injected_total"
+_RETRY_COUNTERS = (
+    "bodywork_tpu_store_retries_total",
+    "bodywork_tpu_scoring_client_retries_total",
+)
+
+
+def chaos_pipeline_spec(model_type: str = "linear",
+                        scoring_mode: str = "batch"):
+    """The canonical daily pipeline with the serve stage swapped for the
+    flaky-mode wrapper (identical spec otherwise, so the faulted run's
+    work plan matches the baseline's exactly)."""
+    from bodywork_tpu.pipeline import default_pipeline
+
+    spec = default_pipeline(model_type, scoring_mode)
+    spec.stages["stage-2-serve-model"].executable = (
+        "bodywork_tpu.chaos.http:flaky_serve_stage"
+    )
+    return spec
+
+
+def _strip_csv_column(data: bytes, column: str) -> bytes:
+    """Remove one column from CSV bytes, textually (no float reparsing —
+    every surviving byte still has to match exactly)."""
+    lines = data.decode("utf-8").splitlines()
+    if not lines:
+        return data
+    header = lines[0].split(",")
+    if column not in header:
+        return data
+    idx = header.index(column)
+    out = []
+    for line in lines:
+        fields = line.split(",")
+        del fields[idx]
+        out.append(",".join(fields))
+    return ("\n".join(out) + "\n").encode("utf-8")
+
+
+def _snapshot_coverage(store: ArtefactStore):
+    """``[(day key, rows), ...]`` of the latest loadable snapshot, or
+    None when no snapshot loads (absent or torn)."""
+    from bodywork_tpu.data.snapshot import load_latest_snapshot
+
+    snap = load_latest_snapshot(store, record_outcome=False)
+    if snap is None:
+        return None
+    return sorted((e["key"], e["rows"]) for e in snap.entries)
+
+
+def compare_stores(baseline: ArtefactStore, chaos: ArtefactStore) -> dict:
+    """Final-artefact comparison (module docstring has the rules)."""
+    base_keys = [
+        k for k in baseline.list_keys() if not k.startswith(SNAPSHOTS_PREFIX)
+    ]
+    chaos_keys = [
+        k for k in chaos.list_keys() if not k.startswith(SNAPSHOTS_PREFIX)
+    ]
+    missing = sorted(set(base_keys) - set(chaos_keys))
+    extra = sorted(set(chaos_keys) - set(base_keys))
+    mismatched: list[str] = []
+    matched = 0
+    for key in sorted(set(base_keys) & set(chaos_keys)):
+        a = baseline.get_bytes(key)
+        b = chaos.get_bytes(key)
+        if key.startswith(TEST_METRICS_PREFIX):
+            a = _strip_csv_column(a, "mean_response_time")
+            b = _strip_csv_column(b, "mean_response_time")
+        if a == b:
+            matched += 1
+        else:
+            mismatched.append(key)
+    torn: list[str] = []
+    for store in (baseline, chaos):
+        root = getattr(store, "root", None)
+        if root is not None:
+            torn.extend(
+                str(p.relative_to(root))
+                for p in Path(root).rglob(".tmp-*")
+            )
+    base_cov = _snapshot_coverage(baseline)
+    chaos_cov = _snapshot_coverage(chaos)
+    snapshot_ok = base_cov == chaos_cov and (
+        base_cov is not None or not baseline.list_keys(SNAPSHOTS_PREFIX)
+    )
+    if chaos_cov is None and chaos.list_keys(SNAPSHOTS_PREFIX):
+        torn.append(f"{SNAPSHOTS_PREFIX} (latest snapshot unreadable)")
+    return {
+        "matched": matched,
+        "missing": missing,
+        "extra": extra,
+        "mismatched": mismatched,
+        "torn": torn,
+        "snapshot_ok": snapshot_ok,
+        "ok": not (missing or extra or mismatched or torn) and snapshot_ok,
+    }
+
+
+def _counter_values(name: str) -> dict[tuple, float]:
+    from bodywork_tpu.obs import get_registry
+
+    metric = get_registry().get(name)
+    if metric is None:
+        return {}
+    return {
+        tuple(sorted(s["labels"].items())): s["value"]
+        for s in metric.snapshot_samples()
+    }
+
+
+def _counter_delta(name: str, before: dict) -> dict[str, float]:
+    out = {}
+    for labels, value in _counter_values(name).items():
+        delta = value - before.get(labels, 0.0)
+        if delta:
+            out[",".join(f"{k}={v}" for k, v in labels)] = delta
+    return out
+
+
+def run_chaos_sim(
+    root: str | Path,
+    start: date,
+    days: int,
+    plan: FaultPlan,
+    model_type: str = "linear",
+    scoring_mode: str = "batch",
+    drift=None,
+) -> dict:
+    """Run the baseline and faulted simulations under ``root`` (in
+    ``baseline/`` and ``chaos/`` subdirectories, which must not already
+    hold artefacts) and return the comparison + fault/retry summary."""
+    from bodywork_tpu.pipeline import LocalRunner, default_pipeline
+
+    root = Path(root)
+    baseline_dir, chaos_dir = root / "baseline", root / "chaos"
+    for d in (baseline_dir, chaos_dir):
+        if d.exists() and any(d.iterdir()):
+            raise ValueError(
+                f"chaos sim target {d} already holds artefacts; point "
+                "--store at a fresh directory (the comparison needs two "
+                "clean stores)"
+            )
+    before = {
+        name: _counter_values(name)
+        for name in (_FAULT_COUNTER, *_RETRY_COUNTERS)
+    }
+
+    log.info(f"chaos sim: baseline run ({days} day(s)) -> {baseline_dir}")
+    baseline_store = FilesystemStore(baseline_dir)
+    LocalRunner(
+        default_pipeline(model_type, scoring_mode), baseline_store,
+        drift=drift,
+    ).run_simulation(start, days)
+
+    log.info(
+        f"chaos sim: faulted run (seed={plan.seed}) -> {chaos_dir}"
+    )
+    real_store = FilesystemStore(chaos_dir)
+    wrapped = ResilientStore(FaultInjectingStore(real_store, plan))
+    with activate(plan):
+        LocalRunner(
+            chaos_pipeline_spec(model_type, scoring_mode), wrapped,
+            drift=drift,
+        ).run_simulation(start, days)
+
+    comparison = compare_stores(baseline_store, real_store)
+    summary = {
+        "days": days,
+        "seed": plan.seed,
+        "plan": plan.to_dict(),
+        "comparison": comparison,
+        "faults_injected": _counter_delta(_FAULT_COUNTER, before[_FAULT_COUNTER]),
+        "retries": {
+            name: _counter_delta(name, before[name])
+            for name in _RETRY_COUNTERS
+        },
+        "breaker_state": wrapped.breaker.state,
+        "ok": comparison["ok"],
+    }
+    return summary

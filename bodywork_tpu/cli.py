@@ -15,6 +15,7 @@ its own driver:
     python -m bodywork_tpu.cli report    --store DIR
     python -m bodywork_tpu.cli compact   --store DIR [--dry-run]
     python -m bodywork_tpu.cli deploy    --out DIR [--store-path P] [--image I]
+    python -m bodywork_tpu.cli chaos run-sim --store DIR --days N [--seed S] [--plan F]
 
 Every command exits 0 on success and 1 with a logged error otherwise — the
 exit-code contract the reference implements per-script
@@ -495,6 +496,79 @@ def cmd_compact(args) -> int:
     return 0
 
 
+def cmd_chaos_run_sim(args) -> int:
+    """Seeded chaos soak (docs/RESILIENCE.md): run the N-day simulation
+    fault-free AND under the fault plan, then require the faulted run's
+    final artefacts to match the clean run's byte-for-byte (zero torn
+    artefacts). Exit 0 on a verified-identical pass, 1 otherwise.
+
+    Reproducibility: the seed (flag, or env ``BODYWORK_TPU_CHAOS_SEED``)
+    and plan (flag, or env ``BODYWORK_TPU_CHAOS_PLAN`` naming a JSON
+    file) fully determine each op stream's fault sequence — re-running
+    with the same seed replays the same adversity."""
+    from bodywork_tpu.chaos import FaultPlan, run_chaos_sim
+
+    if args.store.startswith("gs://"):
+        log.error(
+            "chaos run-sim needs two fresh local stores for the "
+            "byte-level comparison; point --store at a directory, "
+            "not gs://"
+        )
+        return 1
+    # seed precedence: explicit --seed flag > plan file's seed > env
+    # knob > 0. The env knob must NOT override a plan file's own seed —
+    # the plan documents the run it reproduces, and a stale exported
+    # BODYWORK_TPU_CHAOS_SEED silently replaying different adversity
+    # would break the reproduce-by-seed contract.
+    env_seed = _env_number("BODYWORK_TPU_CHAOS_SEED", int, 0)
+    if args.plan:
+        plan = FaultPlan.from_file(args.plan)
+        if args.seed is not None:
+            plan.seed = args.seed
+    else:
+        seed = args.seed if args.seed is not None else env_seed
+        plan = FaultPlan.default(seed if seed is not None else 0)
+    drift = None
+    if args.samples_per_day is not None:
+        from bodywork_tpu.data.drift_config import DriftConfig
+
+        drift = DriftConfig(n_samples=args.samples_per_day)
+    summary = run_chaos_sim(
+        args.store, _date(args), args.days, plan,
+        model_type=args.model, scoring_mode=args.mode, drift=drift,
+    )
+    faults = summary["faults_injected"]
+    print(
+        "faults injected: "
+        + (
+            # keys arrive as "kind=<name>" label strings; print name=count
+            " ".join(
+                f"{k.removeprefix('kind=')}={int(v)}"
+                for k, v in sorted(faults.items())
+            )
+            or "none"
+        )
+    )
+    for name, deltas in summary["retries"].items():
+        total = int(sum(deltas.values()))
+        print(f"{name.removeprefix('bodywork_tpu_')}: {total}")
+    print(f"breaker state: {summary['breaker_state']}")
+    comparison = summary["comparison"]
+    if summary["ok"]:
+        print(
+            f"PASS: {comparison['matched']} final artefact(s) "
+            f"byte-identical to the fault-free run "
+            f"(seed={plan.seed}, {args.days} day(s), 0 torn)"
+        )
+        return 0
+    log.error(
+        f"chaos soak FAILED: mismatched={comparison['mismatched']} "
+        f"missing={comparison['missing']} extra={comparison['extra']} "
+        f"torn={comparison['torn']} snapshot_ok={comparison['snapshot_ok']}"
+    )
+    return 1
+
+
 def cmd_deploy(args) -> int:
     from bodywork_tpu.pipeline import write_manifests
 
@@ -769,6 +843,40 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--keep", type=_positive_int, default=None, metavar="N",
                    help="snapshots to retain after writing (default: "
                         "data.snapshot.SNAPSHOT_KEEP)")
+
+    p = sub.add_parser(
+        "chaos",
+        help="deterministic fault-injection harness (docs/RESILIENCE.md)",
+    )
+    chaos_sub = p.add_subparsers(dest="chaos_command", required=True)
+    p = chaos_sub.add_parser(
+        "run-sim",
+        help="seeded chaos soak: faulted N-day sim vs fault-free twin, "
+             "final artefacts must be byte-identical",
+    )
+    p.set_defaults(fn=cmd_chaos_run_sim)
+    p.add_argument("--store", required=True,
+                   help="fresh local directory for the two runs' stores "
+                        "(baseline/ and chaos/ subdirs; gs:// refused — "
+                        "the byte-level comparison needs local twins)")
+    p.add_argument("--days", type=_positive_int, required=True)
+    p.add_argument("--date", default=None, help="start date (YYYY-MM-DD)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="fault-plan seed; same seed => same per-op-stream "
+                        "fault sequence. Precedence: this flag > a --plan "
+                        "file's own seed > env BODYWORK_TPU_CHAOS_SEED > 0")
+    p.add_argument("--plan", default=os.environ.get("BODYWORK_TPU_CHAOS_PLAN"),
+                   metavar="FILE",
+                   help="JSON fault plan (FaultPlan fields; unknown keys "
+                        "rejected). Default: the stock all-kinds plan "
+                        "(env BODYWORK_TPU_CHAOS_PLAN overrides). Only an "
+                        "explicit --seed overrides the file's seed")
+    p.add_argument("--samples-per-day", type=_positive_int, default=None,
+                   metavar="N",
+                   help="shrink the generator to N rows/day for quick "
+                        "soaks (default: the full reference-parity 1440)")
+    p.add_argument("--model", default="linear", choices=["linear", "mlp"])
+    p.add_argument("--mode", default="batch", choices=["single", "batch"])
 
     p = add("deploy", cmd_deploy, help="write GKE TPU manifests")
     p.add_argument("--spec", default=None, help="pipeline spec YAML (overrides --model/--mode)")

@@ -299,7 +299,12 @@ def plan_compaction(store: ArtefactStore) -> dict:
         return plan
     from bodywork_tpu.data.io import load_history_parts
 
-    parts = load_history_parts(store, hist, tokens, record_outcome=False)
+    # fetch only the consolidatable days: token-less days are skipped by
+    # the writer, so downloading their payloads here would be pure waste
+    # (the filter-before-fetch rule write_snapshot itself follows)
+    parts = load_history_parts(
+        store, consolidatable, tokens, record_outcome=False
+    )
     rows = sum(len(parts[k]) for k, _ in consolidatable)
     n_features = next(iter(parts.values())).X.shape[1]
     plan.update(

@@ -14,6 +14,14 @@ Reference parity, with its known bugs fixed idiomatically (SURVEY.md §2):
 - The connection-error handler actually logs the exception (the reference
   references an unbound name and would NameError — ``stage_4:84``).
 
+Both scoring clients retry 5xx/429 RESPONSE statuses — not just
+connection-level failures, which is all the reference's
+``HTTPAdapter(max_retries=3)`` ever covered — through the shared retry
+policy (:mod:`bodywork_tpu.utils.retry`: full-jitter backoff, deadline
+budget), honouring a numeric ``Retry-After`` header as a floor under the
+backoff sleep. Retries are reported as
+``bodywork_tpu_scoring_client_retries_total{reason=status|connection}``.
+
 Metric definitions preserved exactly (``stage_4:101-113``): MAPE = mean APE,
 ``r_squared`` = Pearson correlation of score vs label (the reference's —
 arguably mislabeled — definition), ``max_residual`` = max APE, plus
@@ -41,6 +49,77 @@ log = get_logger("monitor.tester")
 
 _APE_EPS = 2.220446049250313e-16
 
+#: response statuses worth retrying: rate limiting and transient server
+#: failures (a 4xx other than 429 is a deterministic client error)
+RETRYABLE_STATUSES = frozenset({429, 500, 502, 503, 504})
+
+
+class _RetryableStatus(Exception):
+    """Internal: a retryable HTTP response status, raised inside the
+    retry thunk so ``call_with_retry``'s transient machinery (and its
+    ``retry_after_s`` floor) applies to response statuses exactly as it
+    does to connection errors. Named ``TransientError``-compatible via
+    the taxonomy below rather than subclassing requests' classes."""
+
+    def __init__(self, status_code: int, retry_after_s: float | None):
+        super().__init__(f"retryable scoring response: HTTP {status_code}")
+        self.status_code = status_code
+        self.retry_after_s = retry_after_s
+
+
+def _retry_after_seconds(headers) -> float | None:
+    """A numeric ``Retry-After`` header value, if present (HTTP-date
+    forms are ignored — the backoff still applies without the floor)."""
+    raw = headers.get("Retry-After")
+    if raw is None:
+        return None
+    try:
+        return max(float(raw), 0.0)
+    except ValueError:
+        return None
+
+
+def _record_client_retry(exc, attempt, sleep_s) -> None:
+    from bodywork_tpu.obs import get_registry
+
+    get_registry().counter(
+        "bodywork_tpu_scoring_client_retries_total",
+        "Scoring-client request retries by reason",
+    ).inc(reason="status" if isinstance(exc, _RetryableStatus) else "connection")
+
+
+def _is_retryable_scoring_failure(exc: BaseException) -> bool:
+    from bodywork_tpu.utils.retry import is_transient
+
+    return isinstance(exc, _RetryableStatus) or is_transient(exc)
+
+
+def _post_with_retries(post, policy):
+    """The ONE retry path both scoring clients share: run ``post()`` (a
+    thunk returning an HTTP-shaped response with ``status_code`` and
+    ``headers``) under ``policy``, retrying retryable response statuses
+    (converted to :class:`_RetryableStatus` so the ``Retry-After`` floor
+    applies) and transient transport errors, reporting each retry to the
+    registry. Returns the final non-retryable response; raises
+    :class:`_RetryableStatus` when the budget is spent on statuses (a
+    transport error past the budget propagates as itself)."""
+    from bodywork_tpu.utils.retry import call_with_retry
+
+    def attempt():
+        response = post()
+        if response.status_code in RETRYABLE_STATUSES:
+            raise _RetryableStatus(
+                response.status_code, _retry_after_seconds(response.headers)
+            )
+        return response
+
+    return call_with_retry(
+        attempt,
+        policy,
+        is_retryable=_is_retryable_scoring_failure,
+        on_retry=_record_client_retry,
+    )
+
 
 def scoring_endpoint(base_url: str, mode: str = "single") -> str:
     """Normalise a scoring-service URL to the endpoint for ``mode``.
@@ -58,49 +137,101 @@ def scoring_endpoint(base_url: str, mode: str = "single") -> str:
 
 
 class HttpScoringClient:
-    """Scores over real HTTP with per-request retries
-    (reference ``stage_4:68-85``: ``HTTPAdapter(max_retries=3)``)."""
+    """Scores over real HTTP with per-request retries covering BOTH
+    connection-level failures and retryable response statuses
+    (the reference's ``HTTPAdapter(max_retries=3)`` — ``stage_4:68-85`` —
+    only ever saw the former; a 503 *response* sailed straight through).
+    Retries follow the shared policy: full-jitter backoff floored by a
+    numeric ``Retry-After``, bounded by attempts and a deadline budget."""
 
-    def __init__(self, url: str, max_retries: int = 3, timeout_s: float = 10.0):
+    def __init__(
+        self,
+        url: str,
+        max_retries: int = 3,
+        timeout_s: float = 10.0,
+        backoff_s: float = 0.05,
+    ):
         import requests
+
+        from bodywork_tpu.utils.retry import RetryPolicy
 
         self.url = url
         self.timeout_s = timeout_s
+        self._policy = RetryPolicy(
+            attempts=1 + max_retries,
+            base_delay_s=backoff_s,
+            max_delay_s=1.0,
+            deadline_s=30.0,
+        )
         self._session = requests.Session()
-        self._session.mount(url, requests.adapters.HTTPAdapter(max_retries=max_retries))
+        # adapter retries OFF: the shared policy owns ALL retrying, so
+        # connection and status retries share one budget instead of
+        # multiplying (adapter x client loop)
+        self._session.mount(url, requests.adapters.HTTPAdapter(max_retries=0))
 
     def score(self, payload: dict) -> tuple[bool, list[float], float]:
-        """POST a payload; returns (ok, predictions, seconds)."""
+        """POST a payload; returns (ok, predictions, seconds). The
+        elapsed time covers retries — a retried request really did take
+        that long to answer."""
         import requests
 
         start = perf_counter()
         try:
-            response = self._session.post(self.url, json=payload, timeout=self.timeout_s)
-            elapsed = perf_counter() - start
-            if response.ok:
-                body = response.json()
-                preds = (
-                    body["predictions"] if "predictions" in body else [body["prediction"]]
-                )
-                return True, [float(p) for p in preds], elapsed
-            log.error(f"scoring request failed: HTTP {response.status_code}")
-            return False, [], elapsed
+            response = _post_with_retries(
+                lambda: self._session.post(
+                    self.url, json=payload, timeout=self.timeout_s
+                ),
+                self._policy,
+            )
+        except _RetryableStatus as exc:
+            log.error(
+                f"scoring request failed after retries: "
+                f"HTTP {exc.status_code}"
+            )
+            return False, [], perf_counter() - start
         except (requests.ConnectionError, requests.Timeout) as exc:
             log.error(f"scoring request failed: {exc!r}")
             return False, [], perf_counter() - start
+        elapsed = perf_counter() - start
+        if response.ok:
+            body = response.json()
+            preds = (
+                body["predictions"] if "predictions" in body else [body["prediction"]]
+            )
+            return True, [float(p) for p in preds], elapsed
+        log.error(f"scoring request failed: HTTP {response.status_code}")
+        return False, [], elapsed
 
 
 class InProcessScoringClient:
     """Scores through a Flask test client — lets integration tests and the
-    local runner exercise the exact HTTP contract without sockets."""
+    local runner exercise the exact HTTP contract without sockets. Same
+    status-retry semantics as :class:`HttpScoringClient` (a tighter
+    backoff: there is no network to be polite to), so the in-process
+    daily loop survives a flaky or momentarily model-less service too."""
 
     def __init__(self, app, path: str = "/score/v1"):
+        from bodywork_tpu.utils.retry import RetryPolicy
+
         self._client = app.test_client()
         self.path = path
+        self._policy = RetryPolicy(
+            attempts=4, base_delay_s=0.005, max_delay_s=0.05, deadline_s=5.0
+        )
 
     def score(self, payload: dict) -> tuple[bool, list[float], float]:
         start = perf_counter()
-        response = self._client.post(self.path, json=payload)
+        try:
+            response = _post_with_retries(
+                lambda: self._client.post(self.path, json=payload),
+                self._policy,
+            )
+        except _RetryableStatus as exc:
+            log.error(
+                f"scoring request failed after retries: "
+                f"HTTP {exc.status_code}"
+            )
+            return False, [], perf_counter() - start
         elapsed = perf_counter() - start
         if response.status_code == 200:
             body = response.get_json()
@@ -115,6 +246,7 @@ class InProcessScoringClient:
         clone = InProcessScoringClient.__new__(InProcessScoringClient)
         clone._client = self._client
         clone.path = "/score/v1/batch"
+        clone._policy = self._policy
         return clone
 
 

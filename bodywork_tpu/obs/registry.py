@@ -48,7 +48,10 @@ METRIC_NAME_RE = re.compile(r"^bodywork_tpu_[a-z0-9_]+$")
 
 #: recognised unit suffixes (Prometheus naming conventions, plus the
 #: domain units this framework measures). ``_total`` is reserved for
-#: counters; ``_loss`` is the (unitless) training-loss channel.
+#: counters; ``_loss`` is the (unitless) training-loss channel;
+#: ``_state`` is a small-integer state-machine gauge (breaker
+#: closed/half-open/open, serve healthy/degraded/no-model — the value
+#: encoding lives with each metric in docs/RESILIENCE.md).
 UNIT_SUFFIXES = (
     "_total",
     "_seconds",
@@ -59,6 +62,7 @@ UNIT_SUFFIXES = (
     "_count",
     "_info",
     "_loss",
+    "_state",
 )
 
 #: default histogram buckets, tuned for this service's latency regime:

@@ -110,11 +110,17 @@ class LocalRunner:
 
         from bodywork_tpu.store.epoch import EpochGuardedStore
 
+        from bodywork_tpu.utils.retry import classify_error
+
         fn = resolve_executable(stage.executable)
         last_exc: BaseException | None = None
+        last_kind = "unknown"
         for attempt in range(1 + stage.retries):
             if attempt:
-                log.warning(f"retrying {stage.name} (attempt {attempt + 1})")
+                log.warning(
+                    f"retrying {stage.name} (attempt {attempt + 1}; "
+                    f"last failure classified {last_kind})"
+                )
             # A daemon thread (not an executor) so a stage hung past its
             # deadline is truly abandoned — like a k8s Job past
             # activeDeadlineSeconds — and cannot block interpreter exit via
@@ -155,7 +161,23 @@ class LocalRunner:
                 break
             if "exc" in box:
                 last_exc = box["exc"]  # type: ignore[assignment]
-                log.error(f"{stage.name} failed: {last_exc!r}")
+                # fail fast on permanent errors (utils.retry taxonomy):
+                # a ValueError/TypeError/KeyError — or a StageError not
+                # caused by anything transient — can never succeed on
+                # retry, so burning the remaining attempts against the
+                # completion deadline only delays the day's failure
+                last_kind = classify_error(last_exc)
+                log.error(
+                    f"{stage.name} failed ({last_kind}): {last_exc!r}"
+                )
+                if last_kind == "permanent":
+                    log.error(
+                        f"{stage.name}: permanent error — aborting "
+                        f"without the remaining "
+                        f"{stage.retries - attempt} retr"
+                        f"{'y' if stage.retries - attempt == 1 else 'ies'}"
+                    )
+                    break
             else:
                 return box.get("result")
         raise StageFailure(stage.name, repr(last_exc))

@@ -20,7 +20,17 @@ TPU-native additions beyond parity:
 - ``POST /score/v1/batch`` — score many rows in one request through the
   shape-bucketed predictor (BASELINE.json config 4: 1k-row predict requests).
 - ``GET /healthz`` — readiness probe for the orchestrator (the reference
-  relies on k8s TCP probes only).
+  relies on k8s TCP probes only). Carries the degraded-mode channel: a
+  service serving its last-good model after a failed hot reload answers
+  200 with ``degraded: true`` + reason (it IS serving — readiness must
+  keep routing traffic; the flag and the
+  ``bodywork_tpu_serve_degraded_state`` gauge are the operator signal),
+  while a service with no model loaded yet answers 503 + ``Retry-After``.
+- degraded-mode serving: an app may boot with NO model (``model=None`` —
+  e.g. ``serve --reload-interval`` against a store whose first
+  checkpoint has not landed). Scoring answers 503 + ``Retry-After``
+  instead of the process dying, and the first successful
+  :meth:`ScoringApp.swap_model` brings it live.
 - opt-in cross-request micro-batching (``serve.batcher``): concurrent
   single-row ``/score/v1`` requests coalesce into shared padded device
   calls, so per-worker throughput under load scales with bucket size
@@ -58,6 +68,11 @@ _FAST_PHASE_BUCKETS = (
 #: histogram (the "requests scored" accounting the bench cross-checks)
 _SCORING_ROUTES = ("/score/v1", "/score/v1/batch")
 
+#: Retry-After hint (seconds) on 503s from a not-yet-loaded service —
+#: long enough for a checkpoint-watcher poll to land a model, short
+#: enough that a retrying client converges quickly
+RETRY_AFTER_S = 5
+
 
 def _json_response(payload: dict, status: int = 200) -> Response:
     return Response(
@@ -87,21 +102,33 @@ class ScoringApp:
 
     def __init__(
         self,
-        model: Regressor,
+        model: Regressor | None,
         model_date: date | None = None,
         buckets: tuple[int, ...] | None = None,
         predictor=None,
         batcher=None,
         metrics_dir: str | None = None,
     ):
-        # a custom predictor (e.g. parallel.DataParallelPredictor over a
-        # device mesh) replaces the single-device bucketed default
-        predictor = predictor or (
-            PaddedPredictor(model, buckets) if buckets else PaddedPredictor(model)
-        )
-        self._served = _Served(
-            predictor, model.info, str(model_date) if model_date else None
-        )
+        if model is None:
+            # degraded boot: no checkpoint exists yet. Scoring answers
+            # 503 + Retry-After until the first swap_model (the
+            # checkpoint watcher's job) — the server never dies for
+            # having started before its first artefact.
+            assert predictor is None, "a predictor needs a model"
+            self._served = None
+        else:
+            # a custom predictor (e.g. parallel.DataParallelPredictor
+            # over a device mesh) replaces the single-device bucketed
+            # default
+            predictor = predictor or (
+                PaddedPredictor(model, buckets) if buckets else PaddedPredictor(model)
+            )
+            self._served = _Served(
+                predictor, model.info, str(model_date) if model_date else None
+            )
+        #: reason the service is degraded (serving last-good after a
+        #: failed reload), or None when healthy; surfaced in /healthz
+        self._degraded_reason: str | None = None
         # opt-in request coalescer (serve.batcher.RequestCoalescer);
         # None = every request dispatches its own padded device call
         self.batcher = batcher
@@ -142,6 +169,13 @@ class ScoringApp:
             "bodywork_tpu_coalescer_fallback_total",
             "Requests degraded to a direct dispatch (coalescer saturated)",
         )
+        self._g_degraded = reg.gauge(
+            "bodywork_tpu_serve_degraded_state",
+            "Serving degradation: 0=healthy, 1=serving last-good model "
+            "after a failed reload, 2=no model loaded",
+            aggregate="max",
+        )
+        self._g_degraded.set(2.0 if self._served is None else 0.0)
         self._routes = {
             ("POST", "/score/v1"): self.score_data_instance,
             ("POST", "/score/v1/batch"): self.score_batch,
@@ -152,15 +186,31 @@ class ScoringApp:
     # -- served-model access (single read point for atomic swaps) ----------
     @property
     def predictor(self):
-        return self._served.predictor
+        served = self._served
+        return None if served is None else served.predictor
 
     @property
-    def model_info(self) -> str:
-        return self._served.model_info
+    def model_info(self) -> str | None:
+        served = self._served
+        return None if served is None else served.model_info
 
     @property
     def model_date(self) -> str | None:
-        return self._served.model_date
+        served = self._served
+        return None if served is None else served.model_date
+
+    # -- degraded-mode channel (serve.reload drives it) --------------------
+    def set_degraded(self, reason: str) -> None:
+        """Flag the service as serving its last-good model (a hot reload
+        failed). The service keeps answering — the flag rides /healthz
+        and the state gauge so operators see the stall."""
+        self._degraded_reason = reason
+        if self._served is not None:
+            self._g_degraded.set(1.0)
+
+    def clear_degraded(self) -> None:
+        self._degraded_reason = None
+        self._g_degraded.set(0.0 if self._served is not None else 2.0)
 
     def swap_model(
         self,
@@ -170,10 +220,16 @@ class ScoringApp:
     ) -> None:
         """Atomically replace the served model (hot reload). The caller is
         responsible for warming the new predictor OFF the request path
-        first (``serve.reload.CheckpointWatcher`` does)."""
-        predictor = predictor or PaddedPredictor(
-            model, self._served.predictor.buckets
-        )
+        first (``serve.reload.CheckpointWatcher`` does). A successful
+        swap clears the degraded flag — and brings a model-less app
+        (degraded boot) live."""
+        if predictor is None:
+            old = self._served
+            predictor = (
+                PaddedPredictor(model, old.predictor.buckets)
+                if old is not None
+                else PaddedPredictor(model)
+            )
         self._served = _Served(
             predictor, model.info, str(model_date) if model_date else None
         )
@@ -194,6 +250,7 @@ class ScoringApp:
                     "fully drained; old-model rows may still be in flight"
                 )
         self._m_swaps.inc()
+        self.clear_degraded()
         log.info(f"hot-swapped served model -> {model.info} ({model_date})")
 
     def close(self) -> None:
@@ -261,14 +318,27 @@ class ScoringApp:
             return None, _json_response({"error": "'X' must be finite"}, 400)
         return X, None
 
+    def _no_model_response(self) -> Response:
+        response = _json_response(
+            {"error": "no model loaded yet; retry shortly"}, 503
+        )
+        response.headers["Retry-After"] = str(RETRY_AFTER_S)
+        return response
+
     # -- routes ------------------------------------------------------------
     def score_data_instance(self, request: Request) -> Response:
         """Single-instance scoring; reference-parity contract
         (``stage_2:73-80``)."""
         X, err = self._features_from(request)
         if err is not None:
+            # validation precedes the no-model check: a malformed request
+            # can never succeed, so it must get its non-retryable 400
+            # even from a model-less server (a 503 would make clients
+            # burn their whole Retry-After budget on it)
             return err
         served = self._served  # one read: stable across a hot swap
+        if served is None:
+            return self._no_model_response()
         X = np.array(X, ndmin=2)  # scalar -> (1, 1), as the reference
         prediction0 = None
         if self.batcher is not None and X.shape[0] == 1:
@@ -301,8 +371,10 @@ class ScoringApp:
         """Batched scoring: one padded device call for up to bucket-size rows."""
         X, err = self._features_from(request)
         if err is not None:
-            return err
+            return err  # 400 before 503: see score_data_instance
         served = self._served  # one read: stable across a hot swap
+        if served is None:
+            return self._no_model_response()
         if X.ndim == 0:
             X = X[None]
         t0 = time.perf_counter()
@@ -322,13 +394,32 @@ class ScoringApp:
 
     def healthz(self, request: Request) -> Response:
         served = self._served  # one read: stable across a hot swap
-        return _json_response(
-            {
-                "status": "ok",
-                "model_info": served.model_info,
-                "model_date": served.model_date,
-            }
-        )
+        if served is None:
+            response = _json_response(
+                {
+                    "status": "no model loaded",
+                    "degraded": True,
+                    "reason": "no model has been loaded yet",
+                    "model_info": None,
+                    "model_date": None,
+                },
+                503,
+            )
+            response.headers["Retry-After"] = str(RETRY_AFTER_S)
+            return response
+        reason = self._degraded_reason
+        payload = {
+            # 200 + "ok" even when degraded: the service IS serving, so
+            # readiness must keep routing; the flag/reason (and the
+            # state gauge) carry the operator signal
+            "status": "ok",
+            "model_info": served.model_info,
+            "model_date": served.model_date,
+            "degraded": reason is not None,
+        }
+        if reason is not None:
+            payload["reason"] = reason
+        return _json_response(payload)
 
     def metrics_endpoint(self, request: Request) -> Response:
         """Prometheus text exposition of this process's registry, merged
@@ -344,7 +435,7 @@ class ScoringApp:
 
 
 def create_app(
-    model: Regressor,
+    model: Regressor | None,
     model_date: date | None = None,
     buckets: tuple[int, ...] | None = None,
     warmup: bool = True,
@@ -373,6 +464,6 @@ def create_app(
         ).start()
     app = ScoringApp(model, model_date, buckets, predictor=predictor,
                      batcher=batcher, metrics_dir=metrics_dir)
-    if warmup:
+    if warmup and app.predictor is not None:
         app.predictor.warmup(sync=warmup_sync)
     return app

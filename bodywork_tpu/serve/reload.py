@@ -21,6 +21,13 @@ from bodywork_tpu.utils.logging import get_logger
 
 log = get_logger("serve.reload")
 
+#: ``served_key`` sentinel for "the caller is serving NO model" (degraded
+#: boot on an empty store): the watcher must treat whatever checkpoint it
+#: first finds as NEW. Passing None instead would make the constructor
+#: snapshot ``latest()`` as already-served — and a checkpoint published
+#: between the caller's failed lookup and construction would never load.
+NOTHING_SERVED = object()
+
 
 class CheckpointWatcher:
     """Polls ``store`` for a newer model checkpoint and hot-swaps it into
@@ -54,9 +61,12 @@ class CheckpointWatcher:
         # should be the key the caller actually LOADED — snapshotting
         # latest() here instead would mark a checkpoint published during
         # the caller's (slow, compile-heavy) warmup as already served and
-        # skip it until the next one lands.
+        # skip it until the next one lands. A caller serving NOTHING
+        # passes the NOTHING_SERVED sentinel for the same reason.
         self._current: tuple | None = None
-        if served_key is None:
+        if served_key is NOTHING_SERVED:
+            served_key = None
+        elif served_key is None:
             try:
                 served_key, _ = store.latest(MODELS_PREFIX)
             except ArtefactNotFound:
@@ -71,9 +81,10 @@ class CheckpointWatcher:
     def check_once(self) -> bool:
         """One poll: swap if the store has a different latest checkpoint.
         Returns whether a swap happened. Load/warm errors are logged and
-        swallowed — the service keeps answering with the current model and
-        retries on the next poll (a half-written checkpoint must never
-        take the service down)."""
+        swallowed — the service keeps answering with the current model
+        (flagged DEGRADED in /healthz and the state gauge, so a stuck
+        reload is visible) and retries on the next poll (a half-written
+        checkpoint must never take the service down)."""
         try:
             key, model_date = self.store.latest(MODELS_PREFIX)
         except ArtefactNotFound:
@@ -97,14 +108,16 @@ class CheckpointWatcher:
             #    would e.g. hand the Pallas kernel sub-ROW_TILE buckets
             #    that all pad to the same program — several duplicate
             #    compiles per warmup for nothing.
-            current = self.apps[0].predictor
-            old_resolved = resolve_engine(
-                self.engine, current.model, self.mesh_data
+            current = self.apps[0].predictor  # None on a degraded boot
+            old_resolved = (
+                resolve_engine(self.engine, current.model, self.mesh_data)
+                if current is not None
+                else None  # nothing served yet: nothing to inherit
             )
             new_resolved = resolve_engine(self.engine, model, self.mesh_data)
             if self.buckets is not None:
                 swap_buckets = self.buckets
-            elif new_resolved == old_resolved:
+            elif current is not None and new_resolved == old_resolved:
                 swap_buckets = current.buckets
             else:
                 swap_buckets = None
@@ -122,6 +135,13 @@ class CheckpointWatcher:
             predictor.warmup()
         except Exception as exc:
             log.error(f"hot reload of {key} failed (will retry): {exc!r}")
+            # keep serving the last-good model, but SAY so: the degraded
+            # flag rides /healthz + bodywork_tpu_serve_degraded_state
+            # until a later poll swaps successfully (swap_model clears it)
+            for app in self.apps:
+                app.set_degraded(
+                    f"hot reload of {key} failed; serving last-good model"
+                )
             return False
         # swap_model is an atomic bundle swap; for apps with a request
         # coalescer it ALSO drains the batch queue before returning.

@@ -249,14 +249,34 @@ def serve_latest_model(
     without a restart (``serve.reload``; the reference re-deploys the
     service for every new day's model — ``stage_2:113``). With
     ``block=False`` returns a started :class:`ServiceHandle`.
+
+    Degraded boot: with the watcher enabled, a store holding NO model
+    checkpoint yet starts the service anyway — scoring answers 503 +
+    ``Retry-After`` until the watcher swaps in the first checkpoint —
+    instead of the process dying and flapping its supervisor. Without a
+    watcher there is no path to ever serve, so the error still raises.
     """
+    from bodywork_tpu.store.base import ArtefactNotFound
     from bodywork_tpu.store.schema import MODELS_PREFIX
 
-    served_key, _ = store.latest(MODELS_PREFIX)
-    model, model_date = load_model(store, served_key)
-    # with buckets set, build_predictor always returns a predictor (every
-    # engine honours the list), so create_app never needs the knob here
-    predictor = build_predictor(model, mesh_data, engine, buckets=buckets)
+    try:
+        served_key, _ = store.latest(MODELS_PREFIX)
+    except ArtefactNotFound:
+        if not watch_interval_s:
+            raise
+        log.warning(
+            "no model checkpoint in the store yet; serving 503s until "
+            "the checkpoint watcher finds one"
+        )
+        served_key = None
+    if served_key is None:
+        model = model_date = predictor = None
+    else:
+        model, model_date = load_model(store, served_key)
+        # with buckets set, build_predictor always returns a predictor
+        # (every engine honours the list), so create_app never needs the
+        # knob here
+        predictor = build_predictor(model, mesh_data, engine, buckets=buckets)
     app = create_app(
         model, model_date, predictor=predictor,
         batch_window_ms=batch_window_ms, batch_max_rows=batch_max_rows,
@@ -265,11 +285,15 @@ def serve_latest_model(
     # the coalescer's dispatcher stops (after flushing) with the service
     handle.add_cleanup(app.close)
     if watch_interval_s:
-        from bodywork_tpu.serve.reload import CheckpointWatcher
+        from bodywork_tpu.serve.reload import NOTHING_SERVED, CheckpointWatcher
 
         watcher = CheckpointWatcher(
             app, store, poll_interval_s=watch_interval_s,
-            mesh_data=mesh_data, engine=engine, served_key=served_key,
+            mesh_data=mesh_data, engine=engine,
+            # degraded boot serves nothing: the sentinel (NOT None, which
+            # would re-snapshot latest() as already-served and skip a
+            # checkpoint published in the lookup->construction window)
+            served_key=served_key if served_key is not None else NOTHING_SERVED,
             buckets=buckets,
         )
         watcher.start()

@@ -1,5 +1,10 @@
-from bodywork_tpu.store.base import ArtefactStore, ArtefactNotFound
+from bodywork_tpu.store.base import (
+    ArtefactStore,
+    ArtefactNotFound,
+    DelegatingStore,
+)
 from bodywork_tpu.store.filesystem import FilesystemStore
+from bodywork_tpu.store.resilient import ResilientStore
 from bodywork_tpu.store import schema
 from bodywork_tpu.store.schema import (
     DATASETS_PREFIX,
@@ -17,7 +22,9 @@ from bodywork_tpu.store.schema import (
 __all__ = [
     "ArtefactStore",
     "ArtefactNotFound",
+    "DelegatingStore",
     "FilesystemStore",
+    "ResilientStore",
     "schema",
     "DATASETS_PREFIX",
     "MODELS_PREFIX",

@@ -25,6 +25,18 @@ primitive ops instrumented through the shared obs registry
 (``bodywork_tpu_store_ops_total{backend,op}`` + an op-latency
 histogram), so the data plane's round-trip count is a first-class
 observable next to the serving histograms.
+
+Transparent wrappers (the per-attempt write-epoch guard, the resilience
+layer's retry/breaker wrapper, the chaos fault injector) all derive from
+:class:`DelegatingStore`, which delegates every primitive and metadata
+op to the wrapped store — so a backend's ``get_many`` parallelism and
+its ``backend_label`` instrumentation survive any wrapper stack, and
+``mutable_cache`` always reaches the one long-lived real store. The
+canonical composition order, innermost first::
+
+    real backend  <-  FaultInjectingStore (chaos runs only)
+                  <-  ResilientStore (retries + circuit breaker)
+                  <-  EpochGuardedStore (one per stage attempt)
 """
 from __future__ import annotations
 
@@ -98,6 +110,13 @@ class ArtefactStore(abc.ABC):
     #: their primitive ops into obs instrumentation; wrapper stores leave
     #: it unset so a delegated call is counted once, at the backend
     backend_label: str | None = None
+
+    #: True for backends whose ops already run under the shared retry
+    #: policy internally (GCS). ``ResilientStore`` consults it so exactly
+    #: ONE layer owns retrying — wrapping a self-retrying backend in a
+    #: second retry loop would multiply attempt budgets (3x3 backend
+    #: hits per op) and double-count the shared retries metric.
+    self_retrying: bool = False
 
     def __init_subclass__(cls, **kwargs):
         super().__init_subclass__(**kwargs)
@@ -235,3 +254,70 @@ class ArtefactStore(abc.ABC):
         if not hist:
             raise ArtefactNotFound(f"no date-keyed artefacts under '{prefix}'")
         return hist[-1]
+
+
+class DelegatingStore(ArtefactStore):
+    """Base for TRANSPARENT store wrappers (write-epoch guard, resilience
+    layer, chaos fault injector): every primitive and metadata op
+    delegates to the wrapped store, and no ``backend_label`` is declared
+    — a delegated call is instrumented once, at the real backend.
+
+    ``get_many`` is delegated (not inherited) so a backend's parallel
+    override survives the wrapper stack; ``mutable_cache`` is delegated
+    so caches live on the one long-lived real store rather than dying
+    with a throwaway wrapper.
+    """
+
+    def __init__(self, inner: ArtefactStore):
+        self._inner = inner
+
+    @property
+    def inner(self) -> ArtefactStore:
+        return self._inner
+
+    def put_bytes(self, key: str, data: bytes) -> None:
+        self._inner.put_bytes(key, data)
+
+    def get_bytes(self, key: str) -> bytes:
+        return self._inner.get_bytes(key)
+
+    def list_keys(self, prefix: str = "") -> list[str]:
+        return self._inner.list_keys(prefix)
+
+    def delete(self, key: str) -> None:
+        self._inner.delete(key)
+
+    def exists(self, key: str) -> bool:
+        return self._inner.exists(key)
+
+    def get_many(self, keys: list[str]) -> dict[str, bytes]:
+        return self._inner.get_many(keys)
+
+    def version_token(self, key: str):
+        return self._inner.version_token(key)
+
+    def version_tokens(self, keys: list[str]) -> dict[str, object]:
+        return self._inner.version_tokens(keys)
+
+    def mutable_cache(self, name: str) -> dict:
+        return self._inner.mutable_cache(name)
+
+
+def innermost_backend(store: ArtefactStore) -> ArtefactStore | None:
+    """The real backend under any wrapper stack (the first store down
+    the ``_inner`` chain declaring a ``backend_label``), or None."""
+    seen = set()
+    while store is not None and id(store) not in seen:
+        seen.add(id(store))
+        if store.backend_label:
+            return store
+        store = getattr(store, "_inner", None) or getattr(store, "inner", None)
+    return None
+
+
+def innermost_backend_label(store: ArtefactStore) -> str | None:
+    """The real backend's ``backend_label`` under any wrapper stack, or
+    None — used to label wrapper-layer metrics (retries, breaker state)
+    with the backend actually being protected."""
+    backend = innermost_backend(store)
+    return None if backend is None else backend.backend_label

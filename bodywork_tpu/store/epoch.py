@@ -15,15 +15,18 @@ it revokes the epoch, after which every WRITE through the guard raises
 allowed: an abandoned reader is harmless, and failing it would only
 change which exception the dead thread swallows.
 
-The guard composes with any backend (filesystem, GCS, in-memory fakes)
-because it delegates the four primitive ops and inherits every
-convenience method from :class:`ArtefactStore`.
+The guard derives from :class:`~bodywork_tpu.store.base.DelegatingStore`
+so it composes with any backend or wrapper stack (filesystem, GCS,
+in-memory fakes, the resilience layer's ``ResilientStore``, the chaos
+``FaultInjectingStore``): reads, ``get_many`` parallelism, and
+``mutable_cache`` delegate untouched; only the write ops are epoch-
+checked.
 """
 from __future__ import annotations
 
 import threading
 
-from bodywork_tpu.store.base import ArtefactStore
+from bodywork_tpu.store.base import ArtefactStore, DelegatingStore
 
 __all__ = ["EpochGuardedStore", "WriteEpochRevoked"]
 
@@ -33,9 +36,9 @@ class WriteEpochRevoked(RuntimeError):
     (the writing stage attempt was timed out and abandoned)."""
 
 
-class EpochGuardedStore(ArtefactStore):
+class EpochGuardedStore(DelegatingStore):
     def __init__(self, inner: ArtefactStore, label: str = "stage"):
-        self._inner = inner
+        super().__init__(inner)
         self._label = label
         self._revoked = threading.Event()
 
@@ -54,7 +57,7 @@ class EpochGuardedStore(ArtefactStore):
                 "holding this store epoch was timed out and abandoned"
             )
 
-    # -- primitives (delegated; writes epoch-checked) ----------------------
+    # -- write ops (epoch-checked; everything else delegates) --------------
 
     def put_bytes(self, key: str, data: bytes) -> None:
         self._check_writable(key)
@@ -63,29 +66,3 @@ class EpochGuardedStore(ArtefactStore):
     def delete(self, key: str) -> None:
         self._check_writable(key)
         self._inner.delete(key)
-
-    def get_bytes(self, key: str) -> bytes:
-        return self._inner.get_bytes(key)
-
-    def get_many(self, keys: list[str]) -> dict[str, bytes]:
-        # delegated (not inherited): the default would loop THIS class's
-        # get_bytes and lose the backend's parallel override
-        return self._inner.get_many(keys)
-
-    def list_keys(self, prefix: str = "") -> list[str]:
-        return self._inner.list_keys(prefix)
-
-    def exists(self, key: str) -> bool:
-        return self._inner.exists(key)
-
-    def version_token(self, key: str):
-        return self._inner.version_token(key)
-
-    def version_tokens(self, keys: list[str]) -> dict[str, object]:
-        return self._inner.version_tokens(keys)
-
-    def mutable_cache(self, name: str) -> dict:
-        # caches must live on the REAL store: this wrapper is one stage
-        # attempt's throwaway epoch, and a cache dying with it would
-        # silently restore the O(days) history re-parse
-        return self._inner.mutable_cache(name)

@@ -31,6 +31,12 @@ class FilesystemStore(ArtefactStore):
         try:
             with os.fdopen(fd, "wb") as f:
                 f.write(data)
+                f.flush()
+                # fsync BEFORE the rename: without it a host crash can
+                # surface the new name with zero-length content (rename
+                # durable, data not) — exactly the torn-artefact class
+                # the chaos soak asserts never exists
+                os.fsync(f.fileno())
             os.replace(tmp, path)
         except BaseException:
             if os.path.exists(tmp):

@@ -8,46 +8,37 @@ the rest of the framework runs on :class:`FilesystemStore`.
 Listings iterate the client's paged iterator to exhaustion, so prefixes
 with more than one page of blobs (1000/page on real GCS) are handled; the
 contract suite drives this against a paginating fake. Transient service
-errors (429/5xx classes) are retried with short exponential backoff at
-THIS layer: the real client retries some idempotent calls internally, but
-its policy is invisible to tests and does not cover iteration of an
-already-started listing — an explicit, test-exercised policy beats an
-assumed one.
+errors (429/5xx classes) are retried at THIS layer through the shared
+policy (:mod:`bodywork_tpu.utils.retry`): exponential backoff with FULL
+jitter — the previous fixed delays synchronized across the bounded
+``get_many`` thread pool into a thundering herd on a struggling service
+— and cumulative sleep capped by a per-op deadline budget. The real
+client retries some idempotent calls internally, but its policy is
+invisible to tests and does not cover iteration of an already-started
+listing — an explicit, test-exercised policy beats an assumed one.
+Retries are exported as ``bodywork_tpu_store_retries_total{backend,op}``.
 """
 from __future__ import annotations
 
-import time
-
 from bodywork_tpu.store.base import ArtefactNotFound, ArtefactStore
-
-#: exception type names treated as transient (google.api_core classes are
-#: matched by NAME because google-cloud-storage is an optional dependency
-#: this module must import without)
-_TRANSIENT_ERROR_NAMES = frozenset({
-    "ServiceUnavailable",      # 503
-    "TooManyRequests",         # 429
-    "InternalServerError",     # 500
-    "BadGateway",              # 502
-    "GatewayTimeout",          # 504
-    "DeadlineExceeded",
-    "RetryError",
-    "ConnectionError",
-    "ConnectionResetError",
-})
-
-
-def _is_transient(exc: BaseException) -> bool:
-    return any(
-        t.__name__ in _TRANSIENT_ERROR_NAMES for t in type(exc).__mro__
-    )
+from bodywork_tpu.utils.retry import RetryPolicy, call_with_retry
 
 
 class GCSStore(ArtefactStore):
     backend_label = "gcs"
+    #: ops already run under the shared retry policy here, so a wrapping
+    #: ResilientStore adds only the breaker, not a second retry loop
+    self_retrying = True
 
-    #: transient-retry policy: attempts include the first try
+    #: transient-retry policy knobs (attempts include the first try);
+    #: materialised per call as a utils.retry.RetryPolicy
     RETRY_ATTEMPTS = 3
     RETRY_BASE_DELAY_S = 0.1
+    RETRY_MAX_DELAY_S = 2.0
+    #: per-op deadline budget: backoff sleeps stop once an op has spent
+    #: this long in total, so retry sleep can never eat a caller's
+    #: completion deadline
+    RETRY_DEADLINE_S = 30.0
     #: bounded fan-out for ``get_many`` — enough to overlap the ~67-200 ms
     #: per-object round-trip (PERF.md §1) without stampeding the service
     GET_MANY_MAX_THREADS = 8
@@ -64,20 +55,29 @@ class GCSStore(ArtefactStore):
         self._bucket = self._client.bucket(bucket)
         self._prefix = prefix.strip("/")
 
-    def _with_retries(self, op):
+    def _retry_policy(self) -> RetryPolicy:
+        return RetryPolicy(
+            attempts=self.RETRY_ATTEMPTS,
+            base_delay_s=self.RETRY_BASE_DELAY_S,
+            max_delay_s=self.RETRY_MAX_DELAY_S,
+            deadline_s=self.RETRY_DEADLINE_S,
+        )
+
+    def _with_retries(self, op, op_name: str = "op"):
         """Run ``op`` (a thunk that fully materialises its result — paged
         iteration included, so a mid-listing drop retries the WHOLE
-        listing, never splices two inconsistent pages), retrying
-        transient errors with exponential backoff."""
-        delay = self.RETRY_BASE_DELAY_S
-        for attempt in range(self.RETRY_ATTEMPTS):
-            try:
-                return op()
-            except Exception as exc:
-                if not _is_transient(exc) or attempt == self.RETRY_ATTEMPTS - 1:
-                    raise
-                time.sleep(delay)
-                delay *= 2
+        listing, never splices two inconsistent pages) under the shared
+        retry policy (transient-only, full jitter, deadline budget)."""
+
+        def on_retry(exc, attempt, sleep_s):
+            from bodywork_tpu.obs import get_registry
+
+            get_registry().counter(
+                "bodywork_tpu_store_retries_total",
+                "Artefact-store op retries by backend and op",
+            ).inc(backend=self.backend_label, op=op_name)
+
+        return call_with_retry(op, self._retry_policy(), on_retry=on_retry)
 
     @classmethod
     def from_url(cls, url: str) -> "GCSStore":
@@ -92,13 +92,14 @@ class GCSStore(ArtefactStore):
     def exists(self, key: str) -> bool:
         name = self._blob_name(key)
         return self._with_retries(
-            lambda: self._bucket.blob(name).exists()
+            lambda: self._bucket.blob(name).exists(), "exists"
         )
 
     def put_bytes(self, key: str, data: bytes) -> None:
         name = self._blob_name(key)
         self._with_retries(
-            lambda: self._bucket.blob(name).upload_from_string(data)
+            lambda: self._bucket.blob(name).upload_from_string(data),
+            "put_bytes",
         )
 
     def get_bytes(self, key: str) -> bytes:
@@ -110,7 +111,7 @@ class GCSStore(ArtefactStore):
                 raise ArtefactNotFound(key)
             return blob.download_as_bytes()
 
-        return self._with_retries(_get)
+        return self._with_retries(_get, "get_bytes")
 
     def get_many(self, keys: list[str]) -> dict[str, bytes]:
         # Each object read is an independent round-trip, so a bounded
@@ -136,7 +137,7 @@ class GCSStore(ArtefactStore):
         return self._with_retries(lambda: sorted(
             b.name[strip:]
             for b in self._client.list_blobs(self._bucket, prefix=full)
-        ))
+        ), "list_keys")
 
     def delete(self, key: str) -> None:
         name = self._blob_name(key)
@@ -157,7 +158,7 @@ class GCSStore(ArtefactStore):
             state["delete_attempted"] = True
             blob.delete()
 
-        self._with_retries(_delete)
+        self._with_retries(_delete, "delete")
 
     def version_token(self, key: str):
         # GCS object generation changes on every overwrite; invalid keys
@@ -165,7 +166,8 @@ class GCSStore(ArtefactStore):
         # queries never raise)
         try:
             blob = self._with_retries(
-                lambda: self._bucket.get_blob(self._blob_name(key))
+                lambda: self._bucket.get_blob(self._blob_name(key)),
+                "version_token",
             )
         except ValueError:
             return None
@@ -197,5 +199,5 @@ class GCSStore(ArtefactStore):
                         found[key] = blob.generation
                 return found
 
-            out.update(self._with_retries(_scan))
+            out.update(self._with_retries(_scan, "version_tokens"))
         return out

@@ -1,0 +1,336 @@
+"""Unified resilience primitives: error taxonomy, retry policy, breaker.
+
+Before this module every layer improvised its own failure handling: the
+GCS backend had a private fixed-exponential backoff loop, the pipeline
+runner burned every ``stage.retries`` attempt against the completion
+deadline regardless of whether the failure could ever succeed on retry,
+and the live-service tester only retried connection-level failures
+(``HTTPAdapter(max_retries=...)`` never sees a 503 *response*). This
+module defines the policy ONCE and every layer adopts it:
+
+- **Taxonomy** — :func:`is_transient` (strict allowlist, matched by
+  exception-class NAME so optional dependencies' error classes count
+  without being importable) and :func:`is_permanent` (strict denylist of
+  deterministic programming/lookup errors). :func:`classify_error` walks
+  the ``__cause__``/``__context__`` chain so a wrapped transient error
+  (e.g. a ``StageError`` raised ``from`` a ``ConnectionError``) keeps
+  its retryability.
+- **Policy** — :class:`RetryPolicy` + :func:`call_with_retry`:
+  exponential backoff with FULL jitter (sleep ~ U(0, min(base·2ᵏ, max))
+  — synchronized workers decorrelate instead of thundering-herding) and
+  a per-op deadline budget capping cumulative sleep.
+- **Breaker** — :class:`CircuitBreaker`: closed → open after N
+  consecutive transient failures → half-open single probe → closed on
+  probe success. ``store.resilient.ResilientStore`` wires it over any
+  artefact-store backend.
+
+The chaos subsystem (:mod:`bodywork_tpu.chaos`) injects faults that this
+module's consumers must absorb; ``tests/test_chaos.py`` guards that no
+store module re-grows a private backoff loop.
+"""
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+
+__all__ = [
+    "TRANSIENT_ERROR_NAMES",
+    "PERMANENT_ERROR_TYPES",
+    "TransientError",
+    "is_transient",
+    "is_permanent",
+    "classify_error",
+    "RetryPolicy",
+    "call_with_retry",
+    "CircuitBreaker",
+    "CircuitOpenError",
+]
+
+#: exception type names treated as transient. Matched by NAME through the
+#: MRO because several sources (google.api_core, requests) are optional
+#: dependencies this module must classify without importing. The set is
+#: an ALLOWLIST: unknown errors are NOT transient (a store retry loop
+#: must never spin on a deterministic failure).
+TRANSIENT_ERROR_NAMES = frozenset({
+    # google.api_core HTTP classes (503/429/500/502/504) + client retries
+    "ServiceUnavailable",
+    "TooManyRequests",
+    "InternalServerError",
+    "BadGateway",
+    "GatewayTimeout",
+    "DeadlineExceeded",
+    "RetryError",
+    # stdlib / requests connection-level failures
+    "ConnectionError",
+    "ConnectionResetError",
+    "BrokenPipeError",
+    "TimeoutError",
+    "Timeout",
+    "ConnectTimeout",
+    "ReadTimeout",
+    # this module's own marker class (chaos faults subclass it)
+    "TransientError",
+})
+
+#: deterministic failures: retrying can never succeed, so a retry loop
+#: must fail fast instead of burning its attempt/deadline budget.
+#: (``ArtefactNotFound`` is a ``KeyError`` subclass and lands here.)
+PERMANENT_ERROR_TYPES = (
+    ValueError,
+    TypeError,
+    KeyError,
+    AttributeError,
+    NotImplementedError,
+)
+
+
+class TransientError(Exception):
+    """A failure expected to clear on retry. ``retry_after_s`` (when the
+    failing side names one, e.g. an HTTP ``Retry-After``) is honoured by
+    :func:`call_with_retry` as a floor under the jittered sleep."""
+
+    retry_after_s: float | None = None
+
+
+def is_transient(exc: BaseException) -> bool:
+    """True when ``exc`` is on the transient allowlist (name-matched
+    through the MRO, so optional-dependency classes count)."""
+    return any(
+        t.__name__ in TRANSIENT_ERROR_NAMES for t in type(exc).__mro__
+    )
+
+
+def _chain(exc: BaseException, limit: int = 8):
+    seen = set()
+    while exc is not None and id(exc) not in seen and limit > 0:
+        seen.add(id(exc))
+        limit -= 1
+        yield exc
+        exc = exc.__cause__ or exc.__context__
+
+
+def is_permanent(exc: BaseException) -> bool:
+    """True when ``exc`` is a deterministic failure (denylist match with
+    no transient error anywhere in its cause chain)."""
+    return classify_error(exc) == "permanent"
+
+
+def classify_error(exc: BaseException) -> str:
+    """``"transient"`` | ``"permanent"`` | ``"unknown"``.
+
+    Walks the ``__cause__``/``__context__`` chain: a transient error
+    anywhere in the chain wins (a ``StageError`` raised ``from`` a 503
+    is still worth retrying), then the permanent denylist, then
+    ``"unknown"`` — which callers treat per their own default (stage
+    retries keep retrying unknowns, store retry loops do not).
+    """
+    links = list(_chain(exc))
+    if any(is_transient(e) for e in links):
+        return "transient"
+    from bodywork_tpu.utils.errors import StageError
+
+    if isinstance(exc, (*PERMANENT_ERROR_TYPES, StageError)):
+        return "permanent"
+    return "unknown"
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff with full jitter and a deadline budget.
+
+    ``attempts`` includes the first try. ``deadline_s`` caps the
+    CUMULATIVE time (op time + sleeps) an op may consume across retries
+    — once exceeded, the last error propagates instead of sleeping
+    further (a caller's own deadline must not be eaten by backoff).
+    """
+
+    attempts: int = 3
+    base_delay_s: float = 0.1
+    max_delay_s: float = 2.0
+    deadline_s: float = 30.0
+
+    def __post_init__(self):
+        if self.attempts < 1:
+            raise ValueError(f"attempts must be >= 1, got {self.attempts}")
+
+
+#: process-wide jitter source; tests inject their own rng/sleep instead
+_JITTER_RNG = random.Random()
+
+
+def call_with_retry(
+    fn,
+    policy: RetryPolicy = RetryPolicy(),
+    *,
+    is_retryable=is_transient,
+    on_retry=None,
+    sleep=time.sleep,
+    rng=None,
+    clock=time.monotonic,
+):
+    """Run ``fn()`` under ``policy``: retry failures ``is_retryable``
+    accepts, with full-jitter exponential backoff, until the attempt or
+    deadline budget runs out (then the last error propagates).
+
+    ``on_retry(exc, attempt, sleep_s)`` fires before each backoff sleep —
+    the hook through which call sites report retries to the obs registry
+    (the util itself stays metric-free). A ``retry_after_s`` attribute on
+    the raised error (HTTP 429/503 ``Retry-After``) floors the jittered
+    sleep, but only up to ``policy.max_delay_s``: the server's hint is a
+    politeness floor, the caller's policy bounds its patience — a client
+    configured for millisecond backoff must not be stalled for seconds
+    per attempt by a server that advertises a long retry horizon. ``fn``
+    must fully materialise its result (paged iteration included) so a
+    retry never splices two inconsistent halves.
+    """
+    rng = rng or _JITTER_RNG
+    start = clock()
+    for attempt in range(policy.attempts):
+        try:
+            return fn()
+        except Exception as exc:
+            if not is_retryable(exc) or attempt == policy.attempts - 1:
+                raise
+            remaining = policy.deadline_s - (clock() - start)
+            if remaining <= 0:
+                raise
+            cap = min(policy.base_delay_s * (2 ** attempt), policy.max_delay_s)
+            delay = rng.uniform(0.0, cap)
+            floor = getattr(exc, "retry_after_s", None)
+            if floor:
+                delay = max(delay, min(float(floor), policy.max_delay_s))
+            delay = min(delay, remaining)
+            if on_retry is not None:
+                on_retry(exc, attempt + 1, delay)
+            sleep(delay)
+
+
+class CircuitOpenError(RuntimeError):
+    """Fast-fail: the breaker is open — the backend has failed enough
+    consecutive ops that further calls are rejected without touching it
+    until the reset timeout admits a half-open probe. Deliberately NOT
+    on the transient allowlist (a store retry loop must not spin against
+    an open breaker) and not on the permanent denylist (a stage retry
+    may succeed once the backend recovers)."""
+
+
+class CircuitBreaker:
+    """closed → open after ``failure_threshold`` consecutive failures →
+    (after ``reset_timeout_s``) half-open, admitting ONE probe → closed
+    on probe success, open again on probe failure.
+
+    Callers bracket each op: :meth:`allow` before (raises
+    :class:`CircuitOpenError` when rejecting), then exactly one of
+    :meth:`record_success` / :meth:`record_failure`. ``on_state_change``
+    receives each new state name — the hook the store wrapper uses to
+    export ``bodywork_tpu_store_breaker_state``.
+    """
+
+    CLOSED = "closed"
+    HALF_OPEN = "half_open"
+    OPEN = "open"
+
+    #: gauge encoding of the state machine (docs/RESILIENCE.md)
+    STATE_VALUES = {"closed": 0, "half_open": 1, "open": 2}
+
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        reset_timeout_s: float = 30.0,
+        clock=time.monotonic,
+        on_state_change=None,
+    ):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = failure_threshold
+        self.reset_timeout_s = reset_timeout_s
+        self._clock = clock
+        self.on_state_change = on_state_change
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive = 0
+        self._opened_at: float | None = None
+        self._probe_in_flight = False
+        self._probe_started_at: float | None = None
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _set_state(self, state: str) -> str | None:
+        """Apply a transition (lock held by caller) and return the new
+        state to notify for, or None. The ``on_state_change`` hook is
+        deliberately fired OUTSIDE the lock by the public methods: a
+        caller-supplied hook that reads ``state`` or records an outcome
+        (the natural shape for an alerting callback) must not deadlock
+        against the breaker's own non-reentrant lock."""
+        if state == self._state:
+            return None
+        self._state = state
+        return state
+
+    def _notify(self, state: str | None) -> None:
+        if state is not None and self.on_state_change is not None:
+            self.on_state_change(state)
+
+    def allow(self) -> None:
+        """Admit one op or raise :class:`CircuitOpenError`."""
+        notify = None
+        with self._lock:
+            if self._state == self.OPEN:
+                if (
+                    self._opened_at is not None
+                    and self._clock() - self._opened_at >= self.reset_timeout_s
+                ):
+                    notify = self._set_state(self.HALF_OPEN)
+                    self._probe_in_flight = True
+                    self._probe_started_at = self._clock()
+                else:
+                    raise CircuitOpenError(
+                        f"circuit open after {self._consecutive} consecutive "
+                        f"failures; probing again in <= {self.reset_timeout_s}s"
+                    )
+            elif self._state == self.HALF_OPEN:
+                if self._probe_in_flight:
+                    # a probe that never reported back (e.g. its op died
+                    # on a BaseException the retry layer does not catch)
+                    # must not wedge the breaker half-open forever: after
+                    # the reset timeout the slot is taken over
+                    if (
+                        self._probe_started_at is not None
+                        and self._clock() - self._probe_started_at
+                        >= self.reset_timeout_s
+                    ):
+                        self._probe_started_at = self._clock()
+                    else:
+                        raise CircuitOpenError(
+                            "half-open probe already in flight"
+                        )
+                else:
+                    self._probe_in_flight = True
+                    self._probe_started_at = self._clock()
+        self._notify(notify)
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._probe_in_flight = False
+            self._opened_at = None
+            notify = self._set_state(self.CLOSED)
+        self._notify(notify)
+
+    def record_failure(self) -> None:
+        notify = None
+        with self._lock:
+            self._probe_in_flight = False
+            self._consecutive += 1
+            if (
+                self._state == self.HALF_OPEN
+                or self._consecutive >= self.failure_threshold
+            ):
+                self._opened_at = self._clock()
+                notify = self._set_state(self.OPEN)
+        self._notify(notify)

@@ -1,0 +1,772 @@
+"""Chaos harness + resilience layer: determinism, budgets, breaker,
+degraded serving, and the tier-1 quick soak (ISSUE 4 acceptance).
+
+Everything here is CPU-safe and stays in the default ``-m 'not slow'``
+run; the ``chaos`` marker groups it for targeted runs
+(``pytest -m chaos``)."""
+from datetime import date
+
+import numpy as np
+import pytest
+
+from bodywork_tpu.chaos import (
+    FaultInjectingStore,
+    FaultPlan,
+    FlakyScoringMiddleware,
+    InjectedFault,
+    activate,
+)
+from bodywork_tpu.store.resilient import ResilientStore
+from bodywork_tpu.utils.retry import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryPolicy,
+    TransientError,
+    classify_error,
+)
+from tests.helpers import make_counting_store, make_memory_store
+
+pytestmark = pytest.mark.chaos
+
+#: fast backoff for tests — semantics identical, sleeps negligible
+FAST = RetryPolicy(attempts=3, base_delay_s=0.0001, max_delay_s=0.001)
+
+
+# --- fault-plan determinism + budgets --------------------------------------
+
+
+def _drive(seed, ops=40):
+    plan = FaultPlan(seed=seed, store_transient_p=0.5, max_consecutive=0)
+    store = FaultInjectingStore(make_memory_store(), plan)
+    outcomes = []
+    for i in range(ops):
+        try:
+            store.put_bytes(f"datasets/d{i % 5}.csv", b"x" * 8)
+            outcomes.append("ok")
+        except InjectedFault:
+            outcomes.append("fault")
+    return outcomes, list(plan.injected_log)
+
+
+def test_same_seed_identical_fault_sequence():
+    """The tentpole's determinism contract: same seed => the same ops
+    fault at the same points, and the injected-fault log is identical."""
+    o1, l1 = _drive(7)
+    o2, l2 = _drive(7)
+    assert o1 == o2 and l1 == l2
+    assert "fault" in o1 and "ok" in o1  # p=0.5 actually exercises both
+
+
+def test_different_seed_different_sequence():
+    o1, _ = _drive(7)
+    o3, _ = _drive(8)
+    assert o1 != o3
+
+
+def test_decisions_are_per_stream_not_interleaving_dependent():
+    """Decisions hash (seed, kind, op, key, n) — so one key's fault
+    sequence is unchanged no matter what OTHER keys did in between (the
+    property that keeps chaos runs reproducible under the runner's
+    background threads)."""
+
+    def key_a_outcomes(interleave):
+        plan = FaultPlan(seed=3, store_transient_p=0.5, max_consecutive=0)
+        store = FaultInjectingStore(make_memory_store(), plan)
+        outcomes = []
+        for i in range(12):
+            if interleave:
+                for j in range(i % 3):  # noise on other streams
+                    try:
+                        store.put_bytes(f"models/noise{j}.npz", b"n")
+                    except InjectedFault:
+                        pass
+            try:
+                store.put_bytes("datasets/a.csv", b"x")
+                outcomes.append("ok")
+            except InjectedFault:
+                outcomes.append("fault")
+        return outcomes
+
+    assert key_a_outcomes(False) == key_a_outcomes(True)
+
+
+def test_consecutive_fault_cap_bounds_adversity():
+    """max_consecutive=2 under p=1.0: two faults then a forced success,
+    repeating — the cap that guarantees a 3-attempt retry budget always
+    wins (what makes the soak a proof, not a probability)."""
+    plan = FaultPlan(seed=1, store_transient_p=1.0, max_consecutive=2)
+    store = FaultInjectingStore(make_memory_store(), plan)
+    pattern = []
+    for _ in range(6):
+        try:
+            store.put_bytes("datasets/a.csv", b"x")
+            pattern.append("ok")
+        except InjectedFault:
+            pattern.append("F")
+    assert pattern == ["F", "F", "ok", "F", "F", "ok"]
+
+
+def test_consecutive_cap_spans_fault_kinds():
+    """The cap bounds TOTAL consecutive failures of an op stream, not
+    per-kind streaks: transient + torn-write faults on one put stream
+    share the streak, so two capped transient hits can never be followed
+    by a 'fresh' torn-write hit (which would exhaust a 3-attempt retry
+    budget and void the soak's guarantee)."""
+    plan = FaultPlan(
+        seed=6, store_transient_p=0.6, torn_write_p=1.0, max_consecutive=2
+    )
+    store = FaultInjectingStore(make_memory_store(), plan)
+    streak = max_streak = 0
+    for _ in range(60):
+        try:
+            store.put_bytes("datasets/a.csv", b"payload-bytes")
+            streak = 0
+        except InjectedFault:
+            streak += 1
+            max_streak = max(max_streak, streak)
+    assert max_streak == 2  # adversity present, budget never exceeded
+
+
+def test_get_many_is_single_failure_unit():
+    """One failure decision per batch execution: a capped plan can never
+    fail the same batch more than max_consecutive times in a row, no
+    matter how many keys it holds (per-key streams would compose)."""
+    plan = FaultPlan(seed=1, store_transient_p=1.0, max_consecutive=2)
+    store = FaultInjectingStore(make_memory_store(), plan)
+    keys = [f"datasets/d{i}.csv" for i in range(8)]
+    for key in keys:
+        store._inner.put_bytes(key, b"x")
+    outcomes = []
+    for _ in range(6):
+        try:
+            assert list(store.get_many(keys)) == keys
+            outcomes.append("ok")
+        except InjectedFault:
+            outcomes.append("F")
+    assert outcomes == ["F", "F", "ok", "F", "F", "ok"]
+
+
+def test_fault_plan_validation():
+    with pytest.raises(ValueError, match="probability"):
+        FaultPlan(store_transient_p=1.5)
+    with pytest.raises(ValueError, match="max_consecutive"):
+        FaultPlan(max_consecutive=-1)
+    with pytest.raises(ValueError, match="unknown fault-plan field"):
+        FaultPlan.from_dict({"seed": 1, "store_transient_probability": 0.5})
+    # round-trip: to_dict feeds from_dict
+    plan = FaultPlan.default(seed=9)
+    assert FaultPlan.from_dict(plan.to_dict()).seed == 9
+
+
+def test_activate_is_exclusive():
+    with activate(FaultPlan(seed=1)):
+        with pytest.raises(RuntimeError, match="already active"):
+            with activate(FaultPlan(seed=2)):
+                pass
+
+
+def test_activate_resets_plan_for_identical_replay():
+    """A reused plan object must replay the same seeded adversity:
+    activation clears the draw/streak history and the injected log, so
+    run 2 of the same plan matches a fresh same-seed run."""
+    plan = FaultPlan(seed=7, store_transient_p=0.5, max_consecutive=0)
+
+    def one_run():
+        with activate(plan):
+            store = FaultInjectingStore(make_memory_store(), plan)
+            outcomes = []
+            for i in range(30):
+                try:
+                    store.put_bytes(f"datasets/d{i % 3}.csv", b"x")
+                    outcomes.append("ok")
+                except InjectedFault:
+                    outcomes.append("fault")
+            return outcomes, list(plan.injected_log)
+
+    assert one_run() == one_run()
+
+
+# --- resilience layer: retries, torn writes, breaker -----------------------
+
+
+def _retry_count(op, backend="wrapped"):
+    from bodywork_tpu.obs import get_registry
+
+    return get_registry().counter(
+        "bodywork_tpu_store_retries_total"
+    ).value(backend=backend, op=op)
+
+
+def test_resilient_store_absorbs_capped_transients():
+    plan = FaultPlan(seed=1, store_transient_p=1.0, max_consecutive=2)
+    store = ResilientStore(
+        FaultInjectingStore(make_memory_store(), plan), policy=FAST
+    )
+    store.put_bytes("datasets/a.csv", b"hello")
+    assert store.get_bytes("datasets/a.csv") == b"hello"
+    assert store.list_keys("datasets/") == ["datasets/a.csv"]
+    assert store.breaker.state == "closed"
+
+
+def test_torn_write_is_repaired_by_retry():
+    """Crash-after-partial-write: the injector persists a payload PREFIX
+    then raises; the resilience layer's retry rewrites the full bytes —
+    the torn intermediate state never survives an op."""
+    plan = FaultPlan(seed=1, torn_write_p=1.0, max_consecutive=2)
+    mem = make_memory_store()
+    store = ResilientStore(FaultInjectingStore(mem, plan), policy=FAST)
+    payload = bytes(range(64))
+    store.put_bytes("models/m.npz", payload)
+    assert mem.get_bytes("models/m.npz") == payload
+
+
+def test_every_public_op_routes_through_shared_retry_policy():
+    """Satellite guard: put/get/get_many/list/delete/exists each absorb
+    one injected transient failure AND report the retry through the ONE
+    shared counter — no op has a private (or missing) retry path.
+    version_token(s) are exempt by contract: token queries never raise."""
+    ServiceUnavailable = type("ServiceUnavailable", (Exception,), {})
+
+    class FlakyOnce:
+        """Raises one transient error per op name, then delegates."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self._failed = set()
+            self.backend_label = None
+
+        def __getattr__(self, name):
+            attr = getattr(self._inner, name)
+            if name not in (
+                "put_bytes", "get_bytes", "get_many", "list_keys",
+                "delete", "exists",
+            ):
+                return attr
+
+            def flaky(*args, **kwargs):
+                if name not in self._failed:
+                    self._failed.add(name)
+                    raise ServiceUnavailable(f"injected {name} failure")
+                return attr(*args, **kwargs)
+
+            return flaky
+
+    mem = make_memory_store()
+    mem.put_bytes("datasets/a.csv", b"x")
+    store = ResilientStore(FlakyOnce(mem), policy=FAST, label="guardtest")
+    before = {
+        op: _retry_count(op, "guardtest")
+        for op in ("put_bytes", "get_bytes", "get_many", "list_keys",
+                   "delete", "exists")
+    }
+    store.put_bytes("datasets/b.csv", b"y")
+    assert store.get_bytes("datasets/a.csv") == b"x"
+    assert store.get_many(["datasets/a.csv"]) == {"datasets/a.csv": b"x"}
+    assert store.list_keys("datasets/") == ["datasets/a.csv", "datasets/b.csv"]
+    assert store.exists("datasets/a.csv")
+    store.delete("datasets/b.csv")
+    for op in before:
+        assert _retry_count(op, "guardtest") == before[op] + 1, op
+
+
+def test_no_private_backoff_loops_in_store_modules():
+    """Satellite guard, static half: no store module may re-implement
+    its own sleep/backoff loop — the shared policy (utils/retry.py) is
+    the only place that sleeps between attempts."""
+    import pathlib
+
+    import bodywork_tpu.store as store_pkg
+    from bodywork_tpu.store import gcs
+    from bodywork_tpu.utils import retry
+
+    store_dir = pathlib.Path(store_pkg.__file__).parent
+    for path in sorted(store_dir.glob("*.py")):
+        source = path.read_text()
+        assert "time.sleep" not in source, f"{path.name} sleeps privately"
+        assert "delay *=" not in source, f"{path.name} grows its own backoff"
+    # the GCS backend's retry entrypoint IS the shared one
+    assert gcs.call_with_retry is retry.call_with_retry
+
+
+def test_breaker_state_machine():
+    t = [0.0]
+    states = []
+    breaker = CircuitBreaker(
+        failure_threshold=3, reset_timeout_s=10.0, clock=lambda: t[0],
+        on_state_change=states.append,
+    )
+    assert breaker.state == "closed"
+    for _ in range(2):
+        breaker.allow()
+        breaker.record_failure()
+    assert breaker.state == "closed"  # below threshold
+    breaker.allow()
+    breaker.record_failure()
+    assert breaker.state == "open"
+    with pytest.raises(CircuitOpenError):
+        breaker.allow()
+    t[0] = 10.0  # reset timeout elapsed: one half-open probe
+    breaker.allow()
+    assert breaker.state == "half_open"
+    with pytest.raises(CircuitOpenError):
+        breaker.allow()  # second concurrent probe rejected
+    breaker.record_failure()  # probe failed -> open again
+    assert breaker.state == "open"
+    t[0] = 25.0
+    breaker.allow()
+    breaker.record_success()  # probe succeeded -> closed
+    assert breaker.state == "closed"
+    assert states == ["open", "half_open", "open", "half_open", "closed"]
+    assert CircuitBreaker.STATE_VALUES == {
+        "closed": 0, "half_open": 1, "open": 2,
+    }
+
+
+def test_breaker_half_open_probe_slot_recovers_from_wedged_probe():
+    """A probe whose op dies without reporting back (BaseException past
+    the retry layer) must not wedge the breaker half-open forever: after
+    the reset timeout the probe slot is taken over."""
+    t = [0.0]
+    breaker = CircuitBreaker(
+        failure_threshold=1, reset_timeout_s=5.0, clock=lambda: t[0]
+    )
+    breaker.allow()
+    breaker.record_failure()  # open
+    t[0] = 5.0
+    breaker.allow()  # half-open probe admitted... and never reports back
+    with pytest.raises(CircuitOpenError):
+        breaker.allow()  # slot still fresh: concurrent probe rejected
+    t[0] = 10.0
+    breaker.allow()  # stale probe slot taken over
+    breaker.record_success()
+    assert breaker.state == "closed"
+
+
+def test_breaker_opens_fast_fails_and_recovers_through_store():
+    from bodywork_tpu.obs import get_registry
+
+    t = [0.0]
+    breaker = CircuitBreaker(
+        failure_threshold=2, reset_timeout_s=5.0, clock=lambda: t[0]
+    )
+    plan = FaultPlan(seed=1, store_transient_p=1.0, max_consecutive=0)
+    counting = make_counting_store(make_memory_store())
+    counting.inner.put_bytes("datasets/a.csv", b"x")
+    store = ResilientStore(
+        FaultInjectingStore(counting, plan),
+        policy=RetryPolicy(attempts=2, base_delay_s=0.0001),
+        breaker=breaker,
+        label="breakertest",
+    )
+    gauge = get_registry().get("bodywork_tpu_store_breaker_state")
+    for _ in range(2):  # each op exhausts its retries -> op-level failure
+        with pytest.raises(InjectedFault):
+            store.get_bytes("datasets/a.csv")
+    assert breaker.state == "open"
+    assert gauge.value(backend="breakertest") == 2.0
+    reached_before = counting.ops.get("get_bytes", 0)
+    with pytest.raises(CircuitOpenError):
+        store.get_bytes("datasets/a.csv")
+    # fast-fail: the open breaker rejected the op WITHOUT touching the
+    # backend (no new inner get_bytes)
+    assert counting.ops.get("get_bytes", 0) == reached_before
+    plan.store_transient_p = 0.0  # backend healed
+    t[0] = 6.0  # reset timeout elapsed -> half-open probe admitted
+    assert store.get_bytes("datasets/a.csv") == b"x"
+    assert breaker.state == "closed"
+    assert gauge.value(backend="breakertest") == 0.0
+
+
+# --- corruption: only consumers with integrity checks are targeted ---------
+
+
+def test_corrupt_snapshot_read_falls_back_byte_identically(store):
+    """Payload corruption targets snapshots/ (the one prefix whose
+    consumer validates and falls back): a truncated snapshot read must
+    degrade to per-day fetches and return byte-identical history."""
+    from bodywork_tpu.data.generator import generate_day
+    from bodywork_tpu.data.io import Dataset, load_all_datasets, persist_dataset
+    from bodywork_tpu.data.snapshot import write_snapshot
+    from bodywork_tpu.obs import get_registry
+
+    for day in (1, 2, 3):
+        d = date(2026, 1, day)
+        X, y = generate_day(d)
+        persist_dataset(store, Dataset(X, y, d))
+    assert write_snapshot(store) is not None
+    clean = load_all_datasets(store)
+
+    def cold(s):
+        s.mutable_cache("_parsed_dataset_cache").clear()
+        s.mutable_cache("_concat_history_cache").clear()
+
+    corrupt_counter = get_registry().counter(
+        "bodywork_tpu_snapshot_loads_total"
+    )
+    before = corrupt_counter.value(outcome="corrupt")
+    plan = FaultPlan(seed=2, corrupt_read_p=1.0, max_consecutive=0)
+    cold(store)
+    chaotic = load_all_datasets(FaultInjectingStore(store, plan))
+    assert np.array_equal(chaotic.X, clean.X)
+    assert np.array_equal(chaotic.y, clean.y)
+    assert corrupt_counter.value(outcome="corrupt") > before
+    assert plan.injected_log  # corruption actually fired
+
+
+# --- flaky scoring service + degraded-mode serving -------------------------
+
+
+@pytest.fixture
+def fitted_app():
+    from bodywork_tpu.models import LinearRegressor
+    from bodywork_tpu.serve import create_app
+
+    rng = np.random.default_rng(1)
+    X = rng.uniform(0, 100, 300).astype(np.float32)
+    y = (1.0 + 0.5 * X).astype(np.float32)
+    return create_app(
+        LinearRegressor().fit(X, y), date(2026, 7, 1), buckets=(1, 8),
+        warmup=False,
+    )
+
+
+def test_flaky_middleware_deterministic_and_scoped(fitted_app):
+    plan = FaultPlan(seed=4, http_error_p=1.0, max_consecutive=0,
+                     http_retry_after_s=0.25)
+    client = FlakyScoringMiddleware(fitted_app, plan).test_client()
+    statuses = [
+        client.post("/score/v1", json={"X": 50}).status_code
+        for _ in range(8)
+    ]
+    assert set(statuses) <= {503, 429}
+    assert {503, 429} <= set(statuses)  # the split actually exercises both
+    response = client.post("/score/v1", json={"X": 50})
+    assert response.headers["Retry-After"] == "0.25"
+    # non-scoring routes always pass through: the harness breaks the
+    # data path, never the probes that make the breakage observable
+    assert client.get("/healthz").status_code == 200
+    assert client.get("/metrics").status_code == 200
+
+
+def test_scoring_client_retries_statuses_to_success(fitted_app):
+    """Satellite: the scoring client retries 5xx/429 RESPONSE statuses
+    (not just connection failures) and reports through the registry."""
+    from bodywork_tpu.monitor import InProcessScoringClient
+    from bodywork_tpu.obs import get_registry
+
+    plan = FaultPlan(seed=3, http_error_p=1.0, max_consecutive=2)
+    client = InProcessScoringClient(FlakyScoringMiddleware(fitted_app, plan))
+    counter = get_registry().counter(
+        "bodywork_tpu_scoring_client_retries_total"
+    )
+    before = counter.value(reason="status")
+    ok, preds, _elapsed = client.score({"X": 50})
+    assert ok and len(preds) == 1
+    assert counter.value(reason="status") >= before + 2  # two 5xx absorbed
+
+
+def test_http_client_retries_statuses_over_real_socket(fitted_app):
+    from bodywork_tpu.monitor import HttpScoringClient
+    from bodywork_tpu.serve import ServiceHandle
+
+    plan = FaultPlan(seed=3, http_error_p=1.0, max_consecutive=2)
+    flaky = FlakyScoringMiddleware(fitted_app, plan)
+    with ServiceHandle(flaky, port=0) as handle:
+        client = HttpScoringClient(handle.url, backoff_s=0.005)
+        ok, preds, _elapsed = client.score({"X": 50})
+    assert ok and len(preds) == 1
+
+
+def test_retry_after_floor_is_capped_by_policy_max_delay(fitted_app):
+    """A server advertising a long Retry-After must not stall a client
+    whose policy is configured for millisecond backoff: the floor is
+    honoured only up to max_delay_s (the hint is politeness, the policy
+    bounds patience)."""
+    import time
+
+    from bodywork_tpu.monitor import InProcessScoringClient
+
+    plan = FaultPlan(seed=3, http_error_p=1.0, max_consecutive=2,
+                     http_retry_after_s=60.0)
+    client = InProcessScoringClient(FlakyScoringMiddleware(fitted_app, plan))
+    t0 = time.perf_counter()
+    ok, _preds, _elapsed = client.score({"X": 50})
+    assert ok
+    # two absorbed 503/429s with max_delay_s=0.05 sleeps, never 60 s
+    assert time.perf_counter() - t0 < 2.0
+
+
+def test_serve_answers_503_with_retry_after_before_first_model():
+    from bodywork_tpu.serve import create_app
+
+    app = create_app(None)
+    client = app.test_client()
+    for path, payload in (("/score/v1", {"X": 50}),
+                          ("/score/v1/batch", {"X": [1.0, 2.0]})):
+        response = client.post(path, json=payload)
+        assert response.status_code == 503
+        assert response.headers["Retry-After"] == "5"
+        # a malformed request can never succeed: it gets its
+        # non-retryable 400 even from a model-less server, so clients
+        # never burn a Retry-After budget on it
+        assert client.post(path, json={"X": "junk"}).status_code == 400
+        assert client.post(path, json={"Y": 1}).status_code == 400
+    health = client.get("/healthz")
+    assert health.status_code == 503
+    assert health.get_json()["degraded"] is True
+    assert health.headers["Retry-After"] == "5"
+
+
+def test_first_swap_brings_modelless_app_live(fitted_app):
+    from bodywork_tpu.models import LinearRegressor
+    from bodywork_tpu.serve import create_app
+
+    app = create_app(None)
+    client = app.test_client()
+    assert client.post("/score/v1", json={"X": 50}).status_code == 503
+    rng = np.random.default_rng(2)
+    X = rng.uniform(0, 100, 200).astype(np.float32)
+    model = LinearRegressor().fit(X, (1.0 + 0.5 * X).astype(np.float32))
+    app.swap_model(model, date(2026, 7, 2))
+    assert client.post("/score/v1", json={"X": 50}).status_code == 200
+    health = client.get("/healthz").get_json()
+    assert health["degraded"] is False and health["model_date"] == "2026-07-02"
+
+
+def test_degraded_boot_watcher_serves_preexisting_checkpoint(store):
+    """The NOTHING_SERVED sentinel: a checkpoint published before the
+    watcher was even constructed must still be picked up on the first
+    poll (passing None would snapshot latest() as already-served and
+    leave the model-less server answering 503s until the NEXT day)."""
+    from bodywork_tpu.models import LinearRegressor, save_model
+    from bodywork_tpu.serve import CheckpointWatcher, create_app
+    from bodywork_tpu.serve.reload import NOTHING_SERVED
+
+    rng = np.random.default_rng(4)
+    X = rng.uniform(0, 100, 200).astype(np.float32)
+    save_model(
+        store, LinearRegressor().fit(X, (1 + 0.5 * X).astype(np.float32)),
+        date(2026, 7, 1),
+    )
+    app = create_app(None)  # booted empty — checkpoint already existed
+    watcher = CheckpointWatcher(
+        app, store, poll_interval_s=3600, served_key=NOTHING_SERVED
+    )
+    assert watcher.check_once() is True
+    client = app.test_client()
+    assert client.post("/score/v1", json={"X": 50}).status_code == 200
+    assert client.get("/healthz").get_json()["model_date"] == "2026-07-01"
+
+
+def test_resilient_over_self_retrying_backend_has_one_retry_owner(monkeypatch):
+    """GCS already routes every op through the shared policy internally;
+    wrapping it in ResilientStore must add ONLY the breaker — not a
+    second retry loop multiplying attempt budgets and double-counting
+    the shared retries metric."""
+    from tests.helpers import install_fake_gcs
+
+    GCSStore = install_fake_gcs(monkeypatch)
+    gcs = GCSStore.from_url("gs://resilient-test/exp1")
+    store = ResilientStore(gcs)
+    assert store._policy.attempts == 1  # breaker-only wrapper
+    gcs.put_bytes("datasets/a.csv", b"x")
+    before = _retry_count("get_bytes", backend="gcs")
+    gcs._bucket.inject_failures("download", 1)
+    assert store.get_bytes("datasets/a.csv") == b"x"
+    # exactly one retry recorded, by the backend's own (only) loop
+    assert _retry_count("get_bytes", backend="gcs") == before + 1
+    assert store.breaker.state == "closed"
+
+    # ...but the shortcut applies only DIRECTLY over the backend: with
+    # the chaos injector in between, faults are raised ABOVE the
+    # backend's internal loop and only this layer can retry them
+    plan = FaultPlan(seed=1, store_transient_p=1.0, max_consecutive=2)
+    wrapped = ResilientStore(FaultInjectingStore(gcs, plan), policy=None)
+    assert wrapped._policy.attempts > 1
+    assert wrapped.get_bytes("datasets/a.csv") == b"x"  # fault absorbed
+
+
+def test_breaker_state_hook_may_read_breaker_without_deadlock():
+    """on_state_change fires OUTSIDE the breaker's lock: a hook that
+    reads .state (the natural alerting-callback shape) must not
+    deadlock the transition that invoked it."""
+    observed = []
+    breaker = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0)
+    breaker.on_state_change = lambda s: observed.append((s, breaker.state))
+    breaker.allow()
+    breaker.record_failure()  # would deadlock if fired under the lock
+    assert observed == [("open", "open")]
+
+
+def test_failed_hot_reload_flags_degraded_and_recovers(store):
+    """Degraded-mode serving: a failed reload keeps the last-good model
+    LIVE (200s, old model_date) while /healthz + the state gauge say
+    degraded; the next good checkpoint clears the flag."""
+    from bodywork_tpu.models import LinearRegressor, load_model, save_model
+    from bodywork_tpu.obs import get_registry
+    from bodywork_tpu.serve import CheckpointWatcher, create_app
+    from bodywork_tpu.store.schema import MODELS_PREFIX
+
+    rng = np.random.default_rng(3)
+    X = rng.uniform(0, 100, 200).astype(np.float32)
+    save_model(
+        store, LinearRegressor().fit(X, (1 + 0.5 * X).astype(np.float32)),
+        date(2026, 7, 1),
+    )
+    model, model_date = load_model(store)
+    app = create_app(model, model_date, buckets=(1,), warmup=False)
+    client = app.test_client()
+    watcher = CheckpointWatcher(app, store, poll_interval_s=3600)
+    gauge = get_registry().get("bodywork_tpu_serve_degraded_state")
+
+    store.put_bytes(f"{MODELS_PREFIX}/regressor-2026-07-02.npz", b"garbage")
+    assert watcher.check_once() is False
+    health = client.get("/healthz")
+    assert health.status_code == 200  # still serving == still ready
+    assert health.get_json()["degraded"] is True
+    assert "2026-07-02" in health.get_json()["reason"]
+    assert gauge.value() == 1.0
+    assert client.post("/score/v1", json={"X": 50}).status_code == 200
+
+    save_model(
+        store, LinearRegressor().fit(X, (1 + 2.0 * X).astype(np.float32)),
+        date(2026, 7, 3),
+    )
+    assert watcher.check_once() is True
+    health = client.get("/healthz").get_json()
+    assert health["degraded"] is False and health["model_date"] == "2026-07-03"
+    assert gauge.value() == 0.0
+
+
+# --- fail-fast stage retries (satellite) -----------------------------------
+
+
+def _count_attempt(ctx):
+    n = (
+        int(ctx.store.get_text("attempts"))
+        if ctx.store.exists("attempts")
+        else 0
+    ) + 1
+    ctx.store.put_text("attempts", str(n))
+    return n
+
+
+def _permanent_stage(ctx, **kwargs):
+    _count_attempt(ctx)
+    raise ValueError("bad hyperparameter")
+
+
+def _transient_then_ok_stage(ctx, **kwargs):
+    if _count_attempt(ctx) < 3:
+        raise TransientError("injected 503")
+    return "ok"
+
+
+def _wrapped_transient_stage(ctx, **kwargs):
+    from bodywork_tpu.utils.errors import StageError
+
+    if _count_attempt(ctx) < 2:
+        try:
+            raise ConnectionError("connection dropped")
+        except ConnectionError as exc:
+            raise StageError("s", "scoring request failed") from exc
+    return "ok"
+
+
+def _single_stage_spec(executable, retries=2):
+    from bodywork_tpu.pipeline.spec import PipelineSpec, StageSpec
+
+    stage = StageSpec(
+        name="s", kind="batch", executable=executable, retries=retries
+    )
+    return PipelineSpec(name="t", dag=[["s"]], stages={"s": stage})
+
+
+def test_permanent_stage_error_fails_fast(store):
+    """ValueError/TypeError/KeyError abort on attempt 1 instead of
+    burning every stage.retries attempt against the deadline."""
+    from bodywork_tpu.pipeline import LocalRunner
+    from bodywork_tpu.pipeline.runner import StageFailure
+
+    spec = _single_stage_spec("tests.test_chaos:_permanent_stage", retries=2)
+    with pytest.raises(StageFailure, match="bad hyperparameter"):
+        LocalRunner(spec, store).run_day(date(2026, 1, 1))
+    assert store.get_text("attempts") == "1"  # no retry burn
+
+
+def test_transient_stage_error_is_retried_to_success(store):
+    from bodywork_tpu.pipeline import LocalRunner
+
+    spec = _single_stage_spec(
+        "tests.test_chaos:_transient_then_ok_stage", retries=2
+    )
+    result = LocalRunner(spec, store).run_day(date(2026, 1, 1))
+    assert result.stage_results["s"] == "ok"
+    assert store.get_text("attempts") == "3"
+
+
+def test_stage_error_wrapping_transient_cause_is_retried(store):
+    """A StageError raised FROM a transient error classifies transient
+    (the cause chain wins), so it retries instead of failing fast."""
+    from bodywork_tpu.pipeline import LocalRunner
+
+    spec = _single_stage_spec(
+        "tests.test_chaos:_wrapped_transient_stage", retries=2
+    )
+    result = LocalRunner(spec, store).run_day(date(2026, 1, 1))
+    assert result.stage_results["s"] == "ok"
+    assert store.get_text("attempts") == "2"
+    # and the classification itself is pinned
+    try:
+        raise RuntimeError("wrapped") from ConnectionError("drop")
+    except RuntimeError as exc:
+        assert classify_error(exc) == "transient"
+    assert classify_error(ValueError("x")) == "permanent"
+    assert classify_error(RuntimeError("x")) == "unknown"
+
+
+# --- the quick soak (acceptance criterion) ---------------------------------
+
+
+def test_chaos_quick_soak_ten_days_byte_identical(tmp_path):
+    """ISSUE 4 acceptance: a 10-day run_simulation under a seeded fault
+    plan injecting transient store errors, latency, crash-after-partial-
+    write, and flaky scoring responses completes with final artefacts
+    byte-identical to the fault-free run, zero torn artefacts, and the
+    breaker/degraded/fault metrics visible in the registry snapshot."""
+    from bodywork_tpu.chaos import run_chaos_sim
+    from bodywork_tpu.data.drift_config import DriftConfig
+    from bodywork_tpu.obs import get_registry
+
+    plan = FaultPlan.default(seed=5)
+    summary = run_chaos_sim(
+        tmp_path / "soak", date(2026, 1, 1), 10, plan,
+        drift=DriftConfig(n_samples=120),  # smaller days, same pipeline
+    )
+    comparison = summary["comparison"]
+    assert comparison["mismatched"] == []
+    assert comparison["missing"] == [] and comparison["extra"] == []
+    assert comparison["torn"] == []
+    assert comparison["snapshot_ok"]
+    assert summary["ok"]
+    assert comparison["matched"] >= 40  # 10 days x 4 artefact families
+
+    # every required fault kind actually fired under this seed
+    faults = summary["faults_injected"]
+    for kind in ("transient", "latency", "torn_write"):
+        assert faults.get(f"kind={kind}", 0) > 0, (kind, faults)
+    assert (
+        faults.get("kind=http_503", 0) + faults.get("kind=http_429", 0) > 0
+    ), faults
+    # the resilience layer did real work
+    assert sum(
+        summary["retries"]["bodywork_tpu_store_retries_total"].values()
+    ) > 0
+    assert summary["breaker_state"] == "closed"
+
+    # breaker/degraded/fault metrics all visible in one registry snapshot
+    snapshot = get_registry().snapshot()
+    assert "bodywork_tpu_store_breaker_state" in snapshot
+    assert "bodywork_tpu_serve_degraded_state" in snapshot
+    assert "bodywork_tpu_chaos_faults_injected_total" in snapshot
+    assert "bodywork_tpu_store_retries_total" in snapshot
