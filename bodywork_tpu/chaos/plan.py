@@ -83,10 +83,16 @@ class FaultPlan:
     #: get_bytes: return truncated payload — only for keys under
     #: corrupt_prefixes, because corrupting a read whose consumer has no
     #: integrity check silently changes results instead of testing
-    #: recovery. The snapshot loader validates and falls back, so
-    #: ``snapshots/`` is the default (and currently only safe) target.
+    #: recovery. Safe defaults: the snapshot loader validates and falls
+    #: back (``snapshots/``), and registry readers validate the JSON
+    #: schema and re-read under the consecutive cap (``registry/`` —
+    #: records degrade to absent-with-counter past the budget, the alias
+    #: document raises and callers keep current state; see
+    #: ``registry/records.py``). The cap (default 2) below the registry
+    #: read budget (3 attempts) is what keeps chaos-run gate decisions
+    #: byte-identical to the fault-free twin's.
     corrupt_read_p: float = 0.0
-    corrupt_prefixes: tuple[str, ...] = ("snapshots/",)
+    corrupt_prefixes: tuple[str, ...] = ("snapshots/", "registry/")
     #: scoring service /score/v1* requests: answer 503 or 429 (split
     #: evenly, deterministically) with a Retry-After header
     http_error_p: float = 0.0

@@ -130,6 +130,10 @@ def compare_stores(baseline: ArtefactStore, chaos: ArtefactStore) -> dict:
             torn.extend(
                 str(p.relative_to(root))
                 for p in Path(root).rglob(".tmp-*")
+                # CAS sidecar locks are deliberately persistent (the
+                # flock protocol must never unlink them — filesystem.py
+                # _acquire_cas_lock), not abandoned write temp files
+                if not p.name.startswith(".tmp-lock.")
             )
     base_cov = _snapshot_coverage(baseline)
     chaos_cov = _snapshot_coverage(chaos)

@@ -19,8 +19,10 @@ state invisibly):
   error — the retry's full rewrite repairs it (and if every retry were
   exhausted, the final-artefact comparison would catch the torn bytes);
 - **corrupt reads** truncate the returned payload, only for key
-  prefixes whose consumers carry an integrity check (the snapshot
-  loader validates row counts and falls back — ``plan.corrupt_prefixes``);
+  prefixes whose consumers carry an integrity check
+  (``plan.corrupt_prefixes``: the snapshot loader validates row counts
+  and falls back; registry readers validate the JSON schema and re-read
+  under the consecutive cap — ``registry/records.py``);
 - **latency** sleeps briefly before the op;
 - ``version_token``/``version_tokens``/``exists`` get latency only:
   the token contract is "never raise".
@@ -66,6 +68,18 @@ class FaultInjectingStore(DelegatingStore):
                 f"injected crash after partial write of {key!r}"
             )
         self._inner.put_bytes(key, data)
+
+    def put_bytes_if_match(self, key: str, data: bytes, expected_token=None):
+        # transient-BEFORE faults only (no torn variant: the backend CAS
+        # is atomic — tmp+rename / if_generation_match — so there is no
+        # partial-payload state to simulate, and an applied-then-failed
+        # injection would surface as a CasConflict the filesystem
+        # backend cannot disambiguate, breaking the byte-identical
+        # soak). The resilience layer's retry absorbs these within the
+        # consecutive cap like any other op.
+        self.plan.store_latency("put_bytes_if_match", key)
+        self._maybe_fail("put_bytes_if_match", key)
+        return self._inner.put_bytes_if_match(key, data, expected_token)
 
     def get_bytes(self, key: str) -> bytes:
         self.plan.store_latency("get_bytes", key)

@@ -16,6 +16,7 @@ its own driver:
     python -m bodywork_tpu.cli compact   --store DIR [--dry-run]
     python -m bodywork_tpu.cli deploy    --out DIR [--store-path P] [--image I]
     python -m bodywork_tpu.cli chaos run-sim --store DIR --days N [--seed S] [--plan F]
+    python -m bodywork_tpu.cli registry list|show|promote|rollback|gate --store DIR ...
 
 Every command exits 0 on success and 1 with a logged error otherwise — the
 exit-code contract the reference implements per-script
@@ -569,6 +570,137 @@ def cmd_chaos_run_sim(args) -> int:
     return 1
 
 
+#: alias names `registry show` resolves (anything else must look like a
+#: model key or a date, or the command exits 1 with a clear message)
+_REGISTRY_ALIASES = ("production", "previous")
+
+
+def _registry_model_key(raw: str) -> str:
+    """Accept a full model key, a bare record basename, or a date."""
+    from bodywork_tpu.store.schema import MODELS_PREFIX
+
+    if raw.startswith(MODELS_PREFIX):
+        return raw
+    try:
+        return f"{MODELS_PREFIX}regressor-{parse_date(raw)}.npz"
+    except ValueError:
+        return f"{MODELS_PREFIX}{raw}"
+
+
+def cmd_registry_list(args) -> int:
+    from bodywork_tpu.registry import ModelRegistry, read_aliases
+
+    store = _store(args)
+    registry = ModelRegistry(store)
+    records = registry.records()
+    if not records:
+        print("no registry records")
+        return 0
+    aliases = read_aliases(store) or {}  # ONE validated read for both
+    production = aliases.get("production")
+    previous = aliases.get("previous")
+    print(f"{'MODEL KEY':<42} {'STATUS':<10} {'DATE':<10} ALIAS")
+    for record in records:
+        alias = (
+            "production" if record["model_key"] == production
+            else "previous" if record["model_key"] == previous
+            else ""
+        )
+        print(
+            f"{record['model_key']:<42} {record['status']:<10} "
+            f"{record.get('data_date') or '-':<10} {alias}"
+        )
+    return 0
+
+
+def cmd_registry_show(args) -> int:
+    import json as _json
+
+    from bodywork_tpu.registry import resolve_alias
+    from bodywork_tpu.registry.records import load_record
+
+    store = _store(args)
+    what = args.what
+    if what in _REGISTRY_ALIASES:
+        key = resolve_alias(store, what)
+        if key is None:
+            log.error(f"alias {what!r} is not set (no promotion yet?)")
+            return 1
+    elif what == "aliases":
+        from bodywork_tpu.registry import read_aliases
+
+        doc = read_aliases(store)
+        if doc is None:
+            log.error("no registry alias document")
+            return 1
+        print(_json.dumps(doc, indent=2, sort_keys=True))
+        return 0
+    elif "/" not in what and "." not in what and not any(
+        c.isdigit() for c in what
+    ):
+        # looks like a (mistyped) alias name, not a key or date — say so
+        # instead of fabricating a models/ key that can never exist
+        log.error(
+            f"unknown alias {what!r}; known aliases: "
+            f"{', '.join(_REGISTRY_ALIASES)} (or pass a model key/date)"
+        )
+        return 1
+    else:
+        key = _registry_model_key(what)
+    record = load_record(store, key)
+    if record is None:
+        log.error(f"no registry record for {key!r}")
+        return 1
+    print(_json.dumps(record, indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_registry_promote(args) -> int:
+    from bodywork_tpu.registry import ModelRegistry
+
+    store = _store(args)
+    key = _registry_model_key(args.model)
+    doc = ModelRegistry(store).promote(
+        key, day=_date(args), reason="cli: operator promote"
+    )
+    print(f"production -> {doc['production']} (previous: {doc['previous']})")
+    return 0
+
+
+def cmd_registry_rollback(args) -> int:
+    from bodywork_tpu.registry import ModelRegistry
+
+    doc = ModelRegistry(_store(args)).rollback(
+        day=_date(args), reason="cli: operator rollback"
+    )
+    print(f"production -> {doc['production']} (previous: {doc['previous']})")
+    return 0
+
+
+def cmd_registry_gate(args) -> int:
+    from bodywork_tpu.registry import GatePolicy, ModelRegistry
+
+    store = _store(args)
+    policy = GatePolicy()
+    if args.shadow_days is not None:
+        policy.shadow_days = args.shadow_days
+    registry = ModelRegistry(store, policy=policy)
+    key = _registry_model_key(args.model) if args.model else None
+    decision = registry.gate(
+        day=_date(args), model_key=key, dry_run=args.dry_run
+    )
+    if decision is None:
+        print("no candidate to gate")
+        return 0
+    verdict = "PROMOTE" if decision.promote else "REJECT"
+    prefix = "dry-run: would " if args.dry_run else ""
+    print(f"{prefix}{verdict} {decision.model_key}")
+    for check in decision.checks:
+        print(f"  [{'ok' if check['ok'] else 'FAIL'}] "
+              f"{check['name']}: {check['detail']}")
+    return 0
+
+
 def cmd_deploy(args) -> int:
     from bodywork_tpu.pipeline import write_manifests
 
@@ -877,6 +1009,73 @@ def build_parser() -> argparse.ArgumentParser:
                         "soaks (default: the full reference-parity 1440)")
     p.add_argument("--model", default="linear", choices=["linear", "mlp"])
     p.add_argument("--mode", default="batch", choices=["single", "batch"])
+
+    p = sub.add_parser(
+        "registry",
+        help="model registry: gated promotion, shadow eval, rollback "
+             "(docs/REGISTRY.md)",
+    )
+    registry_sub = p.add_subparsers(dest="registry_command", required=True)
+
+    p = registry_sub.add_parser("list", help="list registry records + aliases")
+    p.set_defaults(fn=cmd_registry_list)
+    p.add_argument("--store", **common_store)
+
+    p = registry_sub.add_parser(
+        "show",
+        help="show one record (by model key or date) or resolve an alias "
+             "(production/previous) or dump the alias doc (aliases)",
+    )
+    p.set_defaults(fn=cmd_registry_show)
+    p.add_argument("--store", **common_store)
+    p.add_argument("what",
+                   help="model key, date, 'production', 'previous', or "
+                        "'aliases'")
+
+    p = registry_sub.add_parser(
+        "promote",
+        help="point the production alias at a registered model (one CAS; "
+             "old production becomes 'previous')",
+    )
+    p.set_defaults(fn=cmd_registry_promote)
+    p.add_argument("--store", **common_store)
+    p.add_argument("--model", required=True,
+                   help="model key or date to promote")
+    p.add_argument("--date", default=None,
+                   help="day to stamp the promotion events with "
+                        "(YYYY-MM-DD; default today)")
+
+    p = registry_sub.add_parser(
+        "rollback",
+        help="ONE operation back to the previous production (a single "
+             "alias CAS flip; the checkpoint watcher swaps on next poll)",
+    )
+    p.set_defaults(fn=cmd_registry_rollback)
+    p.add_argument("--store", **common_store)
+    p.add_argument("--date", default=None,
+                   help="day to stamp the rollback events with "
+                        "(YYYY-MM-DD; default today)")
+
+    p = registry_sub.add_parser(
+        "gate",
+        help="adjudicate the newest candidate (promote or reject) — the "
+             "step run-day runs between train and serve",
+    )
+    p.set_defaults(fn=cmd_registry_gate)
+    p.add_argument("--store", **common_store)
+    p.add_argument("--model", default=None,
+                   help="candidate to gate (default: newest record in "
+                        "candidate status)")
+    p.add_argument("--date", default=None,
+                   help="day to stamp decision events with (YYYY-MM-DD)")
+    p.add_argument("--dry-run", action="store_true",
+                   help="evaluate and print the decision WITHOUT writing "
+                        "anything (no events, no status move, no alias CAS)")
+    p.add_argument("--shadow-days", type=_positive_int, default=None,
+                   metavar="K",
+                   help="also shadow-evaluate the candidate against "
+                        "production over the last K dataset days "
+                        "(in-process, no live traffic; default off)")
 
     p = add("deploy", cmd_deploy, help="write GKE TPU manifests")
     p.add_argument("--spec", default=None, help="pipeline spec YAML (overrides --model/--mode)")

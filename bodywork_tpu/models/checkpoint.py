@@ -98,24 +98,80 @@ def load_model_bytes(data: bytes, device: bool = True):
     return model
 
 
-def save_model(store: ArtefactStore, model, artefact_date: date) -> str:
+def save_model(
+    store: ArtefactStore, model, artefact_date: date,
+    data: bytes | None = None,
+) -> str:
     """Persist a fitted model under ``models/regressor-<date>.npz``
-    (reference ``stage_1:111-125``)."""
+    (reference ``stage_1:111-125``). ``data`` lets a caller that also
+    needs the serialised bytes (e.g. the registry's lineage digest)
+    serialise once instead of paying the params host-transfer + npz
+    encode twice."""
     key = model_key(artefact_date)
-    store.put_bytes(key, save_model_bytes(model))
+    store.put_bytes(key, data if data is not None else save_model_bytes(model))
     log.info(f"persisted {model.info} to {key}")
     return key
 
 
+def resolve_serving_key(store: ArtefactStore) -> tuple[str, str]:
+    """The (key, source) serving should load with no explicit key:
+
+    - a store with an ACTIVE registry (an alias document exists —
+      ``bodywork_tpu.registry``) resolves the ``production`` alias, so
+      only gate-promoted checkpoints ever take traffic; ``source`` is
+      ``"production"``;
+    - otherwise the newest date-keyed checkpoint under ``models/`` that
+      the gate has not REJECTED — a bootstrapping store whose very
+      first candidate failed the gate (records exist, no promotion yet)
+      must not serve it through the fallback; a checkpoint with no
+      record, or one still in ``candidate`` status, serves exactly as
+      today (registry-less stores are byte-identical: with no records
+      the record probe is one empty listing); ``source`` is
+      ``"latest"``. No serviceable checkpoint raises
+      :class:`~bodywork_tpu.store.base.ArtefactNotFound` (the degraded
+      -boot path).
+
+    A corrupt alias document raises
+    (:class:`bodywork_tpu.registry.records.RegistryCorrupt`) rather
+    than silently degrading to the ungated fallback.
+    """
+    from bodywork_tpu.registry.records import load_record, resolve_alias
+    from bodywork_tpu.store.base import ArtefactNotFound
+    from bodywork_tpu.store.schema import REGISTRY_RECORDS_PREFIX
+
+    key = resolve_alias(store, "production")
+    if key is not None:
+        return key, "production"
+    hist = store.history(MODELS_PREFIX)
+    if not hist:
+        raise ArtefactNotFound(f"no date-keyed artefacts under '{MODELS_PREFIX}'")
+    if not store.list_keys(REGISTRY_RECORDS_PREFIX):
+        return hist[-1][0], "latest"  # registry-less: today's behavior
+    for candidate_key, _d in reversed(hist):
+        record = load_record(store, candidate_key)
+        if record is not None and record.get("status") == "rejected":
+            log.info(
+                f"skipping gate-rejected checkpoint {candidate_key} in "
+                "latest-fallback resolution"
+            )
+            continue
+        return candidate_key, "latest"
+    raise ArtefactNotFound(
+        f"every checkpoint under '{MODELS_PREFIX}' was gate-rejected "
+        "and none was ever promoted"
+    )
+
+
 def load_model(store: ArtefactStore, key: str | None = None, device: bool = True):
-    """Load a model by key, or the latest under ``models/`` if key is None
-    (reference ``stage_2:46-70``). Returns (model, artefact_date)."""
+    """Load a model by key; with ``key=None``, resolve the registry's
+    ``production`` alias when one exists and fall back to the latest
+    under ``models/`` on a registry-less store (reference
+    ``stage_2:46-70``). Returns (model, artefact_date)."""
     from bodywork_tpu.utils.dates import date_from_key
 
     if key is None:
-        key, d = store.latest(MODELS_PREFIX)
-    else:
-        d = date_from_key(key)
+        key, _source = resolve_serving_key(store)
+    d = date_from_key(key)
     model = load_model_bytes(store.get_bytes(key), device=device)
     log.info(f"loaded {model.info} from {key} (trained {d})")
     return model, d

@@ -572,13 +572,19 @@ def generate_manifests(
         )
     elif daily_schedule:
         first_stage = next(iter(spec.stages.values()))
-        # run-day executes ALL four stages in-process, so its pod needs
-        # every stage's import closure: it must run the PIPELINE-WIDE
-        # image, never a per-stage image whose pins cover only stage-1
-        # (a stage-1 image lacks e.g. werkzeug and the deployed loop
-        # would crash at stage-2 with ModuleNotFoundError). Keep
-        # stage-1's TPU resources — run-day trains on-device — but drop
-        # the image/requirements overrides and use an honest name.
+        # run-day executes ALL four stages in-process — plus the model-
+        # registry promotion gate between train and serve (runner.py
+        # _run_registry_gate: the daily CronJob is therefore the k8s
+        # materialisation of the gate too; a rejected retrain never
+        # moves the production alias, and `cli registry rollback`
+        # against the same store is the one-op recovery path) — so its
+        # pod needs every stage's import closure: it must run the
+        # PIPELINE-WIDE image, never a per-stage image whose pins cover
+        # only stage-1 (a stage-1 image lacks e.g. werkzeug and the
+        # deployed loop would crash at stage-2 with
+        # ModuleNotFoundError). Keep stage-1's TPU resources — run-day
+        # trains on-device — but drop the image/requirements overrides
+        # and use an honest name.
         run_day_stage = dataclasses.replace(
             first_stage, name="daily-loop", image=None, requirements=[],
         )

@@ -280,12 +280,60 @@ class LocalRunner:
             ctx.failures[stage_name] = exc
             return
         stage_seconds[stage_name] = time.perf_counter() - t0
+        extra = {}
+        if stage.kind == "service":
+            # the serve span records WHAT went live and under whose
+            # authority (registry production vs latest-checkpoint
+            # fallback) — the trace answers "which model served this
+            # day" without correlating against the store
+            apps = getattr(result, "replica_apps", None)
+            app = apps[0] if apps else getattr(result, "app", None)
+            served_key = getattr(app, "model_key", None)
+            if served_key is not None:
+                extra["served_key"] = served_key
+                extra["model_source"] = getattr(app, "model_source", None)
         self.recorder.add(stage_name, "stage", start_rel,
-                          stage_seconds[stage_name], day=str(today))
+                          stage_seconds[stage_name], day=str(today), **extra)
         stage_results[stage_name] = result
         log.info(
             f"[{today}] {stage_name} done in {stage_seconds[stage_name]:.3f}s"
         )
+
+    def _run_registry_gate(self, today: date, stage_results: dict) -> None:
+        """The promotion-gate step between train and serve
+        (``bodywork_tpu.registry``): adjudicate the candidate the train
+        step just registered — promote it to the ``production`` alias or
+        reject it — BEFORE the serve step resolves what to load, so a
+        bad retrain never takes traffic. Runner-internal, so it rides
+        the day report as its own ``gate``-category span (plus the
+        decision in ``stage_results``) rather than an entry in
+        ``stage_seconds``, which stays exactly the user's DECLARED DAG.
+        No retries; a gate FAILURE (as opposed to a rejection) only
+        logs — serving then keeps the current production (or the
+        latest-checkpoint fallback on a store that has never promoted)."""
+        start_rel = self.recorder.now()
+        t0 = time.perf_counter()
+        failed = False
+        verdict = None
+        try:
+            from bodywork_tpu.registry import ModelRegistry
+
+            decision = ModelRegistry(self.store).gate(day=today)
+            stage_results["registry-gate"] = decision
+            if decision is not None:
+                verdict = "promoted" if decision.promote else "rejected"
+                log.info(
+                    f"[{today}] registry gate: {verdict.upper()} "
+                    f"{decision.model_key}"
+                )
+        except Exception as exc:
+            failed = True
+            log.error(f"registry gate failed (non-fatal): {exc!r}")
+        extra = {"verdict": verdict} if verdict else {}
+        if failed:
+            extra["failed"] = True
+        self.recorder.add("registry-gate", "gate", start_rel,
+                          time.perf_counter() - t0, day=str(today), **extra)
 
     def _generate_offsets(self) -> list[int]:
         return [
@@ -440,6 +488,29 @@ class LocalRunner:
             for name, s in self.spec.stages.items()
             if s.executable.endswith(":generate_stage")
         }
+        train_stages = {
+            name
+            for name, s in self.spec.stages.items()
+            if s.executable.endswith(":train_stage")
+        }
+        gate_pending = bool(train_stages)
+        if gate_pending and any(
+            set(step) & train_stages
+            and any(self.spec.stages[n].kind == "service" for n in step)
+            for step in self.spec.dag
+        ):
+            # the gate fires at the step BARRIER after train completes;
+            # a spec co-locating train and a service stage in one step
+            # makes the service resolve its model before this day's
+            # candidate is adjudicated — say so rather than silently
+            # weakening the "a bad retrain never takes traffic" contract
+            log.warning(
+                "pipeline spec places a train stage and a service stage "
+                "in the same DAG step: the registry gate runs at the "
+                "step boundary, so the service resolves its model "
+                "BEFORE today's candidate is gated (it serves the "
+                "previous production / latest until the next reload poll)"
+            )
         stage_seconds: dict[str, float] = {}
         stage_results = ctx.stage_results
         span_mark = self.recorder.mark()
@@ -470,6 +541,13 @@ class LocalRunner:
                     failed = [n for n in step if n in ctx.failures]
                     if failed:
                         raise ctx.failures[failed[0]]
+                # the registry gate sits BETWEEN train and serve: as soon
+                # as every train stage has registered its candidate (and
+                # before any later step resolves what to serve), the gate
+                # promotes or rejects it
+                if gate_pending and train_stages <= set(stage_results):
+                    self._run_registry_gate(today, stage_results)
+                    gate_pending = False
                 # tomorrow's training set is complete once every generate
                 # stage has persisted: overlap tomorrow's train with the
                 # rest of today (typically the test stage)

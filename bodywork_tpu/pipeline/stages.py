@@ -201,15 +201,20 @@ def serve_stage(
     from bodywork_tpu.models.checkpoint import load_model
     from bodywork_tpu.serve import ServiceHandle, create_app
 
-    # Load the artefact WITHOUT the host->device transfer first: if the
-    # in-process train stage produced this exact checkpoint this day, its
-    # params are already resident in HBM — verify the artefact bytes match
-    # the in-memory copy and reuse it, saving the re-upload round-trip.
-    # (The artefact is still read and remains the source of truth: any
-    # mismatch falls back to serving exactly what the store holds.)
-    from bodywork_tpu.store.schema import MODELS_PREFIX as _MODELS_PREFIX
+    # Resolve WHAT to serve through the registry when one exists (the
+    # production alias — only gate-promoted checkpoints take traffic;
+    # bodywork_tpu.registry), falling back to the newest date-keyed
+    # checkpoint on a registry-less store (original behavior,
+    # byte-identical). Load the artefact WITHOUT the host->device
+    # transfer first: if the in-process train stage produced this exact
+    # checkpoint this day, its params are already resident in HBM —
+    # verify the artefact bytes match the in-memory copy and reuse it,
+    # saving the re-upload round-trip. (The artefact is still read and
+    # remains the source of truth: any mismatch falls back to serving
+    # exactly what the store holds.)
+    from bodywork_tpu.models.checkpoint import resolve_serving_key
 
-    served_key, _ = ctx.store.latest(_MODELS_PREFIX)
+    served_key, served_source = resolve_serving_key(ctx.store)
     model, model_date = load_model(ctx.store, served_key, device=False)
     reused = False
     # snapshot: concurrent step siblings may insert results mid-iteration
@@ -244,6 +249,8 @@ def serve_stage(
             model_date,
             buckets=tuple(buckets) if buckets else None,
             predictor=predictor,
+            model_key=served_key,
+            model_source=served_source,
         )
         for _ in range(max(replicas, 1))
     ]

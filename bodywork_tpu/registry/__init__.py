@@ -1,0 +1,36 @@
+"""Model registry: gated promotion, shadow evaluation, one-op rollback.
+
+The release-management layer between training and serving (docs/REGISTRY.md):
+training registers candidates, the gate engine promotes or rejects them,
+serving resolves the ``production`` alias, and rollback is one
+compare-and-swap flip back to ``previous``.
+"""
+from bodywork_tpu.registry.gates import GateDecision, GatePolicy, evaluate_candidate
+from bodywork_tpu.registry.manager import (
+    ModelRegistry,
+    PromotionConflict,
+    RegistryError,
+)
+from bodywork_tpu.registry.records import (
+    RegistryCorrupt,
+    read_aliases,
+    register_candidate,
+    registry_exists,
+    resolve_alias,
+)
+from bodywork_tpu.registry.shadow import shadow_evaluate
+
+__all__ = [
+    "GateDecision",
+    "GatePolicy",
+    "ModelRegistry",
+    "PromotionConflict",
+    "RegistryCorrupt",
+    "RegistryError",
+    "evaluate_candidate",
+    "read_aliases",
+    "register_candidate",
+    "registry_exists",
+    "resolve_alias",
+    "shadow_evaluate",
+]

@@ -1,0 +1,321 @@
+"""Registry operations: register / gate / promote / rollback / demote.
+
+:class:`ModelRegistry` is the one mutation surface over the record and
+alias artefacts (:mod:`bodywork_tpu.registry.records`). Every alias
+mutation is ONE compare-and-swap of the alias document against the
+token it was read under — two concurrent promoters cannot interleave:
+the loser's CAS fails with a clean :class:`PromotionConflict` and the
+document never holds a half-updated state. Rollback is the same single
+CAS flipping ``production`` <-> ``previous`` — one operation, no
+artefact copying, no deletion.
+
+Record updates (status moves, decision events) happen AFTER the alias
+CAS lands: records are the audit trail, the alias is the truth, and a
+crash between the two leaves serving correct with a repairable ledger —
+never the reverse.
+
+Operations emit metrics:
+``bodywork_tpu_registry_promotions_total{outcome=promoted|rejected|conflict}``
+and ``bodywork_tpu_registry_rollbacks_total``.
+"""
+from __future__ import annotations
+
+from datetime import date
+
+from bodywork_tpu.registry import records as rec
+from bodywork_tpu.registry.gates import GateDecision, GatePolicy, evaluate_candidate
+from bodywork_tpu.store.base import ArtefactStore, CasConflict
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("registry.manager")
+
+
+class RegistryError(RuntimeError):
+    """A registry operation could not be applied (unknown model, nothing
+    to roll back to, …) — a clean operator-facing error, not a crash."""
+
+
+class PromotionConflict(RegistryError):
+    """Another promoter's alias write landed first. The alias is intact
+    (the CAS lost cleanly); re-read and retry if still relevant."""
+
+
+def _count_promotion(outcome: str) -> None:
+    from bodywork_tpu.obs import get_registry
+
+    get_registry().counter(
+        "bodywork_tpu_registry_promotions_total",
+        "Registry promotion gate outcomes",
+    ).inc(outcome=outcome)
+
+
+def _count_rollback() -> None:
+    from bodywork_tpu.obs import get_registry
+
+    get_registry().counter(
+        "bodywork_tpu_registry_rollbacks_total",
+        "Registry rollbacks (production alias flipped back to previous)",
+    ).inc()
+
+
+class ModelRegistry:
+    def __init__(self, store: ArtefactStore, policy: GatePolicy | None = None):
+        self.store = store
+        self.policy = policy or GatePolicy()
+
+    # -- reads -------------------------------------------------------------
+
+    def resolve(self, alias: str = "production") -> str | None:
+        return rec.resolve_alias(self.store, alias)
+
+    def records(self) -> list[dict]:
+        return rec.list_records(self.store)
+
+    def newest_candidate(self) -> dict | None:
+        """The most recent record still in ``candidate`` status (date-key
+        order — the thing the daily gate step adjudicates). Walks
+        records NEWEST-first, loading lazily, and stops at the first
+        ``production``/``archived`` record: candidates predating the
+        current production are stale history the gate would never pick,
+        so the daily gate reads O(1-2) records, not O(models-ever-
+        trained) — that scan would grow by one store GET per day,
+        forever."""
+        from bodywork_tpu.store.schema import REGISTRY_RECORDS_PREFIX
+
+        for key, _d in reversed(self.store.history(REGISTRY_RECORDS_PREFIX)):
+            record = rec._validated_read(
+                self.store, key, rec.RECORD_SCHEMA, "record"
+            )
+            if record is None:
+                continue  # corrupt past budget: counted + flagged
+            status = record.get("status")
+            if status == "candidate":
+                return record
+            if status in ("production", "archived"):
+                return None
+        return None
+
+    def production_record(self) -> dict | None:
+        key = self.resolve("production")
+        return rec.load_record(self.store, key) if key else None
+
+    # -- mutations ---------------------------------------------------------
+
+    def register(
+        self,
+        model_key: str,
+        metrics_key: str | None = None,
+        day: date | None = None,
+    ) -> dict:
+        return rec.register_candidate(
+            self.store, model_key, metrics_key=metrics_key, day=day
+        )
+
+    def promote(
+        self,
+        model_key: str,
+        day: date | None = None,
+        reason: str = "promoted",
+    ) -> dict:
+        """Point ``production`` at ``model_key`` (one alias CAS; the old
+        production becomes ``previous``). The model must be registered —
+        promotion of an unknown checkpoint is refused, that is the whole
+        point of the registry. Returns the new alias document."""
+        record = rec.load_record(self.store, model_key)
+        if record is None:
+            raise RegistryError(
+                f"cannot promote unregistered model {model_key!r}; "
+                "register it first"
+            )
+        doc, token = rec.read_aliases(self.store, with_token=True)
+        old_production = doc.get("production") if doc else None
+        if old_production == model_key:
+            # alias already points here — but REPAIR a ledger that
+            # disagrees (e.g. a crash between a past alias CAS and its
+            # record update, or a same-key re-register): the aliased
+            # model's record must read "production"
+            if record.get("status") != "production":
+                rec.append_event(
+                    self.store, model_key,
+                    {"event": "promoted", "day": str(day) if day else None,
+                     "reason": "repair: alias already points here"},
+                    status="production",
+                )
+            log.info(f"{model_key} is already production; no-op")
+            return doc
+        new_doc = {
+            "schema": rec.ALIAS_SCHEMA,
+            "production": model_key,
+            "previous": old_production,
+            "rev": (doc.get("rev", 0) + 1) if doc else 1,
+            "updated_day": str(day) if day else None,
+            "last_op": "promote",
+        }
+        try:
+            rec.write_aliases(self.store, new_doc, token)
+        except CasConflict as exc:
+            _count_promotion("conflict")
+            raise PromotionConflict(
+                f"promotion of {model_key!r} lost the alias race: {exc}"
+            ) from exc
+        event_day = str(day) if day else None
+        rec.append_event(
+            self.store, model_key,
+            {"event": "promoted", "day": event_day, "reason": reason,
+             "replaced": old_production},
+            status="production",
+        )
+        if old_production and old_production != model_key:
+            rec.append_event(
+                self.store, old_production,
+                {"event": "superseded", "day": event_day,
+                 "by": model_key},
+                status="archived",
+            )
+        _count_promotion("promoted")
+        log.info(
+            f"promoted {model_key} to production "
+            f"(previous: {old_production or 'none'})"
+        )
+        return new_doc
+
+    def rollback(self, day: date | None = None, reason: str = "rollback") -> dict:
+        """ONE operation back to the previous production: a single CAS
+        flipping the alias document's ``production`` <-> ``previous``.
+        No artefacts move; the checkpoint watcher's next poll swaps the
+        restored model back in."""
+        doc, token = rec.read_aliases(self.store, with_token=True)
+        if doc is None:
+            raise RegistryError("no registry alias document; nothing to roll back")
+        current, previous = doc.get("production"), doc.get("previous")
+        if not previous:
+            raise RegistryError(
+                "no previous production recorded; nothing to roll back to"
+            )
+        new_doc = {
+            "schema": rec.ALIAS_SCHEMA,
+            "production": previous,
+            "previous": current,
+            "rev": doc.get("rev", 0) + 1,
+            "updated_day": str(day) if day else None,
+            "last_op": "rollback",
+        }
+        try:
+            rec.write_aliases(self.store, new_doc, token)
+        except CasConflict as exc:
+            raise PromotionConflict(
+                f"rollback lost the alias race: {exc}"
+            ) from exc
+        event_day = str(day) if day else None
+        rec.append_event(
+            self.store, previous,
+            {"event": "restored", "day": event_day, "reason": reason},
+            status="production",
+        )
+        if current:
+            rec.append_event(
+                self.store, current,
+                {"event": "rolled_back", "day": event_day, "reason": reason},
+                status="rejected",
+            )
+        _count_rollback()
+        log.info(f"rolled back production {current} -> {previous}")
+        return new_doc
+
+    def demote(
+        self,
+        model_key: str,
+        day: date | None = None,
+        reason: str = "demoted",
+    ) -> dict:
+        """Mark a non-production record ``rejected`` (a bad candidate an
+        operator retires by hand). Demoting PRODUCTION is refused —
+        that is what :meth:`rollback` is for (it also decides what
+        serves next; demote must not leave the alias dangling)."""
+        if self.resolve("production") == model_key:
+            raise RegistryError(
+                f"{model_key!r} is production; use rollback instead of demote"
+            )
+        record = rec.append_event(
+            self.store, model_key,
+            {"event": "demoted", "day": str(day) if day else None,
+             "reason": reason},
+            status="rejected",
+        )
+        if record is None:
+            raise RegistryError(f"no registry record for {model_key!r}")
+        return record
+
+    # -- the gate ----------------------------------------------------------
+
+    def gate(
+        self,
+        day: date | None = None,
+        model_key: str | None = None,
+        policy: GatePolicy | None = None,
+        dry_run: bool = False,
+    ) -> GateDecision | None:
+        """Adjudicate one candidate (named, or the newest in
+        ``candidate`` status): evaluate the policy, then promote or
+        reject. Returns the decision, or None when there is nothing to
+        gate. ``dry_run`` evaluates and returns WITHOUT writing
+        anything — no decision event, no status move, no alias CAS.
+
+        Bootstrap: with no production yet, a candidate passing the
+        absolute checks is promoted directly — the gate cannot compare
+        against a production that does not exist, and a registry with
+        an empty alias gates nothing."""
+        policy = policy or self.policy
+        if model_key is not None:
+            if self.resolve("production") == model_key:
+                # rejecting here would flip the SERVING model's record to
+                # ``rejected`` while the alias keeps serving it — the
+                # ledger disowning production. Retiring production is what
+                # rollback is for (it also decides what serves next).
+                raise RegistryError(
+                    f"{model_key!r} is production; the gate adjudicates "
+                    "candidates — use rollback to retire production"
+                )
+            candidate = rec.load_record(self.store, model_key)
+            if candidate is None:
+                raise RegistryError(f"no registry record for {model_key!r}")
+        else:
+            candidate = self.newest_candidate()
+            if candidate is None:
+                return None
+        production = self.production_record()
+        decision = evaluate_candidate(
+            self.store, candidate, production, policy=policy, day=day
+        )
+        if dry_run:
+            return decision
+        if decision.promote:
+            rec.append_event(
+                self.store, candidate["model_key"], decision.to_event()
+            )
+            self.promote(
+                candidate["model_key"], day=day, reason="gate: passed"
+            )
+        else:
+            # one CAS read-modify-write carries both the decision event
+            # (promote=false + reasons) and the status move
+            written = rec.append_event(
+                self.store, candidate["model_key"], decision.to_event(),
+                status="rejected",
+            )
+            if written is None:
+                # the record vanished or reads corrupt past the repair
+                # budget since we loaded it: the rejection did NOT stick,
+                # and without status='rejected' the latest-checkpoint
+                # fallback still treats this checkpoint as serveable
+                log.error(
+                    f"gate rejection of {candidate['model_key']} could not "
+                    "be recorded (record unreadable); the checkpoint stays "
+                    "a fallback candidate until its record is repaired"
+                )
+            _count_promotion("rejected")
+            log.warning(
+                f"gate REJECTED {candidate['model_key']}: "
+                f"{'; '.join(decision.reasons) or 'policy'}"
+            )
+        return decision

@@ -1,0 +1,368 @@
+"""Registry records and the alias document (the release ledger).
+
+The registry is a release-management layer BETWEEN training and serving:
+training registers each checkpoint as a *candidate* record; the gate
+engine (:mod:`bodywork_tpu.registry.gates`) decides promotion; serving
+(:func:`bodywork_tpu.models.checkpoint.load_model`,
+:class:`bodywork_tpu.serve.reload.CheckpointWatcher`) resolves the
+``production`` alias instead of blindly following the newest key under
+``models/``. Two artefact shapes, both plain JSON on the artefact store:
+
+- **Per-model records** under ``registry/records/`` — one date-keyed
+  document per checkpoint carrying lineage (model key, content digest,
+  dataset-day coverage, metrics key), a status
+  (``candidate``/``production``/``rejected``/``archived``) and an
+  append-only ``history`` of events (register, gate decisions,
+  promote/rollback/demote). Records are the audit trail; the serving
+  path never requires them.
+- **The alias document** ``registry/aliases.json`` — the single
+  authoritative mapping of ``production``/``previous`` to model keys.
+  It is mutated EXCLUSIVELY through the store's compare-and-swap
+  primitive (``ArtefactStore.put_bytes_if_match``), so two concurrent
+  promoters cannot clobber each other: exactly one wins, the loser gets
+  a clean :class:`~bodywork_tpu.store.base.CasConflict`, and the
+  document never tears. A guard test pins that no code path issues a
+  raw ``put_bytes`` against the alias key.
+
+Determinism: records carry NO wall-clock timestamps — events are
+stamped with the *simulated* day and the lineage token is a content
+digest (sha256 of the checkpoint bytes), not a backend version token —
+so the chaos harness's byte-identical final-artefact guarantee
+(docs/RESILIENCE.md) extends over ``registry/``.
+
+Corrupt-read handling: every read validates the JSON schema. A corrupt
+payload is retried a bounded number of times (under the chaos plan's
+``max_consecutive`` cap a retried read is guaranteed clean, which keeps
+chaos runs deterministic); a record still unreadable after the budget is
+treated as ABSENT, counted on
+``bodywork_tpu_registry_corrupt_records_total`` and flagged
+``repair_needed`` on the store's registry state cache — the same
+recover-and-flag shape the snapshot loader uses. The ALIAS document is
+stricter: treating a corrupt alias as absent could silently revert
+serving to the ungated latest-checkpoint fallback, so alias readers
+raise :class:`RegistryCorrupt` instead and callers keep their current
+state.
+
+Stdlib-only on purpose: the serving hot path (checkpoint watcher) and
+every stage pod resolve through this module, so it must not widen any
+stage's pinned dependency closure.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from datetime import date
+
+from bodywork_tpu.store.base import ArtefactStore
+from bodywork_tpu.store.schema import (
+    DATASETS_PREFIX,
+    REGISTRY_ALIAS_KEY,
+    REGISTRY_RECORDS_PREFIX,
+    model_metrics_key,
+    registry_record_key,
+)
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("registry.records")
+
+RECORD_SCHEMA = "bodywork_tpu.registry_record/1"
+ALIAS_SCHEMA = "bodywork_tpu.registry_aliases/1"
+
+#: the status state machine a record moves through
+STATUSES = ("candidate", "production", "rejected", "archived")
+
+#: validation-read retry budget: 1 + CORRUPT_READ_RETRIES attempts.
+#: Chosen to exceed the chaos plan's default ``max_consecutive`` cap of
+#: 2, so a seeded soak's corrupt reads NEVER escalate to record-absent
+#: (which would make gate decisions diverge from the fault-free twin).
+CORRUPT_READ_RETRIES = 2
+
+
+class RegistryCorrupt(RuntimeError):
+    """The alias document failed validation on every read attempt.
+    Callers must keep their current state (a watcher keeps serving what
+    it serves) — falling back to latest-checkpoint here would put an
+    ungated model live."""
+
+
+def _count_corrupt(kind: str) -> None:
+    from bodywork_tpu.obs import get_registry
+
+    get_registry().counter(
+        "bodywork_tpu_registry_corrupt_records_total",
+        "Registry reads that failed JSON/schema validation, by kind",
+    ).inc(kind=kind)
+
+
+def _flag_repair(store: ArtefactStore) -> None:
+    # same shape as the snapshot loader's repair flag: a maintenance
+    # pass (or the next register/promote rewrite) can act on it
+    store.mutable_cache("_registry_state")["repair_needed"] = True
+
+
+def _validated_read(
+    store: ArtefactStore, key: str, schema: str, kind: str
+) -> dict | None:
+    """Read + validate a registry JSON document. Returns None when the
+    key is absent, or when it stays corrupt past the retry budget (the
+    caller decides whether absent-on-corrupt is safe — the alias reader
+    does NOT accept it). Every corrupt attempt is counted."""
+    from bodywork_tpu.store.base import ArtefactNotFound
+
+    corrupt = False
+    for _attempt in range(1 + CORRUPT_READ_RETRIES):
+        try:
+            raw = store.get_bytes(key)
+        except ArtefactNotFound:
+            return None
+        try:
+            doc = json.loads(raw.decode("utf-8"))
+            if isinstance(doc, dict) and doc.get("schema") == schema:
+                return doc
+        except (UnicodeDecodeError, ValueError):
+            pass
+        corrupt = True
+        _count_corrupt(kind)
+        log.warning(f"corrupt registry document at {key!r}; re-reading")
+    if corrupt:
+        _flag_repair(store)
+    return None
+
+
+# -- per-model records -----------------------------------------------------
+
+
+def load_record(
+    store: ArtefactStore, model_key: str, with_token: bool = False
+):
+    """The registry record for ``model_key``, or None (absent, or corrupt
+    past the retry budget — treated as absent, counted, flagged).
+    ``with_token=True`` returns ``(record_or_None, version_token)`` with
+    the token read BEFORE the payload, so a CAS against it can only win
+    if nothing changed since; a ``(None, token)`` pair means the key
+    EXISTS but is corrupt — the CAS repair-overwrite case."""
+    key = registry_record_key(model_key)
+    token = store.version_token(key) if with_token else None
+    doc = _validated_read(store, key, RECORD_SCHEMA, "record")
+    return (doc, token) if with_token else doc
+
+
+def put_record(store: ArtefactStore, record: dict, expected_token) -> str:
+    """Write one record through the SAME CAS primitive as the alias doc
+    (``expected_token``: the token its read was taken under, None for
+    create-only) — record mutations are read-modify-writes, and a
+    concurrent gate and operator CLI appending to one record must not
+    silently drop each other's events. :func:`update_record` is the
+    retrying caller."""
+    key = registry_record_key(record["model_key"])
+    data = json.dumps(record, sort_keys=True, indent=1).encode("utf-8")
+    store.put_bytes_if_match(key, data, expected_token)
+    return key
+
+
+def update_record(store: ArtefactStore, model_key: str, mutate, attempts: int = 4):
+    """CAS read-modify-write loop for one record: load (token first),
+    apply ``mutate(record_or_None) -> record_or_None``, conditional
+    write; a lost race re-reads and re-applies. Returns the written
+    record, or None when ``mutate`` returned None (nothing to do).
+    ``mutate`` sees None for an absent record and may create one; a
+    corrupt-past-budget record also reads as None but keeps its token,
+    so the conditional write REPAIRS it in place."""
+    from bodywork_tpu.store.base import CasConflict
+
+    last: CasConflict | None = None
+    for _attempt in range(attempts):
+        record, token = load_record(store, model_key, with_token=True)
+        updated = mutate(record)
+        if updated is None:
+            return None
+        try:
+            put_record(store, updated, expected_token=token)
+            return updated
+        except CasConflict as exc:
+            last = exc  # concurrent writer: re-read, re-apply
+    raise last
+
+
+def list_records(store: ArtefactStore) -> list[dict]:
+    """All readable records, oldest first (date-key order). Corrupt or
+    unparseable records are skipped (counted by ``load_record``)."""
+    out = []
+    for key, _d in store.history(REGISTRY_RECORDS_PREFIX):
+        doc = _validated_read(store, key, RECORD_SCHEMA, "record")
+        if doc is not None:
+            out.append(doc)
+    return out
+
+
+def model_digest(data: bytes) -> str:
+    """Content digest used as the record's lineage version token —
+    backend-independent (a filesystem inode token or GCS generation
+    would tie the record's bytes to one backend instance and break the
+    chaos twin comparison) and tamper-evident."""
+    return "sha256:" + hashlib.sha256(data).hexdigest()
+
+
+def register_candidate(
+    store: ArtefactStore,
+    model_key: str,
+    metrics_key: str | None = None,
+    day: date | None = None,
+    model_bytes: bytes | None = None,
+) -> dict:
+    """Create (or refresh) the candidate record for a persisted
+    checkpoint: lineage (content digest, dataset-day coverage, metrics
+    key) + a ``registered`` event. Training calls this instead of
+    implicitly publishing — the checkpoint takes traffic only after a
+    promotion flips the alias. Idempotent per (model_key, content): a
+    re-register of identical bytes leaves the record byte-stable.
+    ``model_bytes`` lets a caller that just wrote the checkpoint skip
+    the full-artefact re-download the digest would otherwise cost (one
+    GET per training day on a remote store)."""
+    from bodywork_tpu.utils.dates import date_from_key
+
+    model_date = date_from_key(model_key)
+    day = day or model_date
+    if metrics_key is None and model_date is not None:
+        metrics_key = model_metrics_key(model_date)
+        if not store.exists(metrics_key):
+            metrics_key = None
+    if model_bytes is None:
+        model_bytes = store.get_bytes(model_key)
+    digest = model_digest(model_bytes)
+    days = [str(d) for _k, d in store.history(DATASETS_PREFIX)]
+
+    def _mutate(existing: dict | None) -> dict | None:
+        if existing is not None:
+            if existing.get("model_digest") == digest:
+                return None  # byte-stable: same checkpoint, same record
+            record = existing  # re-trained same key: refresh lineage
+            record["model_digest"] = digest
+            record["metrics_key"] = metrics_key
+            # the retrain saw TODAY's dataset coverage — keeping the
+            # original registration's span would make `registry show`
+            # misstate the training data behind the bytes now recorded
+            record["dataset_days"] = {
+                "first": days[0] if days else None,
+                "last": days[-1] if days else None,
+                "count": len(days),
+            }
+            if record.get("status") != "production":
+                # a retrained rejected/archived key becomes a candidate
+                # again; PRODUCTION keeps its status — silently flipping
+                # the currently-aliased record to candidate would make
+                # the ledger disown the model that is actually serving
+                # (the digest-change event below records the drift)
+                record["status"] = "candidate"
+        else:
+            record = {
+                "schema": RECORD_SCHEMA,
+                "model_key": model_key,
+                "model_digest": digest,
+                "data_date": str(model_date) if model_date else None,
+                "dataset_days": {
+                    "first": days[0] if days else None,
+                    "last": days[-1] if days else None,
+                    "count": len(days),
+                },
+                "metrics_key": metrics_key,
+                "status": "candidate",
+                "history": [],
+            }
+        record["history"].append(
+            {"event": "registered", "day": str(day) if day else None,
+             **({"digest_changed": True} if existing is not None else {})}
+        )
+        return record
+
+    record = update_record(store, model_key, _mutate)
+    if record is None:
+        return load_record(store, model_key)  # byte-stable no-op
+    log.info(f"registered candidate {model_key} ({digest[:15]}…)")
+    return record
+
+
+def append_event(
+    store: ArtefactStore,
+    model_key: str,
+    event: dict,
+    status: str | None = None,
+) -> dict | None:
+    """Append one event to a record's history (and optionally move its
+    status) — a CAS read-modify-write, so a concurrent gate and operator
+    CLI appending to the same record lose nothing. Records are
+    append-only: history never shrinks."""
+    if status is not None:
+        assert status in STATUSES, status
+
+    def _mutate(record: dict | None) -> dict | None:
+        if record is None:
+            return None
+        record["history"].append(event)
+        if status is not None:
+            record["status"] = status
+        return record
+
+    return update_record(store, model_key, _mutate)
+
+
+# -- the alias document ----------------------------------------------------
+
+
+def read_aliases(store: ArtefactStore, with_token: bool = False):
+    """The alias document (validated), or None when it does not exist.
+    ``with_token=True`` returns ``(doc, version_token)`` with the token
+    read BEFORE the payload — so a CAS against that token can only
+    succeed if nothing changed since (a write landing between the two
+    reads makes the token stale and the CAS fail cleanly). Raises
+    :class:`RegistryCorrupt` when the document exists but stays invalid
+    past the retry budget."""
+    token = store.version_token(REGISTRY_ALIAS_KEY)
+    if token is None and not store.exists(REGISTRY_ALIAS_KEY):
+        # absent — two metadata probes, no payload read: a reload
+        # watcher polls this on every cycle, and a registry-less store
+        # must not pay a failing GET (plus its corrupt-read retries)
+        # per poll forever. Token-less backends fall through on the
+        # exists() check, so absence is never inferred from a None
+        # token alone.
+        return (None, None) if with_token else None
+    doc = _validated_read(store, REGISTRY_ALIAS_KEY, ALIAS_SCHEMA, "alias")
+    if doc is None:
+        if store.exists(REGISTRY_ALIAS_KEY):
+            raise RegistryCorrupt(
+                f"alias document {REGISTRY_ALIAS_KEY!r} failed validation "
+                f"on every read attempt"
+            )
+        return (None, None) if with_token else None
+    return (doc, token) if with_token else doc
+
+
+def write_aliases(store: ArtefactStore, doc: dict, expected_token):
+    """One CAS write of the alias document. Raises
+    :class:`~bodywork_tpu.store.base.CasConflict` when someone else won
+    the race — the ONLY way this document is ever written."""
+    assert doc.get("schema") == ALIAS_SCHEMA, doc
+    return store.put_bytes_if_match(
+        REGISTRY_ALIAS_KEY,
+        json.dumps(doc, sort_keys=True, indent=1).encode("utf-8"),
+        expected_token,
+    )
+
+
+def registry_exists(store: ArtefactStore) -> bool:
+    """True when the store has an ACTIVE registry — i.e. an alias
+    document. Records alone do not count: before the first promotion
+    there is nothing gated to serve, so serving keeps the
+    latest-checkpoint behavior byte-identically."""
+    return store.exists(REGISTRY_ALIAS_KEY)
+
+
+def resolve_alias(store: ArtefactStore, alias: str = "production") -> str | None:
+    """The model key the alias currently maps to, or None (no registry,
+    or alias unset). Raises :class:`RegistryCorrupt` for an unreadable
+    alias document — see the module docstring for why that must not
+    silently become the latest-checkpoint fallback."""
+    doc = read_aliases(store)
+    if doc is None:
+        return None
+    return doc.get(alias)

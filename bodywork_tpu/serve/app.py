@@ -82,14 +82,28 @@ def _json_response(payload: dict, status: int = 200) -> Response:
 
 class _Served:
     """One served model: predictor + identity, swapped as a unit so a
-    request can never pair one model's prediction with another's info."""
+    request can never pair one model's prediction with another's info.
+    ``model_key`` is the artefact key the model was loaded from and
+    ``source`` how it was resolved (``"production"`` via the registry
+    alias, ``"latest"`` via the date-key fallback, None when the caller
+    didn't say) — surfaced on ``/healthz`` and the served-model info
+    gauge so an operator can see WHAT serves and under WHOSE authority."""
 
-    __slots__ = ("predictor", "model_info", "model_date")
+    __slots__ = ("predictor", "model_info", "model_date", "model_key", "source")
 
-    def __init__(self, predictor, model_info: str, model_date: str | None):
+    def __init__(
+        self,
+        predictor,
+        model_info: str,
+        model_date: str | None,
+        model_key: str | None = None,
+        source: str | None = None,
+    ):
         self.predictor = predictor
         self.model_info = model_info
         self.model_date = model_date
+        self.model_key = model_key
+        self.source = source
 
 
 class ScoringApp:
@@ -108,6 +122,8 @@ class ScoringApp:
         predictor=None,
         batcher=None,
         metrics_dir: str | None = None,
+        model_key: str | None = None,
+        model_source: str | None = None,
     ):
         if model is None:
             # degraded boot: no checkpoint exists yet. Scoring answers
@@ -124,7 +140,9 @@ class ScoringApp:
                 PaddedPredictor(model, buckets) if buckets else PaddedPredictor(model)
             )
             self._served = _Served(
-                predictor, model.info, str(model_date) if model_date else None
+                predictor, model.info,
+                str(model_date) if model_date else None,
+                model_key=model_key, source=model_source,
             )
         #: reason the service is degraded (serving last-good after a
         #: failed reload), or None when healthy; surfaced in /healthz
@@ -176,12 +194,39 @@ class ScoringApp:
             aggregate="max",
         )
         self._g_degraded.set(2.0 if self._served is None else 0.0)
+        # served-model-version info gauge: the CURRENT served artefact's
+        # sample is 1 and its resolution source rides as a label
+        # ("production" = registry alias, "latest" = date-key fallback);
+        # a swap zeroes the superseded sample so a scrape shows exactly
+        # one live version per process
+        self._g_model_version = reg.gauge(
+            "bodywork_tpu_serve_model_version_info",
+            "Served model version: 1 on the (model_key, source) sample "
+            "currently serving, 0 on superseded ones",
+            aggregate="max",
+        )
+        self._model_version_labels: dict | None = None
+        self._record_model_version()
         self._routes = {
             ("POST", "/score/v1"): self.score_data_instance,
             ("POST", "/score/v1/batch"): self.score_batch,
             ("GET", "/healthz"): self.healthz,
             ("GET", "/metrics"): self.metrics_endpoint,
         }
+
+    def _record_model_version(self) -> None:
+        served = self._served
+        if served is None or served.model_key is None:
+            return
+        labels = {
+            "model_key": served.model_key,
+            "source": served.source or "unspecified",
+        }
+        old = self._model_version_labels
+        if old is not None and old != labels:
+            self._g_model_version.set(0.0, **old)
+        self._g_model_version.set(1.0, **labels)
+        self._model_version_labels = labels
 
     # -- served-model access (single read point for atomic swaps) ----------
     @property
@@ -198,6 +243,16 @@ class ScoringApp:
     def model_date(self) -> str | None:
         served = self._served
         return None if served is None else served.model_date
+
+    @property
+    def model_key(self) -> str | None:
+        served = self._served
+        return None if served is None else served.model_key
+
+    @property
+    def model_source(self) -> str | None:
+        served = self._served
+        return None if served is None else served.source
 
     # -- degraded-mode channel (serve.reload drives it) --------------------
     def set_degraded(self, reason: str) -> None:
@@ -217,12 +272,15 @@ class ScoringApp:
         model: Regressor,
         model_date: date | None = None,
         predictor=None,
+        model_key: str | None = None,
+        model_source: str | None = None,
     ) -> None:
         """Atomically replace the served model (hot reload). The caller is
         responsible for warming the new predictor OFF the request path
         first (``serve.reload.CheckpointWatcher`` does). A successful
         swap clears the degraded flag — and brings a model-less app
-        (degraded boot) live."""
+        (degraded boot) live. ``model_key``/``model_source`` update the
+        /healthz identity and the served-model-version info gauge."""
         if predictor is None:
             old = self._served
             predictor = (
@@ -231,8 +289,10 @@ class ScoringApp:
                 else PaddedPredictor(model)
             )
         self._served = _Served(
-            predictor, model.info, str(model_date) if model_date else None
+            predictor, model.info, str(model_date) if model_date else None,
+            model_key=model_key, source=model_source,
         )
+        self._record_model_version()
         if self.batcher is not None:
             # the coalescer's bundle-grouping already guarantees no batch
             # mixes generations; draining here additionally flushes every
@@ -402,6 +462,8 @@ class ScoringApp:
                     "reason": "no model has been loaded yet",
                     "model_info": None,
                     "model_date": None,
+                    "model_key": None,
+                    "model_source": None,
                 },
                 503,
             )
@@ -415,6 +477,13 @@ class ScoringApp:
             "status": "ok",
             "model_info": served.model_info,
             "model_date": served.model_date,
+            # WHAT serves and under WHOSE authority: the artefact key
+            # plus how it was resolved — "production" (registry alias,
+            # gated), "latest" (registry-less date-key fallback), None
+            # (caller never said). A degraded service additionally
+            # carries the degraded flag + reason below.
+            "model_key": served.model_key,
+            "model_source": served.source,
             "degraded": reason is not None,
         }
         if reason is not None:
@@ -444,6 +513,8 @@ def create_app(
     batch_window_ms: float | None = None,
     batch_max_rows: int | None = None,
     metrics_dir: str | None = None,
+    model_key: str | None = None,
+    model_source: str | None = None,
 ) -> ScoringApp:
     """``batch_window_ms`` > 0 opts into cross-request micro-batching
     (``serve.batcher``): concurrent single-row ``/score/v1`` requests
@@ -463,7 +534,8 @@ def create_app(
             max_rows=batch_max_rows or DEFAULT_MAX_ROWS,
         ).start()
     app = ScoringApp(model, model_date, buckets, predictor=predictor,
-                     batcher=batcher, metrics_dir=metrics_dir)
+                     batcher=batcher, metrics_dir=metrics_dir,
+                     model_key=model_key, model_source=model_source)
     if warmup and app.predictor is not None:
         app.predictor.warmup(sync=warmup_sync)
     return app

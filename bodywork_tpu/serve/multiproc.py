@@ -55,14 +55,15 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
     the parent's initialized XLA runtime threads — undefined behavior)."""
     from werkzeug.serving import make_server
 
-    from bodywork_tpu.models.checkpoint import load_model
+    from bodywork_tpu.models.checkpoint import load_model, resolve_serving_key
     from bodywork_tpu.serve.app import create_app
     from bodywork_tpu.serve.server import build_predictor
     from bodywork_tpu.store import open_store
-    from bodywork_tpu.store.schema import MODELS_PREFIX
 
     store = open_store(store_path)
-    served_key, _ = store.latest(MODELS_PREFIX)
+    # registry-aware resolution: the production alias when one exists,
+    # else the newest date-keyed checkpoint (models/checkpoint.py)
+    served_key, served_source = resolve_serving_key(store)
     model, model_date = load_model(store, served_key)
     predictor = build_predictor(model, None, engine, buckets=buckets)
     # one coalescer PER WORKER PROCESS: replicas never share a dispatcher
@@ -72,7 +73,8 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
                      buckets=buckets,
                      batch_window_ms=batch_window_ms,
                      batch_max_rows=batch_max_rows,
-                     metrics_dir=metrics_dir)
+                     metrics_dir=metrics_dir,
+                     model_key=served_key, model_source=served_source)
     flusher = None
     if metrics_dir is not None:
         # each replica flushes its registry snapshot to the shared dir;

@@ -2,19 +2,25 @@
 
 The reference loads its model once at boot (``stage_2_serve_model.py:57-65,
 113``): serving a new day's model requires the orchestrator to re-deploy
-the whole service. Here a :class:`CheckpointWatcher` polls the store's
-``models/`` prefix for a newer artefact — latest date key plus the
-backend's version token, so an in-place overwrite of the same key is also
-seen — loads and warms the replacement OFF the request path, then swaps it
-into the running :class:`~bodywork_tpu.serve.app.ScoringApp` atomically.
-A k8s serve Deployment therefore lives across days instead of being
-re-rolled per retrain.
+the whole service. Here a :class:`CheckpointWatcher` polls the store for
+the checkpoint serving SHOULD run — the registry's ``production`` alias
+when one exists (``bodywork_tpu.registry``: only gate-promoted models
+ever take traffic, and a one-op rollback flips the alias so the next
+poll swaps the previous production back in), falling back to the newest
+date-keyed artefact under ``models/`` on a registry-less store (the
+original behavior, byte-identical). The target key plus the backend's
+version token are compared, so an in-place overwrite of the same key is
+also seen — the watcher loads and warms the replacement OFF the request
+path, then swaps it into the running
+:class:`~bodywork_tpu.serve.app.ScoringApp` atomically. A k8s serve
+Deployment therefore lives across days instead of being re-rolled per
+retrain.
 """
 from __future__ import annotations
 
 import threading
 
-from bodywork_tpu.models.checkpoint import load_model
+from bodywork_tpu.models.checkpoint import load_model, resolve_serving_key
 from bodywork_tpu.store.base import ArtefactNotFound, ArtefactStore
 from bodywork_tpu.store.schema import MODELS_PREFIX
 from bodywork_tpu.utils.logging import get_logger
@@ -68,27 +74,63 @@ class CheckpointWatcher:
             served_key = None
         elif served_key is None:
             try:
-                served_key, _ = store.latest(MODELS_PREFIX)
+                served_key, _source = resolve_serving_key(store)
             except ArtefactNotFound:
+                served_key = None
+            except Exception as exc:  # e.g. a corrupt alias document:
+                # snapshot nothing-served; polls retry resolution
+                log.error(
+                    f"serving-key resolution failed at watcher init "
+                    f"(polls will retry): {exc!r}"
+                )
                 served_key = None
         if served_key is not None:
             self._current = (served_key, store.version_token(served_key))
+        # whether THIS watcher flagged the apps degraded for a serving-key
+        # resolution failure — a healed resolution that needs no swap must
+        # clear exactly that flag (a swap clears it via swap_model anyway)
+        self._resolve_degraded = False
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="checkpoint-watcher", daemon=True
         )
 
     def check_once(self) -> bool:
-        """One poll: swap if the store has a different latest checkpoint.
-        Returns whether a swap happened. Load/warm errors are logged and
-        swallowed — the service keeps answering with the current model
-        (flagged DEGRADED in /healthz and the state gauge, so a stuck
-        reload is visible) and retries on the next poll (a half-written
-        checkpoint must never take the service down)."""
+        """One poll: swap if the store resolves a DIFFERENT checkpoint to
+        serve — the registry's ``production`` alias when one exists
+        (a candidate that fails the promotion gate never moves the alias
+        and therefore never goes live; a rollback moves it back and the
+        next poll swaps accordingly), else the newest date-keyed
+        checkpoint. Returns whether a swap happened. Load/warm errors —
+        and a corrupt alias document — are logged and swallowed: the
+        service keeps answering with the current model (flagged DEGRADED
+        in /healthz and the state gauge, so a stuck reload is visible)
+        and retries on the next poll (a half-written checkpoint must
+        never take the service down)."""
         try:
-            key, model_date = self.store.latest(MODELS_PREFIX)
+            key, source = resolve_serving_key(self.store)
         except ArtefactNotFound:
             return False
+        except Exception as exc:
+            # e.g. registry.records.RegistryCorrupt: falling back to
+            # latest here could put an UNGATED checkpoint live — keep
+            # serving what we serve and let the next poll retry. SAY so:
+            # while resolution fails, promotions/rollbacks cannot take
+            # effect, and that must show in /healthz + the state gauge
+            log.error(f"serving-key resolution failed (will retry): {exc!r}")
+            if not self._resolve_degraded:
+                self._resolve_degraded = True
+                for app in self.apps:
+                    app.set_degraded(
+                        "serving-key resolution failing; promotions and "
+                        "rollbacks are not taking effect"
+                    )
+            return False
+        if self._resolve_degraded:
+            # resolution healed; if a swap is also due, swap_model clears
+            self._resolve_degraded = False
+            for app in self.apps:
+                app.clear_degraded()
         candidate = (key, self.store.version_token(key))
         if candidate == self._current:
             return False
@@ -152,7 +194,8 @@ class CheckpointWatcher:
         # splits old-model and new-model rows into separate device calls,
         # never one mixed batch.
         for app in self.apps:
-            app.swap_model(model, model_date, predictor)
+            app.swap_model(model, model_date, predictor,
+                           model_key=key, model_source=source)
         self._current = candidate
         return True
 
@@ -166,8 +209,9 @@ class CheckpointWatcher:
     def start(self) -> "CheckpointWatcher":
         self._thread.start()
         log.info(
-            f"watching {MODELS_PREFIX} for new checkpoints every "
-            f"{self.poll_interval_s:.0f}s"
+            f"watching the serving target every "
+            f"{self.poll_interval_s:.0f}s (registry production alias "
+            f"when one exists, else newest under {MODELS_PREFIX})"
         )
         return self
 

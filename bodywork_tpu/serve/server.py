@@ -256,23 +256,33 @@ def serve_latest_model(
     instead of the process dying and flapping its supervisor. Without a
     watcher there is no path to ever serve, so the error still raises.
     """
+    from bodywork_tpu.models.checkpoint import resolve_serving_key
+    from bodywork_tpu.registry.records import RegistryCorrupt
     from bodywork_tpu.store.base import ArtefactNotFound
-    from bodywork_tpu.store.schema import MODELS_PREFIX
 
     try:
-        served_key, _ = store.latest(MODELS_PREFIX)
-    except ArtefactNotFound:
+        # registry-aware: the production alias when one exists, else the
+        # newest date-keyed checkpoint (models/checkpoint.py)
+        served_key, served_source = resolve_serving_key(store)
+        model, model_date = load_model(store, served_key)
+    except (ArtefactNotFound, RegistryCorrupt) as exc:
+        # no serviceable checkpoint YET (empty store, all candidates
+        # gate-rejected), an unreadable alias document, or an alias
+        # pointing at a checkpoint that no longer exists (load_model is
+        # inside the try for exactly that dangling case): with a watcher
+        # the service boots degraded (503 + Retry-After) and the
+        # watcher's polls pick up the first resolvable checkpoint —
+        # dying here would just flap the pod supervisor against a
+        # condition only time or an operator can clear
         if not watch_interval_s:
             raise
         log.warning(
-            "no model checkpoint in the store yet; serving 503s until "
-            "the checkpoint watcher finds one"
+            f"no serviceable checkpoint at boot ({exc!r}); serving 503s "
+            "until the checkpoint watcher resolves one"
         )
-        served_key = None
-    if served_key is None:
+        served_key = served_source = None
         model = model_date = predictor = None
     else:
-        model, model_date = load_model(store, served_key)
         # with buckets set, build_predictor always returns a predictor
         # (every engine honours the list), so create_app never needs the
         # knob here
@@ -280,6 +290,7 @@ def serve_latest_model(
     app = create_app(
         model, model_date, predictor=predictor,
         batch_window_ms=batch_window_ms, batch_max_rows=batch_max_rows,
+        model_key=served_key, model_source=served_source,
     )
     handle = ServiceHandle(app, host, port)
     # the coalescer's dispatcher stops (after flushing) with the service

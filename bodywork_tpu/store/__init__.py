@@ -1,6 +1,7 @@
 from bodywork_tpu.store.base import (
     ArtefactStore,
     ArtefactNotFound,
+    CasConflict,
     DelegatingStore,
 )
 from bodywork_tpu.store.filesystem import FilesystemStore
@@ -10,11 +11,15 @@ from bodywork_tpu.store.schema import (
     DATASETS_PREFIX,
     MODELS_PREFIX,
     MODEL_METRICS_PREFIX,
+    REGISTRY_ALIAS_KEY,
+    REGISTRY_PREFIX,
+    REGISTRY_RECORDS_PREFIX,
     SNAPSHOTS_PREFIX,
     TEST_METRICS_PREFIX,
     dataset_key,
     model_key,
     model_metrics_key,
+    registry_record_key,
     snapshot_key,
     test_metrics_key,
 )
@@ -22,6 +27,7 @@ from bodywork_tpu.store.schema import (
 __all__ = [
     "ArtefactStore",
     "ArtefactNotFound",
+    "CasConflict",
     "DelegatingStore",
     "FilesystemStore",
     "ResilientStore",
@@ -29,11 +35,15 @@ __all__ = [
     "DATASETS_PREFIX",
     "MODELS_PREFIX",
     "MODEL_METRICS_PREFIX",
+    "REGISTRY_ALIAS_KEY",
+    "REGISTRY_PREFIX",
+    "REGISTRY_RECORDS_PREFIX",
     "SNAPSHOTS_PREFIX",
     "TEST_METRICS_PREFIX",
     "dataset_key",
     "model_key",
     "model_metrics_key",
+    "registry_record_key",
     "snapshot_key",
     "test_metrics_key",
 ]

@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import abc
 import functools
+import threading
 import time
 from datetime import date
 
@@ -52,12 +53,21 @@ class ArtefactNotFound(KeyError):
     """No artefact exists at the requested key/prefix."""
 
 
+class CasConflict(RuntimeError):
+    """A ``put_bytes_if_match`` compare-and-swap lost its race: the key's
+    current version token no longer matches the caller's expectation
+    (someone else wrote between the caller's read and its write). The
+    store is untouched by the losing write — the caller re-reads and
+    decides whether to retry its read-modify-write."""
+
+
 #: primitive + metadata ops wrapped with obs instrumentation when a
 #: backend declares ``backend_label`` (wrapper stores — epoch guards,
 #: counting fixtures — declare none and stay transparent, so delegated
 #: calls are counted exactly once, at the real backend)
 _INSTRUMENTED_OPS = (
     "put_bytes",
+    "put_bytes_if_match",
     "get_bytes",
     "list_keys",
     "delete",
@@ -174,6 +184,52 @@ class ArtefactStore(abc.ABC):
         except ArtefactNotFound:
             return False
 
+    def put_bytes_if_match(
+        self, key: str, data: bytes, expected_token=None
+    ):
+        """Compare-and-swap write: persist ``data`` at ``key`` only if the
+        key's current ``version_token`` equals ``expected_token``
+        (``None`` = create-only: the key must not exist yet). Raises
+        :class:`CasConflict` — leaving the store untouched — otherwise.
+        Returns the new version token of the written artefact.
+
+        This is the concurrency primitive the model registry's alias
+        document rides (two concurrent promoters: exactly one wins, the
+        loser gets a clean conflict, the document never tears). Backends
+        with a native conditional write override it (GCS
+        ``if_generation_match``); the filesystem backend serialises CAS
+        writers through a sidecar lock file + atomic rename. This base
+        implementation serialises CAS calls through a per-store-object
+        lock — genuinely atomic for in-process backends (the in-memory
+        test store), and only best-effort across processes, which real
+        backends must not rely on. Backends without version tokens
+        cannot support CAS on existing keys and raise
+        ``NotImplementedError``.
+        """
+        self.validate_key(key)
+        # setdefault on __dict__ is atomic under the GIL, so two first
+        # callers can never install two different locks
+        lock = self.__dict__.setdefault("_cas_lock", threading.Lock())
+        with lock:
+            current = self.version_token(key)
+            if current is None and self.exists(key):
+                raise NotImplementedError(
+                    f"{type(self).__name__} has no version tokens; "
+                    "put_bytes_if_match cannot verify the current content"
+                )
+            if expected_token is None:
+                if current is not None:
+                    raise CasConflict(
+                        f"create-only write of {key!r} lost: key exists"
+                    )
+            elif current != expected_token:
+                raise CasConflict(
+                    f"conditional write of {key!r} lost: token changed "
+                    f"({expected_token!r} -> {current!r})"
+                )
+            self.put_bytes(key, data)
+            return self.version_token(key)
+
     def get_many(self, keys: list[str]) -> dict[str, bytes]:
         """Fetch many artefacts; returns ``{key: bytes}`` in input order.
 
@@ -277,6 +333,13 @@ class DelegatingStore(ArtefactStore):
 
     def put_bytes(self, key: str, data: bytes) -> None:
         self._inner.put_bytes(key, data)
+
+    def put_bytes_if_match(self, key: str, data: bytes, expected_token=None):
+        # delegated (not inherited): the base fallback's per-object lock
+        # would serialise against OTHER wrapper instances' CAS calls
+        # instead of the one real backend's — the backend's own CAS
+        # protocol (lock file, if-generation-match) must arbitrate
+        return self._inner.put_bytes_if_match(key, data, expected_token)
 
     def get_bytes(self, key: str) -> bytes:
         return self._inner.get_bytes(key)

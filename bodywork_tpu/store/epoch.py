@@ -63,6 +63,12 @@ class EpochGuardedStore(DelegatingStore):
         self._check_writable(key)
         self._inner.put_bytes(key, data)
 
+    def put_bytes_if_match(self, key: str, data: bytes, expected_token=None):
+        # a CAS write is still a write: an abandoned attempt must not be
+        # able to flip e.g. the registry alias after its epoch ended
+        self._check_writable(key)
+        return self._inner.put_bytes_if_match(key, data, expected_token)
+
     def delete(self, key: str) -> None:
         self._check_writable(key)
         self._inner.delete(key)

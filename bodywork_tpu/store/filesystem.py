@@ -7,15 +7,21 @@ a concurrently-reading service stage never sees a torn artefact.
 """
 from __future__ import annotations
 
+import fcntl
 import os
 import tempfile
 from pathlib import Path
 
-from bodywork_tpu.store.base import ArtefactNotFound, ArtefactStore
+from bodywork_tpu.store.base import ArtefactNotFound, ArtefactStore, CasConflict
 
 
 class FilesystemStore(ArtefactStore):
     backend_label = "filesystem"
+
+    #: how long a CAS writer waits on a contended sidecar lock before
+    #: giving up with a conflict (a crashed holder's stale lock file must
+    #: not wedge promotions forever — see put_bytes_if_match)
+    CAS_LOCK_TIMEOUT_S = 5.0
 
     def __init__(self, root: str | os.PathLike):
         self.root = Path(root)
@@ -24,8 +30,7 @@ class FilesystemStore(ArtefactStore):
     def _path(self, key: str) -> Path:
         return self.root / self.validate_key(key)
 
-    def put_bytes(self, key: str, data: bytes) -> None:
-        path = self._path(key)
+    def _write_atomic(self, path: Path, data: bytes) -> None:
         path.parent.mkdir(parents=True, exist_ok=True)
         fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=".tmp-")
         try:
@@ -42,6 +47,89 @@ class FilesystemStore(ArtefactStore):
             if os.path.exists(tmp):
                 os.unlink(tmp)
             raise
+
+    def put_bytes(self, key: str, data: bytes) -> None:
+        self._write_atomic(self._path(key), data)
+
+    def _acquire_cas_lock(self, key: str, lock_path: Path) -> int:
+        """Bounded wait for the CAS sidecar lock: an ``fcntl.flock`` on
+        a persistent ``.tmp-lock.<name>`` file (the ``.tmp-`` prefix
+        keeps it out of ``list_keys``; it is created once and NEVER
+        unlinked — the classic flock unlink race would let two writers
+        hold 'the lock' on different inodes). flock is released by the
+        kernel when the holder's fd closes — including on a crash — so
+        there is no stale-lock state and no lock *breaking*: breaking a
+        merely-slow holder's lock would admit two writers whose token
+        checks then both pass, the silent lost update CAS exists to
+        prevent. A holder slower than the timeout just makes contenders
+        fail with a clean conflict. Between-attempt sleeping goes
+        through the SHARED retry policy (``utils.retry.call_with_retry``
+        — the chaos guard pins store modules backoff-loop-free, and the
+        jittered waits decorrelate contending promoters)."""
+        from bodywork_tpu.utils.retry import RetryPolicy, call_with_retry
+
+        fd = os.open(lock_path, os.O_CREAT | os.O_RDWR)
+
+        def _try_lock():
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)  # BlockingIOError
+            return fd
+
+        try:
+            return call_with_retry(
+                _try_lock,
+                RetryPolicy(
+                    attempts=4096,  # the deadline budget is the real bound
+                    base_delay_s=0.002,
+                    max_delay_s=0.01,
+                    deadline_s=self.CAS_LOCK_TIMEOUT_S,
+                ),
+                is_retryable=lambda exc: isinstance(exc, BlockingIOError),
+            )
+        except BlockingIOError:
+            os.close(fd)
+            raise CasConflict(
+                f"CAS lock on {key!r} contended past "
+                f"{self.CAS_LOCK_TIMEOUT_S}s"
+            )
+        except BaseException:
+            # a real I/O fault (EIO, ENOSPC, …) is NOT a lost race —
+            # mapping it to CasConflict would have promoters retry
+            # forever against a broken disk reporting 'conflict'
+            os.close(fd)
+            raise
+
+    def put_bytes_if_match(self, key: str, data: bytes, expected_token=None):
+        """CAS via sidecar lock + atomic rename: an ``flock`` on the
+        persistent ``.tmp-lock.<name>`` sidecar (see
+        :meth:`_acquire_cas_lock`) serialises concurrent CAS writers —
+        across threads AND processes — then the token check and
+        tmp+fsync+rename run under the lock. Plain ``put_bytes`` does
+        not take the lock, which is why alias-style documents must only
+        ever be written through THIS op (the registry guard test pins
+        that)."""
+        path = self._path(key)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        lock_path = path.parent / f".tmp-lock.{path.name}"
+        lock_fd = self._acquire_cas_lock(key, lock_path)
+        try:
+            current = self.version_token(key)
+            if expected_token is None:
+                if current is not None:
+                    raise CasConflict(
+                        f"create-only write of {key!r} lost: key exists"
+                    )
+            elif current != expected_token:
+                raise CasConflict(
+                    f"conditional write of {key!r} lost: token changed "
+                    f"({expected_token!r} -> {current!r})"
+                )
+            self._write_atomic(path, data)
+            return self.version_token(key)
+        finally:
+            # closing the fd releases the flock; the lock FILE stays on
+            # disk deliberately (unlink would reopen the flock-unlink
+            # race — see _acquire_cas_lock)
+            os.close(lock_fd)
 
     def exists(self, key: str) -> bool:
         return self._path(key).is_file()

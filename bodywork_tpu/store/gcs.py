@@ -20,8 +20,24 @@ Retries are exported as ``bodywork_tpu_store_retries_total{backend,op}``.
 """
 from __future__ import annotations
 
-from bodywork_tpu.store.base import ArtefactNotFound, ArtefactStore
-from bodywork_tpu.utils.retry import RetryPolicy, call_with_retry
+from bodywork_tpu.store.base import ArtefactNotFound, ArtefactStore, CasConflict
+from bodywork_tpu.utils.retry import RetryPolicy, _chain, call_with_retry
+
+#: exception class names the GCS client raises for a failed
+#: ``if_generation_match`` precondition (name-matched like the transient
+#: taxonomy, so the optional dependency's classes need not be importable)
+_PRECONDITION_FAILED_NAMES = frozenset(
+    {"PreconditionFailed", "FailedPrecondition"}
+)
+
+
+def _is_precondition_failure(exc: BaseException) -> bool:
+    # same cause-chain walk as the transient taxonomy (utils.retry)
+    return any(
+        type(e).__name__ in _PRECONDITION_FAILED_NAMES
+        or getattr(e, "code", None) == 412  # HTTP Precondition Failed
+        for e in _chain(exc)
+    )
 
 
 class GCSStore(ArtefactStore):
@@ -101,6 +117,57 @@ class GCSStore(ArtefactStore):
             lambda: self._bucket.blob(name).upload_from_string(data),
             "put_bytes",
         )
+
+    def put_bytes_if_match(self, key: str, data: bytes, expected_token=None):
+        """CAS via GCS's native conditional write: ``if_generation_match``
+        pinned to the expected generation (0 = create-only, exactly the
+        ``expected_token=None`` contract). A precondition failure maps to
+        :class:`CasConflict`; it is NOT transient, so the retry loop
+        propagates it immediately rather than burning attempts on a race
+        already lost — EXCEPT when our own earlier attempt may have
+        committed before its response was dropped (upload applied
+        server-side, transient error on the reply, retry now sees the
+        bumped generation): the post-check below re-reads the object and
+        treats current-content-equals-our-payload as the success it is,
+        so a promotion that actually landed is never misreported as a
+        lost race (which would leave the caller's follow-up record
+        updates unapplied)."""
+        name = self._blob_name(key)
+        match = 0 if expected_token is None else expected_token
+
+        def _put():
+            blob = self._bucket.blob(name)
+            blob.upload_from_string(data, if_generation_match=match)
+            return blob.generation
+
+        def _verify_own_write():
+            # fetch + download inside ONE retried thunk: the flaky
+            # network that dropped the upload's response is exactly the
+            # network likely to blip the verification read, and a
+            # transient here must not convert a LANDED write into a
+            # reported conflict
+            blob = self._bucket.get_blob(name)
+            if blob is not None and blob.download_as_bytes() == data:
+                return blob.generation
+            return None
+
+        try:
+            return self._with_retries(_put, "put_bytes_if_match")
+        except Exception as exc:
+            if _is_precondition_failure(exc):
+                try:
+                    generation = self._with_retries(
+                        _verify_own_write, "put_bytes_if_match"
+                    )
+                    if generation is not None:
+                        return generation
+                except Exception:  # noqa: BLE001 — post-check best-effort
+                    pass  # cannot verify: report the conflict below
+                raise CasConflict(
+                    f"conditional write of {key!r} lost: generation "
+                    f"{match} no longer current"
+                ) from exc
+            raise
 
     def get_bytes(self, key: str) -> bytes:
         name = self._blob_name(key)

@@ -143,6 +143,18 @@ class ResilientStore(DelegatingStore):
     def put_bytes(self, key: str, data: bytes) -> None:
         self._guarded("put_bytes", lambda: self._inner.put_bytes(key, data))
 
+    def put_bytes_if_match(self, key: str, data: bytes, expected_token=None):
+        # CAS rides the same retry+breaker as every other op. Safe to
+        # retry: CasConflict is not on the transient allowlist (a lost
+        # race propagates immediately, attempt budget intact), and a
+        # transient failure AFTER the write applied surfaces on retry as
+        # a conflict the backend disambiguates (GCS's own-write
+        # post-check turns it back into success)
+        return self._guarded(
+            "put_bytes_if_match",
+            lambda: self._inner.put_bytes_if_match(key, data, expected_token),
+        )
+
     def get_bytes(self, key: str) -> bytes:
         return self._guarded("get_bytes", lambda: self._inner.get_bytes(key))
 

@@ -14,6 +14,19 @@ per compaction covering every dataset day up to its embedded date, so a
 cold process loads all history in O(1 + tail) store reads instead of
 O(days). Snapshots are derived artefacts — deleting the prefix is always
 safe (readers fall back to the per-day CSVs).
+
+``registry/`` holds the model-registry release-management layer
+(``bodywork_tpu/registry/``): date-keyed per-model records under
+``registry/records/`` plus the single alias document
+``registry/aliases.json`` mapping ``production``/``previous`` to model
+keys. Delete safety: the ALIAS DOCUMENT is authoritative for what
+serves — deleting it reverts serving to the latest-checkpoint fallback
+(losing gating, not data); records are append-only lineage/decision
+history and are never required by the serving path, but deleting them
+discards the audit trail, so treat the prefix as durable, not derived.
+The alias doc is mutated exclusively through the compare-and-swap
+primitive ``ArtefactStore.put_bytes_if_match`` (never a raw
+``put_bytes``), so concurrent promoters cannot tear it.
 """
 from __future__ import annotations
 
@@ -24,6 +37,13 @@ MODELS_PREFIX = "models/"
 MODEL_METRICS_PREFIX = "model-metrics/"
 TEST_METRICS_PREFIX = "test-metrics/"
 SNAPSHOTS_PREFIX = "snapshots/"
+REGISTRY_PREFIX = "registry/"
+REGISTRY_RECORDS_PREFIX = "registry/records/"
+#: the single alias document (no embedded date: invisible to the
+#: date-key ``history``/``latest`` protocol by design). Authoritative
+#: mapping of ``production``/``previous`` to model keys; written ONLY
+#: via ``put_bytes_if_match`` — see the module docstring's delete note.
+REGISTRY_ALIAS_KEY = "registry/aliases.json"
 
 ALL_PREFIXES = (
     DATASETS_PREFIX,
@@ -31,6 +51,7 @@ ALL_PREFIXES = (
     MODEL_METRICS_PREFIX,
     TEST_METRICS_PREFIX,
     SNAPSHOTS_PREFIX,
+    REGISTRY_PREFIX,
 )
 
 
@@ -48,6 +69,16 @@ def model_metrics_key(d: date) -> str:
 
 def test_metrics_key(d: date) -> str:
     return f"{TEST_METRICS_PREFIX}regressor-test-results-{d}.csv"
+
+
+def registry_record_key(model_key: str) -> str:
+    """Registry-record key for a model artefact key: the checkpoint's
+    basename (extension dropped) under ``registry/records/``. Model keys
+    embed their date, so record keys do too — the standard date-key
+    protocol (``history``/``latest``) orders records chronologically."""
+    base = model_key.rsplit("/", 1)[-1]
+    stem = base.rsplit(".", 1)[0] if "." in base else base
+    return f"{REGISTRY_RECORDS_PREFIX}{stem}.json"
 
 
 def snapshot_key(d: date) -> str:

@@ -44,11 +44,41 @@ class TrainResult:
     n_rows: int
 
 
+def _register_candidate(
+    store: ArtefactStore, model_key_: str, metrics_key: str,
+    data_date: date, model_bytes: bytes,
+) -> None:
+    """Register the freshly persisted checkpoint as a registry CANDIDATE
+    (``bodywork_tpu.registry``): training no longer implicitly publishes
+    — the checkpoint takes traffic only after the promotion gate flips
+    the ``production`` alias. ``model_bytes`` is the very buffer
+    save_model wrote, so the lineage digest costs neither a checkpoint
+    re-download nor a second serialisation. Registration failure is
+    non-fatal: the artefacts are already durable, and a registry-less
+    consumer still serves the latest checkpoint exactly as before."""
+    try:
+        from bodywork_tpu.registry.records import register_candidate
+
+        register_candidate(
+            store, model_key_, metrics_key=metrics_key, day=data_date,
+            model_bytes=model_bytes,
+        )
+    except Exception as exc:
+        log.warning(f"candidate registration failed (non-fatal): {exc!r}")
+
+
 def persist_train_result(store: ArtefactStore, result: TrainResult) -> TrainResult:
     """Write a computed-but-unpersisted TrainResult's model + metrics
-    artefacts and return the result with its keys filled in."""
-    model_key_ = save_model(store, result.model, result.data_date)
+    artefacts (and register the checkpoint as a registry candidate) and
+    return the result with its keys filled in."""
+    from bodywork_tpu.models.checkpoint import save_model_bytes
+
+    data = save_model_bytes(result.model)
+    model_key_ = save_model(store, result.model, result.data_date, data=data)
     metrics_key = persist_metrics(store, result.metrics, result.data_date)
+    _register_candidate(
+        store, model_key_, metrics_key, result.data_date, data
+    )
     return dataclasses.replace(
         result,
         model_artefact_key=model_key_,
@@ -231,8 +261,12 @@ def train_on_history(
     # train must not mutate the store before its stage's DAG position —
     # an aborted day would otherwise leave a future-dated model behind)
     if persist:
-        model_key_ = save_model(store, fitted, ds.date)
+        from bodywork_tpu.models.checkpoint import save_model_bytes
+
+        data = save_model_bytes(fitted)
+        model_key_ = save_model(store, fitted, ds.date, data=data)
         metrics_key = persist_metrics(store, metrics, ds.date)
+        _register_candidate(store, model_key_, metrics_key, ds.date, data)
     else:
         model_key_ = metrics_key = None
     if prewarm_next and not use_mesh:

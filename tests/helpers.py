@@ -15,6 +15,11 @@ import types
 #: transient matching treats injected failures exactly like real ones
 ServiceUnavailable = type("ServiceUnavailable", (Exception,), {})
 
+#: named like google.api_core's 412 class so GCSStore's name-based
+#: precondition matching maps fake if_generation_match losses to
+#: CasConflict exactly as with the real client
+PreconditionFailed = type("PreconditionFailed", (Exception,), {})
+
 
 class FakeBlob:
     """In-memory stand-in for google.cloud.storage.Blob (the subset the
@@ -29,12 +34,23 @@ class FakeBlob:
         self._bucket._maybe_fail("exists")
         return self.name in self._bucket._objects
 
-    def upload_from_string(self, data):
+    def upload_from_string(self, data, if_generation_match=None):
         self._bucket._maybe_fail("upload")
         if isinstance(data, str):
             data = data.encode()
-        gen = self._bucket._objects.get(self.name, (None, 0))[1] + 1
-        self._bucket._objects[self.name] = (data, gen)
+        current = self._bucket._objects.get(self.name, (None, 0))[1]
+        if if_generation_match is not None and if_generation_match != current:
+            # 0 means "must not exist" on real GCS; any other value pins
+            # the expected current generation
+            raise PreconditionFailed(
+                f"generation mismatch on {self.name}: "
+                f"expected {if_generation_match}, have {current}"
+            )
+        self._bucket._objects[self.name] = (data, current + 1)
+        # applied-but-response-lost: the server committed the write, then
+        # the response was dropped (the case the CAS own-write post-check
+        # exists for — mirror of delete_after_apply)
+        self._bucket._maybe_fail("upload_after_apply")
 
     def download_as_bytes(self):
         self._bucket._maybe_fail("download")
@@ -203,6 +219,14 @@ def _make_counting_store_cls():
         def put_bytes(self, key, data):
             self._count("put_bytes", key)
             self.inner.put_bytes(key, data)
+
+        def put_bytes_if_match(self, key, data, expected_token=None):
+            # counted as its own op (NOT folded into put_bytes), so
+            # registry tests can assert exact CAS budgets — e.g. a
+            # promotion is ONE alias CAS, and the alias key sees zero raw
+            # put_bytes calls
+            self._count("put_bytes_if_match", key)
+            return self.inner.put_bytes_if_match(key, data, expected_token)
 
         def get_bytes(self, key):
             self._count("get_bytes", key)

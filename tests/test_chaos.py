@@ -221,8 +221,9 @@ def test_torn_write_is_repaired_by_retry():
 
 
 def test_every_public_op_routes_through_shared_retry_policy():
-    """Satellite guard: put/get/get_many/list/delete/exists each absorb
-    one injected transient failure AND report the retry through the ONE
+    """Satellite guard: put/get/get_many/list/delete/exists — and the
+    registry's CAS primitive put_bytes_if_match — each absorb one
+    injected transient failure AND report the retry through the ONE
     shared counter — no op has a private (or missing) retry path.
     version_token(s) are exempt by contract: token queries never raise."""
     ServiceUnavailable = type("ServiceUnavailable", (Exception,), {})
@@ -238,8 +239,8 @@ def test_every_public_op_routes_through_shared_retry_policy():
         def __getattr__(self, name):
             attr = getattr(self._inner, name)
             if name not in (
-                "put_bytes", "get_bytes", "get_many", "list_keys",
-                "delete", "exists",
+                "put_bytes", "put_bytes_if_match", "get_bytes",
+                "get_many", "list_keys", "delete", "exists",
             ):
                 return attr
 
@@ -256,10 +257,11 @@ def test_every_public_op_routes_through_shared_retry_policy():
     store = ResilientStore(FlakyOnce(mem), policy=FAST, label="guardtest")
     before = {
         op: _retry_count(op, "guardtest")
-        for op in ("put_bytes", "get_bytes", "get_many", "list_keys",
-                   "delete", "exists")
+        for op in ("put_bytes", "put_bytes_if_match", "get_bytes",
+                   "get_many", "list_keys", "delete", "exists")
     }
     store.put_bytes("datasets/b.csv", b"y")
+    store.put_bytes_if_match("registry/aliases.json", b"v1", None)
     assert store.get_bytes("datasets/a.csv") == b"x"
     assert store.get_many(["datasets/a.csv"]) == {"datasets/a.csv": b"x"}
     assert store.list_keys("datasets/") == ["datasets/a.csv", "datasets/b.csv"]

@@ -391,6 +391,74 @@ def test_chaos_run_sim_arg_validation(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_registry_cli_smoke(tmp_path, capsys):
+    """registry list/show/gate/promote/rollback over a real store: train
+    registers a candidate, gate --dry-run prints the decision WITHOUT
+    writing, gate promotes, a second day's promote enables a rollback."""
+    from bodywork_tpu.registry import resolve_alias
+    from bodywork_tpu.store import open_store
+
+    store = str(tmp_path / "artefacts")
+    assert main(["generate", "--store", store, "--date", "2026-01-01"]) == 0
+    assert main(["train", "--store", store]) == 0
+    capsys.readouterr()
+    assert main(["registry", "list", "--store", store]) == 0
+    out = capsys.readouterr().out
+    assert "models/regressor-2026-01-01.npz" in out and "candidate" in out
+    # dry-run prints the verdict and writes nothing
+    assert main(["registry", "gate", "--store", store, "--dry-run",
+                 "--date", "2026-01-01"]) == 0
+    out = capsys.readouterr().out
+    assert "dry-run: would PROMOTE" in out and "candidate-metrics" in out
+    assert resolve_alias(open_store(store), "production") is None
+    # the real gate flips the alias
+    assert main(["registry", "gate", "--store", store,
+                 "--date", "2026-01-01"]) == 0
+    assert resolve_alias(open_store(store), "production") == (
+        "models/regressor-2026-01-01.npz"
+    )
+    capsys.readouterr()
+    assert main(["registry", "show", "--store", store, "production"]) == 0
+    assert '"status": "production"' in capsys.readouterr().out
+    # day 2: train + explicit operator promote (by date), then rollback
+    assert main(["generate", "--store", store, "--date", "2026-01-02"]) == 0
+    assert main(["train", "--store", store]) == 0
+    assert main(["registry", "promote", "--store", store,
+                 "--model", "2026-01-02", "--date", "2026-01-02"]) == 0
+    assert resolve_alias(open_store(store), "production") == (
+        "models/regressor-2026-01-02.npz"
+    )
+    assert main(["registry", "rollback", "--store", store,
+                 "--date", "2026-01-03"]) == 0
+    assert resolve_alias(open_store(store), "production") == (
+        "models/regressor-2026-01-01.npz"
+    )
+    capsys.readouterr()
+
+
+def test_registry_cli_arg_validation(tmp_path, capsys):
+    """The clean-error contract: unknown alias exits 1, rollback with no
+    previous production exits 1, promote of an unregistered model exits
+    1 — never a traceback."""
+    store = str(tmp_path / "artefacts")
+    _seed(store)
+    assert main(["train", "--store", store]) == 0
+    # unknown alias name (not a key, not a date) is named in the error
+    assert main(["registry", "show", "--store", store, "staging"]) == 1
+    # no promotion yet: production unresolvable
+    assert main(["registry", "show", "--store", store, "production"]) == 1
+    # promote of an unregistered model refused
+    assert main(["registry", "promote", "--store", store,
+                 "--model", "2030-01-01"]) == 1
+    # rollback with no previous production: clean exit 1 (first with no
+    # alias doc at all, then with a production but no previous)
+    assert main(["registry", "rollback", "--store", store]) == 1
+    assert main(["registry", "gate", "--store", store,
+                 "--date", "2026-01-01"]) == 0
+    assert main(["registry", "rollback", "--store", store]) == 1
+    capsys.readouterr()
+
+
 def test_train_mesh_flags_reach_sharded_path(tmp_path, capsys):
     # `train --mesh-data/--mesh-model` arg wiring: rejects linear (the
     # sharded path is MLP-only), exit-code contract intact

@@ -193,3 +193,42 @@ def test_get_many_parallel_with_per_op_retry(gcs_store):
     # a missing key still surfaces ArtefactNotFound through the pool
     with pytest.raises(ArtefactNotFound):
         gcs_store.get_many([keys[0], "datasets/never.csv"])
+
+
+def test_cas_own_committed_write_is_not_a_conflict(gcs_store):
+    """Response-lost CAS uploads: the conditional write APPLIES
+    server-side, the reply is dropped, and the retry's precondition
+    fails against our own bumped generation. The post-check re-reads the
+    object — current content == our payload means the CAS succeeded, so
+    the caller's follow-up record updates run instead of being skipped
+    on a phantom PromotionConflict."""
+    token = gcs_store.put_bytes_if_match("registry/aliases.json", b"v1", None)
+    # next upload commits, then its response is lost (transient after
+    # apply); the retry sees generation token+1 and preconditions-fails
+    gcs_store._bucket.inject_failures("upload_after_apply", 1)
+    new_token = gcs_store.put_bytes_if_match(
+        "registry/aliases.json", b"v2", token
+    )
+    assert new_token is not None and new_token != token
+    assert gcs_store.get_bytes("registry/aliases.json") == b"v2"
+    # a REAL lost race (someone else's content) still conflicts
+    from bodywork_tpu.store.base import CasConflict
+
+    with pytest.raises(CasConflict):
+        gcs_store.put_bytes_if_match("registry/aliases.json", b"v3", token)
+
+
+def test_cas_own_write_post_check_survives_transient_verify_read(gcs_store):
+    """The post-check's verification read rides the SAME retry loop as
+    every other op: the flaky network that dropped the upload's response
+    is exactly the network likely to blip the re-read, and one transient
+    during verification must not convert a LANDED write into a reported
+    conflict."""
+    token = gcs_store.put_bytes_if_match("registry/aliases.json", b"v1", None)
+    gcs_store._bucket.inject_failures("upload_after_apply", 1)
+    gcs_store._bucket.inject_failures("download", 1)  # verify read blips once
+    new_token = gcs_store.put_bytes_if_match(
+        "registry/aliases.json", b"v2", token
+    )
+    assert new_token is not None and new_token != token
+    assert gcs_store.get_bytes("registry/aliases.json") == b"v2"

@@ -1,0 +1,850 @@
+"""Model registry: gated promotion, CAS alias safety, one-op rollback.
+
+The acceptance spine (ISSUE 5): a candidate that fails the promotion
+gate NEVER goes live (a running CheckpointWatcher keeps serving
+production across poll cycles), ``registry rollback`` restores the
+previous production in ONE operation and the watcher swaps back, and a
+registry-less store exercises the latest-checkpoint path byte-identically
+(the pre-registry serve/reload/pipeline tests pass unmodified — this
+file adds the explicit fallback assertions).
+"""
+import json
+import threading
+from datetime import date
+
+import numpy as np
+import pytest
+
+from bodywork_tpu.models import LinearRegressor, save_model
+from bodywork_tpu.registry import (
+    GatePolicy,
+    ModelRegistry,
+    PromotionConflict,
+    RegistryError,
+    registry_exists,
+    resolve_alias,
+    shadow_evaluate,
+)
+from bodywork_tpu.registry import records as rec
+from bodywork_tpu.store import (
+    REGISTRY_ALIAS_KEY,
+    CasConflict,
+    FilesystemStore,
+    model_key,
+)
+from bodywork_tpu.store.base import DelegatingStore
+from bodywork_tpu.train.trainer import persist_metrics
+
+from tests.helpers import make_counting_store, make_memory_store
+
+
+def _fit_model(slope: float, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(0, 100, 400).astype(np.float32)
+    y = (1.0 + slope * X + rng.normal(0, 1, 400)).astype(np.float32)
+    return LinearRegressor().fit(X, y)
+
+
+def _add_candidate(store, day: int, slope: float = 0.5,
+                   mape: float = 0.05, r2: float = 0.95) -> str:
+    """Persist a checkpoint + metrics for 2026-07-<day> and register it."""
+    d = date(2026, 7, day)
+    key = save_model(store, _fit_model(slope, seed=day), d)
+    persist_metrics(
+        store, {"MAPE": mape, "r_squared": r2, "max_residual": 1.0}, d
+    )
+    rec.register_candidate(store, key, day=d)
+    return key
+
+
+# -- records + aliases -----------------------------------------------------
+
+
+def test_register_candidate_records_lineage(store):
+    key = _add_candidate(store, 1)
+    record = rec.load_record(store, key)
+    assert record["status"] == "candidate"
+    assert record["model_digest"].startswith("sha256:")
+    assert record["metrics_key"] == "model-metrics/regressor-2026-07-01.csv"
+    assert record["history"][0]["event"] == "registered"
+    # idempotent per content: a re-register leaves the record byte-stable
+    raw = store.get_bytes(rec.registry_record_key(key))
+    rec.register_candidate(store, key, day=date(2026, 7, 1))
+    assert store.get_bytes(rec.registry_record_key(key)) == raw
+
+
+def test_registry_exists_requires_alias_not_records(store):
+    # records alone must NOT flip serving away from latest-checkpoint:
+    # before the first promotion there is nothing gated to serve
+    key = _add_candidate(store, 1)
+    assert not registry_exists(store)
+    assert resolve_alias(store, "production") is None
+    ModelRegistry(store).promote(key, day=date(2026, 7, 1))
+    assert registry_exists(store)
+    assert resolve_alias(store, "production") == key
+
+
+def test_promote_requires_registration(store):
+    with pytest.raises(RegistryError, match="unregistered"):
+        ModelRegistry(store).promote("models/regressor-2026-07-09.npz")
+
+
+def test_rollback_is_one_cas_flip_with_op_budget(store):
+    a = _add_candidate(store, 1)
+    b = _add_candidate(store, 2)
+    registry = ModelRegistry(store)
+    registry.promote(a, day=date(2026, 7, 1))
+    registry.promote(b, day=date(2026, 7, 2))
+    counting = make_counting_store(store)
+    doc = ModelRegistry(counting).rollback(day=date(2026, 7, 3))
+    assert doc["production"] == a and doc["previous"] == b
+    # ONE operation flips serving: a single alias CAS. The two record
+    # status updates are CAS read-modify-writes too (concurrent
+    # appenders must not drop each other's events) and NOTHING in the
+    # registry writes raw put_bytes
+    assert counting.by_key[("put_bytes_if_match", REGISTRY_ALIAS_KEY)] == 1
+    assert counting.ops["put_bytes_if_match"] == 3  # alias + 2 records
+    assert counting.ops.get("put_bytes", 0) == 0
+    assert rec.load_record(store, a)["status"] == "production"
+    assert rec.load_record(store, b)["status"] == "rejected"
+    with pytest.raises(RegistryError):  # demote(production) is refused
+        registry.demote(a)
+
+
+def test_rollback_without_previous_is_clean_error(store):
+    with pytest.raises(RegistryError, match="nothing to roll back"):
+        ModelRegistry(store).rollback()
+    key = _add_candidate(store, 1)
+    ModelRegistry(store).promote(key)
+    with pytest.raises(RegistryError, match="nothing to roll back to"):
+        ModelRegistry(store).rollback()
+
+
+def test_reregister_of_production_keeps_its_status(store):
+    """A same-key retrain with CHANGED bytes must not flip the currently
+    aliased production record back to 'candidate' (the ledger would
+    disown the model actually serving, and the gate would compare it
+    against itself): status survives, the digest refresh is recorded as
+    an event, and a retrained REJECTED key becomes a candidate again."""
+    key = _add_candidate(store, 1)
+    ModelRegistry(store).promote(key, day=date(2026, 7, 1))
+    # retrain the same date key with different bytes
+    save_model(store, _fit_model(0.9, seed=99), date(2026, 7, 1))
+    record = rec.register_candidate(store, key, day=date(2026, 7, 1))
+    assert record["status"] == "production"
+    assert record["history"][-1] == {
+        "event": "registered", "day": "2026-07-01", "digest_changed": True,
+    }
+    assert ModelRegistry(store).newest_candidate() is None
+    # …while a rejected record's retrain DOES become a candidate again
+    bad = _add_candidate(store, 2, mape=80.0, r2=0.01)
+    ModelRegistry(store).gate(day=date(2026, 7, 2))
+    assert rec.load_record(store, bad)["status"] == "rejected"
+    save_model(store, _fit_model(0.5, seed=7), date(2026, 7, 2))
+    assert rec.register_candidate(store, bad)["status"] == "candidate"
+
+
+def test_reregister_refresh_updates_dataset_coverage(store):
+    """A same-key retrain saw TODAY's dataset span: the refreshed record
+    must report the coverage behind the NEW bytes, not the original
+    registration's — `registry show` is the lineage audit surface."""
+    from bodywork_tpu.store import dataset_key
+
+    store.put_bytes(dataset_key(date(2026, 7, 1)), b"d1")
+    key = _add_candidate(store, 1)
+    assert rec.load_record(store, key)["dataset_days"]["count"] == 1
+    # more data lands, then the same key is retrained with changed bytes
+    store.put_bytes(dataset_key(date(2026, 7, 2)), b"d2")
+    save_model(store, _fit_model(0.9, seed=7), date(2026, 7, 1))
+    record = rec.register_candidate(store, key, day=date(2026, 7, 2))
+    assert record["dataset_days"] == {
+        "first": "2026-07-01", "last": "2026-07-02", "count": 2,
+    }
+
+
+def test_read_aliases_absent_costs_no_payload_read(store, monkeypatch):
+    """A registry-less store's alias probe is metadata-only on a backend
+    with a native existence check: the reload watcher runs it EVERY
+    poll, and an absent alias must not cost a failing GET (plus
+    corrupt-read retries) per cycle forever."""
+    calls = []
+    orig = type(store).get_bytes
+
+    def counting_get(self, key):
+        calls.append(key)
+        return orig(self, key)
+
+    monkeypatch.setattr(type(store), "get_bytes", counting_get)
+    assert rec.read_aliases(store) is None
+    assert calls == []  # token probe + stat only — zero payload reads
+
+
+def test_concurrent_record_appenders_lose_nothing(store):
+    """append_event is a CAS read-modify-write: two concurrent appenders
+    racing the same record both land their events (the loser re-reads
+    and re-applies) — the audit trail never silently drops a write."""
+    key = _add_candidate(store, 1)
+    barrier = threading.Barrier(2)
+    real_load = rec.load_record
+
+    def racing_load(s, model_key, with_token=False):
+        out = real_load(s, model_key, with_token=with_token)
+        try:
+            barrier.wait(timeout=1)  # both read the SAME revision first
+        except threading.BrokenBarrierError:
+            pass  # retry reads (after one CAS landed) pass straight through
+        return out
+
+    rec.load_record = racing_load
+    try:
+        threads = [
+            threading.Thread(
+                target=rec.append_event,
+                args=(store, key, {"event": f"e{i}", "day": None}),
+            )
+            for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        rec.load_record = real_load
+    events = [e["event"] for e in rec.load_record(store, key)["history"]]
+    assert events.count("e0") == 1 and events.count("e1") == 1
+
+
+# -- CAS races -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("backend", ["filesystem", "memory"])
+def test_concurrent_promoters_exactly_one_wins(backend, tmp_path):
+    """Two promoters race the SAME alias revision: exactly one CAS wins,
+    the loser gets a clean conflict, and the document never tears —
+    on the filesystem backend (sidecar-lock CAS) and the in-memory one
+    (per-store-lock CAS)."""
+    store = (
+        FilesystemStore(tmp_path / "artefacts")
+        if backend == "filesystem"
+        else make_memory_store()
+    )
+    keys = [
+        f"models/regressor-2026-07-0{i}.npz" for i in (1, 2)
+    ]
+    barrier = threading.Barrier(2)
+    results = [None, None]
+
+    def racer(i):
+        try:
+            _doc, token = rec.read_aliases(store, with_token=True)
+            barrier.wait()  # both READ the same revision before either CAS
+            rec.write_aliases(
+                store,
+                {"schema": rec.ALIAS_SCHEMA, "production": keys[i],
+                 "previous": None, "rev": 1, "updated_day": None,
+                 "last_op": "promote"},
+                token,
+            )
+            results[i] = "won"
+        except CasConflict:
+            results[i] = "conflict"
+
+    threads = [threading.Thread(target=racer, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(results) == ["conflict", "won"]
+    # never torn: the surviving document is the winner's, wholly
+    doc = rec.read_aliases(store)
+    winner = results.index("won")
+    assert doc["production"] == keys[winner]
+    assert doc["rev"] == 1
+
+
+def test_losing_promote_raises_promotion_conflict(store, monkeypatch):
+    a = _add_candidate(store, 1)
+    b = _add_candidate(store, 2)
+    ModelRegistry(store).promote(a)
+    # make promote() act on a STALE alias read (as if another promoter's
+    # write landed between its read and its CAS): the CAS must lose with
+    # the registry's clean conflict error, leaving the alias untorn
+    real = rec.read_aliases
+    stale_doc = real(store)
+    monkeypatch.setattr(
+        rec, "read_aliases",
+        lambda s, with_token=False: (
+            (stale_doc, "stale-token") if with_token else stale_doc
+        ),
+    )
+    with pytest.raises(PromotionConflict):
+        ModelRegistry(store).promote(b)
+    monkeypatch.setattr(rec, "read_aliases", real)
+    assert resolve_alias(store, "production") == a
+
+
+def test_cas_race_op_budget_with_counting_store():
+    """Race budget on the counting wrapper: the losing CAS consumes its
+    one put_bytes_if_match and writes NOTHING (no fallback raw put)."""
+    inner = make_memory_store()
+    store = make_counting_store(inner)
+    doc = {"schema": rec.ALIAS_SCHEMA, "production": "models/a.npz",
+           "previous": None, "rev": 1, "updated_day": None,
+           "last_op": "promote"}
+    rec.write_aliases(store, doc, None)
+    store.reset_counts()
+    with pytest.raises(CasConflict):
+        rec.write_aliases(store, {**doc, "production": "models/b.npz"},
+                          "stale-token")
+    assert store.ops["put_bytes_if_match"] == 1
+    assert store.ops.get("put_bytes", 0) == 0  # loser never writes
+    assert rec.read_aliases(inner)["production"] == "models/a.npz"
+
+
+# -- gate engine -----------------------------------------------------------
+
+
+def test_gate_bootstrap_promotes_first_healthy_candidate(store):
+    key = _add_candidate(store, 1)
+    decision = ModelRegistry(store).gate(day=date(2026, 7, 1))
+    assert decision.promote
+    assert resolve_alias(store, "production") == key
+    assert rec.load_record(store, key)["status"] == "production"
+
+
+def test_gate_rejects_candidate_without_metrics(store):
+    d = date(2026, 7, 1)
+    key = save_model(store, _fit_model(0.5), d)
+    rec.register_candidate(store, key, day=d)  # no metrics CSV exists
+    decision = ModelRegistry(store).gate(day=d)
+    assert not decision.promote
+    assert "candidate-metrics" in decision.reasons[0]
+    assert resolve_alias(store, "production") is None
+    assert rec.load_record(store, key)["status"] == "rejected"
+
+
+def test_gate_rejects_degraded_candidate_and_production_stays(store):
+    good = _add_candidate(store, 1, mape=0.05)
+    ModelRegistry(store).gate(day=date(2026, 7, 1))
+    bad = _add_candidate(store, 2, mape=50.0, r2=0.01)  # bad retrain
+    decision = ModelRegistry(store).gate(day=date(2026, 7, 2))
+    assert not decision.promote
+    # the alias NEVER moved — production still the good model
+    assert resolve_alias(store, "production") == good
+    assert rec.load_record(store, bad)["status"] == "rejected"
+    # the decision rides the audit trail — ONE event carrying both the
+    # verdict (promote=false + reasons) and the status move to rejected
+    history = rec.load_record(store, bad)["history"]
+    assert [e["event"] for e in history] == ["registered", "gate_decision"]
+    assert history[-1]["promote"] is False and history[-1]["reasons"]
+    # nothing left to gate: the next gate call is a no-op
+    assert ModelRegistry(store).gate(day=date(2026, 7, 3)) is None
+
+
+def test_gate_vs_production_uses_r2_drop_not_mape_ratio_by_default(store):
+    """The day-level MAPE ratio is measured tail noise for this
+    generator (near-zero labels — the same pathology that keeps `report
+    --mape-ratio` opt-in), so the DEFAULT relative check is the bounded
+    r_squared drop: a noisy-but-healthy retrain with a larger MAPE
+    still promotes; an opt-in MAPE ratio rejects it."""
+    _add_candidate(store, 1, mape=0.2, r2=0.70)
+    ModelRegistry(store).gate(day=date(2026, 7, 1))
+    # 3x the MAPE, correlation held: healthy day-to-day noise — promotes
+    noisy = _add_candidate(store, 2, mape=0.6, r2=0.68)
+    decision = ModelRegistry(store).gate(day=date(2026, 7, 2))
+    assert decision.promote
+    assert resolve_alias(store, "production") == noisy
+    # the same shape with the MAPE ratio OPTED IN is rejected
+    worse = _add_candidate(store, 3, mape=2.5, r2=0.67)
+    policy = GatePolicy(max_mape_vs_production=1.5)
+    decision = ModelRegistry(store, policy=policy).gate(day=date(2026, 7, 3))
+    assert not decision.promote
+    assert resolve_alias(store, "production") == noisy
+    assert rec.load_record(store, worse)["status"] == "rejected"
+
+
+def test_gate_drift_override_promotes_despite_degradation(store):
+    """A candidate degraded past the r2-drop floor still promotes when
+    the live drift signal says production is stale — a frozen
+    production model must not veto every fresh retrain forever."""
+    import pandas as pd
+
+    from bodywork_tpu.monitor.tester import persist_test_metrics
+
+    good = _add_candidate(store, 1, mape=0.05)
+    ModelRegistry(store).gate(day=date(2026, 7, 1))
+    # live tests show production's score/label correlation collapsed
+    for day in (1, 2):
+        persist_test_metrics(
+            store,
+            pd.DataFrame({
+                "date": [date(2026, 7, day)], "MAPE": [3.0],
+                "r_squared": [0.05], "max_residual": [9.0],
+                "mean_response_time": [0.001], "n_failures": [0],
+                "mean_error": [5.0], "error_std": [1.0], "n_scored": [100],
+            }),
+            date(2026, 7, day),
+        )
+    worse = _add_candidate(store, 2, mape=0.5, r2=0.5)  # r2 drop 0.45 > 0.2
+    decision = ModelRegistry(store).gate(day=date(2026, 7, 2))
+    assert decision.promote
+    assert any("drifted" in c["detail"] for c in decision.checks)
+    assert resolve_alias(store, "production") == worse
+
+
+def test_gate_skips_relative_check_on_nonfinite_production_metrics(store):
+    """An operator hand-promotes a model whose metrics CSV carries
+    r_squared=nan (promote, unlike the gate, never validates metrics):
+    every later gate's vs-production comparison can't run — the audit
+    trail must record it SKIPPED, same contract as unreadable metrics,
+    not claim a comparison that never happened passed."""
+    prod = _add_candidate(store, 1, mape=float("nan"), r2=float("nan"))
+    ModelRegistry(store).promote(prod, day=date(2026, 7, 1))
+    _add_candidate(store, 2, mape=0.05, r2=0.9)
+    decision = ModelRegistry(store).gate(day=date(2026, 7, 2))
+    assert decision.promote  # absolute checks carry it
+    vs = [c for c in decision.checks if c["name"] == "vs-production"]
+    assert vs and "SKIPPED" in vs[0]["detail"]
+
+
+def test_gate_refuses_current_production_key(store):
+    """Explicitly gating the key the alias serves is refused: a REJECT
+    verdict would flip the SERVING model's record to 'rejected' while
+    the alias keeps serving it — the ledger disowning production (the
+    same inconsistency demote(production) refuses to create)."""
+    key = _add_candidate(store, 1)
+    registry = ModelRegistry(store)
+    registry.promote(key, day=date(2026, 7, 1))
+    with pytest.raises(RegistryError, match="use rollback"):
+        registry.gate(day=date(2026, 7, 2), model_key=key)
+    assert rec.load_record(store, key)["status"] == "production"
+    assert resolve_alias(store, "production") == key
+
+
+def test_gate_dry_run_writes_nothing(store):
+    key = _add_candidate(store, 1)
+    counting = make_counting_store(store)
+    decision = ModelRegistry(counting).gate(
+        day=date(2026, 7, 1), dry_run=True
+    )
+    assert decision.promote  # would promote…
+    assert counting.ops.get("put_bytes", 0) == 0  # …but wrote nothing
+    assert counting.ops.get("put_bytes_if_match", 0) == 0
+    assert resolve_alias(store, "production") is None
+    assert rec.load_record(store, key)["status"] == "candidate"
+
+
+# -- shadow evaluation -----------------------------------------------------
+
+
+def _persist_day(store, day: int, slope: float = 0.5, n: int = 64):
+    from bodywork_tpu.data.io import Dataset, persist_dataset
+
+    rng = np.random.default_rng(day)
+    X = rng.uniform(0, 100, n).astype(np.float32)
+    y = (1.0 + slope * X).astype(np.float32)
+    persist_dataset(store, Dataset(X, y, date(2026, 7, day)))
+
+
+def test_shadow_evaluate_compares_candidate_to_production(store):
+    for day in (1, 2, 3):
+        _persist_day(store, day)
+    same = _add_candidate(store, 2, slope=0.5)
+    twin = _add_candidate(store, 3, slope=0.5)
+    report = shadow_evaluate(store, twin, same, days=2)
+    assert report["days"] == 2 and report["rows"] == 128
+    assert report["mean_abs_delta"] < 0.5  # near-identical models
+    diverged = _add_candidate(store, 4, slope=2.0)
+    report2 = shadow_evaluate(store, diverged, same, days=2)
+    assert report2["mean_abs_delta"] > 10.0  # slope 2 vs 0.5 over X~[0,100]
+    assert report2["production_mape"] < report2["candidate_mape"]
+
+
+def test_gate_shadow_check_blocks_divergent_candidate(store):
+    for day in (1, 2, 3):
+        _persist_day(store, day)
+    good = _add_candidate(store, 1, slope=0.5)
+    ModelRegistry(store).gate(day=date(2026, 7, 1))
+    # a candidate with healthy TRAIN metrics but wildly different live
+    # predictions: only the shadow check can see it
+    diverged = _add_candidate(store, 2, slope=2.0, mape=0.05)
+    policy = GatePolicy(shadow_days=2, shadow_max_mean_abs_delta=1.0)
+    decision = ModelRegistry(store, policy=policy).gate(day=date(2026, 7, 2))
+    assert not decision.promote
+    assert decision.shadow is not None
+    assert any(c["name"] == "shadow" and not c["ok"] for c in decision.checks)
+    assert resolve_alias(store, "production") == good
+
+
+# -- corrupt payloads ------------------------------------------------------
+
+
+class _CorruptingStore(DelegatingStore):
+    """Corrupts the first N reads of targeted keys (the chaos shape:
+    truncated payloads, bounded by the consecutive cap)."""
+
+    def __init__(self, inner, n: int, prefix: str = "registry/"):
+        super().__init__(inner)
+        self.remaining = n
+        self.prefix = prefix
+
+    def get_bytes(self, key):
+        data = self._inner.get_bytes(key)
+        if key.startswith(self.prefix) and self.remaining > 0:
+            self.remaining -= 1
+            return data[: max(1, len(data) // 2)]
+        return data
+
+
+def test_corrupt_record_read_retries_then_treated_as_absent(store):
+    from bodywork_tpu.obs import get_registry
+
+    key = _add_candidate(store, 1)
+    counter = get_registry().counter(
+        "bodywork_tpu_registry_corrupt_records_total"
+    )
+    before = counter.value(kind="record")
+    # 2 corrupt reads (the chaos plan's max_consecutive default): the
+    # retry budget absorbs them — the record still loads, chaos-run gate
+    # decisions stay byte-identical to the fault-free twin's
+    wrapped = _CorruptingStore(store, n=2)
+    assert rec.load_record(wrapped, key) is not None
+    assert counter.value(kind="record") == before + 2
+    # past the budget: treated as absent + flagged for repair
+    wrapped = _CorruptingStore(store, n=10)
+    assert rec.load_record(wrapped, key) is None
+    assert wrapped.mutable_cache("_registry_state")["repair_needed"] is True
+
+
+def test_corrupt_alias_raises_and_watcher_keeps_serving(store):
+    from bodywork_tpu.registry.records import RegistryCorrupt
+    from bodywork_tpu.serve import CheckpointWatcher, create_app
+    from bodywork_tpu.models import load_model
+
+    key = _add_candidate(store, 1)
+    ModelRegistry(store).promote(key, day=date(2026, 7, 1))
+    model, model_date = load_model(store)
+    app = create_app(model, model_date, buckets=(1,), warmup=False,
+                     model_key=key, model_source="production")
+    watcher = CheckpointWatcher(app, store, poll_interval_s=3600,
+                                served_key=key)
+    # an alias that NEVER reads valid must raise — not silently fall
+    # back to latest (which could put an ungated checkpoint live)
+    wrapped = _CorruptingStore(store, n=100)
+    with pytest.raises(RegistryCorrupt):
+        rec.read_aliases(wrapped)
+    watcher.store = wrapped
+    assert watcher.check_once() is False  # logged, no swap, still serving
+    assert app.model_date == "2026-07-01"
+    # …but SAYS so: while resolution fails, promotions/rollbacks cannot
+    # take effect — /healthz flags degraded (still 200: last-good serves)
+    health = app.test_client().get("/healthz")
+    assert health.status_code == 200
+    assert health.get_json()["degraded"] is True
+    # the alias heals with no swap due: the next poll clears the flag
+    watcher.store = store
+    assert watcher.check_once() is False
+    assert app.test_client().get("/healthz").get_json()["degraded"] is False
+
+
+def test_chaos_default_plan_covers_registry_prefix():
+    from bodywork_tpu.chaos import FaultPlan
+
+    plan = FaultPlan.default(0)
+    assert "registry/" in plan.corrupt_prefixes
+    assert "snapshots/" in plan.corrupt_prefixes
+    # the registry read budget exceeds the cap: a capped corrupt streak
+    # can never make a record read degrade to absent mid-soak
+    assert rec.CORRUPT_READ_RETRIES >= plan.max_consecutive
+
+
+# -- the end-to-end gate proof (ISSUE 5 acceptance) ------------------------
+
+
+def test_failed_gate_never_goes_live_and_rollback_is_one_op(store, tmp_path):
+    """The acceptance spine: candidate fails the gate -> a RUNNING
+    CheckpointWatcher keeps serving production across >= 2 poll cycles;
+    a later good candidate promotes and swaps in; `cli registry
+    rollback` restores the previous production in one operation and the
+    watcher swaps BACK."""
+    from bodywork_tpu.cli import main
+    from bodywork_tpu.models import load_model
+    from bodywork_tpu.serve import CheckpointWatcher, create_app
+
+    prod = _add_candidate(store, 1, slope=0.5, mape=0.05)
+    ModelRegistry(store).gate(day=date(2026, 7, 1))
+    model, model_date = load_model(store)  # resolves the production alias
+    app = create_app(model, model_date, buckets=(1, 8), warmup=True,
+                     model_key=prod, model_source="production")
+    client = app.test_client()
+    watcher = CheckpointWatcher(app, store, poll_interval_s=3600)
+    assert client.post("/score/v1", json={"X": 50}).get_json()[
+        "model_date"
+    ] == "2026-07-01"
+
+    # a BAD retrain lands: newest under models/, rejected by the gate
+    bad = _add_candidate(store, 2, slope=9.0, mape=80.0, r2=0.01)
+    decision = ModelRegistry(store).gate(day=date(2026, 7, 2))
+    assert not decision.promote
+    # >= 2 poll cycles: the watcher keeps serving production — the bad
+    # checkpoint IS the newest date-keyed artefact, and pre-registry
+    # behavior would have swapped it in on the first poll
+    assert watcher.check_once() is False
+    assert watcher.check_once() is False
+    body = client.post("/score/v1", json={"X": 50}).get_json()
+    assert body["model_date"] == "2026-07-01"
+    health = client.get("/healthz").get_json()
+    assert health["model_key"] == prod
+    assert health["model_source"] == "production"
+
+    # a GOOD retrain promotes and the watcher swaps it in
+    good = _add_candidate(store, 3, slope=0.6, mape=0.05)
+    assert ModelRegistry(store).gate(day=date(2026, 7, 3)).promote
+    assert watcher.check_once() is True
+    assert app.model_date == "2026-07-03"
+    assert app.model_key == good
+
+    # rollback: ONE cli operation flips the alias back; the watcher's
+    # next poll swaps the previous production back in
+    assert main(["registry", "rollback", "--store", str(store.root),
+                 "--date", "2026-07-04"]) == 0
+    assert resolve_alias(store, "production") == prod
+    assert watcher.check_once() is True
+    assert app.model_date == "2026-07-01"
+    assert app.model_key == prod
+    body = client.post("/score/v1", json={"X": 50}).get_json()
+    assert body["model_date"] == "2026-07-01"
+    # steady state after the rollback swap
+    assert watcher.check_once() is False
+
+
+def test_registry_less_store_serves_latest_byte_identically(store):
+    """No registry artefacts at all: resolution, the watcher, and
+    /healthz all ride today's latest-checkpoint path (source='latest'),
+    and nothing under registry/ is ever created by serving."""
+    from bodywork_tpu.models import load_model
+    from bodywork_tpu.models.checkpoint import resolve_serving_key
+    from bodywork_tpu.serve import CheckpointWatcher, create_app
+
+    d = date(2026, 7, 1)
+    key = save_model(store, _fit_model(0.5), d)
+    assert resolve_serving_key(store) == (key, "latest")
+    model, model_date = load_model(store)
+    app = create_app(model, model_date, buckets=(1,), warmup=False,
+                     model_key=key, model_source="latest")
+    watcher = CheckpointWatcher(app, store, poll_interval_s=3600)
+    assert watcher.check_once() is False
+    # a newer checkpoint swaps in on the next poll — original behavior
+    key2 = save_model(store, _fit_model(1.0), date(2026, 7, 2))
+    assert watcher.check_once() is True
+    health = app.test_client().get("/healthz").get_json()
+    assert health["model_key"] == key2
+    assert health["model_source"] == "latest"
+    assert store.list_keys("registry/") == []  # serving never writes it
+
+
+def test_rejected_bootstrap_candidate_never_served_via_fallback(store):
+    """Records exist but nothing was ever promoted (the very first
+    candidate failed the gate): the latest-checkpoint fallback must SKIP
+    gate-rejected checkpoints — a store is only 'registry-less' when it
+    has no records at all. With every checkpoint rejected there is
+    nothing serviceable (degraded boot), and serve_latest_model boots
+    degraded instead of dying when a watcher is configured."""
+    from bodywork_tpu.models.checkpoint import resolve_serving_key
+    from bodywork_tpu.serve import serve_latest_model
+    from bodywork_tpu.store.base import ArtefactNotFound
+
+    bad = _add_candidate(store, 1, mape=80.0, r2=0.01)
+    decision = ModelRegistry(store).gate(day=date(2026, 7, 1))
+    assert not decision.promote
+    with pytest.raises(ArtefactNotFound, match="gate-rejected"):
+        resolve_serving_key(store)
+    # an ungated CANDIDATE still serves (cli train + serve compat)…
+    ok = _add_candidate(store, 2)
+    assert resolve_serving_key(store) == (ok, "latest")
+    # …and a rejected NEWEST falls back to the newest non-rejected
+    worse = _add_candidate(store, 3, mape=80.0, r2=0.01)
+    ModelRegistry(store).gate(day=date(2026, 7, 3), model_key=worse)
+    assert resolve_serving_key(store) == (ok, "latest")
+    # all-rejected + watcher: degraded boot, not a crash loop
+    ModelRegistry(store).demote(ok, day=date(2026, 7, 3))
+    handle = serve_latest_model(
+        store, host="127.0.0.1", port=0, block=False, watch_interval_s=3600
+    )
+    try:
+        client = handle.app.test_client()
+        assert client.get("/healthz").status_code == 503
+    finally:
+        handle.stop()
+
+
+def test_dangling_production_alias_boots_degraded_with_watcher(store):
+    """The alias resolves but its checkpoint is GONE (e.g. lifecycle
+    pruning deleted old models while registry/ was retained): with a
+    watcher, serve_latest_model boots degraded (503) instead of crash
+    -looping the supervisor; without one it still raises."""
+    from bodywork_tpu.serve import serve_latest_model
+    from bodywork_tpu.store.base import ArtefactNotFound
+
+    key = _add_candidate(store, 1)
+    ModelRegistry(store).promote(key, day=date(2026, 7, 1))
+    store.delete(key)  # alias now dangles
+    with pytest.raises(ArtefactNotFound):
+        serve_latest_model(store, host="127.0.0.1", port=0, block=False)
+    handle = serve_latest_model(
+        store, host="127.0.0.1", port=0, block=False, watch_interval_s=3600
+    )
+    try:
+        client = handle.app.test_client()
+        assert client.get("/healthz").status_code == 503
+    finally:
+        handle.stop()
+
+
+def test_run_day_gate_step_spans_and_serves_production(tmp_path):
+    """The runner's gate step: run-day records a registry-gate span in
+    the day report (own `gate` category — stage_seconds stays exactly
+    the user's declared DAG, so pre-registry pipeline tests pass
+    unmodified), the serve span carries the served key under registry
+    authority, and the gate's decision rides stage_results."""
+    from bodywork_tpu.obs.spans import day_report
+    from bodywork_tpu.pipeline import LocalRunner, default_pipeline
+
+    store = FilesystemStore(tmp_path / "artefacts")
+    runner = LocalRunner(default_pipeline(), store)
+    runner.bootstrap(date(2026, 7, 1))
+    result = runner.run_day(date(2026, 7, 1))
+    assert "registry-gate" not in result.stage_seconds  # declared DAG only
+    gate_spans = [s for s in result.spans if s.name == "registry-gate"]
+    assert gate_spans and gate_spans[0].category == "gate"
+    assert gate_spans[0].meta["verdict"] == "promoted"
+    # the span lands in the structured day report
+    report = day_report(result)
+    assert any(
+        s["name"] == "registry-gate" for s in report["spans"]
+    )
+    serve_span = next(
+        s for s in result.spans if s.name == "stage-2-serve-model"
+    )
+    assert serve_span.meta["served_key"] == "models/regressor-2026-07-01.npz"
+    assert serve_span.meta["model_source"] == "production"
+    assert resolve_alias(store, "production") == (
+        "models/regressor-2026-07-01.npz"
+    )
+    # the decision rides the day's results (day_report input)
+    assert result.stage_results["registry-gate"].promote
+
+
+# -- the alias-mutation guard (ISSUE 5 satellite) --------------------------
+
+
+def test_no_raw_put_bytes_on_alias_key_in_codebase():
+    """Every alias mutation in the codebase routes through
+    put_bytes_if_match: no source file may call put_bytes/put_text on
+    the alias key. (The CAS protocol only arbitrates writers that USE
+    it — one raw writer would reintroduce the clobber race.)"""
+    import pathlib
+    import re
+
+    root = pathlib.Path(__file__).resolve().parents[1] / "bodywork_tpu"
+    raw_write = re.compile(
+        r"put_(?:bytes|text)\(\s*(?:REGISTRY_ALIAS_KEY|ALIAS_KEY"
+        r"|[\"']registry/aliases\.json[\"'])"
+    )
+    offenders = []
+    for path in root.rglob("*.py"):
+        text = path.read_text()
+        if raw_write.search(text):
+            offenders.append(str(path))
+    assert offenders == [], (
+        f"raw alias writes found (must use put_bytes_if_match): {offenders}"
+    )
+    # and the one sanctioned writer really is the CAS helper
+    records_src = (root / "registry" / "records.py").read_text()
+    assert "put_bytes_if_match(" in records_src
+
+
+def test_runtime_alias_mutations_all_go_through_cas(store):
+    """Runtime version of the guard: drive register -> gate -> promote ->
+    rollback through a counting wrapper and assert the alias key is only
+    ever touched by put_bytes_if_match."""
+    counting = make_counting_store(store)
+    d = date(2026, 7, 1)
+    key = save_model(counting, _fit_model(0.5), d)
+    persist_metrics(
+        counting, {"MAPE": 0.05, "r_squared": 0.95, "max_residual": 1.0}, d
+    )
+    rec.register_candidate(counting, key, day=d)
+    ModelRegistry(counting).gate(day=d)
+    key2 = _add_candidate(store, 2)
+    ModelRegistry(counting).gate(day=date(2026, 7, 2))
+    ModelRegistry(counting).rollback(day=date(2026, 7, 3))
+    assert counting.by_key.get(("put_bytes", REGISTRY_ALIAS_KEY), 0) == 0
+    assert counting.by_key[("put_bytes_if_match", REGISTRY_ALIAS_KEY)] == 3
+    # record writes ride the CAS primitive too: zero raw puts anywhere
+    # under registry/ (the model/metrics artefact writes above are the
+    # only raw puts this flow makes)
+    assert not [
+        key for (op, key) in counting.by_key
+        if op == "put_bytes" and key is not None
+        and key.startswith("registry/")
+    ]
+
+
+# -- metrics ---------------------------------------------------------------
+
+
+def test_registry_metrics_exported(store):
+    from bodywork_tpu.obs import get_registry
+
+    reg = get_registry()
+    promotions = reg.counter("bodywork_tpu_registry_promotions_total")
+    rollbacks = reg.counter("bodywork_tpu_registry_rollbacks_total")
+    p0 = promotions.value(outcome="promoted")
+    r0 = promotions.value(outcome="rejected")
+    b0 = rollbacks.value()
+    a = _add_candidate(store, 1, mape=0.05)
+    ModelRegistry(store).gate(day=date(2026, 7, 1))
+    _add_candidate(store, 2, mape=80.0, r2=0.01)
+    ModelRegistry(store).gate(day=date(2026, 7, 2))
+    c = _add_candidate(store, 3, mape=0.05)
+    ModelRegistry(store).gate(day=date(2026, 7, 3))
+    ModelRegistry(store).rollback(day=date(2026, 7, 4))
+    assert promotions.value(outcome="promoted") == p0 + 2
+    assert promotions.value(outcome="rejected") == r0 + 1
+    assert rollbacks.value() == b0 + 1
+
+
+def test_registry_metric_names_pass_obs_lint():
+    # the catalogue entries (docs/OBSERVABILITY.md) are lintable by
+    # construction: namespace prefix + unit suffix + counter/_total rule
+    from bodywork_tpu.obs import validate_metric_name
+
+    validate_metric_name("bodywork_tpu_registry_promotions_total", "counter")
+    validate_metric_name("bodywork_tpu_registry_rollbacks_total", "counter")
+    validate_metric_name("bodywork_tpu_serve_model_version_info", "gauge")
+    validate_metric_name(
+        "bodywork_tpu_registry_corrupt_records_total", "counter"
+    )
+
+
+def test_served_model_version_info_gauge(store):
+    from bodywork_tpu.models import load_model
+    from bodywork_tpu.obs import get_registry
+    from bodywork_tpu.serve import CheckpointWatcher, create_app
+
+    a = _add_candidate(store, 1)
+    ModelRegistry(store).promote(a, day=date(2026, 7, 1))
+    model, model_date = load_model(store)
+    app = create_app(model, model_date, buckets=(1,), warmup=False,
+                     model_key=a, model_source="production")
+    gauge = get_registry().get("bodywork_tpu_serve_model_version_info")
+    assert gauge.value(model_key=a, source="production") == 1.0
+    b = _add_candidate(store, 2)
+    ModelRegistry(store).promote(b, day=date(2026, 7, 2))
+    watcher = CheckpointWatcher(app, store, poll_interval_s=3600,
+                                served_key=a)
+    assert watcher.check_once() is True
+    # the swap moves the live sample and zeroes the superseded one
+    assert gauge.value(model_key=b, source="production") == 1.0
+    assert gauge.value(model_key=a, source="production") == 0.0

@@ -71,6 +71,35 @@ def test_healthz(client):
     assert body["model_date"] == "2026-07-01"
 
 
+def test_healthz_reports_served_key_and_registry_status(fitted_model):
+    """ISSUE 5 satellite: /healthz carries the served model KEY and how
+    it was resolved — "production" (registry alias), "latest"
+    (registry-less fallback) — and the degraded channel keeps riding
+    next to them after a failed reload."""
+    key = "models/regressor-2026-07-01.npz"
+    app = create_app(fitted_model, date(2026, 7, 1), buckets=(1,),
+                     warmup=False, model_key=key, model_source="production")
+    body = app.test_client().get("/healthz").get_json()
+    assert body["model_key"] == key
+    assert body["model_source"] == "production"
+    assert body["degraded"] is False
+    # a failed hot reload: still serving, but flagged — key/source stay
+    app.set_degraded("hot reload of models/x.npz failed")
+    body = app.test_client().get("/healthz").get_json()
+    assert body["degraded"] is True and body["model_key"] == key
+    app.clear_degraded()
+    # fallback-latest resolution reports itself as such
+    fallback = create_app(fitted_model, date(2026, 7, 1), buckets=(1,),
+                          warmup=False, model_key=key, model_source="latest")
+    assert fallback.test_client().get("/healthz").get_json()[
+        "model_source"
+    ] == "latest"
+    # a model-less (degraded-boot) app reports null identity on its 503
+    empty = create_app(None)
+    body = empty.test_client().get("/healthz").get_json()
+    assert body["model_key"] is None and body["model_source"] is None
+
+
 def test_padded_predictor_matches_direct(fitted_model):
     pred = PaddedPredictor(fitted_model, buckets=(1, 8, 64))
     for n in [1, 3, 8, 9, 64, 200]:  # 200 > max bucket => chunked

@@ -180,6 +180,69 @@ def test_get_many_contract(store):
         store.get_many([keys[0], "datasets/never-written.csv"])
 
 
+def test_put_bytes_if_match_contract(store):
+    # the compare-and-swap primitive the registry's alias document rides
+    # (same semantics on every backend: create-only with None, token-
+    # pinned overwrite, clean CasConflict on a lost race, store untouched)
+    from bodywork_tpu.store import REGISTRY_ALIAS_KEY, CasConflict
+
+    token = store.put_bytes_if_match(REGISTRY_ALIAS_KEY, b"v1", None)
+    assert token is not None
+    assert store.get_bytes(REGISTRY_ALIAS_KEY) == b"v1"
+    # create-only against an existing key loses cleanly
+    with pytest.raises(CasConflict):
+        store.put_bytes_if_match(REGISTRY_ALIAS_KEY, b"clobber", None)
+    assert store.get_bytes(REGISTRY_ALIAS_KEY) == b"v1"
+    # token-pinned overwrite wins exactly once
+    token2 = store.put_bytes_if_match(REGISTRY_ALIAS_KEY, b"v2", token)
+    assert token2 is not None and token2 != token
+    with pytest.raises(CasConflict):
+        store.put_bytes_if_match(REGISTRY_ALIAS_KEY, b"v3", token)  # stale
+    assert store.get_bytes(REGISTRY_ALIAS_KEY) == b"v2"
+    # a raw overwrite (e.g. another writer ignoring the protocol) still
+    # invalidates an in-flight CAS: the token moved
+    store.put_bytes(REGISTRY_ALIAS_KEY, b"raw")
+    with pytest.raises(CasConflict):
+        store.put_bytes_if_match(REGISTRY_ALIAS_KEY, b"v4", token2)
+
+
+def test_put_bytes_if_match_lock_sidecar_is_invisible_and_releases(tmp_path):
+    # filesystem-specific: the CAS flock sidecar is a PERSISTENT
+    # .tmp-lock.* file (unlinking it would reopen the flock-unlink
+    # two-inode race) that never appears in listings, and the flock
+    # itself is released after the op — a second CAS acquires instantly
+    fs = FilesystemStore(tmp_path / "artefacts")
+    token = fs.put_bytes_if_match("registry/aliases.json", b"v1", None)
+    assert (fs.root / "registry" / ".tmp-lock.aliases.json").exists()
+    assert fs.list_keys("registry/") == ["registry/aliases.json"]
+    # lock released: the next CAS succeeds without waiting out a holder
+    fs.put_bytes_if_match("registry/aliases.json", b"v2", token)
+    assert fs.get_bytes("registry/aliases.json") == b"v2"
+
+
+def test_cas_lock_io_fault_is_not_a_conflict(tmp_path, monkeypatch):
+    # filesystem-specific: an EIO out of flock is a broken disk, not a
+    # lost race — surfacing it as CasConflict would have promoters
+    # retry forever against an 'eternal conflict' that is really a
+    # failing device. Only BlockingIOError (lock contention) converts.
+    import errno
+
+    from bodywork_tpu.store import CasConflict
+
+    fs = FilesystemStore(tmp_path / "artefacts")
+
+    def _broken(fd, op):
+        raise OSError(errno.EIO, "I/O error")
+
+    monkeypatch.setattr(
+        "bodywork_tpu.store.filesystem.fcntl.flock", _broken
+    )
+    with pytest.raises(OSError) as exc_info:
+        fs.put_bytes_if_match("registry/aliases.json", b"v1", None)
+    assert not isinstance(exc_info.value, CasConflict)
+    assert exc_info.value.errno == errno.EIO
+
+
 def test_exists_via_version_token_transfers_no_payload():
     # Satellite: the BASE exists() consults version_token first, so a
     # backend with tokens answers a multi-MB existence check from
