@@ -36,6 +36,12 @@ under ``"configs"``. ``--config N`` runs a single config:
    mechanism is round-trip elimination — O(days) GETs collapse to
    O(1 + tail) — not device speed; the in-record 67 ms/GET projection
    translates the counts onto the measured tunnel transport (PERF.md §1)
+9. open-loop serving (``bodywork_tpu.traffic``): seeded arrival-rate
+   load at 0.5x/1x/2x of each engine's measured closed-loop capacity,
+   thread vs aio front-end — offered vs goodput rps, p50/p99/p99.9 on
+   admitted responses (measured from scheduled arrival), shed fraction,
+   plus an MMPP burst point and a cross-engine byte-identity check.
+   CPU-safe: the mechanism is front-end queueing/admission control
 
 Protocol (configs 2/3/5): bootstrap a fresh store, run the multi-day
 simulation, report the mean wall-clock of the steady-state days (day 1
@@ -80,7 +86,7 @@ from datetime import date
 BASELINE_DAY_S = 1317 * 0.00822  # reference stage-4 scoring loop, see above
 BASELINE_REQUEST_S = 0.00822  # reference per-request scoring latency
 
-ALL_CONFIGS = (1, 2, 3, 4, 5, 6, 7, 8)
+ALL_CONFIGS = (1, 2, 3, 4, 5, 6, 7, 8, 9)
 HEADLINE_CONFIG = 2  # the north-star day loop
 
 # -- config 6: the "wide" workload (no reference analogue) -------------------
@@ -1484,6 +1490,381 @@ def bench_history_cold_start(
     }
 
 
+#: open-loop sweep shape (config 9): offered-load multiples of the
+#: measured closed-loop capacity. 0.5x shows the uncontended floor, 1x
+#: the knee, 2x the overload regime where admission control either
+#: holds goodput or the queue collapses.
+OPEN_LOOP_FACTORS = (0.5, 1.0, 2.0)
+#: offered-rate ceiling: keeps a fast machine's 2x point inside what
+#: the single-event-loop driver can schedule faithfully (the record
+#: carries send_lag_p99_s so a lagging driver is visible, not silent)
+OPEN_LOOP_RATE_CAP_RPS = 2000.0
+#: engines config 9 sweeps — pinned == serve.server.SERVER_ENGINES by
+#: tests/test_aio.py (the sync guard): a front-end missing here ships
+#: unmeasured
+OPEN_LOOP_ENGINES = ("thread", "aio")
+
+
+def _byte_identity_check(urls: dict) -> dict:
+    """POST the same bodies to every engine and compare raw response
+    bytes — the cross-engine contract (serve.app's shared payload
+    builders make it true by construction; this measures it)."""
+    import requests as rq
+
+    cases = {
+        "single": ("/score/v1", {"X": [50.0]}),
+        "batch": ("/score/v1/batch", {"X": [1.0, 2.0, 3.0]}),
+        "malformed": ("/score/v1", {"nope": 1}),
+    }
+    result: dict = {"identical": True, "cases": {}}
+    for name, (route, body) in cases.items():
+        bodies = {}
+        for engine, base in urls.items():
+            resp = rq.post(base + route, json=body, timeout=30)
+            bodies[engine] = (resp.status_code, resp.content)
+        statuses = {engine: b[0] for engine, b in bodies.items()}
+        unique = {b for _s, b in bodies.values()}
+        result["cases"][name] = {
+            "statuses": statuses, "identical": len(unique) == 1,
+        }
+        if len(unique) != 1:
+            result["identical"] = False
+    return result
+
+
+def _open_loop_capacity(url: str, rate_cap_rps: float,
+                        window_s: float = 3.0,
+                        start_rps: float = 100.0) -> tuple[float, list]:
+    # (window_s is plumbed through bench_open_loop_serving's
+    # capacity_window_s so the tier-1 smoke can shrink the ramp)
+    """Capacity estimation (docs/PERF.md §config 9): ramp the offered
+    rate (doubling each window) and take the PEAK in-window goodput as
+    the sustainable service rate — the top of the classic
+    throughput-vs-offered-load curve. Past saturation, in-window
+    goodput *under*-states capacity (arrivals near the window's end
+    sit behind a queue and complete after it), so the peak — not the
+    last window — is the estimate; the ramp stops once a window falls
+    clearly past the peak. A closed-loop probe can't do this job here:
+    its GIL-sharing client threads saturate the *client* long before
+    the event-loop server, underestimating capacity so badly that
+    "2x capacity" never overloads anything."""
+    from bodywork_tpu.traffic import TrafficConfig, generate_request_log, run_open_loop
+
+    def window(rate: float, seed: int):
+        cfg = TrafficConfig(rate_rps=rate, duration_s=window_s, seed=seed)
+        return run_open_loop(
+            url, generate_request_log(cfg), timeout_s=15.0,
+            duration_s=window_s,
+        )
+
+    ramp = []
+    rate = start_rps
+    best = 0.0
+    while rate <= rate_cap_rps:
+        report = window(rate, seed=89)
+        saturated = report.goodput_in_window_rps < 0.9 * report.offered_rps
+        if saturated and report.shed_fraction == 0.0:
+            # an apparently-saturated window with ZERO sheds is ambiguous:
+            # real saturation queues (and on the aio engine sheds), but a
+            # host scheduling stall (CPU-quota throttle period, noisy
+            # neighbour) produces the same goodput dip. Confirm with a
+            # second independent window and keep the better of the two —
+            # truncating the ramp on a blip underestimates capacity so
+            # badly that the 2x "overload" point never overloads anything.
+            retry = window(rate, seed=189)
+            if retry.goodput_in_window_rps > report.goodput_in_window_rps:
+                report = retry
+            saturated = (
+                report.goodput_in_window_rps < 0.9 * report.offered_rps
+            )
+        ramp.append({
+            "offered_rps": report.offered_rps,
+            "goodput_in_window_rps": report.goodput_in_window_rps,
+            "shed_fraction": report.shed_fraction,
+        })
+        best = max(best, report.goodput_in_window_rps)
+        past_peak = report.goodput_in_window_rps < 0.75 * best
+        if saturated or past_peak:
+            break
+        rate *= 2.0
+    return best, ramp
+
+
+def _wait_healthy(base_url: str, proc, timeout_s: float = 90.0) -> None:
+    import requests as rq
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"serve subprocess died during startup "
+                f"(rc={proc.returncode})"
+            )
+        try:
+            if rq.get(base_url + "/healthz", timeout=2).status_code == 200:
+                return
+        except Exception:
+            pass
+        time.sleep(0.25)
+    proc.terminate()
+    raise TimeoutError(f"serve subprocess not healthy within {timeout_s}s")
+
+
+class _ServeTarget:
+    """One scoring service under open-loop test — in its own OS process
+    (default: the driver's event loop must not steal GIL time from the
+    server it is measuring, or capacity collapses with offered load and
+    the sweep measures the *bench*) or in-process (``isolate=False``:
+    the tier-1 smoke, where rates are too low for contention to
+    matter)."""
+
+    def __init__(self, store_path: str, engine: str, window_ms: float,
+                 max_rows: int, buckets, isolate: bool):
+        self.engine = engine
+        self._proc = None
+        self._handle = None
+        if isolate:
+            port = _free_port()
+            self.base_url = f"http://127.0.0.1:{port}"
+            self._proc = subprocess.Popen(
+                [sys.executable, "-m", "bodywork_tpu.cli", "serve",
+                 "--store", store_path, "--host", "127.0.0.1",
+                 "--port", str(port), "--server-engine", engine,
+                 "--reload-interval", "0",
+                 "--batch-window-ms", str(window_ms),
+                 "--batch-max-rows", str(max_rows),
+                 "--buckets", ",".join(str(b) for b in buckets)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            _wait_healthy(self.base_url, self._proc)
+        else:
+            from bodywork_tpu.serve import serve_latest_model
+            from bodywork_tpu.store import FilesystemStore
+
+            self._handle = serve_latest_model(
+                FilesystemStore(store_path), host="127.0.0.1", port=0,
+                block=False, buckets=buckets, batch_window_ms=window_ms,
+                batch_max_rows=max_rows, server_engine=engine,
+            )
+            self.base_url = self._handle.url.replace("/score/v1", "")
+
+    @property
+    def url(self) -> str:
+        return self.base_url + "/score/v1"
+
+    def admission_state(self):
+        """The /healthz admission block — the same numbers either way,
+        read over HTTP so process isolation costs nothing."""
+        import requests as rq
+
+        return rq.get(self.base_url + "/healthz", timeout=10).json().get(
+            "admission"
+        )
+
+    def stop(self) -> None:
+        if self._handle is not None:
+            self._handle.stop()
+        if self._proc is not None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+                self._proc.wait(timeout=10)
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def bench_open_loop_serving(
+    duration_s: float = 8.0,
+    probe_clients: int = 16,
+    probe_requests: int = 40,
+    load_factors: tuple = OPEN_LOOP_FACTORS,
+    window_ms: float = 2.0,
+    max_rows: int = 64,
+    rate_cap_rps: float = OPEN_LOOP_RATE_CAP_RPS,
+    mmpp_point: bool = True,
+    isolate: bool = True,
+    capacity_window_s: float = 3.0,
+) -> dict:
+    """Config 9: open-loop serving — offered load vs goodput, tail
+    latency, and shed fraction at 0.5x/1x/2x measured capacity, for
+    both HTTP front-ends.
+
+    Every earlier serving number (configs 4/7) is *closed-loop*: the
+    clients wait for responses, so offered load can never exceed
+    service rate and queueing collapse is invisible. This config drives
+    arrival-rate load (``bodywork_tpu.traffic``, Poisson arrivals,
+    seeded) that does NOT slow down when the server falls behind:
+
+    - per engine, estimate capacity with a short closed-loop probe,
+      then offer ``load_factors`` multiples of it for ``duration_s``
+      each and record offered/goodput rps, p50/p99/p99.9 latency on
+      admitted (200) responses measured from the SCHEDULED arrival
+      (coordinated-omission-free), and the shed fraction;
+    - the aio engine runs with its default admission control: at 2x it
+      must shed the excess at the front door and keep goodput ≈
+      capacity with bounded p99 — the acceptance claim. The threaded
+      engine is the admit-everything contrast: same overload, queueing
+      delay instead of sheds;
+    - one MMPP (bursty) point at 1x mean rate for the aio engine:
+      same offered load as the Poisson 1x point, delivered in squalls —
+      burst tolerance, the regime autoscaling reacts too slowly for;
+    - a byte-identity check pins that both engines answer the same
+      requests with identical bytes (the cross-engine contract that
+      makes ``--server-engine`` a pure operational choice).
+
+    CPU-safe: the mechanism under test is front-end queueing/admission,
+    not device speed (capacity is measured, not assumed).
+    """
+    from bodywork_tpu.data import Dataset, generate_day, persist_dataset
+    from bodywork_tpu.store import FilesystemStore
+    from bodywork_tpu.train import train_on_history
+    from bodywork_tpu.traffic import TrafficConfig, generate_request_log, run_open_loop
+
+    store_path = tempfile.mkdtemp(prefix="bench-openloop-")
+    store = FilesystemStore(store_path)
+    d = date(2026, 1, 1)
+    X, y = generate_day(d)
+    persist_dataset(store, Dataset(X, y, d))
+    train_on_history(store, "linear")
+    buckets = tuple(sorted({1, 16, max_rows}))
+
+    def start(engine):
+        return _ServeTarget(store_path, engine, window_ms, max_rows,
+                            buckets, isolate)
+
+    # -- byte-identity across engines (both up at once) ---------------------
+    targets = {engine: start(engine) for engine in OPEN_LOOP_ENGINES}
+    try:
+        identity = _byte_identity_check({
+            engine: t.base_url for engine, t in targets.items()
+        })
+    finally:
+        for t in targets.values():
+            t.stop()
+
+    # -- per-engine open-loop sweep -----------------------------------------
+    engines: dict = {}
+    for engine in OPEN_LOOP_ENGINES:
+        target = start(engine)
+        try:
+            # closed-loop view for cross-reference with config 7 (it is
+            # NOT the capacity estimate: its GIL-sharing client threads
+            # bottleneck before the server does)
+            closed_loop = _closed_loop_throughput(
+                target.url, probe_clients, probe_requests
+            )
+            # untimed warm burst: absorbs the front-end's one-time
+            # connection-path costs so the first sweep point isn't the
+            # one that pays them
+            warm_s = min(1.0, duration_s)
+            warm_cfg = TrafficConfig(rate_rps=100.0, duration_s=warm_s,
+                                     seed=88)
+            run_open_loop(target.url, generate_request_log(warm_cfg),
+                          timeout_s=15.0, duration_s=warm_s)
+            capacity, ramp = _open_loop_capacity(
+                target.url, rate_cap_rps, window_s=capacity_window_s
+            )
+            print(f"  {engine}: estimated capacity {capacity:.0f} rps "
+                  f"({len(ramp)} ramp windows)", file=sys.stderr)
+            sweep = []
+            for i, factor in enumerate(load_factors):
+                rate = min(factor * capacity, rate_cap_rps)
+                log_cfg = TrafficConfig(
+                    rate_rps=rate, duration_s=duration_s,
+                    arrival="poisson", seed=90 + i,
+                )
+                report = run_open_loop(
+                    target.url, generate_request_log(log_cfg),
+                    timeout_s=30.0, duration_s=duration_s,
+                )
+                sweep.append({"load_factor": factor, **report.to_dict()})
+                print(
+                    f"  {engine} {factor}x: offered "
+                    f"{report.offered_rps:.0f} -> goodput "
+                    f"{report.goodput_in_window_rps:.0f} rps in-window, "
+                    f"shed {report.shed_fraction:.1%}, p99 "
+                    f"{report.latency['p99_s']}s",
+                    file=sys.stderr,
+                )
+            entry = {
+                "closed_loop_reference": closed_loop,
+                "capacity_rps": capacity,
+                "capacity_ramp": ramp,
+                "sweep": sweep,
+            }
+            if mmpp_point and engine == "aio":
+                mmpp_cfg = TrafficConfig(
+                    rate_rps=min(capacity, rate_cap_rps),
+                    duration_s=duration_s, arrival="mmpp", seed=97,
+                )
+                entry["mmpp_1x"] = run_open_loop(
+                    target.url, generate_request_log(mmpp_cfg),
+                    timeout_s=30.0, duration_s=duration_s,
+                ).to_dict()
+            admission = target.admission_state()
+            if admission is not None:
+                entry["admission"] = admission
+            engines[engine] = entry
+        finally:
+            target.stop()
+
+    def _point(engine, factor):
+        for p in engines[engine]["sweep"]:
+            if p["load_factor"] == factor:
+                return p
+        return None
+
+    aio_1x, aio_2x = _point("aio", 1.0), _point("aio", 2.0)
+    record = {
+        "metric": "open_loop_goodput_retention",
+        "unit": "goodput_2x/goodput_1x",
+        "vs_baseline": None,
+        "baseline_note": (
+            "the reference (and configs 4/7) only ever measured "
+            "closed-loop clients, which cannot overrun the server; "
+            "there is no open-loop baseline number to compare against "
+            "— the 2x-overload retention IS the new claim"
+        ),
+        "protocol": (
+            "per engine: open-loop ramp capacity estimate (offered "
+            "rate doubles per window until in-window goodput < 0.9x "
+            "offered; capacity = saturated in-window goodput), then "
+            "seeded Poisson arrival logs at "
+            f"{'/'.join(str(f) + 'x' for f in load_factors)} of it for "
+            f"{duration_s}s each (traffic.generator; latency measured "
+            "from scheduled arrival; goodput counts in-window 200s "
+            "only), plus one MMPP burst point at 1x for the aio "
+            f"engine; coalescer on (window {window_ms} ms, max_rows "
+            f"{max_rows}); aio runs its default admission control, "
+            "thread is the admit-everything contrast; the "
+            f"{probe_clients}-client closed-loop reference ties back "
+            "to config 7"
+        ),
+        "byte_identity": identity,
+        "engines": engines,
+    }
+    if aio_1x and aio_2x:
+        retention = (
+            aio_2x["goodput_in_window_rps"] / aio_1x["goodput_in_window_rps"]
+            if aio_1x["goodput_in_window_rps"] else None
+        )
+        # `is not None`, not truthiness: a total 2x collapse is a REAL
+        # 0.0, distinguishable from "no data"
+        record["value"] = round(retention, 4) if retention is not None else None
+        record["aio_2x_shed_fraction"] = aio_2x["shed_fraction"]
+        record["aio_2x_p99_s"] = aio_2x["latency"]["p99_s"]
+    return record
+
+
 #: the all-configs run list: every entry here must also carry a
 #: CONFIG_TIMEOUT_S budget and appear in ALL_CONFIGS — pinned by
 #: tests/test_bench.py::test_config_registry_sync so a new config can
@@ -1500,6 +1881,7 @@ CONFIG_BENCHES = {
     6: lambda: bench_wide(),
     7: lambda: bench_single_row_scoring(),
     8: lambda: bench_history_cold_start(),
+    9: lambda: bench_open_loop_serving(),
 }
 
 
@@ -1555,8 +1937,12 @@ RESUME_MAX_AGE_S = 6 * 3600
 #: budget covers JAX init + bucket warmup + ~1.7k requests twice
 #: config 8 is host-side store I/O + four small linear fits — the budget
 #: covers JAX init plus the per-horizon compiles
+#: config 9 is host-side open-loop HTTP around tiny device calls — the
+#: budget covers JAX init + two engines x (capacity probe + 3 timed
+#: sweep points + the aio MMPP point) at ~4 s per point
 CONFIG_TIMEOUT_S = {
     1: 300, 2: 300, 3: 600, 4: 600, 5: 450, 6: 1200, 7: 600, 8: 300,
+    9: 600,
 }
 
 
@@ -1855,10 +2241,12 @@ def compact_output(records: list[dict], backend: str,
             # recreate the parsed-as-null failure (full text is in the
             # full record). 120 chars each keeps the worst case — every
             # config errored AND flagged — under the 2000-char tail now
-            # that the run list holds 8 configs
+            # that the run list holds 9 configs; per-config `unit` is
+            # dropped from the one-liners for the same budget (the
+            # headline keeps its unit, the full record has them all)
             k: (r[k][:120] if k in ("error", "cpu_scaled_protocol",
                                     "timing_anomaly") else r[k])
-            for k in ("config", "metric", "value", "unit", "vs_baseline",
+            for k in ("config", "metric", "value", "vs_baseline",
                       "backend", "elapsed_s", "resumed", "error",
                       "cpu_scaled_protocol", "timing_anomaly")
             if k in r

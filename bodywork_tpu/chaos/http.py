@@ -41,6 +41,13 @@ class FlakyScoringMiddleware:
             self.plan.http_latency(path)
             status = self.plan.http_error(path)
             if status is not None:
+                # injected refusals share the shed counter under their
+                # OWN reason label, so a dashboard can always tell
+                # chaos-injected 503/429s from real admission sheds
+                # (serve.admission counts reason="admission")
+                from bodywork_tpu.serve.admission import count_shed
+
+                count_shed("chaos")
                 body = json.dumps(
                     {"error": f"injected fault: HTTP {status}"}
                 ).encode()

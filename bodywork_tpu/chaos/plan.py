@@ -259,8 +259,18 @@ class FaultPlan:
 
     # -- HTTP hooks (FlakyScoringMiddleware) -------------------------------
 
-    def http_latency(self, path: str) -> None:
+    def http_latency_delay(self, path: str) -> float | None:
+        """Decide-only variant of :meth:`http_latency` for the asyncio
+        front-end (``serve.aio``): returns the injected delay in seconds
+        (to ``await asyncio.sleep`` — a ``time.sleep`` would stall the
+        whole event loop) or None. Same draw stream as the blocking
+        form, so either engine replays one seed identically."""
         if self._decide("http_latency", f"http|{path}", self.http_latency_p):
+            return self.http_latency_s
+        return None
+
+    def http_latency(self, path: str) -> None:
+        if self.http_latency_delay(path) is not None:
             time.sleep(self.http_latency_s)
 
     def http_error(self, path: str) -> int | None:

@@ -17,6 +17,7 @@ its own driver:
     python -m bodywork_tpu.cli deploy    --out DIR [--store-path P] [--image I]
     python -m bodywork_tpu.cli chaos run-sim --store DIR --days N [--seed S] [--plan F]
     python -m bodywork_tpu.cli registry list|show|promote|rollback|gate --store DIR ...
+    python -m bodywork_tpu.cli traffic run --url URL [--rate R] [--duration S] ...
 
 Every command exits 0 on success and 1 with a logged error otherwise — the
 exit-code contract the reference implements per-script
@@ -118,6 +119,23 @@ def _positive_int(raw: str) -> int:
     return value
 
 
+def _env_choice(name: str, choices: tuple, default: str):
+    """Parser-build-time env default for an enum flag: an unknown value
+    is ignored with a stderr note (same contract as :func:`_env_number`)."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    value = raw.strip()
+    if value not in choices:
+        print(
+            f"warning: ignoring {name}={raw!r} (expected one of "
+            f"{', '.join(choices)})",
+            file=sys.stderr,
+        )
+        return default
+    return value
+
+
 def _env_number(name: str, cast, minimum):
     """Parser-build-time env default: a malformed or out-of-range value
     is IGNORED with a stderr note rather than crashing every subcommand
@@ -165,6 +183,9 @@ def cmd_serve(args) -> int:
             batch_window_ms=batch_window,
             batch_max_rows=args.batch_max_rows,
             metrics=args.metrics,
+            server_engine=args.server_engine,
+            max_pending=args.max_pending,
+            retry_after_max_s=args.retry_after_max_s,
         ).start()
         if svc.metrics_url:
             log.info(f"aggregated metrics at {svc.metrics_url}")
@@ -188,8 +209,62 @@ def cmd_serve(args) -> int:
         buckets=args.buckets,
         batch_window_ms=batch_window,
         batch_max_rows=args.batch_max_rows,
+        server_engine=args.server_engine,
+        max_pending=args.max_pending,
+        retry_after_max_s=args.retry_after_max_s,
     )
     return 0
+
+
+def cmd_traffic_run(args) -> int:
+    """Open-loop load run (docs/PERF.md §config 9): generate — or replay
+    — a seeded request log and drive it at its scheduled arrival times
+    against a live scoring service."""
+    from bodywork_tpu.traffic import (
+        TrafficConfig,
+        generate_request_log,
+        read_request_log,
+        run_open_loop,
+        write_request_log,
+    )
+    from bodywork_tpu.traffic.runner import format_report
+
+    # stdout carries exactly ONE JSON document (the load report) so the
+    # command composes with jq/scripts; logs go to stderr, as bench.py
+    configure_logger(stream=sys.stderr)
+
+    try:
+        if args.log_in:
+            config, requests = read_request_log(args.log_in)
+            log.info(
+                f"replaying {len(requests)} requests from {args.log_in} "
+                f"(seed {config.seed}, {config.arrival})"
+            )
+        else:
+            config = TrafficConfig(
+                rate_rps=args.rate,
+                duration_s=args.duration,
+                arrival=args.arrival,
+                batch_fraction=args.batch_fraction,
+                batch_rows=args.batch_rows,
+                seed=args.seed,
+                burst_multiplier=args.burst_multiplier,
+            )
+            requests = generate_request_log(config)
+        if args.log_out:
+            write_request_log(args.log_out, config, requests)
+        if args.url is None:
+            if not args.log_out:
+                log.error("nothing to do: need --url (drive) or "
+                          "--log-out (generate only)")
+                return 1
+            return 0
+        report = run_open_loop(args.url, requests, timeout_s=args.timeout)
+        print(format_report(report))
+        return 0
+    except (OSError, ValueError) as exc:
+        log.error(f"traffic run failed: {exc}")
+        return 1
 
 
 def cmd_test(args) -> int:
@@ -853,6 +928,36 @@ def build_parser() -> argparse.ArgumentParser:
              "/metrics unconditionally; this flag is the multi-worker "
              "aggregation switch (docs/OBSERVABILITY.md)",
     )
+    p.add_argument(
+        # choices hardcoded to keep parser construction import-light;
+        # pinned == serve.server.SERVER_ENGINES by tests/test_aio.py
+        "--server-engine", default=_env_choice(
+            "BODYWORK_TPU_SERVER_ENGINE", ("thread", "aio"), "thread"
+        ),
+        choices=["thread", "aio"],
+        help="HTTP front-end: 'thread' (werkzeug thread-per-request, "
+             "default; env BODYWORK_TPU_SERVER_ENGINE overrides) or "
+             "'aio' (asyncio event loop, serve.aio — built for "
+             "open-loop arrival-rate load; arms admission control by "
+             "default). Responses are byte-identical across engines",
+    )
+    p.add_argument(
+        "--max-pending", type=_positive_int, metavar="N",
+        default=_env_number("BODYWORK_TPU_MAX_PENDING", int, 1),
+        help="admission budget (serve.admission): at most N scoring "
+             "requests admitted-and-unfinished at once; beyond it "
+             "requests answer 429 + Retry-After BEFORE any work. "
+             "Default: off for --server-engine thread, 512 for aio "
+             "(env BODYWORK_TPU_MAX_PENDING overrides). Per worker "
+             "process with --workers N",
+    )
+    p.add_argument(
+        "--retry-after-max-s", type=float, metavar="S",
+        default=_env_number("BODYWORK_TPU_RETRY_AFTER_MAX_S", float, 1.0),
+        help="cap on the EWMA-derived Retry-After hint that shed 429s "
+             "and degraded 503s carry (default 30; env "
+             "BODYWORK_TPU_RETRY_AFTER_MAX_S overrides)",
+    )
 
     p = add("test", cmd_test, help="test a live scoring service")
     p.add_argument("--store", **common_store)
@@ -1076,6 +1181,60 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also shadow-evaluate the candidate against "
                         "production over the last K dataset days "
                         "(in-process, no live traffic; default off)")
+
+    p = sub.add_parser(
+        "traffic",
+        help="open-loop load harness: seeded arrival-rate traffic "
+             "against a live scoring service (docs/PERF.md §config 9)",
+    )
+    traffic_sub = p.add_subparsers(dest="traffic_command", required=True)
+    p = traffic_sub.add_parser(
+        "run",
+        help="generate (or replay) a seeded request log and drive it "
+             "open-loop — requests fire at their scheduled arrival "
+             "times whether or not earlier responses returned",
+    )
+    p.set_defaults(fn=cmd_traffic_run)
+    p.add_argument("--url", default=None,
+                   help="base URL of the service under load (e.g. "
+                        "http://127.0.0.1:5000 — per-request routes come "
+                        "from the log). Omit with --log-out to only "
+                        "generate the log")
+    p.add_argument("--rate", type=float, default=100.0, metavar="RPS",
+                   help="mean offered load, requests/second (default 100)")
+    p.add_argument("--duration", type=float, default=5.0, metavar="S",
+                   help="log span in seconds (default 5)")
+    p.add_argument(
+        # choices hardcoded to keep parser construction import-light;
+        # pinned == traffic.ARRIVAL_PROCESSES by tests/test_traffic.py
+        "--arrival", default="poisson", choices=["poisson", "mmpp"],
+        help="arrival process: memoryless 'poisson' or bursty 'mmpp' "
+             "(2-state Markov-modulated: calm/burst squalls at the SAME "
+             "mean rate — the shape that breaks queues)",
+    )
+    p.add_argument("--batch-fraction", type=float, default=0.0,
+                   metavar="P",
+                   help="probability an arrival is a /score/v1/batch "
+                        "request (default 0: all single-row)")
+    p.add_argument("--batch-rows", type=_positive_int, default=64,
+                   metavar="N",
+                   help="rows per batch request (default 64)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="request-log seed: the same (seed, knobs) "
+                        "generates the identical request sequence — "
+                        "replayable adversity, as chaos run-sim")
+    p.add_argument("--burst-multiplier", type=float, default=4.0,
+                   metavar="M",
+                   help="mmpp: burst-state rate as a multiple of calm "
+                        "(default 4)")
+    p.add_argument("--timeout", type=float, default=30.0, metavar="S",
+                   help="per-request response timeout (default 30)")
+    p.add_argument("--log-out", default=None, metavar="FILE",
+                   help="write the generated request log (JSONL) here "
+                        "for later replay")
+    p.add_argument("--log-in", default=None, metavar="FILE",
+                   help="replay THIS request log instead of generating "
+                        "one (ignores the shape flags)")
 
     p = add("deploy", cmd_deploy, help="write GKE TPU manifests")
     p.add_argument("--spec", default=None, help="pipeline spec YAML (overrides --model/--mode)")

@@ -51,7 +51,9 @@ METRIC_NAME_RE = re.compile(r"^bodywork_tpu_[a-z0-9_]+$")
 #: counters; ``_loss`` is the (unitless) training-loss channel;
 #: ``_state`` is a small-integer state-machine gauge (breaker
 #: closed/half-open/open, serve healthy/degraded/no-model — the value
-#: encoding lives with each metric in docs/RESILIENCE.md).
+#: encoding lives with each metric in docs/RESILIENCE.md); ``_depth``
+#: is a queue-occupancy gauge (requests currently held — the admission
+#: layer's saturation signal, docs/OBSERVABILITY.md).
 UNIT_SUFFIXES = (
     "_total",
     "_seconds",
@@ -63,6 +65,7 @@ UNIT_SUFFIXES = (
     "_info",
     "_loss",
     "_state",
+    "_depth",
 )
 
 #: default histogram buckets, tuned for this service's latency regime:

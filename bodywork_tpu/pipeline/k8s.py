@@ -221,6 +221,22 @@ def _container(
     if env_from:
         container["envFrom"] = env_from
     if stage.kind == "service" and stage.port:
+        # serving front-end + admission knobs (serve.aio / serve.admission,
+        # read by serve_stage at boot): materialised as env vars so an
+        # operator flips the HTTP engine or the pending budget with one
+        # `kubectl set env` — no image rebuild, next rollout picks it up.
+        # Defaults preserve the deployed behaviour exactly: the threaded
+        # engine with admission off (MAX_PENDING empty = unset; setting
+        # ENGINE=aio arms admission at its default budget of 512).
+        declared = {e["name"] for e in env}
+        for name, value in (
+            ("BODYWORK_TPU_SERVER_ENGINE", "thread"),
+            ("BODYWORK_TPU_MAX_PENDING", ""),
+            ("BODYWORK_TPU_RETRY_AFTER_MAX_S", ""),
+        ):
+            if name not in declared:
+                env.append({"name": name, "value": value})
+        container["env"] = env
         # one named port serves scoring AND the GET /metrics Prometheus
         # exposition (serve.app registers the route unconditionally); the
         # name is what the pod-template scrape annotations point at
@@ -230,6 +246,15 @@ def _container(
             "initialDelaySeconds": 2,
             "periodSeconds": 3,
             "failureThreshold": int(stage.max_startup_time_s // 3) or 1,
+            # readiness semantics under admission control: a replica AT
+            # its pending budget keeps answering /healthz 200 (shedding
+            # is the service doing its job — failing readiness would
+            # pull it from the endpoints and dogpile its share onto the
+            # siblings; serve.app.healthz_payload). Only a replica with
+            # NO model (503) leaves the rotation. The tight timeout is
+            # safe for the same reason: /healthz never queues behind
+            # scoring work on either engine.
+            "timeoutSeconds": 2,
         }
     return container
 

@@ -167,6 +167,54 @@ def train_stage(
     )
 
 
+def _serve_env_knobs() -> tuple[str, int | None, float | None]:
+    """The deployed serving knobs (``(server_engine, max_pending,
+    retry_after_max_s)``) from the pod environment — the k8s serve
+    Deployment materialises them as env vars (``pipeline/k8s.py``) so an
+    operator flips the HTTP front-end or the admission budget with a
+    ``kubectl set env``, no image rebuild. Malformed values are ignored
+    with a warning (same contract as ``cli serve``'s env defaults): a
+    typo must degrade to the default, never crash the serving pod."""
+    import os
+
+    from bodywork_tpu.serve.server import SERVER_ENGINES
+
+    engine = os.environ.get("BODYWORK_TPU_SERVER_ENGINE", "").strip()
+    if engine and engine not in SERVER_ENGINES:
+        log.warning(
+            f"ignoring BODYWORK_TPU_SERVER_ENGINE={engine!r} "
+            f"(expected one of {SERVER_ENGINES})"
+        )
+        engine = ""
+    max_pending: int | None = None
+    raw = os.environ.get("BODYWORK_TPU_MAX_PENDING", "").strip()
+    if raw:
+        try:
+            max_pending = int(raw)
+            if max_pending < 1:
+                raise ValueError(raw)
+        except ValueError:
+            log.warning(
+                f"ignoring BODYWORK_TPU_MAX_PENDING={raw!r} "
+                "(need an int >= 1)"
+            )
+            max_pending = None
+    retry_after_max_s: float | None = None
+    raw = os.environ.get("BODYWORK_TPU_RETRY_AFTER_MAX_S", "").strip()
+    if raw:
+        try:
+            retry_after_max_s = float(raw)
+            if retry_after_max_s < 1.0:
+                raise ValueError(raw)
+        except ValueError:
+            log.warning(
+                f"ignoring BODYWORK_TPU_RETRY_AFTER_MAX_S={raw!r} "
+                "(need a number >= 1)"
+            )
+            retry_after_max_s = None
+    return engine or "thread", max_pending, retry_after_max_s
+
+
 def serve_stage(
     ctx: StageContext,
     host: str = "127.0.0.1",
@@ -175,6 +223,9 @@ def serve_stage(
     replicas: int = 1,
     watch_interval_s: float | None = None,
     engine: str = "auto",
+    server_engine: str | None = None,
+    max_pending: int | None = None,
+    retry_after_max_s: float | None = None,
 ) -> "ServiceHandle":  # noqa: F821
     """Load the latest model into device HBM and start the scoring service
     on a background thread (reference stage 2). Returns the handle; the
@@ -197,7 +248,16 @@ def serve_stage(
     regime and resolves to the plain XLA apply everywhere else, so the
     parity workloads are unchanged); a non-default predictor instance is
     shared read-only across the replicas, the same sharing the hot-reload
-    watcher applies on swap."""
+    watcher applies on swap.
+
+    ``server_engine``/``max_pending``/``retry_after_max_s`` pick the
+    HTTP front-end and admission budget (``serve.server.SERVER_ENGINES``
+    / ``serve.admission``), defaulting from the pod environment
+    (:func:`_serve_env_knobs` — the knobs the k8s serve Deployment
+    materialises) so a deployed service switches engines without a
+    spec change. One admission controller is shared across the replica
+    apps: they share the listen port, so they share the backpressure
+    boundary."""
     from bodywork_tpu.models.checkpoint import load_model
     from bodywork_tpu.serve import ServiceHandle, create_app
 
@@ -233,8 +293,25 @@ def serve_stage(
         import jax
 
         model.params = jax.device_put(model.params)
-    from bodywork_tpu.serve.server import build_predictor
+    from bodywork_tpu.serve.server import (
+        SERVER_ENGINES,
+        build_admission,
+        build_predictor,
+    )
 
+    env_engine, env_max_pending, env_retry_max = _serve_env_knobs()
+    if server_engine is None:
+        server_engine = env_engine
+    if server_engine not in SERVER_ENGINES:
+        raise ValueError(
+            f"unknown server engine {server_engine!r}; "
+            f"expected one of {SERVER_ENGINES}"
+        )
+    if max_pending is None:
+        max_pending = env_max_pending
+    if retry_after_max_s is None:
+        retry_after_max_s = env_retry_max
+    admission = build_admission(server_engine, max_pending, retry_after_max_s)
     predictor = build_predictor(  # mesh_data=None: single-device serving
         model, None, engine,
         buckets=tuple(buckets) if buckets else None,
@@ -251,13 +328,22 @@ def serve_stage(
             predictor=predictor,
             model_key=served_key,
             model_source=served_source,
+            # ONE controller shared across replica apps: they share the
+            # listen port, so they share the backpressure boundary
+            admission=admission,
         )
         for _ in range(max(replicas, 1))
     ]
-    from bodywork_tpu.serve.server import RoundRobinApp
+    if server_engine == "aio":
+        # the asyncio front-end round-robins replica apps natively
+        from bodywork_tpu.serve.aio import AioServiceHandle
 
-    front = RoundRobinApp(apps) if len(apps) > 1 else apps[0]
-    handle = ServiceHandle(front, host=host, port=port)
+        handle = AioServiceHandle(apps, host=host, port=port)
+    else:
+        from bodywork_tpu.serve.server import RoundRobinApp
+
+        front = RoundRobinApp(apps) if len(apps) > 1 else apps[0]
+        handle = ServiceHandle(front, host=host, port=port)
     if watch_interval_s:
         # hot reload (beyond-parity): the deployed service lives across
         # days, swapping in each retrain's checkpoint instead of being

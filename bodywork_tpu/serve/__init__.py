@@ -1,17 +1,23 @@
 from bodywork_tpu.serve.predictor import BF16MLPPredictor, PaddedPredictor
+from bodywork_tpu.serve.admission import AdmissionController
+from bodywork_tpu.serve.aio import AioServiceHandle
 from bodywork_tpu.serve.app import create_app
 from bodywork_tpu.serve.batcher import CoalescerSaturated, RequestCoalescer
 from bodywork_tpu.serve.multiproc import MultiProcessService
 from bodywork_tpu.serve.reload import CheckpointWatcher
 from bodywork_tpu.serve.server import (
+    SERVER_ENGINES,
     RoundRobinApp,
     ServiceHandle,
+    build_admission,
     build_predictor,
     resolve_engine,
     serve_latest_model,
 )
 
 __all__ = [
+    "AdmissionController",
+    "AioServiceHandle",
     "BF16MLPPredictor",
     "CheckpointWatcher",
     "CoalescerSaturated",
@@ -19,6 +25,8 @@ __all__ = [
     "MultiProcessService",
     "PaddedPredictor",
     "RoundRobinApp",
+    "SERVER_ENGINES",
+    "build_admission",
     "build_predictor",
     "create_app",
     "resolve_engine",

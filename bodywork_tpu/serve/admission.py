@@ -1,0 +1,289 @@
+"""Admission control for the scoring service (ROADMAP open item 2).
+
+Closed-loop benches hide queueing collapse: a client that waits for each
+response before sending the next can never overrun the server, so
+"requests/s at N clients" says nothing about behaviour under *open-loop*
+arrival-rate load, where work keeps arriving whether or not the server
+is keeping up. Without admission control an overloaded server queues
+without bound — every request eventually answers, seconds late, which is
+indistinguishable from an outage for the client and poisons the queue
+for everyone behind it. The standard answer (and this module) is to
+bound the work the server will hold and **shed the rest at the front
+door**: a 429 + ``Retry-After`` returned before any parsing, coalescer
+enqueue, or device work happens costs microseconds and tells a
+well-behaved client exactly when to come back.
+
+:class:`AdmissionController` is the one admission point both serving
+front-ends share (the threaded WSGI engine checks it at the top of
+``ScoringApp.__call__``; the asyncio engine checks it on the event loop
+before touching the coalescer):
+
+- **Bounded pending budget** — at most ``max_pending`` scoring requests
+  admitted-and-unfinished at once; the (N+1)th is shed. The budget is
+  the local analogue of a k8s pod's memory/queue headroom: it is sized
+  so that admitted work clears within an acceptable latency bound.
+- **External depth probe** (:meth:`attach_depth_probe`) — the queue an
+  overloaded server drowns in is not always the one admission watches.
+  On the asyncio engine the *event loop itself* is a queue: when
+  request handling saturates the loop, excess connections back up as
+  pending tasks UPSTREAM of the admission check, the internal pending
+  count stays low (work is drained as fast as it is admitted), and
+  latency grows without a single shed. The probe folds that upstream
+  backlog (busy-connection count, ``serve.aio``) into the same budget:
+  requests are shed while the TOTAL work held — admitted or still in
+  the loop's accept backlog — exceeds ``max_pending``. The threaded
+  engine needs no probe: each request runs admission on its own thread
+  immediately, so the internal count IS the queue.
+- **EWMA queue-delay estimator** — every released request reports the
+  delay it actually experienced (admission -> response ready); the
+  controller keeps an exponentially-weighted moving average. That
+  estimate is the ``Retry-After`` a shed (or model-less 503) response
+  carries, clamped to ``[retry_after_min_s, retry_after_max_s]`` so a
+  cold estimator or a latency spike can never tell clients "come back
+  in an hour" (see :meth:`retry_after_s`).
+- **Saturation signals for the outside world** — current depth rides
+  the ``bodywork_tpu_serve_queue_depth`` gauge (aggregate ``sum``: the
+  multi-worker ``/metrics`` merge adds replica depths into the
+  service-wide queue) and every shed increments
+  ``bodywork_tpu_serve_shed_total{reason="admission"}``. Chaos-injected
+  503/429s count into the same counter under ``reason="chaos"``
+  (:mod:`bodywork_tpu.chaos.http`), so a dashboard can always tell real
+  backpressure from injected adversity. ``/healthz`` surfaces the same
+  numbers per replica (:meth:`state`).
+
+The controller is engine-agnostic and thread-safe: admission decisions
+are one lock acquisition + a counter compare, cheap enough for the
+event-loop hot path.
+"""
+from __future__ import annotations
+
+import math
+import threading
+
+from bodywork_tpu.obs import get_registry
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("serve.admission")
+
+__all__ = [
+    "DEFAULT_MAX_PENDING",
+    "SHED_TOTAL_METRIC",
+    "QUEUE_DEPTH_METRIC",
+    "AdmissionController",
+    "count_shed",
+]
+
+#: default pending-request budget when admission is enabled without an
+#: explicit size (``cli serve --server-engine aio`` with no
+#: ``--max-pending``). Sized for the coalescer regime: 512 queued
+#: single-row requests drain in ~8 full 64-row flushes — well under a
+#: second on every measured backend — so admitted work meets its latency
+#: bound while bursts 2x capacity still mostly admit.
+DEFAULT_MAX_PENDING = 512
+
+#: sheds by reason: ``admission`` (budget exceeded) vs ``chaos``
+#: (fault-injected 503/429) — distinguishable by construction
+SHED_TOTAL_METRIC = "bodywork_tpu_serve_shed_total"
+#: admitted-and-unfinished scoring requests; gauge aggregate ``sum`` so
+#: the multiproc merge reports the service-wide queue
+QUEUE_DEPTH_METRIC = "bodywork_tpu_serve_queue_depth"
+
+
+def count_shed(reason: str) -> None:
+    """Increment the shared shed counter. One helper so the admission
+    layer, the chaos middleware, and the asyncio front-end can never
+    drift onto differently-named/helped counters."""
+    get_registry().counter(
+        SHED_TOTAL_METRIC,
+        "Scoring requests refused before any work, by reason "
+        "(admission=budget exceeded, chaos=injected fault)",
+    ).inc(reason=reason)
+
+
+class AdmissionController:
+    """Bounded-pending admission with an EWMA queue-delay estimator.
+
+    Request lifecycle::
+
+        if not admission.try_admit():
+            return 429 + Retry-After: admission.retry_after_s()
+        t0 = time.perf_counter()
+        try:
+            ... parse, enqueue, score, serialize ...
+        finally:
+            admission.release(time.perf_counter() - t0)
+
+    ``try_admit`` is the ONLY path that counts a shed, so callers cannot
+    forget the metric; ``release`` is the only path that shrinks the
+    depth, so a crashed handler leaks budget only if it skips its
+    ``finally`` — which is why both engines wrap the whole handler.
+    """
+
+    def __init__(
+        self,
+        max_pending: int = DEFAULT_MAX_PENDING,
+        ewma_alpha: float = 0.2,
+        retry_after_min_s: float = 1.0,
+        retry_after_max_s: float = 30.0,
+    ):
+        if max_pending < 1:
+            raise ValueError(f"max_pending must be >= 1, got {max_pending}")
+        if not 0.0 < ewma_alpha <= 1.0:
+            raise ValueError(f"ewma_alpha must be in (0, 1], got {ewma_alpha}")
+        if not 0.0 < retry_after_min_s <= retry_after_max_s:
+            raise ValueError(
+                f"need 0 < retry_after_min_s <= retry_after_max_s, got "
+                f"{retry_after_min_s}..{retry_after_max_s}"
+            )
+        self.max_pending = max_pending
+        self.ewma_alpha = ewma_alpha
+        self.retry_after_min_s = retry_after_min_s
+        self.retry_after_max_s = retry_after_max_s
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._depth_probe = None
+        #: high-water mark of the pending depth — the budget-invariant
+        #: witness the admission tests assert on (never > max_pending)
+        self.max_observed_pending = 0
+        self._ewma_delay_s: float | None = None
+        self._shed_count = 0
+        self._admitted_count = 0
+        reg = get_registry()
+        self._g_depth = reg.gauge(
+            QUEUE_DEPTH_METRIC,
+            "Admitted-and-unfinished scoring requests (per worker; the "
+            "multiproc aggregation sums replicas)",
+            aggregate="sum",
+        )
+        self._g_depth.set(0.0)
+
+    # -- admission ----------------------------------------------------------
+    def attach_depth_probe(self, probe) -> None:
+        """Register a zero-arg callable reporting work queued UPSTREAM
+        of this controller (the aio engine's busy-connection count —
+        see the module docstring). Folded into every admission
+        decision, :attr:`queue_depth`, and :meth:`state`."""
+        self._depth_probe = probe
+
+    def _external_depth(self) -> int:
+        probe = self._depth_probe
+        if probe is None:
+            return 0
+        try:
+            return max(0, int(probe()))
+        except Exception:  # a broken probe must never break admission
+            return 0
+
+    def try_admit(self) -> bool:
+        """Admit one request against the pending budget. Returns False —
+        and counts the shed — when the budget is exhausted, either by
+        admitted-and-unfinished requests or by upstream backlog (the
+        depth probe; ``>`` not ``>=`` because the probing request's own
+        connection is part of that count). O(1), no allocation: this
+        runs before any per-request work."""
+        external = self._external_depth()
+        with self._lock:
+            if (
+                self._pending >= self.max_pending
+                or external > self.max_pending
+            ):
+                self._shed_count += 1
+                shed = True
+                depth = max(self._pending, external)
+            else:
+                self._pending += 1
+                self._admitted_count += 1
+                if self._pending > self.max_observed_pending:
+                    self.max_observed_pending = self._pending
+                depth = max(self._pending, external)
+                shed = False
+        self._g_depth.set(float(depth))
+        if shed:
+            count_shed("admission")
+            return False
+        return True
+
+    def release(self, observed_delay_s: float | None = None) -> None:
+        """Return one unit of budget; ``observed_delay_s`` (admission ->
+        response ready) feeds the EWMA estimator. Under load that delay
+        includes the queueing the NEXT client would experience, which is
+        exactly what its Retry-After should reflect."""
+        external = self._external_depth()
+        with self._lock:
+            if self._pending > 0:
+                self._pending -= 1
+            depth = max(self._pending, external)
+            if observed_delay_s is not None and observed_delay_s >= 0.0:
+                if self._ewma_delay_s is None:
+                    self._ewma_delay_s = float(observed_delay_s)
+                else:
+                    a = self.ewma_alpha
+                    self._ewma_delay_s = (
+                        a * float(observed_delay_s)
+                        + (1.0 - a) * self._ewma_delay_s
+                    )
+        self._g_depth.set(float(depth))
+
+    # -- signals ------------------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        """Requests currently held anywhere: admitted-and-unfinished or
+        queued upstream of admission (the depth probe)."""
+        external = self._external_depth()
+        with self._lock:
+            return max(self._pending, external)
+
+    @property
+    def ewma_delay_s(self) -> float | None:
+        with self._lock:
+            return self._ewma_delay_s
+
+    def retry_after_s(self) -> int:
+        """The numeric ``Retry-After`` (whole seconds, HTTP-legal) every
+        backpressure response carries — shed 429s AND the degraded-mode
+        503s, so clients see ONE consistent hint. Derived from the EWMA
+        queue delay, ceiled to a second, clamped to
+        ``[retry_after_min_s, retry_after_max_s]``: a cold estimator
+        answers the minimum (retry soon — nothing is known to be slow),
+        a collapsed one cannot exile clients forever."""
+        with self._lock:
+            estimate = self._ewma_delay_s
+        if estimate is None:
+            estimate = 0.0
+        clamped = min(
+            max(estimate, self.retry_after_min_s), self.retry_after_max_s
+        )
+        return int(math.ceil(clamped))
+
+    def state(self) -> dict:
+        """The /healthz admission block (both engines): depth, budget,
+        whether the service is currently at budget (shedding), the
+        Retry-After it is handing out, and lifetime admit/shed counts.
+        ``queue_depth`` is the total held work; ``pending`` and
+        ``upstream_depth`` break it into admitted-and-unfinished vs
+        still-queued-before-admission (the aio engine's connection
+        backlog — zero on the threaded engine)."""
+        external = self._external_depth()
+        with self._lock:
+            pending = self._pending
+            ewma = self._ewma_delay_s
+            shed = self._shed_count
+            admitted = self._admitted_count
+        depth = max(pending, external)
+        return {
+            "queue_depth": depth,
+            "pending": pending,
+            "upstream_depth": external,
+            "max_pending": self.max_pending,
+            # the exact try_admit predicate (`>` on the external probe:
+            # the probing request's own connection is part of that
+            # count) — /healthz must never claim "shedding" while
+            # requests are still being admitted
+            "shedding": (
+                pending >= self.max_pending or external > self.max_pending
+            ),
+            "retry_after_s": self.retry_after_s(),
+            "ewma_queue_delay_s": round(ewma, 6) if ewma is not None else None,
+            "admitted_total": admitted,
+            "shed_total": shed,
+        }

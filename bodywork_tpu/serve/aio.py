@@ -1,0 +1,633 @@
+"""Asyncio event-loop front-end for the scoring service (ROADMAP item 2).
+
+The threaded engine (werkzeug, ``serve.server``) spends one OS thread
+per in-flight request. Under closed-loop benches that is invisible — the
+client count bounds the thread count — but under open-loop arrival-rate
+load every queued request pins a thread, and the server collapses by
+context-switching long before the accelerator saturates. This module
+replaces the thread-per-request front with a single event loop:
+
+- **Request parsing on the event loop.** A hand-rolled HTTP/1.1 server
+  over ``asyncio.start_server`` (stdlib only — no new dependencies):
+  request line + headers + Content-Length body, keep-alive connections.
+  Parsing a scoring request is microseconds of pure-Python work; the
+  loop handles thousands of concurrent connections with one thread.
+- **Admission before work** (``serve.admission``): each scoring request
+  is admitted against the bounded pending budget FIRST. A shed request
+  is answered 429 + ``Retry-After`` straight from the loop — no body
+  parse, no coalescer enqueue, no device work, no thread.
+- **The coalescer queue fed directly via futures.** An admitted
+  single-row request enqueues into the existing
+  :class:`~bodywork_tpu.serve.batcher.RequestCoalescer` with
+  ``submit_nowait`` + an ``on_done`` callback that resolves an asyncio
+  future via ``call_soon_threadsafe`` — the event loop never blocks on a
+  batch, and the dispatcher thread never knows HTTP exists. Batch
+  requests and the uncoalesced fallback run the padded device call on a
+  small thread pool (``run_in_executor``), keeping the loop responsive.
+- **Byte-identical responses.** Bodies are built by the same
+  ``parse_features`` / ``single_score_payload`` / ``batch_score_payload``
+  helpers the WSGI engine uses (``serve.app``), and coalesced batches go
+  through the very same dispatcher — the response bytes are equal across
+  engines by construction, which is what lets ``cli serve
+  --server-engine`` be a pure operational choice.
+- **Chaos composition.** When a fault plan is active
+  (``chaos.plan.activate``), scoring requests consult it exactly as the
+  WSGI :class:`~bodywork_tpu.chaos.http.FlakyScoringMiddleware` does —
+  same decision streams, so seeds replay identically — and injected
+  503/429s count as ``bodywork_tpu_serve_shed_total{reason="chaos"}``,
+  never mistakable for admission sheds (``reason="admission"``).
+
+:class:`AioServiceHandle` mirrors the :class:`~bodywork_tpu.serve.server.
+ServiceHandle` lifecycle (``start``/``stop``/``wait``/``serve_forever``/
+context manager), so ``serve_latest_model``, the pipeline serve stage,
+the hot-reload watcher, and the multiproc supervisor drive either engine
+through one interface. The hot-swap, degraded-boot, and coalescer
+guarantees all live in :class:`~bodywork_tpu.serve.app.ScoringApp` and
+the batcher, which this front-end reuses rather than reimplements.
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import socket
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+from werkzeug.exceptions import MethodNotAllowed, NotFound
+
+from bodywork_tpu.obs import get_registry
+from bodywork_tpu.serve.admission import count_shed
+from bodywork_tpu.serve.app import (
+    ScoringApp,
+    batch_score_payload,
+    parse_features,
+    single_score_payload,
+)
+from bodywork_tpu.serve.batcher import CoalescerSaturated
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("serve.aio")
+
+__all__ = ["AioScoringServer", "AioServiceHandle"]
+
+#: request line + headers cap (also the StreamReader limit)
+MAX_HEADER_BYTES = 64 * 1024
+#: request body cap — a 2048-row batch of float features is ~100 KB of
+#: JSON; 16 MB leaves two orders of magnitude of headroom while bounding
+#: a hostile Content-Length
+MAX_BODY_BYTES = 16 * 1024 * 1024
+#: ceiling on a coalesced prediction rendezvous (mirrors submit()'s)
+COALESCE_TIMEOUT_S = 60.0
+
+_REASONS = {
+    200: "OK",
+    400: "BAD REQUEST",
+    404: "NOT FOUND",
+    405: "METHOD NOT ALLOWED",
+    408: "REQUEST TIMEOUT",
+    411: "LENGTH REQUIRED",
+    413: "PAYLOAD TOO LARGE",
+    429: "TOO MANY REQUESTS",
+    431: "REQUEST HEADER FIELDS TOO LARGE",
+    500: "INTERNAL SERVER ERROR",
+    503: "SERVICE UNAVAILABLE",
+}
+
+
+class _BadRequest(Exception):
+    """Protocol-level parse failure: answer and close the connection."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class AioScoringServer:
+    """The protocol + dispatch core, HTTP-server-agnostic: a callback
+    per connection (``handle_connection``) suitable for
+    ``asyncio.start_server``. Serves one or more replica
+    :class:`~bodywork_tpu.serve.app.ScoringApp` instances round-robin
+    (the in-process analogue of the k8s Service spreading connections),
+    sharing their admission controller and coalescers."""
+
+    def __init__(self, apps, admission=None, executor_workers: int = 4):
+        self.apps = list(apps) if isinstance(apps, (list, tuple)) else [apps]
+        assert self.apps, "need at least one replica app"
+        for app in self.apps:
+            assert isinstance(app, ScoringApp)
+        # ONE admission budget for the whole listener (replicas share the
+        # port, so they share the backpressure boundary); default to the
+        # apps' controller so create_app wiring needs no duplication
+        self.admission = (
+            admission if admission is not None else self.apps[0].admission
+        )
+        #: connections with a request being read, handled, or written —
+        #: the event loop's OWN queue. When request handling saturates
+        #: the loop, excess load backs up HERE (as unscheduled tasks),
+        #: upstream of the app-level admission count, so the controller
+        #: folds this number into its budget via the depth probe (see
+        #: serve.admission). Loop-thread-only writes; no lock needed.
+        self._busy_connections = 0
+        if self.admission is not None:
+            self.admission.attach_depth_probe(lambda: self._busy_connections)
+        self._rr = itertools.count()
+        # small pool for device dispatches the loop must not block on
+        # (uncoalesced single rows, batch scoring, /metrics file reads)
+        self._executor = ThreadPoolExecutor(
+            max_workers=executor_workers, thread_name_prefix="aio-dispatch"
+        )
+        self._plan_getter = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        self._executor.shutdown(wait=False)
+
+    # -- plumbing ----------------------------------------------------------
+    def _next_app(self) -> ScoringApp:
+        return self.apps[next(self._rr) % len(self.apps)]
+
+    def _active_plan(self):
+        """The process-wide chaos fault plan, if any — resolved lazily so
+        serving never imports the chaos subsystem unless one is armed
+        (or could be: the getter import is a sys.modules hit after the
+        first call)."""
+        if self._plan_getter is None:
+            from bodywork_tpu.chaos.plan import get_active_plan
+
+            self._plan_getter = get_active_plan
+        return self._plan_getter()
+
+    # -- HTTP framing ------------------------------------------------------
+    async def _read_request(self, reader):
+        """One request off the connection: ``(method, path, headers,
+        body)``, or None on a clean EOF between requests (keep-alive
+        close)."""
+        try:
+            blob = await reader.readuntil(b"\r\n\r\n")
+        except asyncio.IncompleteReadError as exc:
+            if not exc.partial:
+                return None  # clean close between keep-alive requests
+            raise _BadRequest(400, "truncated request head")
+        except asyncio.LimitOverrunError:
+            raise _BadRequest(431, "request head too large")
+        head = blob.decode("latin-1").split("\r\n")
+        try:
+            method, target, _version = head[0].split(" ", 2)
+        except ValueError:
+            raise _BadRequest(400, "malformed request line")
+        headers: dict[str, str] = {}
+        for line in head[1:]:
+            if not line:
+                continue
+            name, sep, value = line.partition(":")
+            if not sep:
+                raise _BadRequest(400, f"malformed header line {line!r}")
+            headers[name.strip().lower()] = value.strip()
+        body = b""
+        if "transfer-encoding" in headers:
+            raise _BadRequest(400, "chunked request bodies not supported")
+        if "content-length" in headers:
+            try:
+                length = int(headers["content-length"])
+            except ValueError:
+                raise _BadRequest(400, "malformed Content-Length")
+            if length < 0:
+                raise _BadRequest(400, "malformed Content-Length")
+            if length > MAX_BODY_BYTES:
+                raise _BadRequest(413, "request body too large")
+            if length:
+                try:
+                    body = await reader.readexactly(length)
+                except asyncio.IncompleteReadError:
+                    raise _BadRequest(400, "truncated request body")
+        elif method == "POST":
+            raise _BadRequest(411, "POST requires Content-Length")
+        # strip any query string: the WSGI router matches PATH_INFO only
+        path = target.split("?", 1)[0]
+        return method, path, headers, body
+
+    @staticmethod
+    def _encode_response(
+        status: int, body: bytes, content_type: str,
+        extra_headers=(), keep_alive: bool = True,
+    ) -> bytes:
+        reason = _REASONS.get(status, "UNKNOWN")
+        lines = [
+            f"HTTP/1.1 {status} {reason}",
+            f"Content-Type: {content_type}",
+            f"Content-Length: {len(body)}",
+            f"Connection: {'keep-alive' if keep_alive else 'close'}",
+        ]
+        lines += [f"{name}: {value}" for name, value in extra_headers]
+        return ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1") + body
+
+    async def handle_connection(self, reader, writer) -> None:
+        """One keep-alive connection: read request -> dispatch -> write
+        response, until the peer closes (or asks to)."""
+        # a freshly-accepted connection counts as busy immediately: under
+        # open-loop load its first request is already in flight toward
+        # us, and connections whose handler task has not been scheduled
+        # yet ARE the loop's backlog — exactly what the admission depth
+        # probe must see. A keep-alive connection idling between
+        # requests releases its slot (an idle closed-loop client is not
+        # load) and re-takes it when the next request head arrives.
+        self._busy_connections += 1
+        busy = True
+        try:
+            while True:
+                try:
+                    request = await self._read_request(reader)
+                except _BadRequest as exc:
+                    body = json.dumps({"error": exc.message}).encode()
+                    writer.write(self._encode_response(
+                        exc.status, body, "application/json", keep_alive=False
+                    ))
+                    await writer.drain()
+                    break
+                if request is None:
+                    break
+                if not busy:
+                    self._busy_connections += 1
+                    busy = True
+                method, path, headers, body = request
+                status, payload, content_type, extra = await self._dispatch(
+                    method, path, body
+                )
+                keep_alive = headers.get("connection", "").lower() != "close"
+                writer.write(self._encode_response(
+                    status, payload, content_type, extra, keep_alive
+                ))
+                await writer.drain()
+                if not keep_alive:
+                    break
+                self._busy_connections -= 1
+                busy = False
+        except (ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
+            pass  # peer went away (or shutdown): nothing to answer
+        finally:
+            if busy:
+                self._busy_connections -= 1
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    # -- dispatch ----------------------------------------------------------
+    async def _dispatch(self, method: str, path: str, body: bytes):
+        """Route one request. Returns ``(status, body_bytes,
+        content_type, extra_headers)``. Mirrors ``ScoringApp.__call__``'s
+        routing/metrics semantics so dashboards see one request stream
+        regardless of engine."""
+        app = self._next_app()
+        if path.startswith("/score/v1"):
+            # chaos consults BEFORE the timed/counted handler, exactly
+            # where FlakyScoringMiddleware sits on the WSGI engine
+            # (outside the app): an injected response never increments
+            # the request counter and injected latency never lands in
+            # the scoring-latency histogram, so metrics stay
+            # engine-comparable under an active fault plan
+            injected, delay, chaos_retry_after = self._chaos_decision(path)
+            if delay is not None:
+                await asyncio.sleep(delay)
+            if injected is not None:
+                return (
+                    injected,
+                    json.dumps(
+                        {"error": f"injected fault: HTTP {injected}"}
+                    ).encode(),
+                    "application/json",
+                    (("Retry-After", str(chaos_retry_after)),),
+                )
+        t0 = time.perf_counter()
+        scoring = path in ("/score/v1", "/score/v1/batch")
+        routes = {
+            ("POST", "/score/v1"): self._score_single,
+            ("POST", "/score/v1/batch"): self._score_batch,
+            ("GET", "/healthz"): self._healthz,
+            ("GET", "/metrics"): self._metrics,
+        }
+        known_path = any(p == path for _m, p in routes)
+        try:
+            handler = routes.get((method, path))
+            if handler is None:
+                description = (
+                    MethodNotAllowed.description if known_path
+                    else NotFound.description
+                )
+                status, payload, content_type, extra = (
+                    405 if known_path else 404,
+                    json.dumps({"error": description}).encode(),
+                    "application/json",
+                    (),
+                )
+            else:
+                status, payload, content_type, extra = await handler(app, body)
+        except Exception as exc:  # don't leak tracebacks to clients
+            log.error(f"unhandled error serving {path}: {exc!r}")
+            status, payload, content_type, extra = (
+                500,
+                json.dumps({"error": "internal server error"}).encode(),
+                "application/json",
+                (),
+            )
+        app._m_requests.inc(
+            route=path if known_path else "unknown", status=str(status)
+        )
+        if scoring and status == 200:
+            app._m_latency.observe(time.perf_counter() - t0)
+        return status, payload, content_type, extra
+
+    def _chaos_decision(self, path: str):
+        """Consult the active fault plan for this scoring request: returns
+        ``(injected_status_or_None, latency_delay_s_or_None,
+        retry_after_s)``. Same decision streams as the WSGI middleware,
+        so a chaos seed replays identical adversity on either engine."""
+        plan = self._active_plan()
+        if plan is None:
+            return None, None, 0.0
+        delay = plan.http_latency_delay(path)
+        status = plan.http_error(path)
+        if status is not None:
+            count_shed("chaos")
+        return status, delay, plan.http_retry_after_s
+
+    async def _score_common(self, app, body, score):
+        """The shared scoring-request shell: admission, parse, no-model
+        503 — then the per-route ``score`` coroutine. (Chaos injection
+        happens upstream in ``_dispatch``, middleware-style.)"""
+        admission = self.admission
+        if admission is not None and not admission.try_admit():
+            # shed BEFORE parsing: a refused request costs one counter
+            # increment and ~200 bytes of response
+            return (
+                429,
+                json.dumps(
+                    {"error": "server over capacity; request shed"}
+                ).encode(),
+                "application/json",
+                (("Retry-After", str(admission.retry_after_s())),),
+            )
+        t_admit = time.perf_counter()
+        try:
+            t0 = time.perf_counter()
+            try:
+                payload = json.loads(body) if body else None
+            except ValueError:
+                payload = None
+            X, message = parse_features(payload)
+            app._m_parse.observe(time.perf_counter() - t0)
+            if message is not None:
+                return (
+                    400,
+                    json.dumps({"error": message}).encode(),
+                    "application/json",
+                    (),
+                )
+            served = app.served_bundle
+            if served is None:
+                return (
+                    503,
+                    json.dumps(
+                        {"error": "no model loaded yet; retry shortly"}
+                    ).encode(),
+                    "application/json",
+                    (("Retry-After", str(app.retry_after_s())),),
+                )
+            return await score(app, served, X)
+        finally:
+            if admission is not None:
+                admission.release(time.perf_counter() - t_admit)
+
+    async def _score_single(self, app: ScoringApp, body: bytes):
+        async def score(app, served, X):
+            X = np.array(X, ndmin=2)  # scalar -> (1, 1), as the reference
+            loop = asyncio.get_running_loop()
+            prediction0 = None
+            if app.batcher is not None and X.shape[0] == 1:
+                future = loop.create_future()
+
+                def _resolve(sub) -> None:
+                    # dispatcher thread -> event loop handoff; the loop
+                    # may already be gone on shutdown
+                    def _set() -> None:
+                        if future.cancelled():
+                            return
+                        if sub.error is not None:
+                            future.set_exception(sub.error)
+                        else:
+                            future.set_result(sub.result)
+
+                    try:
+                        loop.call_soon_threadsafe(_set)
+                    except RuntimeError:
+                        pass
+
+                try:
+                    app.batcher.submit_nowait(served, X[0], on_done=_resolve)
+                except CoalescerSaturated:
+                    app._m_fallbacks.inc()
+                else:
+                    try:
+                        prediction0 = await asyncio.wait_for(
+                            future, COALESCE_TIMEOUT_S
+                        )
+                    except asyncio.TimeoutError:
+                        return (
+                            500,
+                            json.dumps(
+                                {"error": "internal server error"}
+                            ).encode(),
+                            "application/json",
+                            (),
+                        )
+            if prediction0 is None:
+                t0 = time.perf_counter()
+                predictions = await loop.run_in_executor(
+                    self._executor, served.predictor.predict, X
+                )
+                prediction0 = float(predictions[0])
+                app._m_dispatch.observe(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            payload = json.dumps(
+                single_score_payload(served, prediction0)
+            ).encode()
+            app._m_serialize.observe(time.perf_counter() - t0)
+            return 200, payload, "application/json", ()
+
+        return await self._score_common(app, body, score)
+
+    async def _score_batch(self, app: ScoringApp, body: bytes):
+        async def score(app, served, X):
+            if X.ndim == 0:
+                X = X[None]
+            loop = asyncio.get_running_loop()
+            t0 = time.perf_counter()
+            predictions = await loop.run_in_executor(
+                self._executor, served.predictor.predict, X
+            )
+            app._m_dispatch.observe(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            payload = json.dumps(
+                batch_score_payload(served, predictions)
+            ).encode()
+            app._m_serialize.observe(time.perf_counter() - t0)
+            return 200, payload, "application/json", ()
+
+        return await self._score_common(app, body, score)
+
+    async def _healthz(self, app: ScoringApp, body: bytes):
+        payload, status, retry_after = app.healthz_payload()
+        extra = (
+            (("Retry-After", str(retry_after)),) if retry_after is not None
+            else ()
+        )
+        return status, json.dumps(payload).encode(), "application/json", extra
+
+    async def _metrics(self, app: ScoringApp, body: bytes):
+        from bodywork_tpu.obs.multiproc import aggregated_render
+
+        loop = asyncio.get_running_loop()
+        text = await loop.run_in_executor(
+            self._executor, aggregated_render, get_registry(), app.metrics_dir
+        )
+        return (
+            200,
+            text.encode(),
+            "text/plain; version=0.0.4; charset=utf-8",
+            (),
+        )
+
+
+class AioServiceHandle:
+    """A scoring service on an asyncio event loop, with the
+    :class:`~bodywork_tpu.serve.server.ServiceHandle` lifecycle: the
+    loop runs on a background thread (``start``) or in the calling
+    thread (``serve_forever``); ``stop`` is thread-safe and runs the
+    registered cleanups (watcher stops, coalescer drains)."""
+
+    def __init__(
+        self,
+        app,
+        host: str = "127.0.0.1",
+        port: int = 5000,
+        admission=None,
+        sock: socket.socket | None = None,
+    ):
+        apps = list(app) if isinstance(app, (list, tuple)) else [app]
+        self.server = AioScoringServer(apps, admission=admission)
+        #: the in-process entry tests and the chaos harness use
+        #: (``.test_client()``); scoring through it bypasses the socket
+        #: front exactly as it does for the threaded engine
+        self.app = app if not isinstance(app, (list, tuple)) else apps[0]
+        self.host = host
+        self.port = port
+        self._sock = sock
+        self._cleanups: list = []
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._stop_event: asyncio.Event | None = None
+        self._ready = threading.Event()
+        self._startup_error: BaseException | None = None
+        self._thread = threading.Thread(
+            target=self._run_loop, name="aio-scoring-service", daemon=True
+        )
+
+    # -- ServiceHandle interface -------------------------------------------
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/score/v1"
+
+    def add_cleanup(self, fn) -> None:
+        self._cleanups.append(fn)
+
+    async def _serve_main(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._stop_event = asyncio.Event()
+        try:
+            if self._sock is not None:
+                server = await asyncio.start_server(
+                    self.server.handle_connection,
+                    sock=self._sock,
+                    limit=MAX_HEADER_BYTES,
+                )
+            else:
+                server = await asyncio.start_server(
+                    self.server.handle_connection,
+                    self.host,
+                    self.port,
+                    limit=MAX_HEADER_BYTES,
+                )
+            self.port = server.sockets[0].getsockname()[1]
+        except BaseException as exc:
+            self._startup_error = exc
+            self._ready.set()
+            raise
+        self._ready.set()
+        try:
+            async with server:
+                await self._stop_event.wait()
+        finally:
+            self.server.close()
+
+    def _run_loop(self) -> None:
+        try:
+            asyncio.run(self._serve_main())
+        except BaseException as exc:
+            if self._startup_error is None and not self._ready.is_set():
+                self._startup_error = exc
+                self._ready.set()
+                return  # start()/serve_forever() surface it as startup failure
+            # post-startup crash: propagate. In serve_forever (pod
+            # entrypoint) this exits the process non-zero — a crashed
+            # service must never report success to its supervisor (the
+            # ServiceHandle invariant); on the background thread it dies
+            # loudly via the thread excepthook instead of silently.
+            raise
+
+    def start(self) -> "AioServiceHandle":
+        self._thread.start()
+        self._ready.wait(timeout=30)
+        if self._startup_error is not None:
+            raise RuntimeError(
+                f"asyncio scoring service failed to start: "
+                f"{self._startup_error!r}"
+            ) from self._startup_error
+        if not self._ready.is_set():
+            raise TimeoutError("asyncio scoring service not ready within 30s")
+        log.info(f"scoring service (aio engine) listening on {self.url}")
+        return self
+
+    def serve_forever(self) -> None:
+        """Serve in the calling thread (pod-entrypoint mode)."""
+        log.info(f"scoring service (aio engine) starting on {self.url}")
+        self._run_loop()
+        if self._startup_error is not None:
+            raise RuntimeError(
+                f"asyncio scoring service failed: {self._startup_error!r}"
+            ) from self._startup_error
+
+    def wait(self) -> None:
+        self._thread.join()
+
+    def stop(self) -> None:
+        for fn in self._cleanups:
+            fn()
+        loop, stop_event = self._loop, self._stop_event
+        if loop is not None and stop_event is not None:
+            try:
+                loop.call_soon_threadsafe(stop_event.set)
+            except RuntimeError:
+                pass  # loop already closed
+        if self._thread.ident is not None:
+            self._thread.join(timeout=10)
+        log.info("scoring service (aio engine) stopped")
+
+    def __enter__(self) -> "AioServiceHandle":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -68,9 +68,13 @@ _FAST_PHASE_BUCKETS = (
 #: histogram (the "requests scored" accounting the bench cross-checks)
 _SCORING_ROUTES = ("/score/v1", "/score/v1/batch")
 
-#: Retry-After hint (seconds) on 503s from a not-yet-loaded service —
-#: long enough for a checkpoint-watcher poll to land a model, short
-#: enough that a retrying client converges quickly
+#: Retry-After hint (seconds) on 503s from a not-yet-loaded service
+#: WITHOUT an admission controller — long enough for a checkpoint-watcher
+#: poll to land a model, short enough that a retrying client converges
+#: quickly. With admission enabled, every backpressure response (shed
+#: 429 AND degraded 503) instead derives its Retry-After from the EWMA
+#: queue-delay estimate (``serve.admission``), clamped — one consistent
+#: numeric hint per service.
 RETRY_AFTER_S = 5
 
 
@@ -78,6 +82,48 @@ def _json_response(payload: dict, status: int = 200) -> Response:
     return Response(
         json.dumps(payload), status=status, mimetype="application/json"
     )
+
+
+def parse_features(payload):
+    """Validate a decoded request body into a float32 feature array.
+
+    Returns ``(X, None)`` or ``(None, error_message)``. Factored out of
+    the WSGI handler so BOTH front-ends (threaded werkzeug and the
+    asyncio event loop, ``serve.aio``) validate with the same code and
+    answer malformed input with byte-identical 400 bodies."""
+    if not isinstance(payload, dict) or "X" not in payload:
+        return None, "request body must be a JSON object with an 'X' field"
+    try:
+        X = np.asarray(payload["X"], dtype=np.float32)
+    except (TypeError, ValueError):
+        return None, "'X' must be numeric"
+    if X.size == 0:
+        return None, "'X' must be non-empty"
+    if not np.all(np.isfinite(X)):
+        return None, "'X' must be finite"
+    return X, None
+
+
+def single_score_payload(served, prediction0: float) -> dict:
+    """The ``/score/v1`` response body. One constructor for both
+    front-ends: key order and value formatting are what make coalesced
+    responses byte-identical across engines."""
+    return {
+        "prediction": prediction0,
+        "model_info": served.model_info,
+        "model_date": served.model_date,
+    }
+
+
+def batch_score_payload(served, predictions) -> dict:
+    """The ``/score/v1/batch`` response body (see
+    :func:`single_score_payload` for why this is factored)."""
+    return {
+        "predictions": [float(p) for p in predictions],
+        "n": int(len(predictions)),
+        "model_info": served.model_info,
+        "model_date": served.model_date,
+    }
 
 
 class _Served:
@@ -124,6 +170,7 @@ class ScoringApp:
         metrics_dir: str | None = None,
         model_key: str | None = None,
         model_source: str | None = None,
+        admission=None,
     ):
         if model is None:
             # degraded boot: no checkpoint exists yet. Scoring answers
@@ -150,6 +197,12 @@ class ScoringApp:
         # opt-in request coalescer (serve.batcher.RequestCoalescer);
         # None = every request dispatches its own padded device call
         self.batcher = batcher
+        #: opt-in admission controller (serve.admission): scoring POSTs
+        #: are admitted against its bounded pending budget BEFORE the
+        #: body is even parsed — a shed costs a counter bump and a tiny
+        #: 429, never coalescer or device work. None = admit everything
+        #: (the pre-admission behaviour, byte-identical).
+        self.admission = admission
         #: shared snapshot dir for multi-worker /metrics aggregation
         #: (serve.multiproc); None = this process's registry alone
         self.metrics_dir = metrics_dir
@@ -229,6 +282,14 @@ class ScoringApp:
         self._model_version_labels = labels
 
     # -- served-model access (single read point for atomic swaps) ----------
+    @property
+    def served_bundle(self):
+        """The immutable served-model bundle (predictor + identity), or
+        None before the first model. ONE read is stable across a hot
+        swap — the asyncio front-end (serve.aio) scores against this
+        exactly as the WSGI handlers below do."""
+        return self._served
+
     @property
     def predictor(self):
         served = self._served
@@ -324,6 +385,25 @@ class ScoringApp:
     def __call__(self, environ, start_response):
         request = Request(environ)
         t0 = time.perf_counter()
+        # admission runs FIRST — before parsing, before the no-model
+        # check, before anything that costs per-request work. A shed
+        # request must leave zero footprint beyond its counter: that is
+        # the property that keeps an overloaded server serving its
+        # admitted queue instead of drowning with it.
+        admission = self.admission
+        admitted = False
+        if (
+            admission is not None
+            and request.method == "POST"
+            and request.path in _SCORING_ROUTES
+        ):
+            if not admission.try_admit():
+                response = self.shed_response()
+                self._m_requests.inc(
+                    route=request.path, status=str(response.status_code)
+                )
+                return response(environ, start_response)
+            admitted = True
         try:
             handler = self._routes.get((request.method, request.path))
             if handler is None:
@@ -336,6 +416,11 @@ class ScoringApp:
         except Exception as exc:  # don't leak tracebacks to clients
             log.error(f"unhandled error serving {request.path}: {exc!r}")
             response = _json_response({"error": "internal server error"}, 500)
+        finally:
+            if admitted:
+                # the observed delay (admission -> response ready) is
+                # the EWMA sample behind every Retry-After hint
+                admission.release(time.perf_counter() - t0)
         route = (
             request.path
             if any(path == request.path for _m, path in self._routes)
@@ -362,27 +447,33 @@ class ScoringApp:
             self._m_parse.observe(time.perf_counter() - t0)
 
     def _parse_features(self, request: Request):
-        payload = request.get_json(silent=True)
-        if not isinstance(payload, dict) or "X" not in payload:
-            return None, _json_response(
-                {"error": "request body must be a JSON object with an 'X' field"},
-                400,
-            )
-        try:
-            X = np.asarray(payload["X"], dtype=np.float32)
-        except (TypeError, ValueError):
-            return None, _json_response({"error": "'X' must be numeric"}, 400)
-        if X.size == 0:
-            return None, _json_response({"error": "'X' must be non-empty"}, 400)
-        if not np.all(np.isfinite(X)):
-            return None, _json_response({"error": "'X' must be finite"}, 400)
+        X, message = parse_features(request.get_json(silent=True))
+        if message is not None:
+            return None, _json_response({"error": message}, 400)
         return X, None
+
+    def retry_after_s(self) -> int:
+        """The ONE numeric Retry-After every backpressure response from
+        this app carries (shed 429s and degraded/no-model 503s): the
+        admission layer's clamped EWMA estimate when admission is on,
+        the static watcher-poll default otherwise."""
+        if self.admission is not None:
+            return self.admission.retry_after_s()
+        return RETRY_AFTER_S
+
+    def shed_response(self) -> Response:
+        """The admission-shed 429 (load shedding, serve.admission)."""
+        response = _json_response(
+            {"error": "server over capacity; request shed"}, 429
+        )
+        response.headers["Retry-After"] = str(self.retry_after_s())
+        return response
 
     def _no_model_response(self) -> Response:
         response = _json_response(
             {"error": "no model loaded yet; retry shortly"}, 503
         )
-        response.headers["Retry-After"] = str(RETRY_AFTER_S)
+        response.headers["Retry-After"] = str(self.retry_after_s())
         return response
 
     # -- routes ------------------------------------------------------------
@@ -417,13 +508,7 @@ class ScoringApp:
             prediction0 = float(served.predictor.predict(X)[0])
             self._m_dispatch.observe(time.perf_counter() - t0)
         t0 = time.perf_counter()
-        response = _json_response(
-            {
-                "prediction": prediction0,
-                "model_info": served.model_info,
-                "model_date": served.model_date,
-            }
-        )
+        response = _json_response(single_score_payload(served, prediction0))
         self._m_serialize.observe(time.perf_counter() - t0)
         return response
 
@@ -441,21 +526,29 @@ class ScoringApp:
         predictions = served.predictor.predict(X)
         self._m_dispatch.observe(time.perf_counter() - t0)
         t0 = time.perf_counter()
-        response = _json_response(
-            {
-                "predictions": [float(p) for p in predictions],
-                "n": int(len(predictions)),
-                "model_info": served.model_info,
-                "model_date": served.model_date,
-            }
-        )
+        response = _json_response(batch_score_payload(served, predictions))
         self._m_serialize.observe(time.perf_counter() - t0)
         return response
 
-    def healthz(self, request: Request) -> Response:
+    def healthz_payload(self) -> tuple[dict, int, int | None]:
+        """``(payload, status, retry_after_s-or-None)`` — the health
+        document BOTH front-ends serve (the threaded route below, the
+        asyncio engine directly), so operators see one schema per
+        service regardless of engine."""
         served = self._served  # one read: stable across a hot swap
+        admission = self.admission
+        # queue depth surfaces even without admission: the coalescer's
+        # pending rows are the next-best saturation signal
+        if admission is not None:
+            queue_depth = admission.queue_depth
+            admission_state = admission.state()
+        else:
+            queue_depth = (
+                self.batcher.pending_depth() if self.batcher is not None else 0
+            )
+            admission_state = None
         if served is None:
-            response = _json_response(
+            return (
                 {
                     "status": "no model loaded",
                     "degraded": True,
@@ -464,11 +557,12 @@ class ScoringApp:
                     "model_date": None,
                     "model_key": None,
                     "model_source": None,
+                    "queue_depth": queue_depth,
+                    "admission": admission_state,
                 },
                 503,
+                self.retry_after_s(),
             )
-            response.headers["Retry-After"] = str(RETRY_AFTER_S)
-            return response
         reason = self._degraded_reason
         payload = {
             # 200 + "ok" even when degraded: the service IS serving, so
@@ -485,10 +579,25 @@ class ScoringApp:
             "model_key": served.model_key,
             "model_source": served.source,
             "degraded": reason is not None,
+            # saturation channel (serve.admission): current depth plus —
+            # when admission is on — budget, shedding state, and the
+            # Retry-After currently handed out. Shedding deliberately
+            # does NOT flip the 200: an at-budget replica is doing its
+            # job; pulling it from the endpoints would dogpile its load
+            # onto the siblings (readiness semantics, pipeline/k8s.py).
+            "queue_depth": queue_depth,
+            "admission": admission_state,
         }
         if reason is not None:
             payload["reason"] = reason
-        return _json_response(payload)
+        return payload, 200, None
+
+    def healthz(self, request: Request) -> Response:
+        payload, status, retry_after = self.healthz_payload()
+        response = _json_response(payload, status)
+        if retry_after is not None:
+            response.headers["Retry-After"] = str(retry_after)
+        return response
 
     def metrics_endpoint(self, request: Request) -> Response:
         """Prometheus text exposition of this process's registry, merged
@@ -515,6 +624,7 @@ def create_app(
     metrics_dir: str | None = None,
     model_key: str | None = None,
     model_source: str | None = None,
+    admission=None,
 ) -> ScoringApp:
     """``batch_window_ms`` > 0 opts into cross-request micro-batching
     (``serve.batcher``): concurrent single-row ``/score/v1`` requests
@@ -524,7 +634,12 @@ def create_app(
     ``metrics_dir`` points ``GET /metrics`` at a shared snapshot
     directory so multi-process replicas expose one aggregated view
     (``serve.multiproc`` wires it; single-process serving needs nothing —
-    the endpoint always exposes this process's registry)."""
+    the endpoint always exposes this process's registry).
+
+    ``admission`` (serve.admission.AdmissionController) opts into load
+    shedding: scoring requests beyond its pending budget answer 429 +
+    Retry-After before any work happens. Replica apps sharing one port
+    should share ONE controller (one budget per serving process)."""
     batcher = None
     if batch_window_ms and batch_window_ms > 0:
         from bodywork_tpu.serve.batcher import DEFAULT_MAX_ROWS, RequestCoalescer
@@ -535,7 +650,8 @@ def create_app(
         ).start()
     app = ScoringApp(model, model_date, buckets, predictor=predictor,
                      batcher=batcher, metrics_dir=metrics_dir,
-                     model_key=model_key, model_source=model_source)
+                     model_key=model_key, model_source=model_source,
+                     admission=admission)
     if warmup and app.predictor is not None:
         app.predictor.warmup(sync=warmup_sync)
     return app

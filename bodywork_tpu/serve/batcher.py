@@ -70,17 +70,24 @@ class CoalescerSaturated(RuntimeError):
 
 class _Submission:
     """One enqueued row: the input, the served bundle it must be scored
-    by, and the rendezvous the request thread waits on."""
+    by, and the rendezvous the request thread waits on. ``on_done`` is
+    the OPTIONAL push-style completion channel (fired on the dispatcher
+    thread right after ``event`` is set): the asyncio front-end sets it
+    to hand the result back to its event loop without parking a thread
+    on ``event.wait`` — the threaded engine keeps the blocking wait."""
 
-    __slots__ = ("row", "served", "event", "result", "error", "enqueued_at")
+    __slots__ = (
+        "row", "served", "event", "result", "error", "enqueued_at", "on_done",
+    )
 
-    def __init__(self, row: np.ndarray, served):
+    def __init__(self, row: np.ndarray, served, on_done=None):
         self.row = row
         self.served = served
         self.event = threading.Event()
         self.result: float | None = None
         self.error: BaseException | None = None
         self.enqueued_at = time.monotonic()
+        self.on_done = on_done
 
 
 class RequestCoalescer:
@@ -168,13 +175,14 @@ class RequestCoalescer:
             self._thread.join(timeout=10)
 
     # -- request path ------------------------------------------------------
-    def submit(self, served, row: np.ndarray, timeout_s: float = 60.0) -> float:
-        """Enqueue one ``(1, n_features)``-shaped row against ``served``
-        (the app's immutable served-model bundle) and block until its
-        prediction returns. Raises :class:`CoalescerSaturated` when the
-        queue is full/stopped, or the batch's own error if the device
-        call failed."""
-        sub = _Submission(np.asarray(row, dtype=np.float32), served)
+    def submit_nowait(self, served, row: np.ndarray, on_done=None) -> _Submission:
+        """Enqueue one row WITHOUT waiting: returns the submission whose
+        ``event`` (pull) or ``on_done`` callback (push — must be set
+        HERE, before the enqueue, or the dispatcher can complete the
+        batch first and the callback never fires) signals completion.
+        The asyncio front-end's bridge into the coalescer; raises
+        :class:`CoalescerSaturated` exactly as :meth:`submit` does."""
+        sub = _Submission(np.asarray(row, dtype=np.float32), served, on_done)
         with self._cond:
             if self._stopped or not self._started:
                 self._m_saturated.inc()
@@ -187,6 +195,22 @@ class RequestCoalescer:
             self._pending.append(sub)
             self.rows_submitted += 1
             self._cond.notify_all()
+        return sub
+
+    def pending_depth(self) -> int:
+        """Rows enqueued or mid-dispatch — the coalescer's contribution
+        to the queue-depth picture (/healthz surfaces it when no
+        admission controller owns the number)."""
+        with self._cond:
+            return len(self._pending) + len(self._inflight)
+
+    def submit(self, served, row: np.ndarray, timeout_s: float = 60.0) -> float:
+        """Enqueue one ``(1, n_features)``-shaped row against ``served``
+        (the app's immutable served-model bundle) and block until its
+        prediction returns. Raises :class:`CoalescerSaturated` when the
+        queue is full/stopped, or the batch's own error if the device
+        call failed."""
+        sub = self.submit_nowait(served, row)
         if not sub.event.wait(timeout_s):
             raise TimeoutError(
                 f"coalesced prediction not ready within {timeout_s:.0f}s"
@@ -291,6 +315,14 @@ class RequestCoalescer:
             self.max_batch_rows = max(self.max_batch_rows, len(batch))
             for sub in batch:
                 sub.event.set()
+                if sub.on_done is not None:
+                    try:
+                        # push-style completion (the asyncio bridge); a
+                        # broken callback must not strand the REST of
+                        # the batch or kill the dispatcher
+                        sub.on_done(sub)
+                    except Exception as exc:
+                        log.error(f"submission on_done callback failed: {exc!r}")
 
     def stats(self) -> dict:
         """Dispatch accounting: ``rows_dispatched / batches_dispatched``

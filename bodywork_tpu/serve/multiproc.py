@@ -49,7 +49,10 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
                  watch_interval_s: float | None, buckets, ready,
                  batch_window_ms: float | None = None,
                  batch_max_rows: int | None = None,
-                 metrics_dir: str | None = None):
+                 metrics_dir: str | None = None,
+                 server_engine: str = "thread",
+                 max_pending: int | None = None,
+                 retry_after_max_s: float | None = None):
     """One serving replica: load latest checkpoint -> predictor -> listen
     on the shared port. Runs in a SPAWNED process (a fork would inherit
     the parent's initialized XLA runtime threads — undefined behavior)."""
@@ -57,7 +60,7 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
 
     from bodywork_tpu.models.checkpoint import load_model, resolve_serving_key
     from bodywork_tpu.serve.app import create_app
-    from bodywork_tpu.serve.server import build_predictor
+    from bodywork_tpu.serve.server import build_admission, build_predictor
     from bodywork_tpu.store import open_store
 
     store = open_store(store_path)
@@ -66,6 +69,12 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
     served_key, served_source = resolve_serving_key(store)
     model, model_date = load_model(store, served_key)
     predictor = build_predictor(model, None, engine, buckets=buckets)
+    # one admission budget PER WORKER PROCESS (as one coalescer per
+    # worker): each replica sheds against its own kernel-balanced
+    # connection share, and the aggregated queue-depth gauge (sum) plus
+    # the shed counter still give the service-wide saturation picture
+    admission = build_admission(server_engine, max_pending,
+                                retry_after_max_s)
     # one coalescer PER WORKER PROCESS: replicas never share a dispatcher
     # (they never share a predictor either), so each worker amortises its
     # own connection share across its own padded device calls
@@ -74,7 +83,8 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
                      batch_window_ms=batch_window_ms,
                      batch_max_rows=batch_max_rows,
                      metrics_dir=metrics_dir,
-                     model_key=served_key, model_source=served_source)
+                     model_key=served_key, model_source=served_source,
+                     admission=admission)
     flusher = None
     if metrics_dir is not None:
         # each replica flushes its registry snapshot to the shared dir;
@@ -86,8 +96,20 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
         flusher = MetricsFlusher(get_registry(), metrics_dir).start()
 
     sock = _reuseport_socket(host, port)
-    sock.listen(128)
-    server = make_server(host, port, app, threaded=True, fd=sock.fileno())
+    aio_handle = None
+    server = None
+    if server_engine == "aio":
+        # the asyncio front-end listens on the same SO_REUSEPORT socket:
+        # the kernel balances connections across replicas regardless of
+        # which front-end each one runs (asyncio's start_server calls
+        # listen() on the bound socket itself)
+        from bodywork_tpu.serve.aio import AioServiceHandle
+
+        aio_handle = AioServiceHandle(app, host, port, sock=sock)
+    else:
+        sock.listen(128)
+        server = make_server(host, port, app, threaded=True,
+                             fd=sock.fileno())
 
     # the supervisor stops workers with terminate() (SIGTERM); without a
     # handler the default disposition kills the process mid-stack and the
@@ -103,14 +125,23 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
             app, store, poll_interval_s=watch_interval_s,
             engine=engine, served_key=served_key, buckets=buckets,
         ).start()
-    ready.put(os.getpid())
     try:
-        server.serve_forever()
+        if aio_handle is not None:
+            # start() returns once the loop is listening — only then is
+            # the replica ready to take its share of connections
+            aio_handle.start()
+            ready.put(os.getpid())
+            aio_handle.wait()
+        else:
+            ready.put(os.getpid())
+            server.serve_forever()
     finally:  # pragma: no cover - only on signal teardown
         if watcher is not None:
             watcher.stop()
         if flusher is not None:
             flusher.stop()  # final snapshot flush
+        if aio_handle is not None:
+            aio_handle.stop()
         app.close()  # flush + stop the worker's coalescer
 
 
@@ -143,8 +174,18 @@ class MultiProcessService:
         batch_window_ms: float | None = None,
         batch_max_rows: int | None = None,
         metrics: bool = False,
+        server_engine: str = "thread",
+        max_pending: int | None = None,
+        retry_after_max_s: float | None = None,
     ):
         assert workers >= 1, "need at least one replica"
+        from bodywork_tpu.serve.server import SERVER_ENGINES
+
+        if server_engine not in SERVER_ENGINES:
+            raise ValueError(
+                f"unknown server engine {server_engine!r}; "
+                f"expected one of {SERVER_ENGINES}"
+            )
         self.store_path = str(store_path)
         self.host = host
         self.workers = workers
@@ -155,6 +196,11 @@ class MultiProcessService:
         # replicas inherit the same policy
         self.batch_window_ms = batch_window_ms
         self.batch_max_rows = batch_max_rows
+        # HTTP front-end + per-worker admission budget (serve.admission);
+        # respawned replicas inherit the same policy
+        self.server_engine = server_engine
+        self.max_pending = max_pending
+        self.retry_after_max_s = retry_after_max_s
         # opt-in aggregated /metrics: a shared snapshot dir every worker
         # flushes into, so any replica can answer for the whole service.
         # Created lazily in start() so a failed startup never leaks it.
@@ -193,7 +239,8 @@ class MultiProcessService:
             args=(self.store_path, self.host, self.port, self.engine,
                   self.watch_interval_s, self.buckets, ready,
                   self.batch_window_ms, self.batch_max_rows,
-                  self.metrics_dir),
+                  self.metrics_dir, self.server_engine,
+                  self.max_pending, self.retry_after_max_s),
             daemon=True,
         )
         proc.start()

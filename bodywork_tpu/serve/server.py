@@ -24,6 +24,15 @@ from bodywork_tpu.utils.logging import get_logger
 
 log = get_logger("serve.server")
 
+#: the serving front-end (HTTP server) registry: ``thread`` is the
+#: werkzeug thread-per-request server (default — the closed-loop-proven
+#: path), ``aio`` the asyncio event-loop front-end (``serve.aio``) built
+#: for open-loop arrival-rate load. Kept in sync with ``cli serve
+#: --server-engine`` choices and bench config 9 by a guard test
+#: (tests/test_aio.py) — a front-end that exists in only some of the
+#: three tables would either be unreachable or unmeasured.
+SERVER_ENGINES = ("thread", "aio")
+
 
 class RoundRobinApp:
     """WSGI front alternating requests across N replica apps.
@@ -226,6 +235,33 @@ def build_predictor(model, mesh_data: int | None = None, engine: str = "xla",
     return predictor
 
 
+def build_admission(
+    server_engine: str,
+    max_pending: int | None,
+    retry_after_max_s: float | None = None,
+):
+    """The admission controller for a serving process, or ``None``.
+
+    Admission is armed by an explicit ``max_pending`` on either engine,
+    and BY DEFAULT (at :data:`~bodywork_tpu.serve.admission.
+    DEFAULT_MAX_PENDING`) on the aio engine: an event-loop front exists
+    to stay responsive past saturation, which it can only do by bounding
+    the work it holds. The threaded engine keeps its historical
+    admit-everything default — its thread pool is its own (cruder)
+    bound, and the closed-loop parity benches must see an unchanged
+    service."""
+    from bodywork_tpu.serve.admission import AdmissionController
+
+    if max_pending is None and server_engine != "aio":
+        return None
+    kwargs: dict = {}
+    if max_pending is not None:
+        kwargs["max_pending"] = max_pending
+    if retry_after_max_s is not None:
+        kwargs["retry_after_max_s"] = retry_after_max_s
+    return AdmissionController(**kwargs)
+
+
 def serve_latest_model(
     store: ArtefactStore,
     host: str = "0.0.0.0",
@@ -237,6 +273,9 @@ def serve_latest_model(
     buckets: tuple[int, ...] | None = None,
     batch_window_ms: float | None = None,
     batch_max_rows: int | None = None,
+    server_engine: str = "thread",
+    max_pending: int | None = None,
+    retry_after_max_s: float | None = None,
 ):
     """Load latest model -> HBM, warm up, serve (reference ``stage_2`` main).
 
@@ -250,6 +289,15 @@ def serve_latest_model(
     service for every new day's model — ``stage_2:113``). With
     ``block=False`` returns a started :class:`ServiceHandle`.
 
+    ``server_engine`` picks the HTTP front-end (:data:`SERVER_ENGINES`):
+    ``thread`` (werkzeug, default) or ``aio`` (asyncio event loop,
+    ``serve.aio`` — built for open-loop arrival-rate load). ``max_pending``
+    arms admission control (``serve.admission``: scoring requests beyond
+    the budget answer 429 + ``Retry-After`` before any work happens); the
+    aio engine arms it by default (its whole point is staying responsive
+    past saturation), the threaded engine only on request.
+    ``retry_after_max_s`` caps the EWMA-derived ``Retry-After`` hint.
+
     Degraded boot: with the watcher enabled, a store holding NO model
     checkpoint yet starts the service anyway — scoring answers 503 +
     ``Retry-After`` until the watcher swaps in the first checkpoint —
@@ -260,6 +308,11 @@ def serve_latest_model(
     from bodywork_tpu.registry.records import RegistryCorrupt
     from bodywork_tpu.store.base import ArtefactNotFound
 
+    if server_engine not in SERVER_ENGINES:
+        raise ValueError(
+            f"unknown server engine {server_engine!r}; "
+            f"expected one of {SERVER_ENGINES}"
+        )
     try:
         # registry-aware: the production alias when one exists, else the
         # newest date-keyed checkpoint (models/checkpoint.py)
@@ -287,12 +340,19 @@ def serve_latest_model(
         # (every engine honours the list), so create_app never needs the
         # knob here
         predictor = build_predictor(model, mesh_data, engine, buckets=buckets)
+    admission = build_admission(server_engine, max_pending, retry_after_max_s)
     app = create_app(
         model, model_date, predictor=predictor,
         batch_window_ms=batch_window_ms, batch_max_rows=batch_max_rows,
         model_key=served_key, model_source=served_source,
+        admission=admission,
     )
-    handle = ServiceHandle(app, host, port)
+    if server_engine == "aio":
+        from bodywork_tpu.serve.aio import AioServiceHandle
+
+        handle = AioServiceHandle(app, host, port)
+    else:
+        handle = ServiceHandle(app, host, port)
     # the coalescer's dispatcher stops (after flushing) with the service
     handle.add_cleanup(app.close)
     if watch_interval_s:

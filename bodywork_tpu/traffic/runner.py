@@ -1,0 +1,351 @@
+"""Open-loop load driver: replay a request log against a live service.
+
+The driver is the *open-loop* half of the harness contract: requests
+fire at their scheduled arrival times **whether or not earlier responses
+have returned**. A slow server does not slow the driver down — it just
+accumulates in-flight requests, exactly as real arrival-rate traffic
+would. (The closed-loop helpers in ``bench.py`` are the opposite
+regime: they measure the server's service rate; this measures its
+behaviour at a fixed offered rate.)
+
+Measurement protocol:
+
+- **Latency is measured from the scheduled arrival time**, not from the
+  moment the request hit the wire. Measuring from send-time is the
+  classic coordinated-omission mistake: a driver that stalls behind a
+  slow server under-reports exactly the latencies that matter. The
+  driver's own scheduling health is reported separately
+  (``send_lag_p99_s``) so a client-side stall is visible instead of
+  silently polluting the server's numbers.
+- **Goodput counts 200s only.** A shed 429, a degraded 503, or a
+  transport error all consumed offered load without delivering a
+  prediction; ``goodput_rps`` is the rate of *useful* responses — the
+  number an SLO is written against.
+- **Keep-alive connection pool.** Requests ride a shared pool of
+  keep-alive connections (grown on demand, one in-flight request per
+  connection), the shape real arrival-rate traffic has by the time it
+  reaches a replica: individual users don't share sockets, but their
+  requests arrive through load balancers and sidecars that do. It also
+  keeps the *measurement* about request admission rather than TCP
+  churn — with a connection dialed per request, an overloaded server
+  pays accept/close for every request it sheds, and at rates where
+  scoring is a cheap coalesced batch that churn (not the scoring) is
+  what collapses, drowning the very effect config 9 exists to measure.
+
+The transport is pluggable (``transport=`` — an async callable taking a
+:class:`~bodywork_tpu.traffic.generator.Request` and returning
+``(status, retry_after_s)``): tests substitute a recording/canned
+transport to prove replay determinism without a socket, the CLI and
+bench use the real HTTP transport.
+"""
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import urllib.parse
+
+from bodywork_tpu.traffic.generator import Request
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("traffic.runner")
+
+__all__ = ["LoadReport", "format_report", "run_open_loop"]
+
+#: response head + headers cap when parsing the reply
+_MAX_HEAD = 64 * 1024
+
+
+@dataclasses.dataclass
+class _Result:
+    t_s: float            # scheduled arrival offset
+    status: int           # 0 = transport error / timeout
+    retry_after_s: float | None
+    latency_s: float      # scheduled arrival -> response complete
+    send_lag_s: float     # scheduled arrival -> actually sent
+
+
+def _percentile(sorted_vals: list, q: float) -> float | None:
+    """Nearest-rank percentile (the bench.py convention)."""
+    if not sorted_vals:
+        return None
+    k = max(0, min(len(sorted_vals) - 1,
+                   int(round(q / 100.0 * len(sorted_vals) + 0.5)) - 1))
+    return sorted_vals[k]
+
+
+@dataclasses.dataclass
+class LoadReport:
+    """One open-loop run, summarised. ``to_dict`` is the record the CLI
+    prints and bench config 9 embeds."""
+
+    requests: int
+    duration_s: float
+    offered_rps: float
+    ok: int
+    #: OK responses that completed INSIDE the offered-load window
+    #: (scheduled arrival + latency <= duration). Under overload the
+    #: plain ``ok`` count includes the post-window queue drain;
+    #: in-window goodput is the sustainable service rate — the capacity
+    #: estimator reads THIS.
+    ok_in_window: int
+    shed: int              # 429 (admission or injected)
+    unavailable: int       # 503
+    client_error: int      # other 4xx
+    server_error: int      # 5xx except 503
+    transport_errors: int  # connect/reset/parse failures
+    timeouts: int
+    goodput_rps: float
+    goodput_in_window_rps: float
+    shed_fraction: float
+    latency: dict          # p50/p99/p999 over OK responses, seconds
+    retry_after: dict      # {responses, mean_s, max_s} where the header appeared
+    send_lag_p99_s: float | None
+    max_in_flight: int
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class _ConnectionPool:
+    """Keep-alive connections to one host:port, grown on demand. Each
+    connection carries ONE request at a time (no pipelining); a
+    connection that errored, was cancelled mid-exchange, or whose
+    server answered ``Connection: close`` is discarded, never reused —
+    a fresh dial replaces it on the next acquire."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._idle: list = []
+
+    async def acquire(self):
+        """``(reader, writer, reused)`` — ``reused`` marks a pooled
+        connection, which the transport may legally find half-closed
+        (the server timed it out while idle) and retry fresh."""
+        while self._idle:
+            reader, writer = self._idle.pop()
+            if reader.at_eof() or writer.is_closing():
+                writer.close()
+                continue
+            return reader, writer, True
+        reader, writer = await asyncio.open_connection(
+            self.host, self.port, limit=_MAX_HEAD
+        )
+        return reader, writer, False
+
+    def release(self, reader, writer, reusable: bool) -> None:
+        if reusable and not reader.at_eof() and not writer.is_closing():
+            self._idle.append((reader, writer))
+        else:
+            writer.close()
+
+    def close(self) -> None:
+        while self._idle:
+            _reader, writer = self._idle.pop()
+            writer.close()
+
+
+async def _http_transport(pool: _ConnectionPool, request: Request):
+    """One request over a pooled keep-alive connection. Returns
+    ``(status, retry_after_s)``; raises on transport failure (the
+    driver counts). On ANY failure — including a cancellation from the
+    driver's timeout — the connection is discarded, so a half-read
+    response can never bleed into the next request.
+
+    A *reused* connection the server closed while it idled in the pool
+    (thread-per-request servers time out keep-alive sockets) fails
+    before a single response byte arrives; scoring is idempotent and
+    nothing was answered, so the request retries exactly once on a
+    fresh dial — the same reused-idempotent rule urllib3 applies. A
+    FRESH connection failing is a real transport error and propagates."""
+    body = request.payload()
+    head = (
+        f"POST {request.route} HTTP/1.1\r\n"
+        f"Host: {pool.host}:{pool.port}\r\n"
+        "Content-Type: application/json\r\n"
+        f"Content-Length: {len(body)}\r\n\r\n"
+    ).encode("latin-1")
+    for attempt in (0, 1):
+        reader, writer, reused = await pool.acquire()
+        reusable = False
+        try:
+            try:
+                writer.write(head + body)
+                await writer.drain()
+                status_line = await reader.readline()
+            except (ConnectionResetError, BrokenPipeError):
+                if reused and attempt == 0:
+                    continue  # stale keep-alive: one retry, fresh dial
+                raise
+            if not status_line:
+                if reused and attempt == 0:
+                    continue  # EOF before the status line, same story
+                raise ConnectionResetError("EOF before response status line")
+            parts = status_line.decode("latin-1").split(" ", 2)
+            status = int(parts[1])
+            retry_after = None
+            content_length = None
+            keep_alive = True
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                name, _sep, value = line.decode("latin-1").partition(":")
+                name = name.strip().lower()
+                if name == "retry-after":
+                    try:
+                        retry_after = float(value.strip())
+                    except ValueError:
+                        pass
+                elif name == "content-length":
+                    try:
+                        content_length = int(value.strip())
+                    except ValueError:
+                        pass
+                elif name == "connection":
+                    keep_alive = value.strip().lower() != "close"
+            if content_length:
+                await reader.readexactly(content_length)
+            # a response with no Content-Length would need a close/EOF
+            # to delimit — never reusable
+            reusable = keep_alive and content_length is not None
+            return status, retry_after
+        finally:
+            pool.release(reader, writer, reusable)
+    raise ConnectionResetError("unreachable")  # pragma: no cover
+
+
+def run_open_loop(
+    url: str,
+    requests_log: list[Request],
+    timeout_s: float = 30.0,
+    transport=None,
+    duration_s: float | None = None,
+) -> LoadReport:
+    """Fire ``requests_log`` at its scheduled arrival times against
+    ``url`` (scheme://host:port — any path component is ignored; each
+    log entry carries its own route) and summarise the outcome.
+
+    Runs its own event loop, so it is callable from plain synchronous
+    code (the CLI, bench children, tests); do not call it from inside a
+    running loop."""
+    if not requests_log:
+        raise ValueError("empty request log: nothing to drive")
+    parsed = urllib.parse.urlsplit(url)
+    host = parsed.hostname or "127.0.0.1"
+    port = parsed.port or 80
+    pool: _ConnectionPool | None = None
+    if transport is None:
+        pool = _ConnectionPool(host, port)
+
+        async def transport(req: Request):
+            return await _http_transport(pool, req)
+
+    span = duration_s if duration_s is not None else max(
+        r.t_s for r in requests_log
+    )
+    span = max(span, 1e-6)
+    results: list[_Result] = []
+    in_flight = 0
+    max_in_flight = 0
+    timeouts = 0
+
+    async def _drive():
+        nonlocal in_flight, max_in_flight, timeouts
+        loop = asyncio.get_running_loop()
+        t_start = loop.time()
+
+        async def fire(req: Request):
+            nonlocal in_flight, max_in_flight, timeouts
+            target = t_start + req.t_s
+            delay = target - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            send_lag = loop.time() - target
+            in_flight += 1
+            max_in_flight = max(max_in_flight, in_flight)
+            try:
+                status, retry_after = await asyncio.wait_for(
+                    transport(req), timeout_s
+                )
+            except asyncio.TimeoutError:
+                timeouts += 1
+                status, retry_after = 0, None
+            except Exception:
+                status, retry_after = 0, None
+            finally:
+                in_flight -= 1
+            results.append(_Result(
+                t_s=req.t_s, status=status, retry_after_s=retry_after,
+                latency_s=loop.time() - target, send_lag_s=send_lag,
+            ))
+
+        try:
+            await asyncio.gather(*[fire(r) for r in requests_log])
+        finally:
+            if pool is not None:
+                pool.close()
+
+    asyncio.run(_drive())
+
+    ok = [r for r in results if r.status == 200]
+    ok_in_window = sum(1 for r in ok if r.t_s + r.latency_s <= span)
+    shed = sum(1 for r in results if r.status == 429)
+    unavailable = sum(1 for r in results if r.status == 503)
+    client_error = sum(
+        1 for r in results if 400 <= r.status < 500 and r.status != 429
+    )
+    server_error = sum(
+        1 for r in results if r.status >= 500 and r.status != 503
+    )
+    transport_errors = sum(1 for r in results if r.status == 0) - timeouts
+    ok_lat = sorted(r.latency_s for r in ok)
+    lags = sorted(r.send_lag_s for r in results)
+    with_retry = [r.retry_after_s for r in results
+                  if r.retry_after_s is not None]
+    report = LoadReport(
+        requests=len(results),
+        duration_s=round(span, 6),
+        offered_rps=round(len(results) / span, 3),
+        ok=len(ok),
+        ok_in_window=ok_in_window,
+        shed=shed,
+        unavailable=unavailable,
+        client_error=client_error,
+        server_error=server_error,
+        transport_errors=transport_errors,
+        timeouts=timeouts,
+        goodput_rps=round(len(ok) / span, 3),
+        goodput_in_window_rps=round(ok_in_window / span, 3),
+        shed_fraction=round(shed / len(results), 6),
+        latency={
+            "p50_s": _round6(_percentile(ok_lat, 50)),
+            "p99_s": _round6(_percentile(ok_lat, 99)),
+            "p999_s": _round6(_percentile(ok_lat, 99.9)),
+        },
+        retry_after={
+            "responses": len(with_retry),
+            "mean_s": _round6(sum(with_retry) / len(with_retry))
+            if with_retry else None,
+            "max_s": _round6(max(with_retry)) if with_retry else None,
+        },
+        send_lag_p99_s=_round6(_percentile(lags, 99)),
+        max_in_flight=max_in_flight,
+    )
+    log.info(
+        f"open-loop run: offered {report.offered_rps:.0f} rps x "
+        f"{report.duration_s:.1f}s -> goodput {report.goodput_rps:.0f} rps, "
+        f"shed {report.shed_fraction:.1%}, "
+        f"p99 {report.latency['p99_s']}s"
+    )
+    return report
+
+
+def _round6(value: float | None) -> float | None:
+    return round(value, 6) if value is not None else None
+
+
+def format_report(report: LoadReport) -> str:
+    """The CLI's stdout shape: one JSON document."""
+    return json.dumps(report.to_dict(), indent=2)

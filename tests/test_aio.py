@@ -1,0 +1,580 @@
+"""Asyncio front-end + admission control (ISSUE 6).
+
+Three contracts under test. (1) Cross-engine byte identity: ``cli serve
+--server-engine`` must be a pure operational choice, so both front-ends
+answer the same requests with identical bytes — singles, batches,
+malformed input, degraded 503s. (2) Admission invariants: the bounded
+pending budget is never exceeded under a concurrent burst, a shed
+request does zero coalescer/device work, and every backpressure response
+(shed 429 AND degraded 503) carries the one EWMA-derived numeric
+``Retry-After`` that the scoring clients floor their retries on. (3) The
+three engine tables — ``serve.server.SERVER_ENGINES``, the ``cli serve
+--server-engine`` choices, and bench config 9's sweep list — stay in
+sync, so a front-end can't ship unreachable or unmeasured.
+"""
+import sys
+import threading
+import time
+from datetime import date
+from pathlib import Path
+
+import numpy as np
+import pytest
+import requests as rq
+
+from bodywork_tpu.models import LinearRegressor
+from bodywork_tpu.obs import get_registry
+from bodywork_tpu.serve import (
+    AdmissionController,
+    AioServiceHandle,
+    ServiceHandle,
+    create_app,
+)
+from bodywork_tpu.serve.admission import (
+    DEFAULT_MAX_PENDING,
+    QUEUE_DEPTH_METRIC,
+    SHED_TOTAL_METRIC,
+)
+from bodywork_tpu.serve.server import SERVER_ENGINES, build_admission
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+@pytest.fixture(scope="module")
+def fitted_model():
+    rng = np.random.default_rng(1)
+    X = rng.uniform(0, 100, 600).astype(np.float32)
+    y = (1.0 + 0.5 * X + rng.normal(0, 1, 600)).astype(np.float32)
+    return LinearRegressor().fit(X, y)
+
+
+def _shed_counter():
+    return get_registry().counter(SHED_TOTAL_METRIC)
+
+
+# -- the three-table sync guard ----------------------------------------------
+
+def test_engine_registry_cli_and_bench_stay_in_sync():
+    """A front-end present in only some of the three tables would be
+    either unreachable (no CLI flag) or unmeasured (no bench sweep)."""
+    from bodywork_tpu.cli import build_parser
+
+    import bench
+
+    serve_parser = build_parser()._subparsers._group_actions[0].choices["serve"]
+    action = next(
+        a for a in serve_parser._actions if a.dest == "server_engine"
+    )
+    assert tuple(action.choices) == SERVER_ENGINES
+    assert bench.OPEN_LOOP_ENGINES == SERVER_ENGINES
+    assert 9 in bench.ALL_CONFIGS and 9 in bench.CONFIG_BENCHES
+
+
+def test_build_admission_defaults():
+    # aio arms admission by default; thread keeps admit-everything
+    aio = build_admission("aio", None)
+    assert aio is not None and aio.max_pending == DEFAULT_MAX_PENDING
+    assert build_admission("thread", None) is None
+    # an explicit budget arms either engine
+    assert build_admission("thread", 7).max_pending == 7
+    assert build_admission("aio", 7, retry_after_max_s=9.0).retry_after_max_s == 9.0
+
+
+# -- cross-engine byte identity over real HTTP -------------------------------
+
+@pytest.fixture(scope="module")
+def engine_pair(fitted_model):
+    handles = {}
+    for engine in SERVER_ENGINES:
+        app = create_app(fitted_model, date(2026, 7, 1), buckets=(1, 8, 64),
+                         warmup=True, batch_window_ms=2.0)
+        cls = AioServiceHandle if engine == "aio" else ServiceHandle
+        handle = cls(app, "127.0.0.1", 0).start()
+        handles[engine] = handle
+    yield {e: h.url.replace("/score/v1", "") for e, h in handles.items()}
+    for handle in handles.values():
+        handle.stop()
+        handle.app.close()
+
+
+@pytest.mark.parametrize("route,body,expect_status", [
+    ("/score/v1", {"X": 50}, 200),
+    ("/score/v1", {"X": [[60.0]]}, 200),
+    ("/score/v1/batch", {"X": [1.0, 2.0, 3.0]}, 200),
+    ("/score/v1", {"Y": 1}, 400),
+    ("/score/v1", {"X": "fifty"}, 400),
+    ("/score/v1", {"X": []}, 400),
+])
+def test_engines_answer_byte_identical(engine_pair, route, body, expect_status):
+    responses = {
+        engine: rq.post(base + route, json=body, timeout=10)
+        for engine, base in engine_pair.items()
+    }
+    contents = set()
+    for engine, resp in responses.items():
+        assert resp.status_code == expect_status, engine
+        contents.add(resp.content)
+    assert len(contents) == 1  # identical bytes across engines
+
+
+def test_coalesced_responses_identical_across_engines(engine_pair, fitted_model):
+    """Concurrent single-row scores ride each engine's coalescer (window
+    2 ms) — the coalesced path must stay byte-identical too."""
+    xs = [float(v) for v in np.linspace(5, 95, 24)]
+
+    def burst(base):
+        out = {}
+
+        def one(x):
+            out[x] = rq.post(base + "/score/v1", json={"X": x}, timeout=10)
+
+        threads = [threading.Thread(target=one, args=(x,)) for x in xs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return out
+
+    per_engine = {e: burst(base) for e, base in engine_pair.items()}
+    for x in xs:
+        contents = {per_engine[e][x].content for e in per_engine}
+        assert len(contents) == 1
+        prediction = per_engine["aio"][x].json()["prediction"]
+        direct = float(fitted_model.predict(np.array([x], dtype=np.float32))[0])
+        assert prediction == pytest.approx(direct, rel=1e-4)
+
+
+def test_aio_routing_edges(engine_pair):
+    base = engine_pair["aio"]
+    assert rq.get(base + "/nope", timeout=10).status_code == 404
+    assert rq.get(base + "/score/v1", timeout=10).status_code == 405
+    assert rq.post(base + "/score/v1", data="not json",
+                   headers={"Content-Type": "application/json"},
+                   timeout=10).status_code == 400
+    metrics = rq.get(base + "/metrics", timeout=10)
+    assert metrics.status_code == 200
+    assert QUEUE_DEPTH_METRIC in metrics.text  # the saturation gauge rides /metrics
+
+
+def test_healthz_surfaces_queue_depth_both_engines(engine_pair):
+    for engine, base in engine_pair.items():
+        body = rq.get(base + "/healthz", timeout=10).json()
+        assert body["status"] == "ok"
+        assert "queue_depth" in body, engine
+        # the pair runs without admission -> depth from the coalescer,
+        # admission block explicitly null (armed services fill it in)
+        assert body["admission"] is None
+
+
+# -- admission invariants ----------------------------------------------------
+
+def test_pending_budget_never_exceeded_under_burst():
+    """32 threads hammer try_admit/release; the high-water mark must
+    never pass the budget and every admit must be released."""
+    admission = AdmissionController(max_pending=5)
+    barrier = threading.Barrier(32)
+
+    def worker():
+        barrier.wait()
+        for _ in range(200):
+            if admission.try_admit():
+                admission.release(0.001)
+
+    threads = [threading.Thread(target=worker) for _ in range(32)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert admission.max_observed_pending <= 5
+    assert admission.queue_depth == 0
+    state = admission.state()
+    assert state["admitted_total"] + state["shed_total"] == 32 * 200
+
+
+def test_admission_sheds_at_budget_and_recovers():
+    admission = AdmissionController(max_pending=2)
+    assert admission.try_admit() and admission.try_admit()
+    before = _shed_counter().value(reason="admission")
+    assert not admission.try_admit()  # budget exhausted -> shed
+    assert _shed_counter().value(reason="admission") == before + 1
+    gauge = get_registry().get(QUEUE_DEPTH_METRIC)
+    assert gauge.value() == 2.0
+    admission.release(0.01)
+    assert admission.try_admit()  # budget freed -> admitted again
+    admission.release(0.01)
+    admission.release(0.01)
+
+
+def test_depth_probe_folds_upstream_backlog():
+    """The aio engine's connection backlog sits UPSTREAM of admission;
+    the probe must shed on it even while the internal count is low."""
+    admission = AdmissionController(max_pending=4)
+    backlog = {"n": 0}
+    admission.attach_depth_probe(lambda: backlog["n"])
+    assert admission.try_admit()
+    backlog["n"] = 5  # > budget: the loop itself is drowning
+    assert not admission.try_admit()
+    assert admission.queue_depth == 5
+    assert admission.state()["upstream_depth"] == 5
+    backlog["n"] = 0
+    assert admission.try_admit()
+    admission.release(0.0)
+    admission.release(0.0)
+    # a broken probe must never break admission
+    admission.attach_depth_probe(lambda: 1 / 0)
+    assert admission.try_admit()
+    admission.release(0.0)
+
+
+def test_shed_request_does_zero_coalescer_or_device_work(fitted_model):
+    """The shed-before-work property: a 429 leaves no footprint beyond
+    its counter — no parse, no coalescer enqueue, no predictor call."""
+    calls = {"n": 0}
+
+    class CountingPredictor:
+        def predict(self, X):
+            calls["n"] += 1
+            return fitted_model.predict(np.asarray(X, dtype=np.float32))
+
+        def warmup(self, sync=False):
+            pass
+
+    admission = AdmissionController(max_pending=1, retry_after_min_s=2.0)
+    app = create_app(fitted_model, date(2026, 7, 1),
+                     predictor=CountingPredictor(), batch_window_ms=5.0,
+                     admission=admission)
+    try:
+        client = app.test_client()
+        assert admission.try_admit()  # occupy the whole budget
+        response = client.post("/score/v1", json={"X": 50})
+        assert response.status_code == 429
+        assert response.headers["Retry-After"] == str(admission.retry_after_s())
+        assert calls["n"] == 0
+        assert app.batcher.rows_submitted == 0
+        assert app.batcher.pending_depth() == 0
+        admission.release(0.5)
+        assert client.post("/score/v1", json={"X": 50}).status_code == 200
+        assert calls["n"] + app.batcher.rows_submitted >= 1  # work resumed
+    finally:
+        app.close()
+
+
+def test_ewma_estimator_and_clamping():
+    admission = AdmissionController(max_pending=8, ewma_alpha=0.5,
+                                    retry_after_min_s=1.0,
+                                    retry_after_max_s=4.0)
+    assert admission.retry_after_s() == 1  # cold estimator -> minimum
+    admission.try_admit()
+    admission.release(2.0)
+    assert admission.ewma_delay_s == pytest.approx(2.0)
+    assert admission.retry_after_s() == 2
+    admission.try_admit()
+    admission.release(100.0)  # spike: clamped, clients never exiled
+    assert admission.retry_after_s() == 4
+
+
+# -- Retry-After round-trip: admission -> header -> scoring client -----------
+
+def test_retry_after_round_trip_to_scoring_client(fitted_model):
+    """Pins the full loop: the EWMA estimate becomes the numeric
+    Retry-After on a shed 429, and the scoring clients' shared retry
+    helper floors its backoff on exactly that number (the injected-sleep
+    seam utils.retry provides for tests)."""
+    from bodywork_tpu.monitor.tester import (
+        _post_with_retries,
+        _retry_after_seconds,
+    )
+    from bodywork_tpu.utils.retry import RetryPolicy, call_with_retry
+
+    admission = AdmissionController(max_pending=1, ewma_alpha=1.0)
+    app = create_app(fitted_model, date(2026, 7, 1), buckets=(1,),
+                     admission=admission)
+    client = app.test_client()
+    admission.try_admit()
+    admission.release(3.2)  # EWMA = 3.2s -> Retry-After ceil = 4
+    assert admission.retry_after_s() == 4
+    admission.try_admit()  # exhaust the budget: every POST now sheds
+
+    response = client.post("/score/v1", json={"X": 50})
+    assert response.status_code == 429
+    assert _retry_after_seconds(response.headers) == 4.0
+
+    # the clients' retry loop (call_with_retry via _post_with_retries)
+    # must floor its sleeps at that hint, up to the policy's max_delay_s
+    sleeps: list = []
+    policy = RetryPolicy(attempts=3, base_delay_s=0.0001, max_delay_s=10.0,
+                         deadline_s=60.0)
+
+    def attempt():
+        resp = client.post("/score/v1", json={"X": 50})
+        from bodywork_tpu.monitor.tester import _RetryableStatus
+
+        raise _RetryableStatus(
+            resp.status_code, _retry_after_seconds(resp.headers)
+        )
+
+    from bodywork_tpu.monitor.tester import (
+        _RetryableStatus,
+        _is_retryable_scoring_failure,
+    )
+
+    with pytest.raises(_RetryableStatus):
+        call_with_retry(attempt, policy,
+                        is_retryable=_is_retryable_scoring_failure,
+                        sleep=sleeps.append)
+    assert len(sleeps) == 2  # attempts - 1
+    assert all(s >= 4.0 for s in sleeps)
+
+    # a tight policy caps the floor at its own max_delay_s: the server's
+    # hint is politeness, the caller's policy bounds its patience
+    sleeps.clear()
+    tight = RetryPolicy(attempts=2, base_delay_s=0.0001, max_delay_s=0.05,
+                        deadline_s=60.0)
+    with pytest.raises(_RetryableStatus):
+        call_with_retry(attempt, tight,
+                        is_retryable=_is_retryable_scoring_failure,
+                        sleep=sleeps.append)
+    assert sleeps and all(s <= 0.05 for s in sleeps)
+    assert _post_with_retries is not None  # the helper both clients share
+
+
+def test_degraded_503_and_shed_429_share_one_retry_after(fitted_model):
+    """Consistency satellite: the model-less 503 and the admission 429
+    hand out the SAME EWMA-derived number — one hint per service."""
+    # tiny alpha: the probe requests' own (fast) releases barely move
+    # the estimate, so one seeded sample pins the hint for the test
+    admission = AdmissionController(max_pending=1, ewma_alpha=0.01)
+    app = create_app(None, None, admission=admission)  # degraded boot
+    client = app.test_client()
+    admission.try_admit()
+    admission.release(7.6)  # first sample sets EWMA = 7.6 -> ceil 8
+    expected = str(admission.retry_after_s())
+    assert expected == "8"
+
+    degraded = client.post("/score/v1", json={"X": 50})
+    assert degraded.status_code == 503
+    assert degraded.headers["Retry-After"] == expected
+
+    admission.try_admit()  # exhaust -> shed path
+    shed = client.post("/score/v1", json={"X": 50})
+    assert shed.status_code == 429
+    assert shed.headers["Retry-After"] == expected
+
+    healthz = client.get("/healthz")
+    assert healthz.status_code == 503  # no model yet: not ready
+    assert healthz.headers["Retry-After"] == expected
+    assert healthz.get_json()["admission"]["max_pending"] == 1
+
+
+# -- chaos composition: reason labels ----------------------------------------
+
+def test_chaos_sheds_distinguishable_from_admission_wsgi(fitted_model):
+    from bodywork_tpu.chaos import FaultPlan, FlakyScoringMiddleware
+
+    plan = FaultPlan(seed=3, http_error_p=1.0, http_retry_after_s=1.0,
+                     max_consecutive=0)
+    app = create_app(fitted_model, date(2026, 7, 1), buckets=(1,))
+    client = FlakyScoringMiddleware(app, plan).test_client()
+    chaos_before = _shed_counter().value(reason="chaos")
+    admission_before = _shed_counter().value(reason="admission")
+    response = client.post("/score/v1", json={"X": 50})
+    assert response.status_code in (503, 429)
+    assert _shed_counter().value(reason="chaos") == chaos_before + 1
+    assert _shed_counter().value(reason="admission") == admission_before
+
+
+def test_chaos_composes_with_aio_engine(fitted_model):
+    """The aio engine consults the active plan exactly as the WSGI
+    middleware does: injected errors come back over HTTP with the plan's
+    Retry-After and count under reason=chaos, never admission."""
+    from bodywork_tpu.chaos import FaultPlan, activate
+
+    app = create_app(fitted_model, date(2026, 7, 1), buckets=(1,),
+                     admission=AdmissionController(max_pending=64))
+    handle = AioServiceHandle(app, "127.0.0.1", 0).start()
+    try:
+        base = handle.url.replace("/score/v1", "")
+        plan = FaultPlan(seed=5, http_error_p=1.0, http_retry_after_s=2.0,
+                         max_consecutive=0)
+        chaos_before = _shed_counter().value(reason="chaos")
+        admission_before = _shed_counter().value(reason="admission")
+        with activate(plan):
+            response = rq.post(base + "/score/v1", json={"X": 50}, timeout=10)
+        assert response.status_code in (503, 429)
+        assert response.headers["Retry-After"] == "2.0"
+        assert "injected fault" in response.json()["error"]
+        assert _shed_counter().value(reason="chaos") == chaos_before + 1
+        assert _shed_counter().value(reason="admission") == admission_before
+        # plan deactivated: scoring is healthy again, zero residue
+        assert rq.post(base + "/score/v1", json={"X": 50},
+                       timeout=10).status_code == 200
+    finally:
+        handle.stop()
+        app.close()
+
+
+# -- aio lifecycle: degraded boot + hot swap ---------------------------------
+
+def test_aio_degraded_boot_then_swap(fitted_model):
+    app = create_app(None, None, admission=AdmissionController(max_pending=8))
+    handle = AioServiceHandle(app, "127.0.0.1", 0).start()
+    try:
+        base = handle.url.replace("/score/v1", "")
+        response = rq.post(base + "/score/v1", json={"X": 50}, timeout=10)
+        assert response.status_code == 503
+        assert int(response.headers["Retry-After"]) >= 1
+        health = rq.get(base + "/healthz", timeout=10)
+        assert health.status_code == 503
+
+        app.swap_model(fitted_model, date(2026, 7, 2))
+        ok = rq.post(base + "/score/v1", json={"X": 50}, timeout=10)
+        assert ok.status_code == 200
+        assert ok.json()["model_date"] == "2026-07-02"
+        assert rq.get(base + "/healthz", timeout=10).status_code == 200
+    finally:
+        handle.stop()
+        app.close()
+
+
+def test_serve_latest_model_aio_engine(fitted_model, store):
+    """The one-stop entry (serve_latest_model / serve_stage path) starts
+    the aio engine with admission armed by default."""
+    from bodywork_tpu.data import Dataset, generate_day, persist_dataset
+    from bodywork_tpu.serve import serve_latest_model
+    from bodywork_tpu.train import train_on_history
+
+    d = date(2026, 1, 1)
+    X, y = generate_day(d)
+    persist_dataset(store, Dataset(X, y, d))
+    train_on_history(store, "linear")
+    handle = serve_latest_model(store, host="127.0.0.1", port=0, block=False,
+                                buckets=(1, 8), server_engine="aio")
+    try:
+        assert isinstance(handle, AioServiceHandle)
+        base = handle.url.replace("/score/v1", "")
+        assert rq.post(base + "/score/v1", json={"X": 50},
+                       timeout=10).status_code == 200
+        health = rq.get(base + "/healthz", timeout=10).json()
+        assert health["admission"]["max_pending"] == DEFAULT_MAX_PENDING
+    finally:
+        handle.stop()
+
+
+def test_unknown_engine_refused(fitted_model, store):
+    from bodywork_tpu.serve import serve_latest_model
+
+    with pytest.raises(ValueError, match="unknown server engine"):
+        serve_latest_model(store, server_engine="gevent")
+
+
+# -- config 9: tier-1 smoke + full sweep -------------------------------------
+
+@pytest.mark.load
+def test_config9_smoke():
+    """Smoke-scale open-loop bench (≤10 s): both engines come up, the
+    sweep produces the record shape the driver commits, byte identity
+    holds. The full acceptance sweep is the `slow`-marked test below."""
+    import bench
+
+    record = bench.bench_open_loop_serving(
+        duration_s=0.5, probe_clients=2, probe_requests=4,
+        load_factors=(1.0,), window_ms=1.0, max_rows=16,
+        rate_cap_rps=150.0, mmpp_point=False, isolate=False,
+        capacity_window_s=0.4,
+    )
+    assert record["metric"] == "open_loop_goodput_retention"
+    assert record["byte_identity"]["identical"] is True
+    for engine in SERVER_ENGINES:
+        entry = record["engines"][engine]
+        assert entry["capacity_rps"] > 0
+        assert len(entry["sweep"]) == 1
+        assert entry["sweep"][0]["requests"] > 0
+    assert record["engines"]["aio"]["admission"] is not None
+
+
+@pytest.mark.load
+@pytest.mark.slow
+def test_config9_full_sweep():
+    """The acceptance sweep (minutes): at 2x capacity the aio engine
+    keeps >= 90% of its 1x goodput with a nonzero shed fraction."""
+    import bench
+
+    record = bench.bench_open_loop_serving()
+    assert record["value"] is not None and record["value"] >= 0.9
+    assert record["aio_2x_shed_fraction"] > 0.0
+    assert record["byte_identity"]["identical"] is True
+
+
+# -- pipeline serve stage: engine + env-knob wiring --------------------------
+
+def test_serve_env_knobs_parsing(monkeypatch):
+    """Malformed pod-env values must degrade to the defaults with a
+    warning, never crash the serving pod (the k8s Deployment
+    materialises these; a kubectl-set-env typo is survivable)."""
+    from bodywork_tpu.pipeline.stages import _serve_env_knobs
+
+    monkeypatch.setenv("BODYWORK_TPU_SERVER_ENGINE", "aio")
+    monkeypatch.setenv("BODYWORK_TPU_MAX_PENDING", "64")
+    monkeypatch.setenv("BODYWORK_TPU_RETRY_AFTER_MAX_S", "12")
+    assert _serve_env_knobs() == ("aio", 64, 12.0)
+    monkeypatch.setenv("BODYWORK_TPU_SERVER_ENGINE", "gevent")
+    monkeypatch.setenv("BODYWORK_TPU_MAX_PENDING", "zero")
+    monkeypatch.setenv("BODYWORK_TPU_RETRY_AFTER_MAX_S", "-3")
+    assert _serve_env_knobs() == ("thread", None, None)
+    for name in ("BODYWORK_TPU_SERVER_ENGINE", "BODYWORK_TPU_MAX_PENDING",
+                 "BODYWORK_TPU_RETRY_AFTER_MAX_S"):
+        monkeypatch.delenv(name)
+    assert _serve_env_knobs() == ("thread", None, None)
+
+
+def test_serve_stage_aio_engine_full_day(store):
+    """A complete pipeline day served through the asyncio front-end:
+    the spec's serve args flip the engine (as the k8s env knobs do), one
+    admission controller is shared across the replica apps, the live
+    test stage scores through it, and the HTTP path answers mid-day."""
+    from datetime import date as date_cls
+
+    from bodywork_tpu.pipeline import LocalRunner, default_pipeline
+    from bodywork_tpu.store.schema import TEST_METRICS_PREFIX
+
+    spec = default_pipeline(scoring_mode="batch")
+    spec.stages["stage-2-serve-model"].args.update(
+        {"server_engine": "aio", "max_pending": 32}
+    )
+    runner = LocalRunner(spec, store)
+    start = date_cls(2026, 1, 1)
+    runner.bootstrap(start)
+    result = runner.run_day(start)
+    handle = result.stage_results["stage-2-serve-model"]
+    assert isinstance(handle, AioServiceHandle)
+    admissions = {id(app.admission) for app in handle.replica_apps}
+    assert len(admissions) == 1  # ONE shared backpressure boundary
+    assert handle.replica_apps[0].admission.max_pending == 32
+    assert store.history(TEST_METRICS_PREFIX)  # live test ran through it
+
+
+def test_cli_and_stage_env_knob_parsers_agree(monkeypatch):
+    """The serve env knobs are parsed twice — cli parser-build defaults
+    (`_env_choice`/`_env_number`, stderr note) and pod-boot
+    `_serve_env_knobs` (log warning) — because the CLI parser must stay
+    import-light. This pins the two layers to the SAME resolution for
+    the same environment, malformed values included, so they cannot
+    drift apart."""
+    from bodywork_tpu.cli import build_parser
+    from bodywork_tpu.pipeline.stages import _serve_env_knobs
+
+    for engine, pending, retry in (
+        ("aio", "64", "12"),           # well-formed
+        ("gevent", "zero", "-3"),      # malformed -> defaults, no crash
+        ("", "", ""),                  # unset-equivalent
+    ):
+        monkeypatch.setenv("BODYWORK_TPU_SERVER_ENGINE", engine)
+        monkeypatch.setenv("BODYWORK_TPU_MAX_PENDING", pending)
+        monkeypatch.setenv("BODYWORK_TPU_RETRY_AFTER_MAX_S", retry)
+        knobs = _serve_env_knobs()
+        args = build_parser().parse_args(["serve", "--store", "s"])
+        assert (
+            args.server_engine,
+            args.max_pending,
+            args.retry_after_max_s,
+        ) == knobs, (engine, pending, retry)

@@ -1,0 +1,269 @@
+"""Open-loop traffic harness: seeded determinism, replay, accounting.
+
+The harness's whole value is that (config, seed) fully determines the
+request sequence — the replayability contract that makes engine-vs-engine
+and knob-vs-knob comparisons under identical adversity possible (the
+chaos harness's property, applied to load). These tests pin it at three
+layers: the generator (same seed -> equal logs), the file round-trip
+(write/read -> equal logs), and the driver (two replays of one log send
+byte-identical request sequences through a recording transport — the
+CountingStore-style proof, no sockets involved).
+"""
+import json
+
+import pytest
+
+from bodywork_tpu.traffic import (
+    TrafficConfig,
+    generate_request_log,
+    read_request_log,
+    run_open_loop,
+    write_request_log,
+)
+from bodywork_tpu.traffic.generator import ARRIVAL_PROCESSES, LOG_SCHEMA, Request
+
+
+# -- seeded determinism ------------------------------------------------------
+
+def test_same_seed_generates_identical_log():
+    cfg = TrafficConfig(rate_rps=200.0, duration_s=2.0, batch_fraction=0.3,
+                        seed=7)
+    assert generate_request_log(cfg) == generate_request_log(cfg)
+
+
+def test_different_seed_generates_different_log():
+    a = generate_request_log(TrafficConfig(rate_rps=200.0, duration_s=2.0,
+                                           seed=7))
+    b = generate_request_log(TrafficConfig(rate_rps=200.0, duration_s=2.0,
+                                           seed=8))
+    assert a != b
+
+
+@pytest.mark.parametrize("arrival", ARRIVAL_PROCESSES)
+def test_mean_rate_is_pinned_to_rate_rps(arrival):
+    """MMPP reshapes traffic into squalls but must offer the SAME mean
+    load as Poisson — otherwise a Poisson-vs-MMPP pair would confound
+    burst tolerance with offered rate."""
+    cfg = TrafficConfig(rate_rps=300.0, duration_s=40.0, arrival=arrival,
+                        seed=11)
+    n = len(generate_request_log(cfg))
+    expected = cfg.rate_rps * cfg.duration_s
+    assert abs(n - expected) / expected < 0.10
+
+
+def test_arrivals_sorted_and_in_range():
+    cfg = TrafficConfig(rate_rps=500.0, duration_s=3.0, arrival="mmpp",
+                        seed=5)
+    times = [r.t_s for r in generate_request_log(cfg)]
+    assert times == sorted(times)
+    assert all(0.0 < t < cfg.duration_s for t in times)
+
+
+def test_batch_mix_and_payload_shape():
+    cfg = TrafficConfig(rate_rps=400.0, duration_s=3.0, batch_fraction=0.5,
+                        batch_rows=16, seed=3)
+    requests = generate_request_log(cfg)
+    singles = [r for r in requests if r.route == "/score/v1"]
+    batches = [r for r in requests if r.route == "/score/v1/batch"]
+    assert singles and batches  # both shapes present at 50/50
+    for r in singles[:5]:
+        body = json.loads(r.payload())
+        assert len(body["X"]) == 1
+    for r in batches[:5]:
+        body = json.loads(r.payload())
+        assert len(body["X"]) == 16
+    # feature domain matches the drift generator's [0, 100)
+    assert all(0.0 <= v < 100.0 for r in requests[:50] for v in r.x)
+
+
+# -- config validation -------------------------------------------------------
+
+@pytest.mark.parametrize("bad", [
+    {"rate_rps": 0.0},
+    {"duration_s": -1.0},
+    {"arrival": "uniform"},
+    {"batch_fraction": 1.5},
+    {"batch_rows": 0},
+    {"burst_multiplier": 0.0},
+    {"dwell_s": (1.0,)},
+    {"dwell_s": (1.0, -0.5)},
+])
+def test_config_validation_rejects(bad):
+    with pytest.raises(ValueError):
+        TrafficConfig(**bad).validate()
+
+
+def test_from_dict_rejects_unknown_fields():
+    with pytest.raises(ValueError, match="unknown traffic config"):
+        TrafficConfig.from_dict({"rate_rps": 10.0, "rps": 10.0})
+
+
+# -- request-log file round-trip ---------------------------------------------
+
+def test_log_roundtrip(tmp_path):
+    cfg = TrafficConfig(rate_rps=150.0, duration_s=2.0, batch_fraction=0.2,
+                        seed=13)
+    requests = generate_request_log(cfg)
+    path = tmp_path / "log.jsonl"
+    write_request_log(path, cfg, requests)
+    cfg2, requests2 = read_request_log(path)
+    assert cfg2 == cfg
+    assert requests2 == requests
+
+
+def test_truncated_log_fails_loudly(tmp_path):
+    """A truncated file must never silently replay a lighter load."""
+    cfg = TrafficConfig(rate_rps=150.0, duration_s=2.0, seed=13)
+    path = tmp_path / "log.jsonl"
+    write_request_log(path, cfg, generate_request_log(cfg))
+    lines = path.read_text().splitlines()
+    path.write_text("\n".join(lines[:-3]) + "\n")
+    with pytest.raises(ValueError, match="truncated"):
+        read_request_log(path)
+
+
+def test_wrong_schema_refused(tmp_path):
+    path = tmp_path / "not-a-log.jsonl"
+    path.write_text(json.dumps({"schema": "something/else"}) + "\n")
+    with pytest.raises(ValueError, match=LOG_SCHEMA.replace("/", "/")):
+        read_request_log(path)
+
+
+# -- driver: replay determinism + accounting ---------------------------------
+
+def _recording_transport(record, statuses=None, retry_afters=None):
+    """A canned transport: records the exact (t_s, route, payload bytes)
+    sequence it is asked to send and answers from the canned lists."""
+    counter = {"i": 0}
+
+    async def transport(req: Request):
+        i = counter["i"]
+        counter["i"] += 1
+        record.append((req.t_s, req.route, req.payload()))
+        status = statuses[i % len(statuses)] if statuses else 200
+        if status == -1:
+            raise ConnectionResetError("canned transport failure")
+        retry_after = (
+            retry_afters[i % len(retry_afters)] if retry_afters else None
+        )
+        return status, retry_after
+
+    return transport
+
+
+def test_replay_sends_identical_request_sequence():
+    """The determinism proof: two replays of one log push byte-identical
+    request sequences through the transport, independent of response
+    behaviour (run 2 answers differently and still sees the same
+    requests)."""
+    cfg = TrafficConfig(rate_rps=800.0, duration_s=0.5, batch_fraction=0.25,
+                        seed=21)
+    requests = generate_request_log(cfg)
+    first: list = []
+    run_open_loop("http://x", requests, transport=_recording_transport(first))
+    second: list = []
+    run_open_loop(
+        "http://x", requests,
+        transport=_recording_transport(second, statuses=[200, 429, 503]),
+    )
+    assert sorted(first) == sorted(second)  # completion order may differ
+    assert len(first) == len(requests)
+
+
+def test_report_accounting():
+    cfg = TrafficConfig(rate_rps=600.0, duration_s=0.5, seed=2)
+    requests = generate_request_log(cfg)
+    statuses = [200, 429, 503, 400, 500, -1]
+    report = run_open_loop(
+        "http://x", requests,
+        transport=_recording_transport([], statuses=statuses,
+                                       retry_afters=[None, 3.0, 5.0,
+                                                     None, None, None]),
+    )
+    n = len(requests)
+    assert report.requests == n
+    counts = [len(range(k, n, len(statuses))) for k in range(len(statuses))]
+    assert report.ok == counts[0]
+    assert report.shed == counts[1]
+    assert report.unavailable == counts[2]
+    assert report.client_error == counts[3]
+    assert report.server_error == counts[4]
+    assert report.transport_errors == counts[5]
+    assert report.timeouts == 0
+    assert report.shed_fraction == pytest.approx(counts[1] / n, abs=1e-6)
+    # goodput counts 200s only
+    assert report.goodput_rps == pytest.approx(
+        counts[0] / report.duration_s, rel=0.01
+    )
+    assert report.ok_in_window <= report.ok
+    # Retry-After stats summarise only responses that carried the header
+    assert report.retry_after["responses"] == counts[1] + counts[2]
+    assert 3.0 <= report.retry_after["mean_s"] <= 5.0
+    assert report.retry_after["max_s"] == 5.0
+    assert report.latency["p50_s"] is not None
+    assert report.max_in_flight >= 1
+
+
+def test_empty_log_is_an_error():
+    with pytest.raises(ValueError, match="empty request log"):
+        run_open_loop("http://x", [])
+
+
+# -- CLI surface -------------------------------------------------------------
+
+def _traffic_run_parser():
+    from bodywork_tpu.cli import build_parser
+
+    sub = build_parser()._subparsers._group_actions[0]
+    traffic = sub.choices["traffic"]
+    return traffic._subparsers._group_actions[0].choices["run"]
+
+
+def test_cli_arrival_choices_match_registry():
+    """cli traffic run --arrival hardcodes its choices (parser stays
+    import-light); this is the sync guard with ARRIVAL_PROCESSES."""
+    action = next(
+        a for a in _traffic_run_parser()._actions if a.dest == "arrival"
+    )
+    assert tuple(action.choices) == ARRIVAL_PROCESSES
+
+
+def test_cli_generate_only_roundtrip(tmp_path, capsys):
+    from bodywork_tpu.cli import main
+
+    path = tmp_path / "log.jsonl"
+    rc = main(["traffic", "run", "--log-out", str(path), "--rate", "50",
+               "--duration", "0.5", "--seed", "9", "--arrival", "mmpp"])
+    assert rc == 0
+    cfg, requests = read_request_log(path)
+    assert cfg.seed == 9 and cfg.arrival == "mmpp"
+    assert requests == generate_request_log(cfg)
+
+
+def test_cli_nothing_to_do_exits_1():
+    from bodywork_tpu.cli import main
+
+    assert main(["traffic", "run", "--rate", "50"]) == 1
+
+
+def test_wheel_packages_include_every_subpackage():
+    """bodywork_tpu.obs (PR 2) and .traffic (this PR) were both nearly
+    shipped missing from the wheel's explicit package list — an
+    installed env would ModuleNotFoundError on first import. Guard: every
+    directory-with-__init__ under bodywork_tpu/ appears in pyproject."""
+    import re
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent
+    text = (root / "pyproject.toml").read_text()
+    block = re.search(r"^packages = \[(.*?)\]", text, re.S | re.M).group(1)
+    declared = set(re.findall(r'"([^"]+)"', block))
+    on_disk = {"bodywork_tpu"} | {
+        f"bodywork_tpu.{p.parent.name}"
+        for p in (root / "bodywork_tpu").glob("*/__init__.py")
+        if p.parent.name != "__pycache__"
+    }
+    assert on_disk <= declared, (
+        f"subpackages missing from pyproject packages: "
+        f"{sorted(on_disk - declared)}"
+    )
