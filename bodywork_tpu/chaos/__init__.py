@@ -18,21 +18,35 @@ from bodywork_tpu.chaos.plan import (
 )
 from bodywork_tpu.chaos.store import FaultInjectingStore
 from bodywork_tpu.chaos.http import FlakyScoringMiddleware, flaky_serve_stage
+from bodywork_tpu.chaos.kill import (
+    KillSwitch,
+    SimulatedCrash,
+    arm_from_env,
+    hit_kill_point,
+)
 from bodywork_tpu.chaos.sim import (
     chaos_pipeline_spec,
     compare_stores,
     run_chaos_sim,
+    run_crash_sim,
+    sweep_points,
 )
 
 __all__ = [
     "FaultPlan",
     "InjectedFault",
+    "KillSwitch",
+    "SimulatedCrash",
     "activate",
+    "arm_from_env",
     "get_active_plan",
+    "hit_kill_point",
     "FaultInjectingStore",
     "FlakyScoringMiddleware",
     "flaky_serve_stage",
     "chaos_pipeline_spec",
     "compare_stores",
     "run_chaos_sim",
+    "run_crash_sim",
+    "sweep_points",
 ]

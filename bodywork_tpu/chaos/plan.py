@@ -92,7 +92,7 @@ class FaultPlan:
     #: read budget (3 attempts) is what keeps chaos-run gate decisions
     #: byte-identical to the fault-free twin's.
     corrupt_read_p: float = 0.0
-    corrupt_prefixes: tuple[str, ...] = ("snapshots/", "registry/")
+    corrupt_prefixes: tuple[str, ...] = ("snapshots/", "registry/", "runs/")
     #: scoring service /score/v1* requests: answer 503 or 429 (split
     #: evenly, deterministically) with a Retry-After header
     http_error_p: float = 0.0
@@ -103,6 +103,16 @@ class FaultPlan:
     #: max consecutive faults per (kind, stream) before a forced success;
     #: 0 = unlimited (lets tests hold a backend down to open the breaker)
     max_consecutive: int = 2
+    #: process-kill points (``chaos.kill``): a list of
+    #: ``{"kind": "stage_boundary", "n": N}`` /
+    #: ``{"kind": "store_op", "op": OP, "key": KEY, "n": N}`` objects.
+    #: Consumed by the crash soak (``chaos.sim.run_crash_sim`` /
+    #: ``cli chaos run-sim --crash-schedule``), which runs each point in
+    #: a SUBPROCESS runner (``os._exit`` kills the interpreter) and then
+    #: restarts it to prove crash-resume convergence. Like every other
+    #: plan field the points are addressed to pure decision streams, so
+    #: background-thread interleaving cannot move a kill.
+    crash_schedule: tuple = ()
 
     def __post_init__(self):
         for field in _PROBABILITY_FIELDS:
@@ -115,6 +125,12 @@ class FaultPlan:
         if self.max_consecutive < 0:
             raise ValueError("max_consecutive must be >= 0 (0 = unlimited)")
         self.corrupt_prefixes = tuple(self.corrupt_prefixes)
+        if self.crash_schedule:
+            from bodywork_tpu.chaos.kill import parse_schedule
+
+            self.crash_schedule = tuple(
+                parse_schedule(list(self.crash_schedule))
+            )
         self._lock = threading.Lock()
         #: decision count per (kind, stream)
         self._draws: dict[tuple, int] = {}

@@ -41,14 +41,24 @@ from pathlib import Path
 from bodywork_tpu.store.base import ArtefactStore
 from bodywork_tpu.store.filesystem import FilesystemStore
 from bodywork_tpu.store.resilient import ResilientStore
-from bodywork_tpu.store.schema import SNAPSHOTS_PREFIX, TEST_METRICS_PREFIX
+from bodywork_tpu.store.schema import (
+    RUNS_PREFIX,
+    SNAPSHOTS_PREFIX,
+    TEST_METRICS_PREFIX,
+)
 from bodywork_tpu.chaos.plan import FaultPlan, activate
 from bodywork_tpu.chaos.store import FaultInjectingStore
 from bodywork_tpu.utils.logging import get_logger
 
 log = get_logger("chaos.sim")
 
-__all__ = ["chaos_pipeline_spec", "compare_stores", "run_chaos_sim"]
+__all__ = [
+    "chaos_pipeline_spec",
+    "compare_stores",
+    "run_chaos_sim",
+    "run_crash_sim",
+    "sweep_points",
+]
 
 #: counters whose per-run delta the summary reports
 _FAULT_COUNTER = "bodywork_tpu_chaos_faults_injected_total"
@@ -101,13 +111,39 @@ def _snapshot_coverage(store: ArtefactStore):
     return sorted((e["key"], e["rows"]) for e in snap.entries)
 
 
+def _journals_ok(store: ArtefactStore) -> bool:
+    """Every ``runs/`` journal must parse and be day-complete — the
+    OPERATIONAL check replacing byte comparison for this prefix (lease
+    owners and expiry wall-clocks legitimately differ between twins)."""
+    import json
+
+    from bodywork_tpu.pipeline.journal import JOURNAL_SCHEMA
+
+    for key in store.list_keys(RUNS_PREFIX):
+        try:
+            doc = json.loads(store.get_bytes(key).decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            return False
+        if not isinstance(doc, dict) or doc.get("schema") != JOURNAL_SCHEMA:
+            return False
+        if doc.get("status") != "complete":
+            return False
+    return True
+
+
+#: prefixes excluded from the byte-identity comparison: snapshots embed
+#: backend version tokens (coverage-compared instead), journals embed
+#: lease identities and wall-clocks (validity-checked instead)
+_COMPARE_EXCLUDED = (SNAPSHOTS_PREFIX, RUNS_PREFIX)
+
+
 def compare_stores(baseline: ArtefactStore, chaos: ArtefactStore) -> dict:
     """Final-artefact comparison (module docstring has the rules)."""
     base_keys = [
-        k for k in baseline.list_keys() if not k.startswith(SNAPSHOTS_PREFIX)
+        k for k in baseline.list_keys() if not k.startswith(_COMPARE_EXCLUDED)
     ]
     chaos_keys = [
-        k for k in chaos.list_keys() if not k.startswith(SNAPSHOTS_PREFIX)
+        k for k in chaos.list_keys() if not k.startswith(_COMPARE_EXCLUDED)
     ]
     missing = sorted(set(base_keys) - set(chaos_keys))
     extra = sorted(set(chaos_keys) - set(base_keys))
@@ -142,6 +178,7 @@ def compare_stores(baseline: ArtefactStore, chaos: ArtefactStore) -> dict:
     )
     if chaos_cov is None and chaos.list_keys(SNAPSHOTS_PREFIX):
         torn.append(f"{SNAPSHOTS_PREFIX} (latest snapshot unreadable)")
+    journal_ok = _journals_ok(baseline) and _journals_ok(chaos)
     return {
         "matched": matched,
         "missing": missing,
@@ -149,7 +186,12 @@ def compare_stores(baseline: ArtefactStore, chaos: ArtefactStore) -> dict:
         "mismatched": mismatched,
         "torn": torn,
         "snapshot_ok": snapshot_ok,
-        "ok": not (missing or extra or mismatched or torn) and snapshot_ok,
+        "journal_ok": journal_ok,
+        "ok": (
+            not (missing or extra or mismatched or torn)
+            and snapshot_ok
+            and journal_ok
+        ),
     }
 
 
@@ -235,3 +277,206 @@ def run_chaos_sim(
         "ok": comparison["ok"],
     }
     return summary
+
+
+# -- the crash soak: process death as a swept input ------------------------
+
+#: restart attempts after a kill before giving up on the lease handover
+#: (the harness shrinks the lease TTL, so the dead twin's lease expires
+#: well inside one child's interpreter start-up; the retries absorb an
+#: unusually fast restart racing the clock)
+_RESTART_ATTEMPTS = 20
+_RESTART_WAIT_S = 0.5
+
+
+def sweep_points(
+    days: int,
+    n_steps: int,
+    artefact_keys=(),
+    seed: int = 0,
+    store_op_samples: int = 2,
+) -> list[dict]:
+    """Enumerate the every-boundary kill schedule for a ``days``-day sim
+    over an ``n_steps``-step DAG: one ``stage_boundary`` point per step
+    barrier (``run_day`` hits one before each step plus one after the
+    last, so ``days * (n_steps + 1)`` in all) plus ``store_op_samples``
+    seeded MID-STAGE points — the first ``put_bytes`` of a result
+    artefact key drawn from ``artefact_keys`` by the same pure
+    ``(seed, kind, op, n)`` addressing every chaos decision uses (the
+    kill lands before the op executes: death with the artefact
+    unwritten)."""
+    import random
+
+    points: list[dict] = [
+        {"kind": "stage_boundary", "n": n}
+        for n in range(days * (n_steps + 1))
+    ]
+    eligible = sorted(
+        k for k in artefact_keys
+        if not k.startswith((RUNS_PREFIX, SNAPSHOTS_PREFIX))
+        and not k.startswith("registry/")
+    )
+    if eligible and store_op_samples > 0:
+        rng = random.Random(seed)
+        for key in rng.sample(eligible, min(store_op_samples, len(eligible))):
+            points.append(
+                {"kind": "store_op", "op": "put_bytes", "key": key, "n": 0}
+            )
+    return points
+
+
+def _runner_cmd(store_dir, start: date, days: int, model_type: str,
+                scoring_mode: str, samples_per_day: int | None) -> list[str]:
+    import sys
+
+    cmd = [
+        sys.executable, "-m", "bodywork_tpu.cli", "run-sim",
+        "--store", str(store_dir), "--days", str(days),
+        "--date", str(start), "--model", model_type, "--mode", scoring_mode,
+    ]
+    if samples_per_day is not None:
+        cmd += ["--samples-per-day", str(samples_per_day)]
+    return cmd
+
+
+def _run_child(cmd: list[str], env: dict, timeout_s: float) -> tuple[int, str]:
+    """Run one child runner; returns ``(exit code, output tail)``."""
+    import subprocess
+
+    proc = subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=timeout_s
+    )
+    tail = ((proc.stdout or "") + "\n" + (proc.stderr or ""))[-2000:]
+    return proc.returncode, tail
+
+
+def run_crash_sim(
+    root: str | Path,
+    start: date,
+    days: int,
+    seed: int = 0,
+    points: list[dict] | None = None,
+    store_op_samples: int = 2,
+    model_type: str = "linear",
+    scoring_mode: str = "batch",
+    samples_per_day: int | None = None,
+    lease_ttl_s: float = 0.5,
+    child_timeout_s: float = 900.0,
+) -> dict:
+    """The crash-resume soak (``cli chaos run-sim --crash-schedule``):
+    prove that killing the runner PROCESS at any point converges.
+
+    One uninterrupted twin runs the N-day sim in a subprocess under
+    ``root/baseline``. Then, per kill point (every stage boundary plus
+    seeded mid-stage store-op points by default — :func:`sweep_points`),
+    a fresh store gets a child runner armed with that single point via
+    ``BODYWORK_TPU_CRASH_SCHEDULE``; the child must die there
+    (``os._exit`` — exit code :data:`chaos.kill.EXIT_KILLED`, no
+    cleanup, the in-process equivalent of OOM-kill), and an unarmed
+    restart must take over the shrunken lease, resume from the journal,
+    and finish with final artefacts BYTE-IDENTICAL to the baseline
+    (``compare_stores``: the PR 4 acceptance bar, now covering process
+    death). A point the child sails past without dying fails the sweep
+    — a kill that never fires would prove nothing, vacuously."""
+    import json as _json
+    import os as _os
+    import time as _time
+
+    from bodywork_tpu.chaos.kill import EXIT_KILLED, parse_schedule
+    from bodywork_tpu.pipeline import default_pipeline
+    from bodywork_tpu.pipeline.journal import LEASE_LOST_EXIT
+
+    root = Path(root)
+    baseline_dir = root / "baseline"
+    if root.exists() and any(root.iterdir()):
+        # a reused root is worse than a reused baseline: stale crash-NNN
+        # stores hold completed journals, so the armed child would
+        # resume-noop past its kill point and fail as "never fired"
+        raise ValueError(
+            f"crash sim target {root} already holds artefacts; point "
+            "--store at a fresh directory"
+        )
+    base_env = {
+        k: v for k, v in _os.environ.items()
+        if k not in ("BODYWORK_TPU_CRASH_SCHEDULE",)
+    }
+    base_env["BODYWORK_TPU_RUN_LEASE_TTL_S"] = str(lease_ttl_s)
+    # children must import THIS checkout's bodywork_tpu even when it is
+    # not installed (dev tree, CI): prepend the package's parent dir
+    import bodywork_tpu as _pkg
+
+    pkg_root = str(Path(_pkg.__file__).resolve().parents[1])
+    base_env["PYTHONPATH"] = _os.pathsep.join(
+        p for p in (pkg_root, base_env.get("PYTHONPATH")) if p
+    )
+    cmd = _runner_cmd(baseline_dir, start, days, model_type, scoring_mode,
+                      samples_per_day)
+    log.info(f"crash sim: uninterrupted twin ({days} day(s)) -> {baseline_dir}")
+    code, tail = _run_child(cmd, base_env, child_timeout_s)
+    if code != 0:
+        raise RuntimeError(
+            f"crash sim baseline run failed (exit {code}):\n{tail}"
+        )
+    baseline_store = FilesystemStore(baseline_dir)
+
+    if points is None:
+        n_steps = len(default_pipeline(model_type, scoring_mode).dag)
+        points = sweep_points(
+            days, n_steps, baseline_store.list_keys(), seed=seed,
+            store_op_samples=store_op_samples,
+        )
+    else:
+        points = parse_schedule(list(points))
+
+    results = []
+    for i, point in enumerate(points):
+        crash_dir = root / f"crash-{i:03d}"
+        cmd = _runner_cmd(crash_dir, start, days, model_type, scoring_mode,
+                          samples_per_day)
+        kill_env = dict(
+            base_env,
+            BODYWORK_TPU_CRASH_SCHEDULE=_json.dumps([point]),
+        )
+        code, tail = _run_child(cmd, kill_env, child_timeout_s)
+        entry = {"point": point, "kill_exit": code, "ok": False}
+        if code != EXIT_KILLED:
+            # exit 0 = the point never fired (vacuous; fails the sweep),
+            # anything else = the child died of something OTHER than the
+            # scheduled kill
+            entry["error"] = (
+                "kill point never fired" if code == 0
+                else f"child failed before the kill point (exit {code})"
+            )
+            entry["tail"] = tail
+            results.append(entry)
+            log.error(f"crash point {point}: {entry['error']}")
+            continue
+        # restart, unarmed: must take over the expired lease and resume
+        for attempt in range(_RESTART_ATTEMPTS):
+            code, tail = _run_child(cmd, base_env, child_timeout_s)
+            if code != LEASE_LOST_EXIT:
+                break
+            _time.sleep(_RESTART_WAIT_S)
+        entry["restart_exit"] = code
+        if code != 0:
+            entry["error"] = f"restart did not converge (exit {code})"
+            entry["tail"] = tail
+            results.append(entry)
+            log.error(f"crash point {point}: {entry['error']}")
+            continue
+        comparison = compare_stores(baseline_store, FilesystemStore(crash_dir))
+        entry["comparison"] = comparison
+        entry["ok"] = comparison["ok"]
+        results.append(entry)
+        log.info(
+            f"crash point {i + 1}/{len(points)} {point}: "
+            + ("converged byte-identical" if entry["ok"]
+               else f"DIVERGED {comparison}")
+        )
+    return {
+        "days": days,
+        "seed": seed,
+        "points": len(points),
+        "results": results,
+        "ok": bool(results) and all(r["ok"] for r in results),
+    }

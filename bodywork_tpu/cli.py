@@ -22,6 +22,10 @@ its own driver:
 Every command exits 0 on success and 1 with a logged error otherwise — the
 exit-code contract the reference implements per-script
 (``stage_1_train_model.py:170-178``) and the orchestrator relies on.
+``run-day`` extends it with documented non-error codes (docs/RESILIENCE.md):
+5 = run lease lost to another runner, 6 = resumed-noop (day already
+complete, journal-verified), 143 = graceful SIGTERM unwind; ``report
+--fail-on-drift`` exits 4, and a chaos kill switch exits 86.
 """
 from __future__ import annotations
 
@@ -158,6 +162,12 @@ def _env_number(name: str, cast, minimum):
 
 
 def cmd_serve(args) -> int:
+    from bodywork_tpu.utils.shutdown import (
+        SIGTERM_EXIT,
+        ShutdownRequested,
+        graceful_sigterm,
+    )
+
     watch = args.reload_interval if args.reload_interval > 0 else None
     batch_window = args.batch_window_ms if args.batch_window_ms > 0 else None
     if args.batch_max_rows and batch_window is None:
@@ -190,30 +200,49 @@ def cmd_serve(args) -> int:
         if svc.metrics_url:
             log.info(f"aggregated metrics at {svc.metrics_url}")
         try:
-            svc.wait()
-            return 0
+            # SIGTERM (k8s pod stop): unwind the wait and terminate the
+            # replica processes INSIDE the armed watchdog, so a wedged
+            # worker join is force-bounded to the grace deadline (the
+            # kubelet's SIGKILL must never win the teardown race)
+            with graceful_sigterm() as sigterm_fired:
+                try:
+                    svc.wait()
+                except ShutdownRequested:
+                    log.warning("SIGTERM: stopping serving replicas")
+                    svc.stop()
+            return SIGTERM_EXIT if sigterm_fired.is_set() else 0
         except KeyboardInterrupt:
             return 0
         finally:
             svc.stop()
     from bodywork_tpu.serve import serve_latest_model
 
-    serve_latest_model(
-        _store(args),
-        host=args.host,
-        port=args.port,
-        block=True,
-        mesh_data=args.mesh_data,
-        engine=args.engine,
-        watch_interval_s=watch,
-        buckets=args.buckets,
-        batch_window_ms=batch_window,
-        batch_max_rows=args.batch_max_rows,
-        server_engine=args.server_engine,
-        max_pending=args.max_pending,
-        retry_after_max_s=args.retry_after_max_s,
-    )
-    return 0
+    # single-process path: serve_latest_model catches ShutdownRequested
+    # itself — admission drains (429 + Retry-After on new work) before
+    # the listener closes — and returns; a SIGTERM landing BEFORE
+    # serve_forever (model load / XLA compile at startup) unwinds to
+    # the except here instead. `fired` tells us it was a SIGTERM unwind
+    # rather than a normal stop.
+    with graceful_sigterm() as sigterm_fired:
+        try:
+            serve_latest_model(
+                _store(args),
+                host=args.host,
+                port=args.port,
+                block=True,
+                mesh_data=args.mesh_data,
+                engine=args.engine,
+                watch_interval_s=watch,
+                buckets=args.buckets,
+                batch_window_ms=batch_window,
+                batch_max_rows=args.batch_max_rows,
+                server_engine=args.server_engine,
+                max_pending=args.max_pending,
+                retry_after_max_s=args.retry_after_max_s,
+            )
+        except ShutdownRequested:
+            log.warning("SIGTERM during service startup; exiting")
+    return SIGTERM_EXIT if sigterm_fired.is_set() else 0
 
 
 def cmd_traffic_run(args) -> int:
@@ -313,12 +342,53 @@ def _prune_templated(template: str, keep: int = TRACE_RETENTION) -> None:
 
 
 def cmd_run_day(args) -> int:
+    """One simulated day, crash-resumable by default (the daily CronJob
+    pod's entrypoint). Exit codes — documented in docs/RESILIENCE.md:
+    0 success, 1 stage failure/error, 2 usage, 3 backend unreachable,
+    5 lease lost (another runner owns the day — stop, retry later),
+    6 resumed-noop (journal says the day already completed and every
+    artefact digest verified; nothing re-ran), 143 graceful SIGTERM."""
+    from bodywork_tpu.chaos.kill import arm_from_env, wrap_store
     from bodywork_tpu.pipeline import LocalRunner
+    from bodywork_tpu.pipeline.journal import (
+        LEASE_LOST_EXIT,
+        RESUMED_NOOP_EXIT,
+        LeaseLost,
+    )
+    from bodywork_tpu.utils.shutdown import (
+        SIGTERM_EXIT,
+        ShutdownRequested,
+        graceful_sigterm,
+    )
 
-    runner = LocalRunner(_pipeline_spec(args), _store(args))
+    arm_from_env()  # the crash soak's child-runner kill schedule
+    runner = LocalRunner(_pipeline_spec(args), wrap_store(_store(args)))
     d = _date(args)
-    runner.bootstrap(d)
-    result = runner.run_day(d)
+    try:
+        with graceful_sigterm():
+            runner.bootstrap(d)
+            result = runner.run_day(d, resume=not args.no_resume)
+    except LeaseLost as exc:
+        log.error(f"{exc}; exiting {LEASE_LOST_EXIT} (lease lost)")
+        return LEASE_LOST_EXIT
+    except ShutdownRequested:
+        log.warning(
+            "run-day interrupted by SIGTERM; journal marks the day "
+            "'interrupted' — the next run resumes from it"
+        )
+        # the journal writes are already durable; skip interpreter
+        # finalization, which SEGFAULTS when a daemon stage thread is
+        # still inside an XLA compile (verified live: exit -11 instead
+        # of 143 without this)
+        os._exit(SIGTERM_EXIT)
+    if result.noop:
+        print(f"day {d}: already complete (resumed as a no-op)")
+        return RESUMED_NOOP_EXIT
+    if result.skipped_stages:
+        print(
+            f"day {d}: resumed — skipped "
+            f"{', '.join(result.skipped_stages)} (journal-verified)"
+        )
     print(f"day {d}: {result.wall_clock_s:.3f}s")
     for name, secs in result.stage_seconds.items():
         print(f"  {name}: {secs:.3f}s")
@@ -364,12 +434,38 @@ def cmd_run_day(args) -> int:
 
 
 def cmd_run_sim(args) -> int:
+    from bodywork_tpu.chaos.kill import arm_from_env, wrap_store
     from bodywork_tpu.pipeline import LocalRunner
-
-    runner = LocalRunner(_pipeline_spec(args), _store(args))
-    results = runner.run_simulation(
-        _date(args), args.days, profile_dir=args.profile_dir
+    from bodywork_tpu.pipeline.journal import LEASE_LOST_EXIT, LeaseLost
+    from bodywork_tpu.utils.shutdown import (
+        SIGTERM_EXIT,
+        ShutdownRequested,
+        graceful_sigterm,
     )
+
+    arm_from_env()  # the crash soak's child-runner kill schedule
+    drift = None
+    if getattr(args, "samples_per_day", None) is not None:
+        from bodywork_tpu.data.drift_config import DriftConfig
+
+        drift = DriftConfig(n_samples=args.samples_per_day)
+    runner = LocalRunner(
+        _pipeline_spec(args), wrap_store(_store(args)), drift=drift
+    )
+    try:
+        with graceful_sigterm():
+            results = runner.run_simulation(
+                _date(args), args.days, profile_dir=args.profile_dir
+            )
+    except LeaseLost as exc:
+        log.error(f"{exc}; exiting {LEASE_LOST_EXIT} (lease lost)")
+        return LEASE_LOST_EXIT
+    except ShutdownRequested:
+        log.warning("run-sim interrupted by SIGTERM; journals mark the "
+                    "in-flight day 'interrupted' — a re-run resumes")
+        # see cmd_run_day: a live XLA compile on a daemon stage thread
+        # segfaults interpreter finalization; the journal is durable
+        os._exit(SIGTERM_EXIT)
     total = sum(r.wall_clock_s for r in results)
     for r in results:
         print(f"day {r.day}: {r.wall_clock_s:.3f}s")
@@ -604,6 +700,8 @@ def cmd_chaos_run_sim(args) -> int:
     else:
         seed = args.seed if args.seed is not None else env_seed
         plan = FaultPlan.default(seed if seed is not None else 0)
+    if args.crash_schedule or plan.crash_schedule:
+        return _chaos_crash_sim(args, plan)
     drift = None
     if args.samples_per_day is not None:
         from bodywork_tpu.data.drift_config import DriftConfig
@@ -641,6 +739,62 @@ def cmd_chaos_run_sim(args) -> int:
         f"chaos soak FAILED: mismatched={comparison['mismatched']} "
         f"missing={comparison['missing']} extra={comparison['extra']} "
         f"torn={comparison['torn']} snapshot_ok={comparison['snapshot_ok']}"
+    )
+    return 1
+
+
+def _chaos_crash_sim(args, plan) -> int:
+    """The crash-resume soak (``--crash-schedule``): kill + restart a
+    subprocess runner at the scheduled points and require convergence to
+    artefacts byte-identical to an uninterrupted twin. The literal value
+    ``sweep`` enumerates EVERY stage boundary of the N-day sim plus
+    seeded mid-stage store-op points; otherwise the value is a JSON kill-
+    point list (inline or a file path), or comes from the --plan file's
+    ``crash_schedule`` key. Children run fault-free — this soak isolates
+    process death; compose in-call faults via a separate run."""
+    from bodywork_tpu.chaos import run_crash_sim
+    from bodywork_tpu.chaos.kill import parse_schedule
+
+    points = None  # None -> run_crash_sim builds the full sweep
+    raw = args.crash_schedule
+    if raw and raw.strip().lower() != "sweep":
+        if os.path.exists(raw):
+            raw = open(raw).read()
+        try:
+            points = parse_schedule(raw)
+        except ValueError as exc:
+            log.error(f"bad --crash-schedule: {exc}")
+            return 1
+    elif not raw and plan.crash_schedule:
+        points = list(plan.crash_schedule)
+    if args.plan and (plan.corrupt_read_p or any(
+        getattr(plan, f) for f in (
+            "store_transient_p", "store_latency_p", "torn_write_p",
+            "http_error_p", "http_latency_p",
+        )
+    )):
+        log.warning(
+            "crash soak children run WITHOUT in-call fault injection; "
+            "the plan's fault probabilities are ignored here"
+        )
+    summary = run_crash_sim(
+        args.store, _date(args), args.days, seed=plan.seed, points=points,
+        model_type=args.model, scoring_mode=args.mode,
+        samples_per_day=args.samples_per_day,
+    )
+    failed = [r for r in summary["results"] if not r["ok"]]
+    for r in failed:
+        log.error(f"crash point {r['point']}: {r.get('error') or 'diverged'}")
+    if summary["ok"]:
+        print(
+            f"PASS: {summary['points']} kill point(s) all converged "
+            f"byte-identical to the uninterrupted twin "
+            f"(seed={summary['seed']}, {args.days} day(s))"
+        )
+        return 0
+    log.error(
+        f"crash soak FAILED: {len(failed)}/{summary['points']} point(s) "
+        "did not converge"
     )
     return 1
 
@@ -980,6 +1134,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "stage seconds + spans) here; defaults to "
                         "<trace-out stem>.report.json when --trace-out "
                         "is given")
+    p.add_argument("--no-resume", action="store_true",
+                   help="ignore the runs/ journal: no lease, no verified "
+                        "skipping, full re-run (the pre-journal "
+                        "behaviour). Default: resume — completed stages "
+                        "whose recorded artefact digests verify against "
+                        "the store are skipped; exit codes 5 (lease "
+                        "lost) / 6 (resumed-noop) are documented in "
+                        "docs/RESILIENCE.md")
 
     p = add("run-sim", cmd_run_sim, help="run an N-day drift simulation")
     p.add_argument("--spec", default=None, help="pipeline spec YAML (overrides --model/--mode)")
@@ -994,6 +1156,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write the whole simulation's stage spans "
                         "(stages, lookahead-train overlap, prefetch, "
                         "prewarm) as one Chrome trace-event file")
+    p.add_argument("--samples-per-day", type=_positive_int, default=None,
+                   metavar="N",
+                   help="shrink the generator to N rows/day (default: "
+                        "the full reference-parity 1440) — what the "
+                        "crash soak's subprocess runners use for quick "
+                        "kill-and-restart cycles")
 
     p = add("run-ab", cmd_run_ab,
             help="concurrent A/B model pipelines on one device pool")
@@ -1112,6 +1280,15 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="N",
                    help="shrink the generator to N rows/day for quick "
                         "soaks (default: the full reference-parity 1440)")
+    p.add_argument("--crash-schedule", default=None, metavar="SPEC",
+                   help="run the crash-resume soak instead: kill+restart "
+                        "a subprocess runner at these points and require "
+                        "final artefacts byte-identical to an "
+                        "uninterrupted twin. SPEC is 'sweep' (every "
+                        "stage boundary + seeded mid-stage store-op "
+                        "points) or a JSON kill-point list (inline or a "
+                        "file path); a --plan file's crash_schedule key "
+                        "works too (docs/RESILIENCE.md §crash-resume)")
     p.add_argument("--model", default="linear", choices=["linear", "mlp"])
     p.add_argument("--mode", default="batch", choices=["single", "batch"])
 

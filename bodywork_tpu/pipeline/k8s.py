@@ -509,10 +509,19 @@ def generate_manifests(
                                     "prometheus.io/path": "/metrics",
                                 },
                             },
-                            "spec": _pod_spec(
-                                spec, stage, store, image, command,
-                                "Always",
-                            ),
+                            "spec": {
+                                **_pod_spec(
+                                    spec, stage, store, image, command,
+                                    "Always",
+                                ),
+                                # explicit grace window matching `cli
+                                # serve`'s SIGTERM drain (utils/shutdown
+                                # DEFAULT_GRACE_S 20 < 30): admission
+                                # sheds new work with Retry-After and
+                                # in-flight requests finish before the
+                                # kubelet's SIGKILL
+                                "terminationGracePeriodSeconds": 30,
+                            },
                         },
                     },
                 }
@@ -638,22 +647,45 @@ def generate_manifests(
             },
             "spec": {
                 "schedule": daily_schedule,
+                # Forbid + the run lease are BOTH needed: Forbid stops
+                # the scheduler from starting a second Job while one
+                # runs, the CAS lease (pipeline/journal.py) stops a
+                # rescheduled pod from interleaving with a still-alive
+                # original the API server has lost sight of. A loser
+                # exits 5 (lease lost) and the backoff retries it after
+                # the holder finishes or its lease expires.
                 "concurrencyPolicy": "Forbid",
                 "jobTemplate": {
                     "spec": {
+                        # retries ride the journal: each retry resumes
+                        # from the last completed stage (verified by
+                        # digest), so a transient mid-day death costs
+                        # only the in-flight stage. NOTE exit 6
+                        # (resumed-noop) marks a retry that found the
+                        # day already complete — the Job shows Failed
+                        # but the artefacts are done (runbook in
+                        # docs/RESILIENCE.md).
+                        "backoffLimit": 3,
                         "template": {
-                            "spec": _pod_spec(
-                                spec,
-                                run_day_stage,
-                                store,
-                                image,
-                                run_day_command,
-                                "Never",
-                                gate_on_deps=False,  # run-day sequences and
-                                # bootstraps internally; a dataset gate here
-                                # would deadlock a fresh store
-                            )
-                        }
+                            "spec": {
+                                **_pod_spec(
+                                    spec,
+                                    run_day_stage,
+                                    store,
+                                    image,
+                                    run_day_command,
+                                    "Never",
+                                    gate_on_deps=False,  # run-day sequences
+                                    # and bootstraps internally; a dataset
+                                    # gate here would deadlock a fresh store
+                                ),
+                                # must exceed utils/shutdown's graceful
+                                # deadline (20 s): SIGTERM -> journal
+                                # 'interrupted' mark + lease release,
+                                # THEN the kubelet's SIGKILL
+                                "terminationGracePeriodSeconds": 30,
+                            }
+                        },
                     }
                 },
             },

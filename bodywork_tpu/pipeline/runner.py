@@ -38,6 +38,28 @@ class StageFailure(StageError):
     """A stage exhausted its retries."""
 
 
+def _hit_kill_point(kind: str) -> None:
+    """Chaos process-kill hook (``chaos.kill``), resolved through
+    ``sys.modules`` so the runner never widens any stage's import
+    closure: the module is only ever present when something (the crash
+    harness, a test) armed a kill switch."""
+    import sys
+
+    mod = sys.modules.get("bodywork_tpu.chaos.kill")
+    if mod is not None:
+        mod.hit_kill_point(kind)
+
+
+def _is_simulated_crash(exc: BaseException) -> bool:
+    """True for ``chaos.kill.SimulatedCrash`` — the in-process stand-in
+    for process death, which must propagate RAW: no stage retry, no
+    StageFailure wrapping, no journal completion."""
+    import sys
+
+    mod = sys.modules.get("bodywork_tpu.chaos.kill")
+    return mod is not None and isinstance(exc, mod.SimulatedCrash)
+
+
 def _device_ctx(device):
     """jax.default_device(device), or a no-op when device is None."""
     if device is None:
@@ -59,6 +81,13 @@ class DayResult:
     #: any overlap/prefetch work that completed inside it) — the input to
     #: obs.spans.day_report / chrome_trace
     spans: list[Span] = dataclasses.field(default_factory=list)
+    #: stages skipped because the run journal recorded them complete and
+    #: every recorded artefact digest verified against the store
+    skipped_stages: tuple[str, ...] = ()
+    #: True when the journal already marked the WHOLE day complete and
+    #: verification confirmed it — nothing executed, no service started
+    #: (``cli run-day`` maps this to its resumed-noop exit code)
+    noop: bool = False
 
 
 def resolve_executable(path: str):
@@ -161,6 +190,11 @@ class LocalRunner:
                 break
             if "exc" in box:
                 last_exc = box["exc"]  # type: ignore[assignment]
+                if _is_simulated_crash(last_exc):
+                    # in-process process-death stand-in: propagate raw —
+                    # retrying it would absorb the very failure mode the
+                    # crash-resume harness exists to prove survivable
+                    raise last_exc
                 # fail fast on permanent errors (utils.retry taxonomy):
                 # a ValueError/TypeError/KeyError — or a StageError not
                 # caused by anything transient — can never succeed on
@@ -275,7 +309,7 @@ class LocalRunner:
                               failed=True)
             if not concurrent:
                 raise
-            if not isinstance(exc, StageFailure):
+            if not isinstance(exc, StageFailure) and not _is_simulated_crash(exc):
                 exc = StageFailure(stage.name, repr(exc))
             ctx.failures[stage_name] = exc
             return
@@ -461,13 +495,111 @@ class LocalRunner:
         t.start()
         self._pending_train = (tomorrow, box)
 
+    # -- crash resume ------------------------------------------------------
+    def _resume_state(self, journal) -> tuple[dict[str, dict], str]:
+        """Verify the journal's completed stages against the store and
+        classify how this run starts. Returns ``(skip set, outcome)``
+        where outcome is a ``bodywork_tpu_runner_resumes_total`` label.
+        Only BATCH stages are ever skippable — a service died with the
+        process and must restart regardless of what the journal says."""
+        skip, mismatch = journal.verify_completed()
+        skip = {
+            name: entry
+            for name, entry in skip.items()
+            if name in self.spec.stages
+            and self.spec.stages[name].kind == "batch"
+        }
+        if journal.was_corrupt:
+            outcome = "rerun_corrupt"
+        elif mismatch:
+            outcome = "rerun_mismatch"
+        elif journal.prior_status is None:
+            outcome = "fresh"
+        else:
+            outcome = "resumed"
+        return skip, outcome
+
+    def _noop_day_result(self, today: date, skip: dict) -> DayResult:
+        """The whole day was already journalled complete and every
+        artefact verified: report it without executing anything (no
+        stage, no service, no gate)."""
+        span_mark = self.recorder.mark()
+        start_rel = self.recorder.now()
+        for name in self.spec.stages:
+            self.recorder.add(name, "stage", start_rel, 0.0,
+                              day=str(today), skipped=True)
+        self.recorder.add(f"run-day-{today}", "day", start_rel, 0.0,
+                          resumed_noop=True)
+        log.info(
+            f"[{today}] run journal marks the day complete and every "
+            "artefact verified; resumed as a no-op"
+        )
+        return DayResult(
+            day=today,
+            wall_clock_s=0.0,
+            stage_seconds={name: 0.0 for name in self.spec.stages},
+            stage_results={
+                name: skip.get(name, {"state": "complete"})
+                for name in self.spec.stages
+            },
+            spans=self.recorder.since(span_mark),
+            skipped_stages=tuple(self.spec.stages),
+            noop=True,
+        )
+
+    def _journal_artefacts(self, names: list[str], ctx) -> dict[str, dict]:
+        """``{stage: {artefact key: content digest}}`` for the batch
+        stages that just completed — what ``record_completes`` persists.
+        Digests hash the bytes actually in the store (the source of
+        truth a resume will verify against), never in-memory copies."""
+        from bodywork_tpu.pipeline.journal import artefact_digest
+        from bodywork_tpu.pipeline.stages import stage_artefact_keys
+
+        out: dict[str, dict] = {}
+        for name in names:
+            stage = self.spec.stages[name]
+            if stage.kind == "service" or name not in ctx.stage_results:
+                continue
+            artefacts: dict[str, str] = {}
+            for key in stage_artefact_keys(
+                stage, ctx.stage_results.get(name), ctx
+            ):
+                try:
+                    artefacts[key] = artefact_digest(self.store.get_bytes(key))
+                except Exception as exc:  # journal stays honest: no digest,
+                    # no skip — the stage just re-runs on resume
+                    log.warning(
+                        f"could not digest {key!r} for the journal: {exc!r}"
+                    )
+            out[name] = artefacts
+        return out
+
     # -- DAG execution -----------------------------------------------------
     def run_day(
         self,
         today: date,
         scoring_url: str | None = None,
         lookahead_train: bool = False,
+        resume: bool = True,
     ) -> DayResult:
+        journal = None
+        skip: dict[str, dict] = {}
+        if resume:
+            from bodywork_tpu.pipeline.journal import RunJournal, count_resume
+
+            journal = RunJournal(self.store, today)
+            journal.acquire()  # LeaseLost propagates: the caller exits
+            skip, outcome = self._resume_state(journal)
+            batch_stages = [
+                n for n, s in self.spec.stages.items() if s.kind == "batch"
+            ]
+            if journal.prior_status == "complete" and all(
+                n in skip for n in batch_stages
+            ):
+                count_resume("noop")
+                journal.release()  # nothing to do: don't sit on the TTL
+                return self._noop_day_result(today, skip)
+            count_resume(outcome)
         ctx = StageContext(
             store=self.store,
             today=today,
@@ -518,13 +650,36 @@ class LocalRunner:
         day_start = time.perf_counter()
         try:
             for step in self.spec.dag:
+                # seeded process-kill point: one per step barrier (plus
+                # one after the last step) — the crash soak's
+                # stage-boundary sweep anchors here
+                _hit_kill_point("stage_boundary")
+                to_run = [n for n in step if n not in skip]
+                for name in step:
+                    if name in skip:
+                        # journal-verified complete: report the skip in
+                        # the same shapes a run records (span + seconds
+                        # + a stage_results entry) so day reports stay
+                        # structurally identical
+                        stage_seconds[name] = 0.0
+                        stage_results[name] = skip[name]
+                        self.recorder.add(name, "stage", self.recorder.now(),
+                                          0.0, day=str(today), skipped=True)
+                        log.info(
+                            f"[{today}] {name} skipped "
+                            "(journal-verified complete)"
+                        )
+                if journal is not None and to_run:
+                    # write-ahead: a crash from here on finds these
+                    # stages at 'intent' and re-executes them
+                    journal.record_intents(to_run)
                 # stages within a step are independent and run CONCURRENTLY
                 # (concurrent pods in the k8s materialisation); steps are
                 # barriers
-                if len(step) == 1:
-                    self._run_stage_timed(step[0], ctx, stage_seconds,
+                if len(to_run) == 1:
+                    self._run_stage_timed(to_run[0], ctx, stage_seconds,
                                           stage_results, today)
-                else:
+                elif to_run:
                     threads = [
                         threading.Thread(
                             target=self._run_stage_timed,
@@ -532,15 +687,19 @@ class LocalRunner:
                                   today, True),
                             name=f"step-{name}",
                         )
-                        for name in step
+                        for name in to_run
                     ]
                     for t in threads:
                         t.start()
                     for t in threads:
                         t.join()
-                    failed = [n for n in step if n in ctx.failures]
+                    failed = [n for n in to_run if n in ctx.failures]
                     if failed:
                         raise ctx.failures[failed[0]]
+                if journal is not None and to_run:
+                    completes = self._journal_artefacts(to_run, ctx)
+                    if completes:
+                        journal.record_completes(completes)
                 # the registry gate sits BETWEEN train and serve: as soon
                 # as every train stage has registered its candidate (and
                 # before any later step resolves what to serve), the gate
@@ -558,6 +717,25 @@ class LocalRunner:
                 ):
                     self._start_lookahead_train(today + timedelta(days=1))
                     lookahead_train = False
+            _hit_kill_point("stage_boundary")
+            if journal is not None:
+                journal.record_day_complete()
+        except BaseException as exc:
+            from bodywork_tpu.utils.shutdown import ShutdownRequested
+
+            if journal is not None:
+                if isinstance(exc, ShutdownRequested):
+                    # graceful SIGTERM: a clean 'interrupted' mark so the
+                    # next run resumes (in-flight stages stay at intent)
+                    journal.record_interrupted()
+                elif not _is_simulated_crash(exc):
+                    # stage failure etc. unwinding normally: release the
+                    # lease so the CronJob's backoff retry starts
+                    # immediately instead of waiting out the TTL. A
+                    # simulated crash gets NO cleanup — it stands in for
+                    # process death, where none runs.
+                    journal.release()
+            raise
         finally:
             for name, handle in ctx.services.items():
                 handle.stop()
@@ -576,6 +754,7 @@ class LocalRunner:
             stage_seconds=stage_seconds,
             stage_results=stage_results,
             spans=self.recorder.since(span_mark),
+            skipped_stages=tuple(n for n in self.spec.stages if n in skip),
         )
 
     # -- multi-day simulation ----------------------------------------------
@@ -637,8 +816,27 @@ class LocalRunner:
                     model_type, model_kwargs, n_now + int(i * per_day * 0.85)
                 )
 
+    def _drain_compactor(self, timeout_s: float = 60.0) -> bool:
+        """Join the background snapshot compactor (True when none is
+        left running). Called on BOTH exits of ``run_simulation`` — a
+        crash path that leaves the daemon thread mid-refresh would let a
+        half-written snapshot race whatever inspects the store next (the
+        crash soak's byte-identity check, a resuming runner)."""
+        thread = self._compact_thread
+        if thread is None:
+            return True
+        thread.join(timeout=timeout_s)
+        if thread.is_alive():
+            log.warning(
+                f"background snapshot refresh still running after "
+                f"{timeout_s:.0f}s; abandoning it"
+            )
+            return False
+        return True
+
     def run_simulation(
-        self, start: date, days: int, profile_dir: str | None = None
+        self, start: date, days: int, profile_dir: str | None = None,
+        resume: bool = True,
     ) -> list[DayResult]:
         """The daily MLOps loop over N simulated days: each day trains on
         history to date, deploys, generates the next (drifted) day, and
@@ -675,32 +873,37 @@ class LocalRunner:
                 f"{time.perf_counter() - t0:.2f}s (bootstrap cost)"
             )
         results = []
-        with maybe_trace(profile_dir, label=f"{days}-day simulation"):
-            for i in range(days):
-                today = start + timedelta(days=i)
-                result = self.run_day(today, lookahead_train=(i < days - 1))
-                results.append(result)
-                log.info(
-                    f"simulated day {today}: "
-                    f"{result.wall_clock_s:.2f}s wall-clock"
-                )
+        try:
+            with maybe_trace(profile_dir, label=f"{days}-day simulation"):
+                for i in range(days):
+                    today = start + timedelta(days=i)
+                    result = self.run_day(
+                        today, lookahead_train=(i < days - 1), resume=resume
+                    )
+                    results.append(result)
+                    log.info(
+                        f"simulated day {today}: "
+                        f"{result.wall_clock_s:.2f}s wall-clock"
+                    )
+        except BaseException:
+            # an exception escaping run_day (stage failure, SIGTERM
+            # unwind, simulated crash) must still drain — or at least
+            # deterministically abandon — the background compactor:
+            # returning with the daemon thread mid-write would let a
+            # half-written snapshot race the soak's byte-identity check
+            # or the resuming runner's first reads
+            self._drain_compactor()
+            raise
         # Drain the background compactor and top up the final day's
         # consolidation before returning (untimed — the day loop's clock
         # already stopped): a process exiting right after run_simulation
         # would otherwise kill the daemon thread mid-refresh, and a
         # 1-day run would never produce a snapshot at all.
-        thread = self._compact_thread
-        if thread is not None:
-            thread.join(timeout=60.0)
-            if thread.is_alive():
-                # an unusually slow write is still in flight: starting a
-                # second full consolidation here would duplicate the
-                # whole O(history) write and race it on the same keys
-                log.warning(
-                    "background snapshot refresh still running after 60s; "
-                    "skipping the final top-up"
-                )
-                return results
+        if not self._drain_compactor():
+            # an unusually slow write is still in flight: starting a
+            # second full consolidation here would duplicate the
+            # whole O(history) write and race it on the same keys
+            return results
         try:
             from bodywork_tpu.data.snapshot import refresh_due, write_snapshot
 

@@ -92,6 +92,37 @@ class StageContext:
     defer_artefacts: bool = False
 
 
+def stage_artefact_keys(stage_spec, result, ctx: StageContext) -> list[str]:
+    """The durable artefact keys a just-completed stage produced — what
+    the run journal (``pipeline/journal.py``) records (with content
+    digests) so a resumed run can verify-and-skip the stage. Keyed off
+    the executable the same way the runner's overlap machinery is;
+    unknown stages return ``[]``, which the journal records as
+    "complete but nothing verifiable" — a resuming run re-executes them
+    rather than trusting blindly."""
+    executable = stage_spec.executable
+    if executable.endswith(":generate_stage"):
+        return [result] if isinstance(result, str) else []
+    if executable.endswith(":train_stage"):
+        keys = [
+            getattr(result, "model_artefact_key", None),
+            getattr(result, "metrics_artefact_key", None),
+        ]
+        return [k for k in keys if k]
+    if executable.endswith(":test_stage"):
+        # the test stage persists metrics keyed by the LATEST dataset
+        # day (the one generate just wrote) — recompute the same key
+        from bodywork_tpu.store.base import ArtefactNotFound
+        from bodywork_tpu.store.schema import DATASETS_PREFIX, test_metrics_key
+
+        try:
+            _key, d = ctx.store.latest(DATASETS_PREFIX)
+        except ArtefactNotFound:
+            return []
+        return [test_metrics_key(d)]
+    return []
+
+
 def generate_stage(ctx: StageContext, offset_days: int = 1) -> str:
     """Generate the *next* simulated day's drifting data
     (reference stage 3: tomorrow's dataset appears today).

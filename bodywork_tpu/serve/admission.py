@@ -141,6 +141,7 @@ class AdmissionController:
         self.retry_after_max_s = retry_after_max_s
         self._lock = threading.Lock()
         self._pending = 0
+        self._draining = False
         self._depth_probe = None
         #: high-water mark of the pending depth — the budget-invariant
         #: witness the admission tests assert on (never > max_pending)
@@ -174,13 +175,32 @@ class AdmissionController:
         except Exception:  # a broken probe must never break admission
             return 0
 
+    def begin_drain(self) -> None:
+        """Graceful-shutdown mode (SIGTERM): stop admitting NEW scoring
+        work — every subsequent ``try_admit`` sheds (429 + Retry-After,
+        counted ``reason="drain"``) — while in-flight requests keep
+        their budget and release normally. One-way: the process is
+        exiting."""
+        with self._lock:
+            self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
     def try_admit(self) -> bool:
         """Admit one request against the pending budget. Returns False —
         and counts the shed — when the budget is exhausted, either by
         admitted-and-unfinished requests or by upstream backlog (the
         depth probe; ``>`` not ``>=`` because the probing request's own
-        connection is part of that count). O(1), no allocation: this
-        runs before any per-request work."""
+        connection is part of that count), or when the controller is
+        draining for shutdown. O(1), no allocation: this runs before
+        any per-request work."""
+        if self._draining:
+            with self._lock:
+                self._shed_count += 1
+            count_shed("drain")
+            return False
         external = self._external_depth()
         with self._lock:
             if (

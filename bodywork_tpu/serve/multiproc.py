@@ -37,6 +37,61 @@ from bodywork_tpu.utils.logging import get_logger
 log = get_logger("serve.multiproc")
 
 
+#: supervisor respawn policy: an instantly-crashing worker (bad
+#: checkpoint, broken env) must not respawn in a hot loop forever —
+#: each consecutive quick death doubles the backoff, and past the
+#: budget the slot is parked with an error instead of burning CPU (the
+#: k8s analogue: CrashLoopBackOff). A worker that stays alive
+#: ``RESTART_RESET_AFTER_S`` clears its slot's streak.
+RESTART_BUDGET = 5
+RESTART_BACKOFF_BASE_S = 0.5
+RESTART_BACKOFF_MAX_S = 30.0
+RESTART_RESET_AFTER_S = 60.0
+
+
+def _count_worker_restart() -> None:
+    from bodywork_tpu.obs import get_registry
+
+    get_registry().counter(
+        "bodywork_tpu_serve_worker_restarts_total",
+        "Serving replica processes respawned by the supervisor",
+    ).inc()
+
+
+class RespawnPolicy:
+    """Pure respawn decisions for ONE worker slot (unit-testable
+    without spawning processes): consecutive quick deaths back off
+    exponentially; past ``budget`` consecutive deaths the slot is
+    exhausted and stays down."""
+
+    def __init__(
+        self,
+        budget: int = RESTART_BUDGET,
+        base_s: float = RESTART_BACKOFF_BASE_S,
+        max_s: float = RESTART_BACKOFF_MAX_S,
+        reset_after_s: float = RESTART_RESET_AFTER_S,
+    ):
+        self.budget = budget
+        self.base_s = base_s
+        self.max_s = max_s
+        self.reset_after_s = reset_after_s
+        self.consecutive = 0
+        self.exhausted = False
+
+    def on_death(self, alive_s: float) -> float | None:
+        """Called when the slot's worker is found dead after living
+        ``alive_s`` seconds. Returns the backoff delay to wait before
+        respawning, or None when the budget is exhausted (the slot
+        stays down)."""
+        if alive_s >= self.reset_after_s:
+            self.consecutive = 0  # it was healthy: a fresh incident
+        self.consecutive += 1
+        if self.consecutive > self.budget:
+            self.exhausted = True
+            return None
+        return min(self.base_s * 2 ** (self.consecutive - 1), self.max_s)
+
+
 def _reuseport_socket(host: str, port: int) -> socket.socket:
     """A TCP socket bound with ``SO_REUSEPORT`` (not yet listening)."""
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -297,24 +352,71 @@ class MultiProcessService:
         return self
 
     def _supervise(self) -> None:
+        #: per-slot supervision state: respawn policy (budget/backoff),
+        #: spawn time (feeds the streak reset), and the scheduled
+        #: respawn instant while backing off
+        slots = [
+            {"policy": RespawnPolicy(), "spawned_at": time.monotonic(),
+             "respawn_at": None}
+            for _ in self._procs
+        ]
         while not self._stopping.wait(0.5):
+            now = time.monotonic()
             for i, proc in enumerate(self._procs):
-                if proc.is_alive() or self._stopping.is_set():
+                if self._stopping.is_set():
+                    break
+                slot = slots[i]
+                if proc.is_alive():
                     continue
-                log.warning(
-                    f"replica pid {proc.pid} died "
-                    f"(exitcode={proc.exitcode})"
-                    + ("; respawning" if self.restart else "")
-                )
-                if not self.restart:
+                if slot["policy"].exhausted:
+                    continue  # parked: budget burned, already reported
+                if slot["respawn_at"] is None:
+                    alive_s = now - slot["spawned_at"]
+                    delay = slot["policy"].on_death(alive_s)
+                    if delay is None:
+                        log.error(
+                            f"replica slot {i} (pid {proc.pid}) died "
+                            f"{slot['policy'].consecutive} consecutive "
+                            f"time(s) within {RESTART_RESET_AFTER_S:.0f}s "
+                            f"of spawn; restart budget "
+                            f"({slot['policy'].budget}) exhausted — "
+                            "leaving the slot down"
+                        )
+                        continue
+                    log.warning(
+                        f"replica pid {proc.pid} died "
+                        f"(exitcode={proc.exitcode}, alive {alive_s:.1f}s)"
+                        + (
+                            f"; respawning in {delay:.1f}s "
+                            f"(streak {slot['policy'].consecutive})"
+                            if self.restart else ""
+                        )
+                    )
+                    if not self.restart:
+                        slot["policy"].exhausted = True  # report once
+                        continue
+                    slot["respawn_at"] = now + delay
                     continue
+                if now < slot["respawn_at"]:
+                    continue  # still backing off
+                slot["respawn_at"] = None
                 new_proc, ready = self._spawn_one()
+                _count_worker_restart()
                 try:
                     self._wait_ready(ready, new_proc)
-                except Exception as exc:  # keep supervising the rest
+                except Exception as exc:  # keep supervising the rest:
+                    # the failed respawn counts against the slot's
+                    # budget on the next tick. spawned_at must be NOW —
+                    # after the (possibly long) readiness wait — or a
+                    # worker that hangs at startup for longer than
+                    # reset_after_s would launder its streak into a
+                    # "healthy" reset and respawn forever
                     log.error(f"replica respawn failed: {exc!r}")
+                    self._procs[i] = new_proc  # dead; next tick backs off
+                    slot["spawned_at"] = time.monotonic()
                     continue
                 self._procs[i] = new_proc
+                slot["spawned_at"] = time.monotonic()
                 log.info(f"replica respawned as pid {new_proc.pid}")
 
     def kill_worker(self, pid: int) -> None:

@@ -21,6 +21,7 @@ from bodywork_tpu.models.checkpoint import load_model
 from bodywork_tpu.serve.app import create_app
 from bodywork_tpu.store.base import ArtefactStore
 from bodywork_tpu.utils.logging import get_logger
+from bodywork_tpu.utils.shutdown import ShutdownRequested
 
 log = get_logger("serve.server")
 
@@ -370,6 +371,22 @@ def serve_latest_model(
         watcher.start()
         handle.add_cleanup(watcher.stop)
     if block:
-        handle.serve_forever()
+        try:
+            handle.serve_forever()
+        except ShutdownRequested:
+            # graceful SIGTERM (utils.shutdown, installed by `cli
+            # serve`): stop ADMITTING first — new scoring requests shed
+            # with Retry-After instead of landing on a dying process —
+            # then stop() drains the rest: watcher down, coalescer
+            # flushed (app.close is a registered cleanup), listener
+            # closed. The shutdown watchdog bounds all of this to the
+            # grace deadline, inside k8s terminationGracePeriodSeconds.
+            log.warning(
+                "SIGTERM: draining scoring service "
+                "(admission closed, in-flight work finishing)"
+            )
+            if admission is not None:
+                admission.begin_drain()
+            handle.stop()
         return None
     return handle.start()

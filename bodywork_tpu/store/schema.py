@@ -15,6 +15,18 @@ cold process loads all history in O(1 + tail) store reads instead of
 O(days). Snapshots are derived artefacts — deleting the prefix is always
 safe (readers fall back to the per-day CSVs).
 
+``runs/`` holds the durable day-run journal (``pipeline/journal.py``):
+one document per simulated day, ``runs/<date>/journal.json``, recording
+per-stage intent/complete entries (artefact keys + content digests) and
+the CAS-acquired run lease that keeps a rescheduled CronJob pod and a
+still-alive original from interleaving writes for the same day. Delete
+safety: journals are OPERATIONAL state, never results — deleting one
+only forfeits crash-resume for that day (the next run re-executes every
+stage, converging to the same artefacts), so the prefix is always safe
+to clear. Like the alias document, journals are mutated EXCLUSIVELY
+through ``ArtefactStore.put_bytes_if_match`` (never a raw ``put_bytes``)
+— the lease protocol is only sound if every writer rides the CAS.
+
 ``registry/`` holds the model-registry release-management layer
 (``bodywork_tpu/registry/``): date-keyed per-model records under
 ``registry/records/`` plus the single alias document
@@ -37,6 +49,7 @@ MODELS_PREFIX = "models/"
 MODEL_METRICS_PREFIX = "model-metrics/"
 TEST_METRICS_PREFIX = "test-metrics/"
 SNAPSHOTS_PREFIX = "snapshots/"
+RUNS_PREFIX = "runs/"
 REGISTRY_PREFIX = "registry/"
 REGISTRY_RECORDS_PREFIX = "registry/records/"
 #: the single alias document (no embedded date: invisible to the
@@ -51,6 +64,7 @@ ALL_PREFIXES = (
     MODEL_METRICS_PREFIX,
     TEST_METRICS_PREFIX,
     SNAPSHOTS_PREFIX,
+    RUNS_PREFIX,
     REGISTRY_PREFIX,
 )
 
@@ -79,6 +93,14 @@ def registry_record_key(model_key: str) -> str:
     base = model_key.rsplit("/", 1)[-1]
     stem = base.rsplit(".", 1)[0] if "." in base else base
     return f"{REGISTRY_RECORDS_PREFIX}{stem}.json"
+
+
+def run_journal_key(d: date) -> str:
+    """The day-run journal document for simulated day ``d``
+    (``pipeline/journal.py``). The embedded date keeps journals visible
+    to the standard date-key protocol for retention tooling, while the
+    per-day subdirectory leaves room for future per-run attachments."""
+    return f"{RUNS_PREFIX}{d}/journal.json"
 
 
 def snapshot_key(d: date) -> str:

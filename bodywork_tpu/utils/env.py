@@ -1,0 +1,28 @@
+"""Shared env-var parsing for operational knobs."""
+from __future__ import annotations
+
+import math
+import os
+
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("utils.env")
+
+__all__ = ["positive_float_env"]
+
+
+def positive_float_env(name: str, default: float) -> float:
+    """A finite float > 0 from ``name``, or ``default`` — garbage (and
+    NaN, which every ``<= 0`` check silently passes) is ignored with a
+    warning rather than crashing the entrypoint."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        value = float(raw)
+    except ValueError:
+        value = None
+    if value is None or not (value > 0) or not math.isfinite(value):
+        log.warning(f"ignoring {name}={raw!r} (need a finite number > 0)")
+        return default
+    return value

@@ -1,0 +1,103 @@
+"""Graceful SIGTERM handling for the long-running CLI entrypoints.
+
+Kubernetes stops a pod by sending SIGTERM, waiting
+``terminationGracePeriodSeconds``, then SIGKILLing. The default Python
+disposition kills the process mid-stack — no journal "interrupted"
+mark, no admission drain, no coalescer flush. :func:`graceful_sigterm`
+converts the signal into a :class:`ShutdownRequested` raised in the
+MAIN thread (CPython runs signal handlers there, so the raise unwinds
+whatever the entrypoint is blocked in — a thread join, a serve loop)
+and arms a watchdog that force-exits if the graceful path itself hangs
+past its deadline — the graceful window must end BEFORE the kubelet's
+SIGKILL so our own teardown (journal marks, metric flushes) wins the
+race against it.
+
+``ShutdownRequested`` subclasses ``BaseException`` deliberately: no
+retry/recovery layer may swallow a shutdown and keep working.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from contextlib import contextmanager
+
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("utils.shutdown")
+
+__all__ = [
+    "SIGTERM_EXIT",
+    "ShutdownRequested",
+    "grace_deadline_from_env",
+    "graceful_sigterm",
+]
+
+#: process exit code after a graceful SIGTERM unwind — 128 + SIGTERM,
+#: the value k8s tooling already reads as "terminated, not failed".
+SIGTERM_EXIT = 143
+
+#: default graceful deadline: comfortably inside the 30 s
+#: ``terminationGracePeriodSeconds`` the emitted manifests set
+#: (``pipeline/k8s.py``), leaving the kubelet margin for the SIGKILL.
+DEFAULT_GRACE_S = 20.0
+
+
+class ShutdownRequested(BaseException):
+    """SIGTERM arrived: unwind, journal/drain, exit ``SIGTERM_EXIT``."""
+
+
+def grace_deadline_from_env(default: float = DEFAULT_GRACE_S) -> float:
+    """``BODYWORK_TPU_GRACE_S`` override — deploys with a non-default
+    ``terminationGracePeriodSeconds`` size the in-process deadline to
+    match (it must stay BELOW the kubelet's, or SIGKILL wins)."""
+    from bodywork_tpu.utils.env import positive_float_env
+
+    return positive_float_env("BODYWORK_TPU_GRACE_S", default)
+
+
+@contextmanager
+def graceful_sigterm(deadline_s: float | None = None):
+    """Install the SIGTERM-to-exception conversion for the duration of
+    the block; restores the previous handler on exit. Yields the
+    ``fired`` event so the caller can map a completed graceful unwind
+    to ``SIGTERM_EXIT``. A second SIGTERM while already unwinding is
+    ignored (the watchdog owns escalation). The watchdog is cancelled
+    once control leaves the block — past that point the process is on
+    its straight-line way out and must not be shot mid-return. No-op
+    outside the main thread (``signal.signal`` would raise)."""
+    if deadline_s is None:
+        deadline_s = grace_deadline_from_env()
+    fired = threading.Event()
+    timer_box: list[threading.Timer] = []
+
+    def _handler(signum, frame):
+        if fired.is_set():
+            return  # already unwinding; the watchdog bounds the rest
+        fired.set()
+        log.warning(
+            f"SIGTERM: beginning graceful shutdown "
+            f"(deadline {deadline_s:.0f}s)"
+        )
+        # the watchdog guarantees the process exits within the deadline
+        # even if the graceful unwind wedges (a stuck flush, a hung join)
+        def _watchdog():
+            os._exit(SIGTERM_EXIT)
+
+        timer = threading.Timer(deadline_s, _watchdog)
+        timer.daemon = True
+        timer.start()
+        timer_box.append(timer)
+        raise ShutdownRequested("SIGTERM")
+
+    try:
+        previous = signal.signal(signal.SIGTERM, _handler)
+    except ValueError:  # not the main thread (in-process tests): no-op
+        yield fired
+        return
+    try:
+        yield fired
+    finally:
+        signal.signal(signal.SIGTERM, previous)
+        for timer in timer_box:
+            timer.cancel()

@@ -391,6 +391,44 @@ def test_chaos_run_sim_arg_validation(tmp_path, capsys):
     capsys.readouterr()
 
 
+@pytest.mark.chaos
+def test_chaos_crash_schedule_validation(tmp_path, capsys):
+    """--crash-schedule rejects malformed kill points by name BEFORE
+    spawning anything: a typo'd point silently never firing would make
+    the crash soak vacuously pass."""
+    store = str(tmp_path / "soak")
+    assert main(["chaos", "run-sim", "--store", store, "--days", "1",
+                 "--crash-schedule",
+                 '[{"kind": "bogus", "n": 0}]']) == 1
+    assert main(["chaos", "run-sim", "--store", store, "--days", "1",
+                 "--crash-schedule", "not json"]) == 1
+    assert main(["chaos", "run-sim", "--store", store, "--days", "1",
+                 "--crash-schedule",
+                 '[{"kind": "store_op", "op": "put_bytes", "n": 0}]']) == 1
+    # gs:// is refused before any crash machinery engages
+    assert main(["chaos", "run-sim", "--store", "gs://bucket/x",
+                 "--days", "1", "--crash-schedule", "sweep"]) == 1
+    capsys.readouterr()
+
+
+def test_run_day_exits_5_when_another_runner_holds_the_lease(tmp_path,
+                                                            capsys):
+    """The rescheduled-twin-pod path: a live foreign lease makes run-day
+    stop cleanly with its documented lease-lost code instead of
+    interleaving writes with the holder."""
+    from datetime import date
+
+    from bodywork_tpu.pipeline.journal import LEASE_LOST_EXIT, RunJournal
+    from bodywork_tpu.store import FilesystemStore
+
+    store_dir = str(tmp_path / "store")
+    RunJournal(FilesystemStore(store_dir), date(2026, 1, 1),
+               owner="still-alive-original", lease_ttl_s=900).acquire()
+    assert main(["run-day", "--store", store_dir,
+                 "--date", "2026-01-01"]) == LEASE_LOST_EXIT
+    capsys.readouterr()
+
+
 def test_registry_cli_smoke(tmp_path, capsys):
     """registry list/show/gate/promote/rollback over a real store: train
     registers a candidate, gate --dry-run prints the decision WITHOUT

@@ -1,0 +1,563 @@
+"""Crash-resumable pipeline runs (ISSUE 7): the durable day-run journal,
+the CAS run lease, seeded process-kill chaos, and graceful shutdown.
+
+The acceptance bar extends PR 4's: killing the runner PROCESS at any
+stage boundary (and at seeded mid-stage store ops) must converge, on
+restart, to final artefacts byte-identical to an uninterrupted twin —
+with the journal's op budget proving completed stages were SKIPPED, not
+re-executed. The every-boundary subprocess sweep is marked slow+chaos;
+the tier-1 smoke covers one seeded boundary of a 2-day in-memory sim.
+"""
+import json
+import os
+import re
+import signal
+import time
+from datetime import date
+
+import pytest
+
+from helpers import make_counting_store, make_memory_store
+
+from bodywork_tpu.chaos import kill
+from bodywork_tpu.chaos.plan import FaultPlan
+from bodywork_tpu.chaos.sim import compare_stores, sweep_points
+from bodywork_tpu.data.drift_config import DriftConfig
+from bodywork_tpu.pipeline import LocalRunner, default_pipeline
+from bodywork_tpu.pipeline.journal import (
+    JOURNAL_SCHEMA,
+    LEASE_LOST_EXIT,
+    RESUMED_NOOP_EXIT,
+    LeaseLost,
+    RunJournal,
+    artefact_digest,
+)
+from bodywork_tpu.store.schema import MODELS_PREFIX, run_journal_key
+from bodywork_tpu.utils.shutdown import (
+    ShutdownRequested,
+    grace_deadline_from_env,
+    graceful_sigterm,
+)
+
+START = date(2026, 8, 1)
+DRIFT = DriftConfig(n_samples=60)
+JKEY = run_journal_key(START)
+
+
+def _runner(store):
+    return LocalRunner(default_pipeline(), store, drift=DRIFT)
+
+
+def _copy_store(src):
+    dst = make_memory_store()
+    for key in src.list_keys():
+        dst.put_bytes(key, src.get_bytes(key))
+    return dst
+
+
+def _counter(name, **labels):
+    from bodywork_tpu.obs import get_registry
+
+    metric = get_registry().get(name)
+    if metric is None:
+        return 0.0
+    return sum(
+        s["value"]
+        for s in metric.snapshot_samples()
+        if all(s["labels"].get(k) == v for k, v in labels.items())
+    )
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    """One uninterrupted 2-day in-memory sim — the byte-identity truth
+    the resume/crash tests compare against (and a warm jax)."""
+    store = make_memory_store()
+    runner = _runner(store)
+    runner.bootstrap(START)
+    runner.run_simulation(START, 2)
+    return store
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_kill_switch():
+    yield
+    kill.uninstall()
+
+
+# -- journal + lease unit tests --------------------------------------------
+
+
+def test_fresh_acquire_lifecycle_and_cas_only_mutations():
+    counting = make_counting_store(make_memory_store())
+    j = RunJournal(counting, START, owner="a", lease_ttl_s=60)
+    assert j.acquire() is None  # fresh day
+    assert j.prior_status is None and j.completed_stages() == {}
+    j.record_intents(["train"])
+    j.record_completes({"train": {"models/m.npz": artefact_digest(b"x")}})
+    j.record_day_complete()
+    doc = json.loads(counting.get_bytes(JKEY).decode())
+    assert doc["schema"] == JOURNAL_SCHEMA
+    assert doc["status"] == "complete"
+    assert doc["stages"]["train"]["state"] == "complete"
+    assert doc["lease"]["owner"] is None  # released with completion
+    # the CAS guard, runtime half: every journal mutation rode
+    # put_bytes_if_match — zero raw puts under runs/
+    assert counting.by_key.get(("put_bytes", JKEY), 0) == 0
+    assert counting.by_key[("put_bytes_if_match", JKEY)] == 4
+
+
+def test_prior_completes_surface_on_reacquire():
+    store = make_memory_store()
+    j = RunJournal(store, START, owner="a", lease_ttl_s=60)
+    j.acquire()
+    j.record_intents(["train", "generate"])
+    j.record_completes({"train": {"k": artefact_digest(b"x")}})
+    j.record_interrupted()  # clean stop: lease released, intents kept
+    j2 = RunJournal(store, START, owner="b", lease_ttl_s=60)
+    prior = j2.acquire()
+    assert prior["status"] == "interrupted"
+    assert j2.prior_status == "interrupted"
+    assert set(j2.completed_stages()) == {"train"}  # intent NOT complete
+    assert json.loads(store.get_bytes(JKEY).decode())["lease"]["owner"] == "b"
+
+
+def test_live_foreign_lease_blocks_second_runner():
+    store = make_memory_store()
+    RunJournal(store, START, owner="original", lease_ttl_s=900).acquire()
+    with pytest.raises(LeaseLost):
+        RunJournal(store, START, owner="twin", lease_ttl_s=900).acquire()
+
+
+def test_expired_lease_takeover_bumps_fence_and_fences_out_old_holder():
+    store = make_memory_store()
+    t0 = 1000.0
+    j1 = RunJournal(store, START, owner="dead", lease_ttl_s=10,
+                    clock=lambda: t0)
+    j1.acquire()
+    fence1 = json.loads(store.get_bytes(JKEY).decode())["lease"]["fence"]
+    # a rescheduled pod arrives after the TTL: takeover, fence bumped
+    j2 = RunJournal(store, START, owner="successor", lease_ttl_s=10,
+                    clock=lambda: t0 + 11)
+    j2.acquire()
+    doc = json.loads(store.get_bytes(JKEY).decode())
+    assert doc["lease"]["owner"] == "successor"
+    assert doc["lease"]["fence"] == fence1 + 1
+    # the original holder (a zombie that was merely slow, not dead) must
+    # fail its next write cleanly: its CAS token is stale
+    with pytest.raises(LeaseLost):
+        j1.record_intents(["train"])
+
+
+def test_release_frees_the_day_immediately():
+    store = make_memory_store()
+    j = RunJournal(store, START, owner="a", lease_ttl_s=900)
+    j.acquire()
+    j.release()
+    # no TTL wait: a new owner acquires at once
+    RunJournal(store, START, owner="b", lease_ttl_s=900).acquire()
+
+
+def test_corrupt_journal_counts_and_repairs_to_full_rerun():
+    store = make_memory_store()
+    store.put_bytes(JKEY, b"\x00not json at all")
+    before = _counter("bodywork_tpu_runner_journal_corrupt_total")
+    j = RunJournal(store, START, owner="a", lease_ttl_s=60)
+    prior = j.acquire()
+    assert j.was_corrupt
+    assert prior is None  # nothing trusted from the torn doc
+    assert j.completed_stages() == {}  # => safe full re-run
+    assert _counter("bodywork_tpu_runner_journal_corrupt_total") == before + 1
+    # and the acquire CAS-repaired the document in place
+    doc = json.loads(store.get_bytes(JKEY).decode())
+    assert doc["schema"] == JOURNAL_SCHEMA
+
+
+def test_verify_completed_checks_digests_against_the_store():
+    store = make_memory_store()
+    store.put_bytes("models/good.npz", b"good")
+    store.put_bytes("models/changed.npz", b"NEW BYTES")
+    j = RunJournal(store, START, owner="a", lease_ttl_s=60)
+    j.acquire()
+    j.record_completes({
+        "ok-stage": {"models/good.npz": artefact_digest(b"good")},
+        "changed-stage": {"models/changed.npz": artefact_digest(b"old")},
+        "gone-stage": {"models/gone.npz": artefact_digest(b"x")},
+        "nothing-recorded": {},
+    })
+    j2 = RunJournal(store, START, owner="b", lease_ttl_s=60,
+                    clock=lambda: time.time() + 120)
+    j2.acquire()
+    verified, mismatch = j2.verify_completed()
+    assert set(verified) == {"ok-stage"}
+    assert mismatch  # digest drift detected -> those stages re-run
+
+
+# -- the kill switch -------------------------------------------------------
+
+
+def test_parse_schedule_rejects_typos_loudly():
+    with pytest.raises(ValueError):
+        kill.parse_schedule([{"kind": "bogus", "n": 0}])
+    with pytest.raises(ValueError):
+        kill.parse_schedule([{"kind": "stage_boundary"}])  # no n
+    with pytest.raises(ValueError):
+        kill.parse_schedule([{"kind": "stage_boundary", "n": 0,
+                              "extra": 1}])
+    with pytest.raises(ValueError):
+        kill.parse_schedule([{"kind": "store_op", "op": "nope",
+                              "key": "k", "n": 0}])
+    with pytest.raises(ValueError):
+        kill.parse_schedule([{"kind": "store_op", "op": "put_bytes",
+                              "n": 0}])  # no key
+    assert kill.parse_schedule('[{"kind": "stage_boundary", "n": 2}]') == [
+        {"kind": "stage_boundary", "n": 2}
+    ]
+
+
+def test_kill_switch_fires_at_nth_hit_per_stream_only():
+    sw = kill.KillSwitch(
+        [{"kind": "store_op", "op": "put_bytes", "key": "a", "n": 1}],
+        action="raise",
+    )
+    sw.hit("store_op", op="put_bytes", key="a")  # n=0: not armed
+    sw.hit("store_op", op="put_bytes", key="b")  # other stream
+    sw.hit("store_op", op="get_bytes", key="a")  # other stream
+    with pytest.raises(kill.SimulatedCrash):
+        sw.hit("store_op", op="put_bytes", key="a")  # n=1: fires
+    assert sw.fired == [("store|put_bytes|a", 1)]
+
+
+def test_wrap_store_is_identity_when_unarmed():
+    store = make_memory_store()
+    assert kill.wrap_store(store) is store
+    kill.install(kill.KillSwitch([], action="raise"))
+    try:
+        assert kill.wrap_store(store) is not store
+    finally:
+        kill.uninstall()
+
+
+def test_fault_plan_carries_and_validates_crash_schedule():
+    plan = FaultPlan(crash_schedule=[{"kind": "stage_boundary", "n": 3}])
+    assert plan.to_dict()["crash_schedule"] == [
+        {"kind": "stage_boundary", "n": 3}
+    ]
+    round_trip = FaultPlan.from_dict(plan.to_dict())
+    assert tuple(round_trip.crash_schedule) == tuple(plan.crash_schedule)
+    with pytest.raises(ValueError):
+        FaultPlan(crash_schedule=[{"kind": "nope", "n": 0}])
+
+
+def test_chaos_corruption_now_covers_run_journals():
+    assert "runs/" in FaultPlan().corrupt_prefixes
+
+
+def test_sweep_points_enumerates_every_boundary_plus_seeded_store_ops():
+    points = sweep_points(
+        3, 4, ["models/a.npz", "datasets/d.csv", "runs/x/journal.json",
+               "snapshots/s.npz"], seed=0, store_op_samples=2,
+    )
+    boundaries = [p for p in points if p["kind"] == "stage_boundary"]
+    store_ops = [p for p in points if p["kind"] == "store_op"]
+    assert [p["n"] for p in boundaries] == list(range(3 * 5))
+    assert len(store_ops) == 2
+    # journals/snapshots are operational state, never kill anchors
+    assert all(not p["key"].startswith(("runs/", "snapshots/"))
+               for p in store_ops)
+    assert points == sweep_points(  # pure in the seed
+        3, 4, ["models/a.npz", "datasets/d.csv", "runs/x/journal.json",
+               "snapshots/s.npz"], seed=0, store_op_samples=2,
+    )
+
+
+# -- runner-level resume ---------------------------------------------------
+
+
+def test_fully_resumed_day_is_a_noop_with_zero_stage_writes(baseline):
+    """The op-budget proof: re-running a journalled-complete day makes
+    ZERO artefact writes — verification reads, one lease CAS cycle on
+    the journal, nothing else."""
+    counting = make_counting_store(_copy_store(baseline))
+    before = _counter("bodywork_tpu_runner_resumes_total", outcome="noop")
+    result = _runner(counting).run_day(START)
+    assert result.noop
+    assert set(result.skipped_stages) == set(default_pipeline().stages)
+    assert all(s == 0.0 for s in result.stage_seconds.values())
+    puts = [k for (op, k), n in counting.by_key.items()
+            if op == "put_bytes" and n]
+    assert puts == [], f"a noop day wrote: {puts}"
+    cas = [k for (op, k), n in counting.by_key.items()
+           if op == "put_bytes_if_match" and n]
+    assert cas == [JKEY]  # acquire + release ride the journal CAS only
+    assert _counter("bodywork_tpu_runner_resumes_total",
+                    outcome="noop") == before + 1
+
+
+def test_half_resumed_day_reruns_only_the_tail(baseline, monkeypatch):
+    """Crash after train: the restart must SKIP train (zero model
+    writes, zero train seconds) and re-execute only serve onward."""
+    monkeypatch.setenv("BODYWORK_TPU_RUN_LEASE_TTL_S", "0.05")
+    store = make_memory_store()
+    runner = _runner(store)
+    runner.bootstrap(START)
+    kill.install(kill.KillSwitch(
+        [{"kind": "stage_boundary", "n": 1}], action="raise"
+    ))
+    with pytest.raises(kill.SimulatedCrash):
+        runner.run_day(START)
+    kill.uninstall()
+    doc = json.loads(store.get_bytes(JKEY).decode())
+    assert doc["status"] == "running"  # process death: no clean mark
+    assert doc["lease"]["owner"] is not None  # lease died with it
+    assert doc["stages"]["stage-1-train-model"]["state"] == "complete"
+    time.sleep(0.1)  # let the shrunken lease expire
+    before = _counter("bodywork_tpu_runner_resumes_total",
+                      outcome="resumed")
+    counting = make_counting_store(store)
+    result = _runner(counting).run_day(START)
+    assert not result.noop
+    assert result.skipped_stages == ("stage-1-train-model",)
+    assert result.stage_seconds["stage-1-train-model"] == 0.0
+    model_puts = [k for (op, k), n in counting.by_key.items()
+                  if op == "put_bytes" and k and k.startswith(MODELS_PREFIX)]
+    assert model_puts == []  # train was skipped, not re-executed
+    assert _counter("bodywork_tpu_runner_resumes_total",
+                    outcome="resumed") == before + 1
+    assert json.loads(store.get_bytes(JKEY).decode())["status"] == "complete"
+
+
+def test_digest_mismatch_forces_rerun_not_blind_trust(baseline, monkeypatch):
+    """'Verify, never trust': a journal claiming complete stages whose
+    artefacts no longer match re-runs them."""
+    monkeypatch.setenv("BODYWORK_TPU_RUN_LEASE_TTL_S", "0.05")
+    store = _copy_store(baseline)
+    model_keys = [k for k in store.list_keys(MODELS_PREFIX)]
+    store.put_bytes(model_keys[0], b"TAMPERED")
+    time.sleep(0.1)
+    before = _counter("bodywork_tpu_runner_resumes_total",
+                      outcome="rerun_mismatch")
+    result = _runner(store).run_day(START)
+    assert not result.noop
+    assert "stage-1-train-model" not in result.skipped_stages
+    assert _counter("bodywork_tpu_runner_resumes_total",
+                    outcome="rerun_mismatch") == before + 1
+    # the stage actually executed (vs the skip path's pinned 0.0)
+    assert result.stage_seconds["stage-1-train-model"] > 0.0
+
+
+def test_corrupt_journal_past_budget_degrades_to_full_rerun(baseline):
+    store = _copy_store(baseline)
+    store.put_bytes(JKEY, b"{torn mid-write")
+    before = _counter("bodywork_tpu_runner_journal_corrupt_total")
+    rerun_before = _counter("bodywork_tpu_runner_resumes_total",
+                            outcome="rerun_corrupt")
+    result = _runner(store).run_day(START)
+    assert not result.noop and result.skipped_stages == ()
+    assert _counter("bodywork_tpu_runner_journal_corrupt_total") == before + 1
+    assert _counter("bodywork_tpu_runner_resumes_total",
+                    outcome="rerun_corrupt") == rerun_before + 1
+    assert json.loads(store.get_bytes(JKEY).decode())["status"] == "complete"
+
+
+def test_no_resume_flag_skips_the_journal_entirely():
+    store = make_counting_store(make_memory_store())
+    runner = _runner(store)
+    runner.bootstrap(START)
+    runner.run_day(START, resume=False)
+    assert not [k for (op, k) in store.by_key
+                if k and k.startswith("runs/")]
+
+
+# -- the tier-1 crash-resume smoke (ISSUE 7 acceptance, small) -------------
+
+
+def test_crash_resume_smoke_one_seeded_boundary(baseline, monkeypatch):
+    """Kill at one seeded boundary of a 2-day in-memory sim; the restart
+    must converge to final artefacts byte-identical to the uninterrupted
+    twin (the full every-boundary sweep is the slow-marked
+    test_crash_sweep_every_boundary_subprocess)."""
+    import random
+
+    monkeypatch.setenv("BODYWORK_TPU_RUN_LEASE_TTL_S", "0.05")
+    n_boundaries = 2 * (len(default_pipeline().dag) + 1)
+    point = {"kind": "stage_boundary",
+             "n": random.Random(7).randrange(n_boundaries)}
+    store = make_memory_store()
+    runner = _runner(store)
+    runner.bootstrap(START)
+    kill.install(kill.KillSwitch([point], action="raise"))
+    with pytest.raises(kill.SimulatedCrash):
+        runner.run_simulation(START, 2)
+    kill.uninstall()
+    time.sleep(0.1)
+    _runner(store).run_simulation(START, 2)  # the restarted pod
+    comparison = compare_stores(baseline, store)
+    assert comparison["ok"], comparison
+
+
+# -- graceful shutdown -----------------------------------------------------
+
+
+def test_graceful_sigterm_unwinds_once_and_ignores_repeats(monkeypatch):
+    force_exits = []
+    monkeypatch.setattr(os, "_exit", lambda code: force_exits.append(code))
+    got = []
+    with graceful_sigterm(deadline_s=0.2) as fired:
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(2)
+            raise AssertionError("SIGTERM never unwound")
+        except ShutdownRequested:
+            got.append("unwound")
+            os.kill(os.getpid(), signal.SIGTERM)  # second: ignored
+            time.sleep(0.05)
+    assert got == ["unwound"]
+    assert fired.is_set()
+    # the watchdog was cancelled on context exit: well past the 0.2s
+    # deadline, no force-exit fired
+    time.sleep(0.4)
+    assert force_exits == []
+
+
+def test_sigterm_watchdog_force_exits_a_wedged_unwind(monkeypatch):
+    force_exits = []
+    monkeypatch.setattr(os, "_exit", lambda code: force_exits.append(code))
+    with graceful_sigterm(deadline_s=0.1):
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(2)
+        except ShutdownRequested:
+            time.sleep(0.4)  # a wedged drain: the watchdog must fire
+    assert force_exits == [143]
+
+
+def test_grace_deadline_env_parse(monkeypatch):
+    monkeypatch.setenv("BODYWORK_TPU_GRACE_S", "7.5")
+    assert grace_deadline_from_env() == 7.5
+    monkeypatch.setenv("BODYWORK_TPU_GRACE_S", "bogus")
+    assert grace_deadline_from_env(3.0) == 3.0
+    monkeypatch.setenv("BODYWORK_TPU_GRACE_S", "-1")
+    assert grace_deadline_from_env(3.0) == 3.0
+
+
+def test_sigterm_mid_day_journals_interrupted_and_next_run_resumes(
+    monkeypatch,
+):
+    """The pod-eviction path end to end, in-process: the SIGTERM
+    handler's ShutdownRequested unwinds run_day mid-day (injected
+    deterministically at the second DAG step's intent write — after
+    train completed, exactly where a real signal raises in the main
+    thread) -> clean 'interrupted' journal entry + released lease ->
+    the next run resumes instead of starting over blind."""
+    from bodywork_tpu.pipeline import journal as journal_mod
+
+    store = make_memory_store()
+    runner = _runner(store)
+    runner.bootstrap(START)
+    real = journal_mod.RunJournal.record_intents
+    state = {"n": 0, "armed": True}
+
+    def intercept(self, names):
+        state["n"] += 1
+        if state["armed"] and state["n"] == 2:
+            state["armed"] = False
+            raise ShutdownRequested("SIGTERM")
+        return real(self, names)
+
+    monkeypatch.setattr(journal_mod.RunJournal, "record_intents", intercept)
+    with pytest.raises(ShutdownRequested):
+        runner.run_day(START)
+    doc = json.loads(store.get_bytes(JKEY).decode())
+    assert doc["status"] == "interrupted"
+    assert doc["lease"]["owner"] is None  # successor starts immediately
+    assert doc["stages"]["stage-1-train-model"]["state"] == "complete"
+    result = _runner(store).run_day(START)  # no TTL wait: lease is free
+    assert json.loads(store.get_bytes(JKEY).decode())["status"] == "complete"
+    assert not result.noop
+    assert "stage-1-train-model" in result.skipped_stages
+
+
+def test_admission_drain_sheds_new_work():
+    from bodywork_tpu.serve.admission import AdmissionController
+
+    adm = AdmissionController(max_pending=8)
+    assert adm.try_admit()
+    before = _counter("bodywork_tpu_serve_shed_total", reason="drain")
+    adm.begin_drain()
+    assert adm.draining
+    assert not adm.try_admit()
+    assert _counter("bodywork_tpu_serve_shed_total",
+                    reason="drain") == before + 1
+    adm.release()  # in-flight work still releases its budget cleanly
+
+
+# -- the CAS guard, static half (the PR 5 alias-guard pattern) -------------
+
+
+def test_no_raw_put_bytes_on_run_journals_in_codebase():
+    """The lease protocol is only sound if EVERY journal writer rides
+    the CAS: no source file may call put_bytes/put_text on a runs/ key,
+    and the journal module itself must not know raw writes exist."""
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[1] / "bodywork_tpu"
+    raw_write = re.compile(
+        r"put_(?:bytes|text)\(\s*(?:run_journal_key\(|[\"']runs/)"
+    )
+    offenders = [
+        str(path) for path in root.rglob("*.py")
+        if raw_write.search(path.read_text())
+    ]
+    assert offenders == [], (
+        f"raw runs/ writes found (must use put_bytes_if_match): {offenders}"
+    )
+    journal_src = (root / "pipeline" / "journal.py").read_text()
+    assert "put_bytes_if_match(" in journal_src
+    assert re.search(r"\bself\.store\.put_bytes\(", journal_src) is None
+
+
+# -- subprocess crash soaks (the real os._exit path) -----------------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_crash_kill_and_restart_subprocess_single_point(tmp_path):
+    """One real os._exit kill + restart through `cli run-sim` child
+    processes — the smoke-scale version of the full sweep below."""
+    from bodywork_tpu.chaos.sim import run_crash_sim
+
+    summary = run_crash_sim(
+        tmp_path, START, 2,
+        points=[{"kind": "stage_boundary", "n": 4}],
+        samples_per_day=60,
+    )
+    assert summary["ok"], summary["results"]
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_crash_sweep_every_boundary_subprocess(tmp_path):
+    """THE acceptance criterion: for every stage boundary and seeded
+    mid-stage store-op kill points across a 3-day sim, kill + restart
+    converges byte-identical to the uninterrupted twin."""
+    from bodywork_tpu.chaos.sim import run_crash_sim
+
+    summary = run_crash_sim(tmp_path, START, 3, samples_per_day=60)
+    assert summary["points"] == 3 * (len(default_pipeline().dag) + 1) + 2
+    failed = [r for r in summary["results"] if not r["ok"]]
+    assert summary["ok"], failed
+
+
+# -- exit codes ------------------------------------------------------------
+
+
+def test_exit_codes_are_distinct_and_documented():
+    from bodywork_tpu.cli import DRIFT_EXIT
+    from bodywork_tpu.utils.shutdown import SIGTERM_EXIT
+
+    codes = {0, 1, 2, DRIFT_EXIT, LEASE_LOST_EXIT, RESUMED_NOOP_EXIT,
+             kill.EXIT_KILLED, SIGTERM_EXIT}
+    assert len(codes) == 8  # no collisions
+    assert (LEASE_LOST_EXIT, RESUMED_NOOP_EXIT, kill.EXIT_KILLED,
+            SIGTERM_EXIT) == (5, 6, 86, 143)

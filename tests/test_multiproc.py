@@ -142,3 +142,42 @@ def test_supervisor_respawns_killed_worker(mp_service):
         s.post(mp_service.url, json={"X": 5}, timeout=30).ok
         for _ in range(8)
     )
+
+
+# -- restart budget + backoff (ISSUE 7 satellite) --------------------------
+
+
+def test_respawn_policy_backs_off_exponentially_then_exhausts():
+    """An instantly-crashing worker must not respawn in a hot loop
+    forever: consecutive quick deaths double the backoff, and past the
+    budget the slot parks (the CrashLoopBackOff analogue)."""
+    from bodywork_tpu.serve.multiproc import RespawnPolicy
+
+    policy = RespawnPolicy(budget=3, base_s=0.5, max_s=30.0,
+                           reset_after_s=60.0)
+    assert [policy.on_death(0.1) for _ in range(3)] == [0.5, 1.0, 2.0]
+    assert not policy.exhausted
+    assert policy.on_death(0.1) is None  # budget burned
+    assert policy.exhausted
+
+
+def test_respawn_policy_healthy_worker_resets_the_streak():
+    from bodywork_tpu.serve.multiproc import RespawnPolicy
+
+    policy = RespawnPolicy(budget=3, base_s=0.5, max_s=30.0,
+                           reset_after_s=60.0)
+    assert policy.on_death(0.1) == 0.5
+    assert policy.on_death(0.1) == 1.0
+    # the respawn stayed alive past reset_after_s: a fresh incident
+    assert policy.on_death(120.0) == 0.5
+    assert policy.consecutive == 1
+
+
+def test_respawn_policy_backoff_is_capped():
+    from bodywork_tpu.serve.multiproc import RespawnPolicy
+
+    policy = RespawnPolicy(budget=50, base_s=0.5, max_s=4.0,
+                           reset_after_s=60.0)
+    delays = [policy.on_death(0.0) for _ in range(8)]
+    assert max(delays) == 4.0
+    assert delays[-1] == 4.0

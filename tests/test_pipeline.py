@@ -1105,3 +1105,25 @@ def test_multihost_tpu_slice_emits_indexed_job_and_headless_service():
     )
     with pytest.raises(ValueError, match="batch stages"):
         generate_manifests(spec, store_path="/mnt/store")
+
+
+def test_daily_loop_cronjob_aligned_with_lease_and_sigterm_semantics():
+    """ISSUE 7 satellite: the run-day CronJob carries concurrencyPolicy
+    Forbid (scheduler-level exclusion), backoffLimit (retries resume via
+    the journal, so they're cheap), and a terminationGracePeriodSeconds
+    sized ABOVE the in-process graceful deadline — the SIGTERM unwind
+    (journal 'interrupted' mark + lease release) must finish before the
+    kubelet's SIGKILL. The serve Deployment drains admission inside the
+    same envelope."""
+    from bodywork_tpu.utils.shutdown import DEFAULT_GRACE_S
+
+    docs = generate_manifests(default_pipeline(), store_path="/mnt/store")
+    cron = docs["99-daily-loop-cronjob.yaml"]["spec"]
+    assert cron["concurrencyPolicy"] == "Forbid"
+    job = cron["jobTemplate"]["spec"]
+    assert job["backoffLimit"] >= 1
+    pod = job["template"]["spec"]
+    assert pod["terminationGracePeriodSeconds"] > DEFAULT_GRACE_S
+    dep = next(d for d in docs.values() if d["kind"] == "Deployment")
+    assert (dep["spec"]["template"]["spec"]["terminationGracePeriodSeconds"]
+            > DEFAULT_GRACE_S)
