@@ -24,6 +24,11 @@ from bodywork_tpu.chaos.kill import (
     arm_from_env,
     hit_kill_point,
 )
+from bodywork_tpu.chaos.canary import (
+    CANARY_SCENARIOS,
+    run_canary_chaos,
+    sabotage_checkpoint_nan,
+)
 from bodywork_tpu.chaos.sim import (
     chaos_pipeline_spec,
     compare_stores,
@@ -33,8 +38,11 @@ from bodywork_tpu.chaos.sim import (
 )
 
 __all__ = [
+    "CANARY_SCENARIOS",
     "FaultPlan",
     "InjectedFault",
+    "run_canary_chaos",
+    "sabotage_checkpoint_nan",
     "KillSwitch",
     "SimulatedCrash",
     "activate",

@@ -62,6 +62,7 @@ _PROBABILITY_FIELDS = (
     "corrupt_read_p",
     "http_error_p",
     "http_latency_p",
+    "canary_latency_p",
 )
 
 
@@ -100,6 +101,14 @@ class FaultPlan:
     #: scoring service: sleep http_latency_s before handling
     http_latency_p: float = 0.0
     http_latency_s: float = 0.002
+    #: adversity addressed to the CANARY stream only: scoring requests
+    #: that routed to the live canary sleep canary_latency_s before
+    #: dispatch (production-routed requests never consult this) — the
+    #: fault the SLO watchdog's p99-latency-ratio breach exists to
+    #: catch. Per-canary-model-key decision streams, so a seeded run
+    #: replays identical adversity regardless of interleaving.
+    canary_latency_p: float = 0.0
+    canary_latency_s: float = 0.05
     #: max consecutive faults per (kind, stream) before a forced success;
     #: 0 = unlimited (lets tests hold a backend down to open the breaker)
     max_consecutive: int = 2
@@ -288,6 +297,22 @@ class FaultPlan:
     def http_latency(self, path: str) -> None:
         if self.http_latency_delay(path) is not None:
             time.sleep(self.http_latency_s)
+
+    def canary_latency_delay(self, model_key: str) -> float | None:
+        """Canary-stream latency injection: the delay in seconds to
+        apply before a canary-routed dispatch, or None. Decide-only so
+        the asyncio engine can ``await`` it (the threaded engine sleeps
+        via :meth:`canary_latency`); same draw stream either way, so one
+        seed replays identically on both engines."""
+        if self._decide(
+            "canary_latency", f"canary|{model_key}", self.canary_latency_p
+        ):
+            return self.canary_latency_s
+        return None
+
+    def canary_latency(self, model_key: str) -> None:
+        if self.canary_latency_delay(model_key) is not None:
+            time.sleep(self.canary_latency_s)
 
     def http_error(self, path: str) -> int | None:
         """503, 429, or None — one decision per scoring request."""

@@ -16,7 +16,9 @@ its own driver:
     python -m bodywork_tpu.cli compact   --store DIR [--dry-run]
     python -m bodywork_tpu.cli deploy    --out DIR [--store-path P] [--image I]
     python -m bodywork_tpu.cli chaos run-sim --store DIR --days N [--seed S] [--plan F]
+    python -m bodywork_tpu.cli chaos canary  --store DIR --scenario nan|latency|healthy
     python -m bodywork_tpu.cli registry list|show|promote|rollback|gate --store DIR ...
+    python -m bodywork_tpu.cli registry canary start|stop|promote|status --store DIR ...
     python -m bodywork_tpu.cli traffic run --url URL [--rate R] [--duration S] ...
 
 Every command exits 0 on success and 1 with a logged error otherwise — the
@@ -799,6 +801,44 @@ def _chaos_crash_sim(args, plan) -> int:
     return 1
 
 
+def cmd_chaos_canary(args) -> int:
+    """Canary release-safety acceptance (docs/RESILIENCE.md §canary):
+    run one seeded sabotage scenario — NaN-weight canary checkpoint,
+    chaos latency addressed to the canary stream, or a healthy canary —
+    against a fresh store and require the SLO watchdog to auto-abort
+    (exactly one alias CAS, zero insane responses serialized, production
+    byte-identical to a canary-free twin) or auto-promote. Exit 0 on a
+    verified PASS, 1 otherwise."""
+    from bodywork_tpu.chaos import run_canary_chaos
+    from bodywork_tpu.store import open_store
+
+    # stdout carries exactly ONE JSON document (the acceptance summary)
+    # so the command composes with jq/scripts; logs go to stderr, as
+    # `traffic run` and bench.py
+    configure_logger(stream=sys.stderr)
+    if args.store.startswith("gs://"):
+        log.error(
+            "chaos canary needs a fresh local store for the twin "
+            "comparison; point --store at a directory, not gs://"
+        )
+        return 1
+    summary = run_canary_chaos(
+        open_store(args.store),
+        scenario=args.scenario,
+        seed=args.seed,
+        n_requests=args.requests,
+        fraction=args.fraction,
+        samples_per_day=args.samples_per_day or 96,
+    )
+    import json as _json
+
+    print(_json.dumps(summary, indent=2, sort_keys=True))
+    if summary["ok"]:
+        return 0
+    log.error(f"canary chaos scenario {args.scenario!r} FAILED")
+    return 1
+
+
 #: alias names `registry show` resolves (anything else must look like a
 #: model key or a date, or the command exits 1 with a clear message)
 _REGISTRY_ALIASES = ("production", "previous")
@@ -927,6 +967,71 @@ def cmd_registry_gate(args) -> int:
     for check in decision.checks:
         print(f"  [{'ok' if check['ok'] else 'FAIL'}] "
               f"{check['name']}: {check['detail']}")
+    return 0
+
+
+def _fraction(raw: str) -> float:
+    """argparse type for --fraction: a probability in (0, 1] — 0 would
+    start a canary no request ever routes to (the watchdog would wait
+    forever) and >1 is a typo; reject both as usage errors (exit 2)."""
+    try:
+        value = float(raw)
+    except ValueError:
+        raise argparse.ArgumentTypeError(f"must be a number, got {raw!r}")
+    if not 0.0 < value <= 1.0:
+        raise argparse.ArgumentTypeError(f"must be in (0, 1], got {raw}")
+    return value
+
+
+def cmd_registry_canary(args) -> int:
+    """The canary lifecycle (docs/REGISTRY.md §canary): every action is
+    one alias-document CAS applied through the ModelRegistry — the
+    action names are pinned against registry.CANARY_ACTIONS and the
+    manager API by a guard test."""
+    import json as _json
+
+    from bodywork_tpu.registry import ModelRegistry
+
+    registry = ModelRegistry(_store(args))
+    action = args.canary_command
+    if action == "start":
+        key = _registry_model_key(args.model) if args.model else None
+        if key is None:
+            candidate = registry.newest_candidate()
+            if candidate is None:
+                log.error("no candidate record to canary; train or pass "
+                          "--model")
+                return 1
+            key = candidate["model_key"]
+        doc = registry.canary_start(
+            key, fraction=args.fraction, seed=args.seed, day=_date(args)
+        )
+        print(
+            f"canary -> {doc['canary']} at fraction "
+            f"{doc['canary_fraction']} (seed {doc['canary_seed']}, "
+            f"production {doc['production']})"
+        )
+        return 0
+    if action == "stop":
+        doc = registry.canary_abort(
+            day=_date(args), reason="cli: operator stop"
+        )
+        if doc is None:
+            log.error("no live canary to stop")
+            return 1
+        print(f"canary stopped (production stays {doc['production']})")
+        return 0
+    if action == "promote":
+        doc = registry.canary_promote(
+            day=_date(args), reason="cli: operator promote"
+        )
+        print(
+            f"production -> {doc['production']} "
+            f"(previous: {doc['previous']})"
+        )
+        return 0
+    # status
+    print(_json.dumps(registry.canary_status(), indent=2, sort_keys=True))
     return 0
 
 
@@ -1292,6 +1397,43 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model", default="linear", choices=["linear", "mlp"])
     p.add_argument("--mode", default="batch", choices=["single", "batch"])
 
+    p = chaos_sub.add_parser(
+        "canary",
+        help="canary release-safety acceptance: a sabotaged canary "
+             "(NaN weights / injected latency) must auto-abort via one "
+             "CAS with production byte-identical to a canary-free twin; "
+             "a healthy one must auto-promote (docs/RESILIENCE.md)",
+    )
+    p.set_defaults(fn=cmd_chaos_canary)
+    p.add_argument("--store", required=True,
+                   help="fresh local directory (gs:// refused — the "
+                        "twin comparison is byte-level)")
+    p.add_argument(
+        # choices hardcoded to keep parser construction import-light;
+        # pinned == chaos.CANARY_SCENARIOS by tests/test_canary.py
+        "--scenario", default="nan",
+        choices=["nan", "latency", "healthy"],
+        help="sabotage mode: 'nan' (NaN-weight canary checkpoint), "
+             "'latency' (chaos latency addressed to the canary stream), "
+             "or 'healthy' (no sabotage; must auto-promote)",
+    )
+    p.add_argument("--seed", type=int, default=0,
+                   help="drives the request stream, the routing hash, "
+                        "and the fault plan — same seed, same verdict "
+                        "at the same request")
+    p.add_argument("--requests", type=_positive_int, default=240,
+                   metavar="N",
+                   help="seeded single-row scoring requests to drive "
+                        "(default 240)")
+    p.add_argument("--fraction", type=_fraction, default=0.35,
+                   metavar="F",
+                   help="canary traffic fraction in (0, 1] (default 0.35)")
+    p.add_argument("--samples-per-day", type=_positive_int, default=None,
+                   metavar="N",
+                   help="rows/day for the two seeded training days "
+                        "(default 96 — small; the scenario tests the "
+                        "release loop, not the fit)")
+
     p = sub.add_parser(
         "registry",
         help="model registry: gated promotion, shadow eval, rollback "
@@ -1358,6 +1500,62 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also shadow-evaluate the candidate against "
                         "production over the last K dataset days "
                         "(in-process, no live traffic; default off)")
+
+    p = registry_sub.add_parser(
+        "canary",
+        help="live canary lifecycle: route a seeded traffic fraction to "
+             "a candidate, watched by the SLO watchdog — auto-abort on "
+             "breach, auto-promote when healthy (docs/REGISTRY.md)",
+    )
+    canary_sub = p.add_subparsers(dest="canary_command", required=True)
+    # action names pinned == registry.CANARY_ACTIONS == the manager API
+    # == docs/REGISTRY.md by tests/test_canary.py (hardcoded here to keep
+    # parser construction import-light)
+    p = canary_sub.add_parser(
+        "start",
+        help="point the canary slot at a candidate (one CAS); serving "
+             "routes --fraction of /score traffic to it on its next poll",
+    )
+    p.set_defaults(fn=cmd_registry_canary)
+    p.add_argument("--store", **common_store)
+    p.add_argument("--model", default=None,
+                   help="model key or date to canary (default: newest "
+                        "record in candidate status)")
+    p.add_argument("--fraction", type=_fraction, default=0.1, metavar="F",
+                   help="fraction of scoring traffic routed to the "
+                        "canary, in (0, 1] (default 0.1)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="routing-hash seed: (seed, request bytes) "
+                        "deterministically pick the stream, so replays "
+                        "and replicas route identically")
+    p.add_argument("--date", default=None,
+                   help="day to stamp the canary events with (YYYY-MM-DD)")
+    p = canary_sub.add_parser(
+        "stop",
+        help="abort the live canary (one CAS; record -> rejected) — the "
+             "manual form of the watchdog's breach action",
+    )
+    p.set_defaults(fn=cmd_registry_canary)
+    p.add_argument("--store", **common_store)
+    p.add_argument("--date", default=None,
+                   help="day to stamp the abort events with (YYYY-MM-DD)")
+    p = canary_sub.add_parser(
+        "promote",
+        help="graduate the live canary to production (one CAS: "
+             "production=canary, old production -> previous, slot "
+             "cleared) — the manual form of the watchdog's healthy-"
+             "window action",
+    )
+    p.set_defaults(fn=cmd_registry_canary)
+    p.add_argument("--store", **common_store)
+    p.add_argument("--date", default=None,
+                   help="day to stamp the promotion events with "
+                        "(YYYY-MM-DD)")
+    p = canary_sub.add_parser(
+        "status", help="the canary slot, serveability, and record status"
+    )
+    p.set_defaults(fn=cmd_registry_canary)
+    p.add_argument("--store", **common_store)
 
     p = sub.add_parser(
         "traffic",

@@ -162,6 +162,27 @@ def resolve_serving_key(store: ArtefactStore) -> tuple[str, str]:
     )
 
 
+def resolve_serving_state(store: ArtefactStore):
+    """:func:`resolve_serving_key` plus the canary slot, with ONE alias
+    read: ``(production_key, source, canary_state, canary_dangling)``.
+
+    ``canary_state`` (``{"key", "fraction", "seed", "day", "bounds"}``)
+    is set when the alias document names a live, serveable canary;
+    ``canary_dangling`` carries the reason when the slot is set but
+    must be ignored — a stale canary pointing at a deleted checkpoint
+    or a rejected record (a crashed watchdog's debris) falls back to
+    production-only serving instead of wedging boot. The reload
+    watcher repairs such a slot; this resolver only reports it."""
+    from bodywork_tpu.registry.records import read_aliases, resolve_canary
+
+    doc = read_aliases(store)  # RegistryCorrupt propagates, as resolve_alias
+    if doc is None or not doc.get("production"):
+        key, source = resolve_serving_key(store)
+        return key, source, None, None
+    canary_state, dangling = resolve_canary(store, doc)
+    return doc["production"], "production", canary_state, dangling
+
+
 def load_model(store: ArtefactStore, key: str | None = None, device: bool = True):
     """Load a model by key; with ``key=None``, resolve the registry's
     ``production`` alias when one exists and fall back to the latest

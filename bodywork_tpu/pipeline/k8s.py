@@ -233,6 +233,15 @@ def _container(
             ("BODYWORK_TPU_SERVER_ENGINE", "thread"),
             ("BODYWORK_TPU_MAX_PENDING", ""),
             ("BODYWORK_TPU_RETRY_AFTER_MAX_S", ""),
+            # SLO-watchdog breach thresholds (ops/slo.py policy_from_env;
+            # empty = the coded defaults): retune the canary abort
+            # budget with `kubectl set env`, no rebuild/redeploy
+            ("BODYWORK_TPU_SLO_WINDOW_REQUESTS", ""),
+            ("BODYWORK_TPU_SLO_MIN_REQUESTS", ""),
+            ("BODYWORK_TPU_SLO_MAX_ERROR_RATE", ""),
+            ("BODYWORK_TPU_SLO_MAX_P99_RATIO", ""),
+            ("BODYWORK_TPU_SLO_MAX_SANITY_VIOLATIONS", ""),
+            ("BODYWORK_TPU_SLO_PROMOTE_AFTER_REQUESTS", ""),
         ):
             if name not in declared:
                 env.append({"name": name, "value": value})

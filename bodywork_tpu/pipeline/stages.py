@@ -351,6 +351,9 @@ def serve_stage(
     # syncs when something new was dispatched — so the persistent day-loop
     # pays the error-surfacing sync exactly once (day 1), one-shot pods
     # always (device faults fail startup, not requests)
+    from bodywork_tpu.serve.server import _registry_bounds
+
+    model_bounds = _registry_bounds(ctx.store, served_key)
     apps = [
         create_app(
             model,
@@ -362,6 +365,7 @@ def serve_stage(
             # ONE controller shared across replica apps: they share the
             # listen port, so they share the backpressure boundary
             admission=admission,
+            model_bounds=model_bounds,
         )
         for _ in range(max(replicas, 1))
     ]
@@ -378,9 +382,13 @@ def serve_stage(
     if watch_interval_s:
         # hot reload (beyond-parity): the deployed service lives across
         # days, swapping in each retrain's checkpoint instead of being
-        # re-rolled per day like the reference's stage 2
+        # re-rolled per day like the reference's stage 2. The SLO
+        # watchdog rides the same loop, closing the canary release loop
+        # (ops/slo.py; breach thresholds from the pod env knobs).
+        from bodywork_tpu.ops.slo import SloWatchdog, policy_from_env
         from bodywork_tpu.serve.reload import CheckpointWatcher
 
+        watchdog = SloWatchdog(ctx.store, apps, policy=policy_from_env())
         watcher = CheckpointWatcher(
             apps, ctx.store, poll_interval_s=watch_interval_s,
             served_key=served_key, engine=engine,
@@ -388,6 +396,7 @@ def serve_stage(
             # swaps (the watcher only re-applies engine default buckets
             # when the caller never narrowed them)
             buckets=tuple(buckets) if buckets else None,
+            slo_watchdog=watchdog,
         )
         watcher.start()
         handle.add_cleanup(watcher.stop)

@@ -3,10 +3,16 @@
 The release-management layer between training and serving (docs/REGISTRY.md):
 training registers candidates, the gate engine promotes or rejects them,
 serving resolves the ``production`` alias, and rollback is one
-compare-and-swap flip back to ``previous``.
+compare-and-swap flip back to ``previous``. The live half of the loop is
+the CANARY slot on the same alias document: ``canary_start`` routes a
+seeded fraction of real traffic to a candidate, the SLO watchdog
+(``bodywork_tpu.ops.slo``) measures it against production, and
+``canary_abort``/``canary_promote`` end the experiment in one CAS each.
 """
 from bodywork_tpu.registry.gates import GateDecision, GatePolicy, evaluate_candidate
 from bodywork_tpu.registry.manager import (
+    CANARY_ACTION_METHODS,
+    CANARY_ACTIONS,
     ModelRegistry,
     PromotionConflict,
     RegistryError,
@@ -17,10 +23,13 @@ from bodywork_tpu.registry.records import (
     register_candidate,
     registry_exists,
     resolve_alias,
+    resolve_canary,
 )
 from bodywork_tpu.registry.shadow import shadow_evaluate
 
 __all__ = [
+    "CANARY_ACTION_METHODS",
+    "CANARY_ACTIONS",
     "GateDecision",
     "GatePolicy",
     "ModelRegistry",
@@ -32,5 +41,6 @@ __all__ = [
     "register_candidate",
     "registry_exists",
     "resolve_alias",
+    "resolve_canary",
     "shadow_evaluate",
 ]

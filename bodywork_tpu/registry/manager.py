@@ -58,6 +58,29 @@ def _count_rollback() -> None:
     ).inc()
 
 
+def _count_canary_event(event: str) -> None:
+    from bodywork_tpu.obs import get_registry
+
+    get_registry().counter(
+        "bodywork_tpu_registry_canary_events_total",
+        "Canary lifecycle transitions (start/abort/promote/repair)",
+    ).inc(event=event)
+
+
+#: the canary lifecycle verbs — ONE list pinned three ways by a guard
+#: test (tests/test_canary.py): ``cli registry canary <action>`` choices,
+#: the :class:`ModelRegistry` methods in :data:`CANARY_ACTION_METHODS`,
+#: and the states documented in docs/REGISTRY.md, so the CLI, the
+#: manager API, and the docs cannot drift apart.
+CANARY_ACTIONS = ("start", "stop", "promote", "status")
+CANARY_ACTION_METHODS = {
+    "start": "canary_start",
+    "stop": "canary_abort",
+    "promote": "canary_promote",
+    "status": "canary_status",
+}
+
+
 class ModelRegistry:
     def __init__(self, store: ArtefactStore, policy: GatePolicy | None = None):
         self.store = store
@@ -150,6 +173,14 @@ class ModelRegistry:
             "rev": (doc.get("rev", 0) + 1) if doc else 1,
             "updated_day": str(day) if day else None,
             "last_op": "promote",
+            # a live canary SURVIVES an ordinary promotion (its baseline
+            # just changed; the watchdog keeps measuring) — unless the
+            # promoted key IS the canary, which graduates the slot
+            **{
+                k: doc[k]
+                for k in (rec.CANARY_DOC_KEYS if doc else ())
+                if k in doc and doc.get("canary") != model_key
+            },
         }
         try:
             rec.write_aliases(self.store, new_doc, token)
@@ -199,6 +230,14 @@ class ModelRegistry:
             "rev": doc.get("rev", 0) + 1,
             "updated_day": str(day) if day else None,
             "last_op": "rollback",
+            # a live canary survives the flip unless the restored
+            # production IS the canary key (the slot would point at the
+            # model now serving 100% anyway)
+            **{
+                k: doc[k]
+                for k in rec.CANARY_DOC_KEYS
+                if k in doc and doc.get("canary") != previous
+            },
         }
         try:
             rec.write_aliases(self.store, new_doc, token)
@@ -245,6 +284,251 @@ class ModelRegistry:
         if record is None:
             raise RegistryError(f"no registry record for {model_key!r}")
         return record
+
+    # -- the canary lifecycle ----------------------------------------------
+    #
+    # A canary is a CAS-mutated slot on the SAME alias document that
+    # already carries production/previous: the serving path routes a
+    # seeded hash-of-request fraction of live traffic to it while the
+    # SLO watchdog (ops/slo.py) measures both streams. Every lifecycle
+    # transition is ONE compare-and-swap of the alias document — a
+    # breaching canary is gone after exactly one CAS, and two concurrent
+    # watchdogs (multi-worker serving) cannot double-apply an abort: the
+    # loser gets a clean PromotionConflict and finds the slot already
+    # cleared on re-read.
+
+    def canary_state(self, doc: dict | None = None) -> dict | None:
+        """The live canary's alias-side state, or None. Unlike
+        :func:`~bodywork_tpu.registry.records.resolve_canary` this does
+        NOT validate serveability — it reports what the slot says.
+        ``doc`` lets a caller that already read the alias document skip
+        a second (possibly torn-across-a-CAS) read."""
+        if doc is None:
+            doc = rec.read_aliases(self.store)
+        if not doc or not doc.get("canary"):
+            return None
+        return {
+            "key": doc.get("canary"),
+            "fraction": doc.get("canary_fraction"),
+            "seed": doc.get("canary_seed"),
+            "day": doc.get("canary_day"),
+        }
+
+    def canary_start(
+        self,
+        model_key: str,
+        fraction: float = 0.1,
+        seed: int = 0,
+        day: date | None = None,
+    ) -> dict:
+        """Open the live release loop: point the ``canary`` slot at a
+        registered candidate so serving routes ``fraction`` of /score
+        traffic to it (deterministically, by seeded request hash —
+        ``serve.app.routes_to_canary``). Refused without a production
+        baseline (nothing to fall back to or compare against), for the
+        production key itself, for a gate-rejected record, and while
+        another canary is live. One alias CAS."""
+        if not 0.0 < fraction <= 1.0:
+            raise RegistryError(
+                f"canary fraction must be in (0, 1], got {fraction!r}"
+            )
+        record = rec.load_record(self.store, model_key)
+        if record is None:
+            raise RegistryError(
+                f"cannot canary unregistered model {model_key!r}; "
+                "register it first"
+            )
+        if record.get("status") == "rejected":
+            raise RegistryError(
+                f"{model_key!r} is gate-rejected; a rejected checkpoint "
+                "must not take live traffic"
+            )
+        doc, token = rec.read_aliases(self.store, with_token=True)
+        if doc is None or not doc.get("production"):
+            raise RegistryError(
+                "no production model; a canary needs a baseline to "
+                "fall back to — promote one first"
+            )
+        if doc.get("production") == model_key:
+            raise RegistryError(f"{model_key!r} already is production")
+        if doc.get("canary"):
+            raise RegistryError(
+                f"a canary is already live ({doc['canary']!r}); stop it "
+                "before starting another"
+            )
+        new_doc = {
+            **{k: v for k, v in doc.items() if k not in rec.CANARY_DOC_KEYS},
+            "rev": doc.get("rev", 0) + 1,
+            "updated_day": str(day) if day else None,
+            "last_op": "canary_start",
+            "canary": model_key,
+            "canary_fraction": float(fraction),
+            "canary_seed": int(seed),
+            "canary_day": str(day) if day else None,
+        }
+        try:
+            rec.write_aliases(self.store, new_doc, token)
+        except CasConflict as exc:
+            raise PromotionConflict(
+                f"canary start of {model_key!r} lost the alias race: {exc}"
+            ) from exc
+        rec.append_event(
+            self.store, model_key,
+            {"event": "canary_started", "day": str(day) if day else None,
+             "fraction": float(fraction), "seed": int(seed)},
+        )
+        _count_canary_event("start")
+        log.info(
+            f"canary started: {model_key} at fraction {fraction} "
+            f"(seed {seed})"
+        )
+        return new_doc
+
+    def _canary_clear(
+        self,
+        last_op: str,
+        event: str,
+        day: date | None,
+        reason: str,
+        record_status: str | None,
+        count_as: str,
+    ) -> dict | None:
+        """The shared canary-ending CAS: clear the slot in ONE alias
+        write, then record the lineage event. Returns the new alias
+        document, or None when no canary was live (idempotent — a
+        concurrent watchdog may have cleared it first)."""
+        doc, token = rec.read_aliases(self.store, with_token=True)
+        if doc is None or not doc.get("canary"):
+            return None
+        canary_key = doc["canary"]
+        new_doc = {
+            **{k: v for k, v in doc.items() if k not in rec.CANARY_DOC_KEYS},
+            "rev": doc.get("rev", 0) + 1,
+            "updated_day": str(day) if day else None,
+            "last_op": last_op,
+        }
+        try:
+            rec.write_aliases(self.store, new_doc, token)
+        except CasConflict as exc:
+            raise PromotionConflict(
+                f"{last_op} of {canary_key!r} lost the alias race: {exc}"
+            ) from exc
+        rec.append_event(
+            self.store, canary_key,
+            {"event": event, "day": str(day) if day else None,
+             "reason": reason},
+            status=record_status,
+        )
+        _count_canary_event(count_as)
+        return new_doc
+
+    def canary_abort(
+        self,
+        day: date | None = None,
+        reason: str = "canary aborted",
+    ) -> dict | None:
+        """Retire the live canary in ONE CAS — the rollback primitive of
+        the live release loop (the SLO watchdog's breach action, also
+        ``cli registry canary stop``). Production never moved, so
+        nothing is restored: the slot clears, 100% of traffic is back
+        on production at the serving layer's next poll (the watchdog
+        clears the in-process routing immediately), and the canary's
+        record moves to ``rejected`` with the abort reason. Returns the
+        new alias document, or None when no canary was live."""
+        doc = self._canary_clear(
+            "canary_abort", "canary_aborted", day, reason,
+            record_status="rejected", count_as="abort",
+        )
+        if doc is not None:
+            log.warning(f"canary ABORTED: {reason}")
+        return doc
+
+    def canary_repair(
+        self,
+        day: date | None = None,
+        reason: str = "dangling canary slot",
+    ) -> dict | None:
+        """Clear a DANGLING canary slot (checkpoint deleted, record
+        rejected — debris a crashed watchdog left). Same single-CAS
+        shape as :meth:`canary_abort`, but the record keeps its status:
+        the repair fixes the alias, it does not adjudicate the model."""
+        doc = self._canary_clear(
+            "canary_repair", "canary_repaired", day, reason,
+            record_status=None, count_as="repair",
+        )
+        if doc is not None:
+            log.warning(f"dangling canary slot repaired: {reason}")
+        return doc
+
+    def canary_promote(
+        self,
+        day: date | None = None,
+        reason: str = "canary: survived SLO window healthy",
+    ) -> dict:
+        """Graduate the live canary to production in ONE CAS: the alias
+        document simultaneously gains ``production = canary key``,
+        demotes the old production to ``previous``, and clears the
+        canary slot — there is no intermediate state where the canary is
+        both slots or neither."""
+        doc, token = rec.read_aliases(self.store, with_token=True)
+        if doc is None or not doc.get("canary"):
+            raise RegistryError("no live canary to promote")
+        canary_key = doc["canary"]
+        old_production = doc.get("production")
+        new_doc = {
+            **{k: v for k, v in doc.items() if k not in rec.CANARY_DOC_KEYS},
+            "production": canary_key,
+            "previous": old_production,
+            "rev": doc.get("rev", 0) + 1,
+            "updated_day": str(day) if day else None,
+            "last_op": "canary_promote",
+        }
+        try:
+            rec.write_aliases(self.store, new_doc, token)
+        except CasConflict as exc:
+            _count_promotion("conflict")
+            raise PromotionConflict(
+                f"canary promotion of {canary_key!r} lost the alias race: "
+                f"{exc}"
+            ) from exc
+        event_day = str(day) if day else None
+        rec.append_event(
+            self.store, canary_key,
+            {"event": "promoted", "day": event_day, "reason": reason,
+             "replaced": old_production},
+            status="production",
+        )
+        if old_production and old_production != canary_key:
+            rec.append_event(
+                self.store, old_production,
+                {"event": "superseded", "day": event_day, "by": canary_key},
+                status="archived",
+            )
+        _count_promotion("promoted")
+        _count_canary_event("promote")
+        log.info(
+            f"canary promoted to production: {canary_key} "
+            f"(previous: {old_production or 'none'})"
+        )
+        return new_doc
+
+    def canary_status(self) -> dict:
+        """The operator-facing canary snapshot (``cli registry canary
+        status``): the alias slot, serveability (dangling or live), and
+        the record's current status."""
+        doc = rec.read_aliases(self.store)  # ONE read feeds every view
+        state, dangling = rec.resolve_canary(self.store, doc)
+        slot = self.canary_state(doc)
+        record = (
+            rec.load_record(self.store, slot["key"]) if slot else None
+        )
+        return {
+            "canary": slot,
+            "live": state is not None,
+            "dangling_reason": dangling,
+            "record_status": record.get("status") if record else None,
+            "production": (doc or {}).get("production"),
+        }
 
     # -- the gate ----------------------------------------------------------
 

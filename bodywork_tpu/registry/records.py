@@ -209,6 +209,7 @@ def register_candidate(
     metrics_key: str | None = None,
     day: date | None = None,
     model_bytes: bytes | None = None,
+    prediction_bounds: dict | None = None,
 ) -> dict:
     """Create (or refresh) the candidate record for a persisted
     checkpoint: lineage (content digest, dataset-day coverage, metrics
@@ -218,7 +219,14 @@ def register_candidate(
     re-register of identical bytes leaves the record byte-stable.
     ``model_bytes`` lets a caller that just wrote the checkpoint skip
     the full-artefact re-download the digest would otherwise cost (one
-    GET per training day on a remote store)."""
+    GET per training day on a remote store).
+
+    ``prediction_bounds`` (``{"lo": float, "hi": float}``, derived from
+    training-label statistics — ``train.trainer._prediction_bounds``)
+    is the serving-side sanity band: the prediction-sanity firewall
+    (``serve.app``) treats outputs outside it as canary violations.
+    Deterministic from the dataset bytes, so the chaos twins' records
+    stay byte-identical."""
     from bodywork_tpu.utils.dates import date_from_key
 
     model_date = date_from_key(model_key)
@@ -247,6 +255,8 @@ def register_candidate(
                 "last": days[-1] if days else None,
                 "count": len(days),
             }
+            if prediction_bounds is not None:
+                record["prediction_bounds"] = prediction_bounds
             if record.get("status") != "production":
                 # a retrained rejected/archived key becomes a candidate
                 # again; PRODUCTION keeps its status — silently flipping
@@ -269,6 +279,8 @@ def register_candidate(
                 "status": "candidate",
                 "history": [],
             }
+            if prediction_bounds is not None:
+                record["prediction_bounds"] = prediction_bounds
         record["history"].append(
             {"event": "registered", "day": str(day) if day else None,
              **({"digest_changed": True} if existing is not None else {})}
@@ -366,3 +378,55 @@ def resolve_alias(store: ArtefactStore, alias: str = "production") -> str | None
     if doc is None:
         return None
     return doc.get(alias)
+
+
+# -- the canary slot -------------------------------------------------------
+
+#: alias-document keys that together describe a live canary; cleared as a
+#: unit by every canary-ending CAS (abort / promote / repair)
+CANARY_DOC_KEYS = ("canary", "canary_fraction", "canary_seed", "canary_day")
+
+
+def resolve_canary(store: ArtefactStore, doc: dict | None = None):
+    """The live canary's serving state from the alias document:
+    ``(state, dangling_reason)``.
+
+    ``state`` is ``{"key", "fraction", "seed", "day", "bounds"}`` when a
+    serveable canary is configured, else None. ``dangling_reason`` is a
+    human-readable reason when the slot IS set but must be ignored — a
+    canary pointing at a deleted checkpoint or a gate-rejected record
+    (the stale slot a crashed watchdog leaves behind). Callers fall
+    back to production-only serving on a dangling slot; the reload
+    watcher additionally repairs it (one CAS + a repair event) so boot
+    is never wedged by release-loop debris. ``doc`` lets a caller that
+    already read the alias document skip the second read."""
+    if doc is None:
+        doc = read_aliases(store)
+    if not doc:
+        return None, None
+    key = doc.get("canary")
+    if not key:
+        return None, None
+    if key == doc.get("production"):
+        return None, f"canary {key!r} already IS production"
+    if not store.exists(key):
+        return None, f"canary checkpoint {key!r} missing from the store"
+    record = load_record(store, key)
+    if record is not None and record.get("status") == "rejected":
+        return None, f"canary {key!r} record is rejected"
+    bounds = (record or {}).get("prediction_bounds")
+    try:
+        fraction = float(doc.get("canary_fraction", 0.1))
+        seed = int(doc.get("canary_seed", 0))
+    except (TypeError, ValueError):
+        return None, f"canary {key!r} has malformed fraction/seed"
+    if not 0.0 < fraction <= 1.0:
+        return None, f"canary {key!r} fraction {fraction!r} outside (0, 1]"
+    state = {
+        "key": key,
+        "fraction": fraction,
+        "seed": seed,
+        "day": doc.get("canary_day"),
+        "bounds": bounds,
+    }
+    return state, None

@@ -61,6 +61,7 @@ from werkzeug.exceptions import MethodNotAllowed, NotFound
 from bodywork_tpu.obs import get_registry
 from bodywork_tpu.serve.admission import count_shed
 from bodywork_tpu.serve.app import (
+    MODEL_KEY_HEADER,
     ScoringApp,
     batch_score_payload,
     parse_features,
@@ -357,9 +358,12 @@ class AioScoringServer:
         return status, delay, plan.http_retry_after_s
 
     async def _score_common(self, app, body, score):
-        """The shared scoring-request shell: admission, parse, no-model
-        503 — then the per-route ``score`` coroutine. (Chaos injection
-        happens upstream in ``_dispatch``, middleware-style.)"""
+        """The shared scoring-request shell: admission, parse, canary
+        routing, no-model 503, per-stream accounting — then the
+        per-route ``score`` coroutine. (Chaos HTTP injection happens
+        upstream in ``_dispatch``, middleware-style; the canary-stream
+        latency injection happens HERE, awaited so the loop never
+        stalls.)"""
         admission = self.admission
         if admission is not None and not admission.try_admit():
             # shed BEFORE parsing: a refused request costs one counter
@@ -388,7 +392,9 @@ class AioScoringServer:
                     "application/json",
                     (),
                 )
-            served = app.served_bundle
+            # canary-aware routing: same seeded hash as the WSGI engine,
+            # so one request routes identically on either front-end
+            served, stream = app.route_stream(X)
             if served is None:
                 return (
                     503,
@@ -398,13 +404,30 @@ class AioScoringServer:
                     "application/json",
                     (("Retry-After", str(app.retry_after_s())),),
                 )
-            return await score(app, served, X)
+            streamed = app.stream_metrics_active()
+            t_stream = time.perf_counter()
+            if streamed:
+                app.count_stream_request(served, stream)
+            delay = app.canary_chaos_delay(stream)
+            if delay is not None:
+                await asyncio.sleep(delay)
+            try:
+                result = await score(app, served, stream, X)
+            except Exception:
+                if streamed:
+                    app.count_stream_error(served, stream)
+                raise
+            if streamed:
+                app.observe_stream_latency(
+                    served, stream, time.perf_counter() - t_stream
+                )
+            return result
         finally:
             if admission is not None:
                 admission.release(time.perf_counter() - t_admit)
 
     async def _score_single(self, app: ScoringApp, body: bytes):
-        async def score(app, served, X):
+        async def score(app, served, stream, X):
             X = np.array(X, ndmin=2)  # scalar -> (1, 1), as the reference
             loop = asyncio.get_running_loop()
             prediction0 = None
@@ -452,17 +475,31 @@ class AioScoringServer:
                 )
                 prediction0 = float(predictions[0])
                 app._m_dispatch.observe(time.perf_counter() - t0)
+            # prediction-sanity firewall: the cheap precheck runs inline
+            # (pure numpy on one float); the fallback dispatch — a device
+            # call — rides the executor so the loop never blocks on it
+            reason = app.sanity_reason(served, prediction0)
+            if reason is not None:
+                served, fallback = await loop.run_in_executor(
+                    self._executor,
+                    app.firewall, served, stream, X, prediction0, reason,
+                )
+                prediction0 = float(np.asarray(fallback).ravel()[0])
             t0 = time.perf_counter()
             payload = json.dumps(
                 single_score_payload(served, prediction0)
             ).encode()
             app._m_serialize.observe(time.perf_counter() - t0)
-            return 200, payload, "application/json", ()
+            extra = (
+                ((MODEL_KEY_HEADER, served.model_key),)
+                if served.model_key else ()
+            )
+            return 200, payload, "application/json", extra
 
         return await self._score_common(app, body, score)
 
     async def _score_batch(self, app: ScoringApp, body: bytes):
-        async def score(app, served, X):
+        async def score(app, served, stream, X):
             if X.ndim == 0:
                 X = X[None]
             loop = asyncio.get_running_loop()
@@ -471,12 +508,22 @@ class AioScoringServer:
                 self._executor, served.predictor.predict, X
             )
             app._m_dispatch.observe(time.perf_counter() - t0)
+            reason = app.sanity_reason(served, predictions)
+            if reason is not None:
+                served, predictions = await loop.run_in_executor(
+                    self._executor,
+                    app.firewall, served, stream, X, predictions, reason,
+                )
             t0 = time.perf_counter()
             payload = json.dumps(
                 batch_score_payload(served, predictions)
             ).encode()
             app._m_serialize.observe(time.perf_counter() - t0)
-            return 200, payload, "application/json", ()
+            extra = (
+                ((MODEL_KEY_HEADER, served.model_key),)
+                if served.model_key else ()
+            )
+            return 200, payload, "application/json", extra
 
         return await self._score_common(app, body, score)
 

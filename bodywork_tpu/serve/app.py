@@ -42,6 +42,7 @@ device call (shared across requests when the coalescer is on).
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import time
 from datetime import date
@@ -57,6 +58,11 @@ from bodywork_tpu.serve.predictor import PaddedPredictor
 from bodywork_tpu.utils.logging import get_logger
 
 log = get_logger("serve.app")
+
+#: every scoring response names the model that ANSWERED it (after any
+#: sanity-firewall fallback) — the attribution channel the traffic
+#: harness's per-model-key report and canary sweeps read
+MODEL_KEY_HEADER = "X-Bodywork-Model-Key"
 
 #: parse/serialize are µs-scale host work — the default latency buckets
 #: would dump them all into the first bucket
@@ -82,6 +88,65 @@ def _json_response(payload: dict, status: int = 200) -> Response:
     return Response(
         json.dumps(payload), status=status, mimetype="application/json"
     )
+
+
+class PredictionSanityError(RuntimeError):
+    """A PRODUCTION prediction failed the sanity firewall (non-finite).
+    There is no healthier model to answer from, so the request fails
+    (500) rather than serialising garbage to the client."""
+
+
+def routes_to_canary(seed: int, fraction: float, X) -> bool:
+    """The canary routing decision for one request: a pure function of
+    ``(seed, request features)`` — no RNG state, no wall clock — so the
+    SAME request routes to the same stream on every replica, every
+    engine, and every replay of a seeded traffic log. The hash's top 64
+    bits are compared against ``fraction`` of the 2^64 space, giving an
+    unbiased fraction over any non-adversarial request distribution."""
+    if fraction >= 1.0:
+        return True
+    if fraction <= 0.0:
+        return False
+    digest = hashlib.sha256(
+        str(int(seed)).encode("ascii")
+        + b"|"
+        + np.ascontiguousarray(np.asarray(X, dtype=np.float32)).tobytes()
+    ).digest()
+    return int.from_bytes(digest[:8], "big") < int(fraction * 2.0**64)
+
+
+def as_bounds(bounds) -> tuple[float, float] | None:
+    """Normalise a registry ``prediction_bounds`` value (``{"lo", "hi"}``
+    dict or ``(lo, hi)`` pair) into a float tuple; malformed/absent ->
+    None (the firewall then only checks finiteness)."""
+    if bounds is None:
+        return None
+    try:
+        if isinstance(bounds, dict):
+            lo, hi = float(bounds["lo"]), float(bounds["hi"])
+        else:
+            lo, hi = float(bounds[0]), float(bounds[1])
+    except (KeyError, IndexError, TypeError, ValueError):
+        return None
+    if not (np.isfinite(lo) and np.isfinite(hi) and lo <= hi):
+        return None
+    return lo, hi
+
+
+def sanity_violation(predictions, bounds: tuple[float, float] | None) -> str | None:
+    """The prediction-sanity firewall's verdict for one response's worth
+    of model output: ``"non_finite"`` (NaN/inf anywhere), ``"out_of_range"``
+    (outside the training-label band recorded in the registry), or None
+    (sane). Runs BEFORE serialization on every scoring path — a
+    violating prediction is never written to a client."""
+    arr = np.asarray(predictions, dtype=np.float64)
+    if not np.all(np.isfinite(arr)):
+        return "non_finite"
+    if bounds is not None:
+        lo, hi = bounds
+        if np.any(arr < lo) or np.any(arr > hi):
+            return "out_of_range"
+    return None
 
 
 def parse_features(payload):
@@ -135,7 +200,10 @@ class _Served:
     didn't say) — surfaced on ``/healthz`` and the served-model info
     gauge so an operator can see WHAT serves and under WHOSE authority."""
 
-    __slots__ = ("predictor", "model_info", "model_date", "model_key", "source")
+    __slots__ = (
+        "predictor", "model_info", "model_date", "model_key", "source",
+        "bounds",
+    )
 
     def __init__(
         self,
@@ -144,12 +212,16 @@ class _Served:
         model_date: str | None,
         model_key: str | None = None,
         source: str | None = None,
+        bounds: tuple[float, float] | None = None,
     ):
         self.predictor = predictor
         self.model_info = model_info
         self.model_date = model_date
         self.model_key = model_key
         self.source = source
+        #: (lo, hi) prediction-sanity band from the registry record's
+        #: training-label statistics; None = finiteness checks only
+        self.bounds = bounds
 
 
 class ScoringApp:
@@ -171,6 +243,7 @@ class ScoringApp:
         model_key: str | None = None,
         model_source: str | None = None,
         admission=None,
+        model_bounds=None,
     ):
         if model is None:
             # degraded boot: no checkpoint exists yet. Scoring answers
@@ -190,10 +263,24 @@ class ScoringApp:
                 predictor, model.info,
                 str(model_date) if model_date else None,
                 model_key=model_key, source=model_source,
+                bounds=as_bounds(model_bounds),
             )
         #: reason the service is degraded (serving last-good after a
         #: failed reload), or None when healthy; surfaced in /healthz
         self._degraded_reason: str | None = None
+        #: the live canary bundle + routing knobs (serve.reload syncs
+        #: them from the registry's alias document). One attribute each:
+        #: a request thread reads them at most once per request, so a
+        #: concurrent abort/promote is an atomic pointer change exactly
+        #: like a production hot swap.
+        self._canary: _Served | None = None
+        self._canary_fraction: float = 0.0
+        self._canary_seed: int = 0
+        #: the SLO watchdog's latest evaluation (ops/slo.py publishes
+        #: it); rides /healthz so probes and the traffic harness can see
+        #: the release loop's state without scraping /metrics
+        self.slo_state: dict | None = None
+        self._plan_getter = None  # lazy chaos-plan resolver (canary latency)
         # opt-in request coalescer (serve.batcher.RequestCoalescer);
         # None = every request dispatches its own padded device call
         self.batcher = batcher
@@ -239,6 +326,30 @@ class ScoringApp:
         self._m_fallbacks = reg.counter(
             "bodywork_tpu_coalescer_fallback_total",
             "Requests degraded to a direct dispatch (coalescer saturated)",
+        )
+        # Per-model-key stream accounting, observed ONLY while a canary
+        # is live (zero hot-path cost otherwise): the SLO watchdog reads
+        # these to compare baseline and canary on comparable traffic.
+        self._m_stream_requests = reg.counter(
+            "bodywork_tpu_serve_model_requests_total",
+            "Scoring requests routed per served model while a canary is "
+            "live, by model_key and stream (production|canary)",
+        )
+        self._m_stream_errors = reg.counter(
+            "bodywork_tpu_serve_model_errors_total",
+            "Scoring requests that errored per served model while a "
+            "canary is live, by model_key and stream",
+        )
+        self._m_stream_latency = reg.histogram(
+            "bodywork_tpu_serve_model_latency_seconds",
+            "Scoring latency per served model while a canary is live, "
+            "by model_key and stream — the SLO watchdog's p99 source",
+        )
+        self._m_sanity = reg.counter(
+            "bodywork_tpu_serve_sanity_violations_total",
+            "Predictions caught by the sanity firewall before "
+            "serialization, by model_key, stream, and reason "
+            "(non_finite|out_of_range)",
         )
         self._g_degraded = reg.gauge(
             "bodywork_tpu_serve_degraded_state",
@@ -335,6 +446,7 @@ class ScoringApp:
         predictor=None,
         model_key: str | None = None,
         model_source: str | None = None,
+        model_bounds=None,
     ) -> None:
         """Atomically replace the served model (hot reload). The caller is
         responsible for warming the new predictor OFF the request path
@@ -352,6 +464,7 @@ class ScoringApp:
         self._served = _Served(
             predictor, model.info, str(model_date) if model_date else None,
             model_key=model_key, source=model_source,
+            bounds=as_bounds(model_bounds),
         )
         self._record_model_version()
         if self.batcher is not None:
@@ -373,6 +486,219 @@ class ScoringApp:
         self._m_swaps.inc()
         self.clear_degraded()
         log.info(f"hot-swapped served model -> {model.info} ({model_date})")
+
+    # -- canary routing + prediction-sanity firewall -----------------------
+
+    @property
+    def canary_key(self) -> str | None:
+        canary = self._canary
+        return None if canary is None else canary.model_key
+
+    @property
+    def canary_fraction(self) -> float:
+        return self._canary_fraction if self._canary is not None else 0.0
+
+    def set_canary(
+        self,
+        model: Regressor,
+        model_date: date | None = None,
+        predictor=None,
+        model_key: str | None = None,
+        fraction: float = 0.1,
+        seed: int = 0,
+        bounds=None,
+    ) -> None:
+        """Install (or replace) the canary bundle: ``fraction`` of
+        scoring traffic routes to it by seeded request hash
+        (:func:`routes_to_canary`), measured under per-model-key labels
+        so the SLO watchdog can compare it against production. The
+        caller (``serve.reload``) warms the predictor first, exactly as
+        for a production hot swap."""
+        if predictor is None:
+            base = self._served
+            predictor = (
+                PaddedPredictor(model, base.predictor.buckets)
+                if base is not None
+                else PaddedPredictor(model)
+            )
+        old = self._canary
+        self._canary_fraction = float(fraction)
+        self._canary_seed = int(seed)
+        self._canary = _Served(
+            predictor, model.info, str(model_date) if model_date else None,
+            model_key=model_key, source="canary", bounds=as_bounds(bounds),
+        )
+        # the canary is a SECOND live version: show it on the info gauge
+        # next to production (the pre-canary blind spot where the gauge
+        # only ever carried one live key)
+        if old is not None and old.model_key and old.model_key != model_key:
+            self._g_model_version.set(
+                0.0, model_key=old.model_key, source="canary"
+            )
+        if model_key:
+            self._g_model_version.set(
+                1.0, model_key=model_key, source="canary"
+            )
+        log.info(
+            f"canary live: {model.info} ({model_key}) at fraction "
+            f"{fraction} (seed {seed})"
+        )
+
+    def clear_canary(self) -> None:
+        """Stop routing to the canary (abort/repair path). Requests that
+        already read the canary bundle finish on it — the same in-flight
+        semantics as a production hot swap."""
+        old = self._canary
+        self._canary = None
+        self._canary_fraction = 0.0
+        if old is not None:
+            if old.model_key:
+                self._g_model_version.set(
+                    0.0, model_key=old.model_key, source="canary"
+                )
+            log.info(f"canary cleared: {old.model_key}")
+
+    def promote_canary_bundle(self) -> None:
+        """Graduate the in-process canary bundle to production (the SLO
+        watchdog's healthy-window action, after its alias CAS landed):
+        the already-loaded, already-warm canary predictor starts taking
+        100% of traffic immediately — no store round-trip, no reload
+        window where the alias and the serving process disagree."""
+        bundle = self._canary
+        if bundle is None:
+            return
+        self.clear_canary()
+        self._served = _Served(
+            bundle.predictor, bundle.model_info, bundle.model_date,
+            model_key=bundle.model_key, source="production",
+            bounds=bundle.bounds,
+        )
+        self._record_model_version()
+        if self.batcher is not None and not self.batcher.drain():
+            log.warning(
+                "canary promotion proceeded before the request coalescer "
+                "fully drained; old-model rows may still be in flight"
+            )
+        self._m_swaps.inc()
+        self.clear_degraded()
+        log.info(
+            f"canary promoted in-process -> {bundle.model_info} "
+            f"({bundle.model_key})"
+        )
+
+    def route_stream(self, X):
+        """The (bundle, stream) a request's features route to:
+        ``("production"|"canary")``. One read of each pointer — stable
+        across concurrent swaps/aborts."""
+        served = self._served
+        canary = self._canary
+        if canary is None or served is None:
+            return served, "production"
+        if routes_to_canary(self._canary_seed, self._canary_fraction, X):
+            return canary, "canary"
+        return served, "production"
+
+    def stream_metrics_active(self) -> bool:
+        """Whether per-model-key stream accounting is on (a canary is
+        live) — the check both engines make before paying labelled
+        metric writes on the hot path."""
+        return self._canary is not None
+
+    def count_stream_request(self, served, stream: str) -> None:
+        self._m_stream_requests.inc(
+            model_key=served.model_key or "unknown", stream=stream
+        )
+
+    def count_stream_error(self, served, stream: str) -> None:
+        self._m_stream_errors.inc(
+            model_key=served.model_key or "unknown", stream=stream
+        )
+
+    def observe_stream_latency(self, served, stream: str, seconds: float) -> None:
+        self._m_stream_latency.observe(
+            seconds, model_key=served.model_key or "unknown", stream=stream
+        )
+
+    def sanity_reason(self, served, predictions) -> str | None:
+        """Cheap precheck (pure numpy) both engines run on every scored
+        prediction; the expensive fallback path only runs when this is
+        non-None."""
+        return sanity_violation(predictions, served.bounds)
+
+    def count_sanity_violation(self, served, stream: str, reason: str) -> None:
+        self._m_sanity.inc(
+            model_key=served.model_key or "unknown",
+            stream=stream,
+            reason=reason,
+        )
+
+    def firewall(self, served, stream: str, X, predictions, reason: str):
+        """Apply the prediction-sanity firewall AFTER a violation was
+        detected: a canary violation is answered from the PRODUCTION
+        model (counted — the violation is the watchdog's abort signal —
+        but the client gets a sane prediction from the baseline, and the
+        violating value is never serialized); a production non-finite
+        raises :class:`PredictionSanityError` (500 — there is no
+        healthier model to answer from); a production out-of-range is
+        counted and served (the band is statistical; refusing real
+        production traffic on it would turn a drifted day into an
+        outage). Returns ``(answering_bundle, predictions)``."""
+        self.count_sanity_violation(served, stream, reason)
+        if stream == "canary":
+            production = self._served
+            log.warning(
+                f"canary prediction sanity violation ({reason}) on "
+                f"{served.model_key}; answering from production"
+            )
+            t0 = time.perf_counter()
+            # X arrives exactly as the route handed it to the canary's
+            # predictor (2-D for single, 1-D or 2-D for batch) — the
+            # predictor's own shape normalisation applies, so fallback
+            # predictions are byte-identical to a production-routed call
+            fallback = production.predictor.predict(X)
+            self._m_dispatch.observe(time.perf_counter() - t0)
+            if sanity_violation(fallback, None) is not None:
+                # production's answer is itself non-finite: nothing sane
+                # to serialize — the zero-garbage guarantee holds by 500
+                self.count_sanity_violation(production, "production", "non_finite")
+                raise PredictionSanityError("non_finite")
+            return production, fallback
+        if reason == "non_finite":
+            log.error(
+                f"production prediction non-finite on {served.model_key}; "
+                "refusing to serialize"
+            )
+            raise PredictionSanityError(reason)
+        log.warning(
+            f"production prediction out of sanity band on "
+            f"{served.model_key} (served anyway; band is statistical)"
+        )
+        return served, predictions
+
+    def canary_chaos_delay(self, stream: str) -> float | None:
+        """The active fault plan's canary-stream latency injection
+        (``FaultPlan.canary_latency_delay``), or None. Decide-only so
+        the asyncio engine can ``await`` it; the threaded engine sleeps
+        via :meth:`apply_canary_chaos`. Adversity addressed to the
+        canary stream ONLY — production requests never consult it."""
+        if stream != "canary":
+            return None
+        if self._plan_getter is None:
+            from bodywork_tpu.chaos.plan import get_active_plan
+
+            self._plan_getter = get_active_plan
+        plan = self._plan_getter()
+        if plan is None:
+            return None
+        canary = self._canary
+        return plan.canary_latency_delay(
+            canary.model_key if canary is not None else "unknown"
+        )
+
+    def apply_canary_chaos(self, stream: str) -> None:
+        delay = self.canary_chaos_delay(stream)
+        if delay is not None:
+            time.sleep(delay)
 
     def close(self) -> None:
         """Release app-owned background resources (the coalescer's
@@ -487,29 +813,63 @@ class ScoringApp:
             # even from a model-less server (a 503 would make clients
             # burn their whole Retry-After budget on it)
             return err
-        served = self._served  # one read: stable across a hot swap
+        # canary-aware routing: one pointer read each — a request scores
+        # entirely against the bundle it routed to, across swaps/aborts
+        served, stream = self.route_stream(X)
         if served is None:
             return self._no_model_response()
+        routed = served  # metrics stay attributed to the ROUTED bundle
+        streamed = self.stream_metrics_active()
+        t_stream = time.perf_counter()
+        if streamed:
+            self.count_stream_request(routed, stream)
         X = np.array(X, ndmin=2)  # scalar -> (1, 1), as the reference
-        prediction0 = None
-        if self.batcher is not None and X.shape[0] == 1:
-            try:
-                # the submission carries ITS served bundle: the batch it
-                # lands in is built from one model generation only, and
-                # the response pairs that generation's prediction with
-                # that generation's identity fields below. Queue-wait and
-                # device-dispatch phases are recorded by the coalescer.
-                prediction0 = self.batcher.submit(served, X[0])
-            except CoalescerSaturated:
-                # overload/shutdown: degrade to a direct dispatch
-                self._m_fallbacks.inc()
-        if prediction0 is None:
-            t0 = time.perf_counter()
-            prediction0 = float(served.predictor.predict(X)[0])
-            self._m_dispatch.observe(time.perf_counter() - t0)
+        try:
+            self.apply_canary_chaos(stream)
+            prediction0 = None
+            if self.batcher is not None and X.shape[0] == 1:
+                try:
+                    # the submission carries ITS served bundle: the batch
+                    # it lands in is built from one model generation only
+                    # (canary rows batch with canary rows), and the
+                    # response pairs that generation's prediction with
+                    # that generation's identity fields below. Queue-wait
+                    # and device-dispatch phases are recorded by the
+                    # coalescer.
+                    prediction0 = self.batcher.submit(served, X[0])
+                except CoalescerSaturated:
+                    # overload/shutdown: degrade to a direct dispatch
+                    self._m_fallbacks.inc()
+            if prediction0 is None:
+                t0 = time.perf_counter()
+                prediction0 = float(served.predictor.predict(X)[0])
+                self._m_dispatch.observe(time.perf_counter() - t0)
+            # the prediction-sanity firewall: BEFORE serialization, on
+            # every path (coalesced included) — a violating value never
+            # reaches a client
+            reason = self.sanity_reason(served, prediction0)
+            if reason is not None:
+                served, fallback = self.firewall(
+                    served, stream, X, prediction0, reason
+                )
+                prediction0 = float(np.asarray(fallback).ravel()[0])
+        except Exception:
+            if streamed:
+                self.count_stream_error(routed, stream)
+            raise
         t0 = time.perf_counter()
         response = _json_response(single_score_payload(served, prediction0))
         self._m_serialize.observe(time.perf_counter() - t0)
+        if served.model_key:
+            # the ANSWERING model (post-fallback) — what the traffic
+            # harness attributes the response to
+            response.headers[MODEL_KEY_HEADER] = served.model_key
+        if streamed:
+            # latency stays on the routed stream: a fallen-back canary
+            # request still COST its caller the canary's time
+            self.observe_stream_latency(
+                routed, stream, time.perf_counter() - t_stream
+            )
         return response
 
     def score_batch(self, request: Request) -> Response:
@@ -517,17 +877,39 @@ class ScoringApp:
         X, err = self._features_from(request)
         if err is not None:
             return err  # 400 before 503: see score_data_instance
-        served = self._served  # one read: stable across a hot swap
+        served, stream = self.route_stream(X)  # whole batch, one stream
         if served is None:
             return self._no_model_response()
+        routed = served
+        streamed = self.stream_metrics_active()
+        t_stream = time.perf_counter()
+        if streamed:
+            self.count_stream_request(routed, stream)
         if X.ndim == 0:
             X = X[None]
-        t0 = time.perf_counter()
-        predictions = served.predictor.predict(X)
-        self._m_dispatch.observe(time.perf_counter() - t0)
+        try:
+            self.apply_canary_chaos(stream)
+            t0 = time.perf_counter()
+            predictions = served.predictor.predict(X)
+            self._m_dispatch.observe(time.perf_counter() - t0)
+            reason = self.sanity_reason(served, predictions)
+            if reason is not None:
+                served, predictions = self.firewall(
+                    served, stream, X, predictions, reason
+                )
+        except Exception:
+            if streamed:
+                self.count_stream_error(routed, stream)
+            raise
         t0 = time.perf_counter()
         response = _json_response(batch_score_payload(served, predictions))
         self._m_serialize.observe(time.perf_counter() - t0)
+        if served.model_key:
+            response.headers[MODEL_KEY_HEADER] = served.model_key
+        if streamed:
+            self.observe_stream_latency(
+                routed, stream, time.perf_counter() - t_stream
+            )
         return response
 
     def healthz_payload(self) -> tuple[dict, int, int | None]:
@@ -547,6 +929,7 @@ class ScoringApp:
                 self.batcher.pending_depth() if self.batcher is not None else 0
             )
             admission_state = None
+        canary = self._canary
         if served is None:
             return (
                 {
@@ -557,6 +940,16 @@ class ScoringApp:
                     "model_date": None,
                     "model_key": None,
                     "model_source": None,
+                    # a degraded boot can still hold a live canary (the
+                    # watcher loads it independently of production) —
+                    # probes must see the release loop's real state
+                    "canary_key": (
+                        canary.model_key if canary is not None else None
+                    ),
+                    "canary_fraction": (
+                        self._canary_fraction if canary is not None else None
+                    ),
+                    "watchdog": self.slo_state,
                     "queue_depth": queue_depth,
                     "admission": admission_state,
                 },
@@ -578,6 +971,15 @@ class ScoringApp:
             # carries the degraded flag + reason below.
             "model_key": served.model_key,
             "model_source": served.source,
+            # the live-release channel: WHICH canary takes a fraction of
+            # traffic (None = no canary) and the SLO watchdog's latest
+            # verdict — so probes and the traffic harness attribute
+            # per-version behaviour without scraping /metrics
+            "canary_key": canary.model_key if canary is not None else None,
+            "canary_fraction": (
+                self._canary_fraction if canary is not None else None
+            ),
+            "watchdog": self.slo_state,
             "degraded": reason is not None,
             # saturation channel (serve.admission): current depth plus —
             # when admission is on — budget, shedding state, and the
@@ -625,6 +1027,7 @@ def create_app(
     model_key: str | None = None,
     model_source: str | None = None,
     admission=None,
+    model_bounds=None,
 ) -> ScoringApp:
     """``batch_window_ms`` > 0 opts into cross-request micro-batching
     (``serve.batcher``): concurrent single-row ``/score/v1`` requests
@@ -651,7 +1054,7 @@ def create_app(
     app = ScoringApp(model, model_date, buckets, predictor=predictor,
                      batcher=batcher, metrics_dir=metrics_dir,
                      model_key=model_key, model_source=model_source,
-                     admission=admission)
+                     admission=admission, model_bounds=model_bounds)
     if warmup and app.predictor is not None:
         app.predictor.warmup(sync=warmup_sync)
     return app

@@ -115,7 +115,11 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
 
     from bodywork_tpu.models.checkpoint import load_model, resolve_serving_key
     from bodywork_tpu.serve.app import create_app
-    from bodywork_tpu.serve.server import build_admission, build_predictor
+    from bodywork_tpu.serve.server import (
+        _registry_bounds,
+        build_admission,
+        build_predictor,
+    )
     from bodywork_tpu.store import open_store
 
     store = open_store(store_path)
@@ -139,7 +143,8 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
                      batch_max_rows=batch_max_rows,
                      metrics_dir=metrics_dir,
                      model_key=served_key, model_source=served_source,
-                     admission=admission)
+                     admission=admission,
+                     model_bounds=_registry_bounds(store, served_key))
     flusher = None
     if metrics_dir is not None:
         # each replica flushes its registry snapshot to the shared dir;
@@ -173,12 +178,19 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
     signal.signal(signal.SIGTERM, lambda signum, frame: sys.exit(0))
     watcher = None
     if watch_interval_s:
+        from bodywork_tpu.ops.slo import SloWatchdog, policy_from_env
         from bodywork_tpu.serve.reload import CheckpointWatcher
 
-        # each replica polls independently, like each k8s pod would
+        # each replica polls independently, like each k8s pod would —
+        # including its own SLO watchdog over the shared canary slot:
+        # the first breach CAS wins and the other replicas' watchdogs
+        # find the slot already cleared (clean PromotionConflict), so an
+        # abort can never double-apply
         watcher = CheckpointWatcher(
             app, store, poll_interval_s=watch_interval_s,
             engine=engine, served_key=served_key, buckets=buckets,
+            slo_watchdog=SloWatchdog(store, [app],
+                                     policy=policy_from_env()),
         ).start()
     try:
         if aio_handle is not None:

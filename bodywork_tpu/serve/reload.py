@@ -20,7 +20,11 @@ from __future__ import annotations
 
 import threading
 
-from bodywork_tpu.models.checkpoint import load_model, resolve_serving_key
+from bodywork_tpu.models.checkpoint import (
+    load_model,
+    resolve_serving_key,
+    resolve_serving_state,
+)
 from bodywork_tpu.store.base import ArtefactNotFound, ArtefactStore
 from bodywork_tpu.store.schema import MODELS_PREFIX
 from bodywork_tpu.utils.logging import get_logger
@@ -50,6 +54,7 @@ class CheckpointWatcher:
         engine: str = "xla",
         served_key: str | None = None,
         buckets: tuple[int, ...] | None = None,
+        slo_watchdog=None,
     ):
         # one watcher drives every replica app: replicas share read-only
         # model state by design, so one load+warm serves them all
@@ -90,6 +95,12 @@ class CheckpointWatcher:
         # resolution failure — a healed resolution that needs no swap must
         # clear exactly that flag (a swap clears it via swap_model anyway)
         self._resolve_degraded = False
+        #: the canary the apps currently serve: (key, token, fraction,
+        #: seed) — compared against the alias document's slot each poll
+        self._current_canary: tuple | None = None
+        #: optional SLO watchdog (ops/slo.py), driven once per poll —
+        #: the loop that makes canary abort/promote automatic
+        self.slo_watchdog = slo_watchdog
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="checkpoint-watcher", daemon=True
@@ -108,8 +119,11 @@ class CheckpointWatcher:
         and retries on the next poll (a half-written checkpoint must
         never take the service down)."""
         try:
-            key, source = resolve_serving_key(self.store)
+            key, source, canary_state, canary_dangling = (
+                resolve_serving_state(self.store)
+            )
         except ArtefactNotFound:
+            self._poll_watchdog()
             return False
         except Exception as exc:
             # e.g. registry.records.RegistryCorrupt: falling back to
@@ -131,73 +145,170 @@ class CheckpointWatcher:
             self._resolve_degraded = False
             for app in self.apps:
                 app.clear_degraded()
+        swapped = False
         candidate = (key, self.store.version_token(key))
-        if candidate == self._current:
-            return False
-        try:
-            model, model_date = load_model(self.store, key)
-            from bodywork_tpu.serve.server import build_predictor, resolve_engine
-
-            # Bucket policy for the swapped-in predictor, in priority order:
-            # 1. the caller's explicit list (a reload must not widen the
-            #    compiled-shape set the spec narrowed);
-            # 2. same resolved engine as currently served -> keep the
-            #    current bucket set (shape-set stability across swaps);
-            # 3. engine CHANGED across the swap (engine='auto' resolving
-            #    differently for the new checkpoint, e.g. narrow->wide MLP
-            #    flipping xla->pallas) -> let the new engine apply its own
-            #    default policy. Inheriting the old engine's buckets here
-            #    would e.g. hand the Pallas kernel sub-ROW_TILE buckets
-            #    that all pad to the same program — several duplicate
-            #    compiles per warmup for nothing.
-            current = self.apps[0].predictor  # None on a degraded boot
-            old_resolved = (
-                resolve_engine(self.engine, current.model, self.mesh_data)
-                if current is not None
-                else None  # nothing served yet: nothing to inherit
-            )
-            new_resolved = resolve_engine(self.engine, model, self.mesh_data)
-            if self.buckets is not None:
-                swap_buckets = self.buckets
-            elif current is not None and new_resolved == old_resolved:
-                swap_buckets = current.buckets
-            else:
-                swap_buckets = None
-            predictor = build_predictor(
-                model, self.mesh_data, new_resolved, buckets=swap_buckets,
-            )
-            if predictor is None:
-                # plain xla engine with no bucket narrowing: the app-level
-                # default predictor (its own default bucket policy)
-                from bodywork_tpu.serve.predictor import PaddedPredictor
-
-                predictor = PaddedPredictor(model)
-            # warm every bucket BEFORE the swap: the first request after
-            # reload must not pay the new model's compiles
-            predictor.warmup()
-        except Exception as exc:
-            log.error(f"hot reload of {key} failed (will retry): {exc!r}")
-            # keep serving the last-good model, but SAY so: the degraded
-            # flag rides /healthz + bodywork_tpu_serve_degraded_state
-            # until a later poll swaps successfully (swap_model clears it)
+        if candidate != self._current:
+            try:
+                model, model_date = load_model(self.store, key)
+                predictor = self._build_swap_predictor(model)
+            except Exception as exc:
+                log.error(f"hot reload of {key} failed (will retry): {exc!r}")
+                # keep serving the last-good model, but SAY so: the
+                # degraded flag rides /healthz +
+                # bodywork_tpu_serve_degraded_state until a later poll
+                # swaps successfully (swap_model clears it)
+                for app in self.apps:
+                    app.set_degraded(
+                        f"hot reload of {key} failed; serving last-good model"
+                    )
+                self._sync_canary(canary_state, canary_dangling)
+                self._poll_watchdog()
+                return False
+            # swap_model is an atomic bundle swap; for apps with a request
+            # coalescer it ALSO drains the batch queue before returning.
+            # Mid-flight batched traffic stays consistent either way:
+            # every coalesced submission carries the served bundle it was
+            # enqueued against, and a batch only ever groups one bundle's
+            # submissions (serve.batcher._take_batch_locked) — a swap
+            # landing mid-queue splits old-model and new-model rows into
+            # separate device calls, never one mixed batch.
+            bounds = self._record_bounds(key)
             for app in self.apps:
-                app.set_degraded(
-                    f"hot reload of {key} failed; serving last-good model"
-                )
-            return False
-        # swap_model is an atomic bundle swap; for apps with a request
-        # coalescer it ALSO drains the batch queue before returning.
-        # Mid-flight batched traffic stays consistent either way: every
-        # coalesced submission carries the served bundle it was enqueued
-        # against, and a batch only ever groups one bundle's submissions
-        # (serve.batcher._take_batch_locked) — a swap landing mid-queue
-        # splits old-model and new-model rows into separate device calls,
-        # never one mixed batch.
+                app.swap_model(model, model_date, predictor,
+                               model_key=key, model_source=source,
+                               model_bounds=bounds)
+            self._current = candidate
+            swapped = True
+        self._sync_canary(canary_state, canary_dangling)
+        self._poll_watchdog()
+        return swapped
+
+    def _build_swap_predictor(self, model):
+        """Build + warm a predictor for a model being swapped in (the
+        production reload and the canary load share this, so a canary
+        serves through exactly the engine selection production does)."""
+        from bodywork_tpu.serve.server import build_predictor, resolve_engine
+
+        # Bucket policy for the swapped-in predictor, in priority order:
+        # 1. the caller's explicit list (a reload must not widen the
+        #    compiled-shape set the spec narrowed);
+        # 2. same resolved engine as currently served -> keep the
+        #    current bucket set (shape-set stability across swaps);
+        # 3. engine CHANGED across the swap (engine='auto' resolving
+        #    differently for the new checkpoint, e.g. narrow->wide MLP
+        #    flipping xla->pallas) -> let the new engine apply its own
+        #    default policy. Inheriting the old engine's buckets here
+        #    would e.g. hand the Pallas kernel sub-ROW_TILE buckets
+        #    that all pad to the same program — several duplicate
+        #    compiles per warmup for nothing.
+        current = self.apps[0].predictor  # None on a degraded boot
+        old_resolved = (
+            resolve_engine(self.engine, current.model, self.mesh_data)
+            if current is not None
+            else None  # nothing served yet: nothing to inherit
+        )
+        new_resolved = resolve_engine(self.engine, model, self.mesh_data)
+        if self.buckets is not None:
+            swap_buckets = self.buckets
+        elif current is not None and new_resolved == old_resolved:
+            swap_buckets = current.buckets
+        else:
+            swap_buckets = None
+        predictor = build_predictor(
+            model, self.mesh_data, new_resolved, buckets=swap_buckets,
+        )
+        if predictor is None:
+            # plain xla engine with no bucket narrowing: the app-level
+            # default predictor (its own default bucket policy)
+            from bodywork_tpu.serve.predictor import PaddedPredictor
+
+            predictor = PaddedPredictor(model)
+        # warm every bucket BEFORE the swap: the first request after
+        # reload must not pay the new model's compiles
+        predictor.warmup()
+        return predictor
+
+    def _record_bounds(self, key: str):
+        """The registry record's prediction-sanity band for a checkpoint
+        (None when absent/registry-less) — one record GET per swap, off
+        the request path. Delegates to the one shared lookup so boot and
+        reload resolve bounds under identical rules."""
+        from bodywork_tpu.serve.server import _registry_bounds
+
+        return _registry_bounds(self.store, key)
+
+    def _sync_canary(self, state: dict | None, dangling_reason: str | None) -> None:
+        """Reconcile the apps' canary bundle with the alias document's
+        slot: load+warm a newly-configured canary OFF the request path,
+        clear a retired one, and REPAIR a dangling slot (stale canary
+        pointing at a deleted/rejected checkpoint — a crashed watchdog's
+        debris) with one CAS + a repair lineage event so boot and every
+        later poll stop tripping over it."""
+        if dangling_reason is not None:
+            log.warning(
+                f"dangling canary slot ignored ({dangling_reason}); "
+                "serving production only"
+            )
+            try:
+                from bodywork_tpu.registry import ModelRegistry
+
+                ModelRegistry(self.store).canary_repair(reason=dangling_reason)
+            except Exception as exc:
+                log.error(f"canary slot repair failed (will retry): {exc!r}")
+            state = None
+        if state is None:
+            if (
+                self._current_canary is not None
+                or self.apps[0].canary_key is not None
+            ):
+                for app in self.apps:
+                    app.clear_canary()
+                self._current_canary = None
+            return
+        desired = (
+            state["key"], self.store.version_token(state["key"]),
+            state["fraction"], state["seed"],
+        )
+        if desired == self._current_canary:
+            return
+        try:
+            model, model_date = load_model(self.store, state["key"])
+            predictor = self._build_swap_predictor(model)
+        except Exception as exc:
+            # a half-written canary checkpoint must not take the service
+            # down OR the production stream with it: keep serving, retry
+            # next poll
+            log.error(
+                f"canary load of {state['key']} failed (will retry): {exc!r}"
+            )
+            return
         for app in self.apps:
-            app.swap_model(model, model_date, predictor,
-                           model_key=key, model_source=source)
-        self._current = candidate
-        return True
+            app.set_canary(
+                model, model_date, predictor, model_key=state["key"],
+                fraction=state["fraction"], seed=state["seed"],
+                bounds=state.get("bounds"),
+            )
+        self._current_canary = desired
+
+    def _poll_watchdog(self) -> None:
+        """Drive the SLO watchdog once per poll. A promote re-anchors
+        the watcher's current-production marker so the next poll does
+        not redundantly reload the checkpoint the apps already serve
+        warm."""
+        if self.slo_watchdog is None:
+            return
+        try:
+            action = self.slo_watchdog.poll()
+        except Exception as exc:  # the watchdog must never kill reloads
+            log.error(f"SLO watchdog poll failed: {exc!r}")
+            return
+        if action == "promote":
+            key = self.apps[0].model_key
+            if key is not None:
+                self._current = (key, self.store.version_token(key))
+            self._current_canary = None
+        elif action == "abort":
+            self._current_canary = None
 
     def _run(self) -> None:
         while not self._stop.wait(self.poll_interval_s):

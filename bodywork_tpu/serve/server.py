@@ -263,6 +263,22 @@ def build_admission(
     return AdmissionController(**kwargs)
 
 
+def _registry_bounds(store: ArtefactStore, key: str | None):
+    """The registry record's prediction-sanity band for a checkpoint —
+    the serving firewall's out-of-range reference. None when the store
+    is registry-less or the record is absent (the firewall then only
+    checks finiteness)."""
+    if key is None:
+        return None
+    try:
+        from bodywork_tpu.registry.records import load_record
+
+        record = load_record(store, key)
+        return (record or {}).get("prediction_bounds")
+    except Exception:  # bounds are an enhancement, never a boot blocker
+        return None
+
+
 def serve_latest_model(
     store: ArtefactStore,
     host: str = "0.0.0.0",
@@ -336,17 +352,19 @@ def serve_latest_model(
         )
         served_key = served_source = None
         model = model_date = predictor = None
+        model_bounds = None
     else:
         # with buckets set, build_predictor always returns a predictor
         # (every engine honours the list), so create_app never needs the
         # knob here
         predictor = build_predictor(model, mesh_data, engine, buckets=buckets)
+        model_bounds = _registry_bounds(store, served_key)
     admission = build_admission(server_engine, max_pending, retry_after_max_s)
     app = create_app(
         model, model_date, predictor=predictor,
         batch_window_ms=batch_window_ms, batch_max_rows=batch_max_rows,
         model_key=served_key, model_source=served_source,
-        admission=admission,
+        admission=admission, model_bounds=model_bounds,
     )
     if server_engine == "aio":
         from bodywork_tpu.serve.aio import AioServiceHandle
@@ -357,8 +375,14 @@ def serve_latest_model(
     # the coalescer's dispatcher stops (after flushing) with the service
     handle.add_cleanup(app.close)
     if watch_interval_s:
+        from bodywork_tpu.ops.slo import SloWatchdog, policy_from_env
         from bodywork_tpu.serve.reload import NOTHING_SERVED, CheckpointWatcher
 
+        # the SLO watchdog rides the reload-watcher loop: canary
+        # routing, breach detection, and the one-CAS auto-abort/promote
+        # all poll on the same cadence as checkpoint swaps. Idle cost
+        # with no canary live: one attribute read per poll.
+        watchdog = SloWatchdog(store, [app], policy=policy_from_env())
         watcher = CheckpointWatcher(
             app, store, poll_interval_s=watch_interval_s,
             mesh_data=mesh_data, engine=engine,
@@ -367,6 +391,7 @@ def serve_latest_model(
             # checkpoint published in the lookup->construction window)
             served_key=served_key if served_key is not None else NOTHING_SERVED,
             buckets=buckets,
+            slo_watchdog=watchdog,
         )
         watcher.start()
         handle.add_cleanup(watcher.stop)

@@ -63,6 +63,9 @@ class _Result:
     retry_after_s: float | None
     latency_s: float      # scheduled arrival -> response complete
     send_lag_s: float     # scheduled arrival -> actually sent
+    #: the X-Bodywork-Model-Key response header (which model ANSWERED —
+    #: production, canary, or a firewall fallback); None when absent
+    model_key: str | None = None
 
 
 def _percentile(sorted_vals: list, q: float) -> float | None:
@@ -102,6 +105,10 @@ class LoadReport:
     retry_after: dict      # {responses, mean_s, max_s} where the header appeared
     send_lag_p99_s: float | None
     max_in_flight: int
+    #: latency/goodput broken down by the RESPONDING model key (the
+    #: X-Bodywork-Model-Key header; "unknown" bucket when absent) — how
+    #: a canary sweep attributes per-version behaviour with this harness
+    per_model_key: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -185,6 +192,7 @@ async def _http_transport(pool: _ConnectionPool, request: Request):
             parts = status_line.decode("latin-1").split(" ", 2)
             status = int(parts[1])
             retry_after = None
+            model_key = None
             content_length = None
             keep_alive = True
             while True:
@@ -198,6 +206,10 @@ async def _http_transport(pool: _ConnectionPool, request: Request):
                         retry_after = float(value.strip())
                     except ValueError:
                         pass
+                elif name == "x-bodywork-model-key":
+                    # which model version ANSWERED — the per-model-key
+                    # report breakdown reads this (canary sweeps)
+                    model_key = value.strip() or None
                 elif name == "content-length":
                     try:
                         content_length = int(value.strip())
@@ -210,7 +222,7 @@ async def _http_transport(pool: _ConnectionPool, request: Request):
             # a response with no Content-Length would need a close/EOF
             # to delimit — never reusable
             reusable = keep_alive and content_length is not None
-            return status, retry_after
+            return status, retry_after, model_key
         finally:
             pool.release(reader, writer, reusable)
     raise ConnectionResetError("unreachable")  # pragma: no cover
@@ -265,10 +277,16 @@ def run_open_loop(
             send_lag = loop.time() - target
             in_flight += 1
             max_in_flight = max(max_in_flight, in_flight)
+            model_key = None
             try:
-                status, retry_after = await asyncio.wait_for(
-                    transport(req), timeout_s
-                )
+                outcome = await asyncio.wait_for(transport(req), timeout_s)
+                # the HTTP transport reports (status, retry_after,
+                # model_key); 2-tuples from older/pluggable transports
+                # land in the "unknown" attribution bucket
+                if len(outcome) == 3:
+                    status, retry_after, model_key = outcome
+                else:
+                    status, retry_after = outcome
             except asyncio.TimeoutError:
                 timeouts += 1
                 status, retry_after = 0, None
@@ -279,6 +297,7 @@ def run_open_loop(
             results.append(_Result(
                 t_s=req.t_s, status=status, retry_after_s=retry_after,
                 latency_s=loop.time() - target, send_lag_s=send_lag,
+                model_key=model_key,
             ))
 
         try:
@@ -304,6 +323,26 @@ def run_open_loop(
     lags = sorted(r.send_lag_s for r in results)
     with_retry = [r.retry_after_s for r in results
                   if r.retry_after_s is not None]
+    # per-responding-model-key breakdown over OK responses: how a canary
+    # sweep attributes latency/goodput per version ("unknown" = no
+    # attribution header — e.g. a pre-canary server or custom transport)
+    by_key: dict[str, list] = {}
+    for r in ok:
+        by_key.setdefault(r.model_key or "unknown", []).append(r)
+    per_model_key = {}
+    for key, rs in sorted(by_key.items()):
+        key_lat = sorted(x.latency_s for x in rs)
+        per_model_key[key] = {
+            "ok": len(rs),
+            "ok_in_window": sum(
+                1 for x in rs if x.t_s + x.latency_s <= span
+            ),
+            "goodput_rps": round(len(rs) / span, 3),
+            "latency": {
+                "p50_s": _round6(_percentile(key_lat, 50)),
+                "p99_s": _round6(_percentile(key_lat, 99)),
+            },
+        }
     report = LoadReport(
         requests=len(results),
         duration_s=round(span, 6),
@@ -332,6 +371,7 @@ def run_open_loop(
         },
         send_lag_p99_s=_round6(_percentile(lags, 99)),
         max_in_flight=max_in_flight,
+        per_model_key=per_model_key,
     )
     log.info(
         f"open-loop run: offered {report.offered_rps:.0f} rps x "

@@ -42,11 +42,33 @@ class TrainResult:
     model_artefact_key: str | None
     metrics_artefact_key: str | None
     n_rows: int
+    #: serving-side sanity band from the training labels (``{"lo", "hi"}``)
+    #: — recorded on the registry candidate so the prediction-sanity
+    #: firewall (serve.app) can catch absurd outputs before serialization
+    prediction_bounds: dict | None = None
+
+
+def _prediction_bounds(y) -> dict:
+    """Sanity bounds for served predictions, from training-label
+    statistics: the observed label range widened by half a range on each
+    side. Wide enough that legitimate extrapolation under drift never
+    trips it, tight enough that a NaN-adjacent or wildly-scaled output
+    (the stage-4 live-scoring failure mode) is caught before a client
+    sees it. Deterministic from the dataset bytes, so chaos-twin
+    registry records stay byte-identical."""
+    import numpy as np
+
+    arr = np.asarray(y, dtype=np.float64)
+    lo, hi = float(np.min(arr)), float(np.max(arr))
+    span = max(hi - lo, 1e-6)  # degenerate label sets still get a band
+    margin = 0.5 * span
+    return {"lo": lo - margin, "hi": hi + margin}
 
 
 def _register_candidate(
     store: ArtefactStore, model_key_: str, metrics_key: str,
     data_date: date, model_bytes: bytes,
+    prediction_bounds: dict | None = None,
 ) -> None:
     """Register the freshly persisted checkpoint as a registry CANDIDATE
     (``bodywork_tpu.registry``): training no longer implicitly publishes
@@ -61,7 +83,7 @@ def _register_candidate(
 
         register_candidate(
             store, model_key_, metrics_key=metrics_key, day=data_date,
-            model_bytes=model_bytes,
+            model_bytes=model_bytes, prediction_bounds=prediction_bounds,
         )
     except Exception as exc:
         log.warning(f"candidate registration failed (non-fatal): {exc!r}")
@@ -77,7 +99,8 @@ def persist_train_result(store: ArtefactStore, result: TrainResult) -> TrainResu
     model_key_ = save_model(store, result.model, result.data_date, data=data)
     metrics_key = persist_metrics(store, result.metrics, result.data_date)
     _register_candidate(
-        store, model_key_, metrics_key, result.data_date, data
+        store, model_key_, metrics_key, result.data_date, data,
+        prediction_bounds=result.prediction_bounds,
     )
     return dataclasses.replace(
         result,
@@ -260,13 +283,15 @@ def train_on_history(
     # persist=False defers the artefact writes to the caller (a lookahead
     # train must not mutate the store before its stage's DAG position —
     # an aborted day would otherwise leave a future-dated model behind)
+    bounds = _prediction_bounds(ds.y)
     if persist:
         from bodywork_tpu.models.checkpoint import save_model_bytes
 
         data = save_model_bytes(fitted)
         model_key_ = save_model(store, fitted, ds.date, data=data)
         metrics_key = persist_metrics(store, metrics, ds.date)
-        _register_candidate(store, model_key_, metrics_key, ds.date, data)
+        _register_candidate(store, model_key_, metrics_key, ds.date, data,
+                            prediction_bounds=bounds)
     else:
         model_key_ = metrics_key = None
     if prewarm_next and not use_mesh:
@@ -301,4 +326,7 @@ def train_on_history(
                 test_size,
                 n_features=ds.X.shape[1],
             )
-    return TrainResult(fitted, metrics, ds.date, model_key_, metrics_key, len(ds))
+    return TrainResult(
+        fitted, metrics, ds.date, model_key_, metrics_key, len(ds),
+        prediction_bounds=bounds,
+    )

@@ -42,7 +42,18 @@ def configure_logger(
     if handlers:
         if stream is not None:  # only an explicit stream re-points
             for h in handlers:
-                h.setStream(stream)
+                try:
+                    h.setStream(stream)
+                except ValueError:
+                    # setStream flushes the OLD stream first; a dead one
+                    # (test-harness capture torn down, redirected pipe
+                    # closed) must not block re-pointing to a live one —
+                    # swap the attribute directly, nothing to flush
+                    h.acquire()
+                    try:
+                        h.stream = stream
+                    finally:
+                        h.release()
     else:
         handler = logging.StreamHandler(stream if stream is not None else sys.stdout)
         handler.setFormatter(logging.Formatter(LOG_FORMAT))

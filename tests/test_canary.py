@@ -1,0 +1,997 @@
+"""Canary serving + SLO watchdog: the closed live-traffic release loop.
+
+Covers ISSUE 8: seeded hash routing, the prediction-sanity firewall
+(zero violating responses serialized), the one-CAS canary lifecycle
+(start/abort/promote/repair), the SLO watchdog's windowed verdicts and
+auto-abort/auto-promote, the dangling-canary boot bugfix, the
+per-model-key attribution header, and the chaos acceptance smoke.
+"""
+import json
+from datetime import date
+
+import numpy as np
+import pytest
+
+import jax
+
+from bodywork_tpu.models import LinearRegressor
+from bodywork_tpu.models.checkpoint import (
+    resolve_serving_state,
+    save_model_bytes,
+)
+from bodywork_tpu.registry import (
+    CANARY_ACTION_METHODS,
+    CANARY_ACTIONS,
+    ModelRegistry,
+    RegistryError,
+    read_aliases,
+    register_candidate,
+    resolve_canary,
+)
+from bodywork_tpu.registry.records import load_record
+from bodywork_tpu.serve.app import (
+    MODEL_KEY_HEADER,
+    as_bounds,
+    create_app,
+    routes_to_canary,
+    sanity_violation,
+)
+from bodywork_tpu.store.schema import REGISTRY_ALIAS_KEY, model_key
+from tests.helpers import make_counting_store, make_memory_store
+
+D1, D2 = date(2026, 7, 1), date(2026, 7, 2)
+KEY1, KEY2 = model_key(D1), model_key(D2)
+
+
+@pytest.fixture(scope="module")
+def fitted_model():
+    rng = np.random.default_rng(1)
+    X = rng.uniform(0, 100, 600).astype(np.float32)
+    y = (1.0 + 0.5 * X + rng.normal(0, 1, 600)).astype(np.float32)
+    return LinearRegressor().fit(X, y)
+
+
+@pytest.fixture(scope="module")
+def second_model():
+    rng = np.random.default_rng(2)
+    X = rng.uniform(0, 100, 600).astype(np.float32)
+    y = (2.0 + 0.4 * X + rng.normal(0, 1, 600)).astype(np.float32)
+    return LinearRegressor().fit(X, y)
+
+
+@pytest.fixture(scope="module")
+def nan_model(second_model):
+    """A fitted model whose params are all-NaN — the live-scoring
+    failure mode the firewall exists for."""
+    import jax.numpy as jnp
+
+    broken = LinearRegressor()
+    broken.params = jax.tree_util.tree_map(
+        lambda a: jnp.full_like(a, jnp.nan), second_model.params
+    )
+    return broken
+
+
+BOUNDS = {"lo": -30.0, "hi": 90.0}
+
+
+def _registry_store(fitted_model, second_model, promote_first=True):
+    """An in-memory store with two registered checkpoints, the first
+    promoted to production."""
+    store = make_memory_store()
+    store.put_bytes(KEY1, save_model_bytes(fitted_model))
+    store.put_bytes(KEY2, save_model_bytes(second_model))
+    register_candidate(store, KEY1, day=D1, prediction_bounds=BOUNDS)
+    register_candidate(store, KEY2, day=D2, prediction_bounds=BOUNDS)
+    if promote_first:
+        ModelRegistry(store).promote(KEY1, day=D1)
+    return store
+
+
+# -- routing + firewall primitives -----------------------------------------
+
+
+def test_routing_is_deterministic_and_tracks_fraction():
+    X = np.array([42.0], dtype=np.float32)
+    assert routes_to_canary(7, 0.5, X) == routes_to_canary(7, 0.5, X)
+    assert routes_to_canary(0, 0.0, X) is False
+    assert routes_to_canary(0, 1.0, X) is True
+    rng = np.random.default_rng(0)
+    xs = rng.uniform(0, 100, 4000)
+    hits = sum(routes_to_canary(3, 0.3, np.array([x])) for x in xs)
+    # unbiased hash: measured fraction within a few std of 0.3
+    assert abs(hits / 4000 - 0.3) < 0.04
+    # different seeds partition differently (replica-consistent, but a
+    # NEW canary gets a fresh assignment)
+    hits_b = sum(routes_to_canary(4, 0.3, np.array([x])) for x in xs)
+    assert hits != hits_b
+
+
+def test_sanity_violation_and_bounds_normalisation():
+    assert sanity_violation([1.0, 2.0], None) is None
+    assert sanity_violation([np.nan], None) == "non_finite"
+    assert sanity_violation([np.inf], (0.0, 10.0)) == "non_finite"
+    assert sanity_violation([11.0], (0.0, 10.0)) == "out_of_range"
+    assert sanity_violation([-0.5], (0.0, 10.0)) == "out_of_range"
+    assert sanity_violation([5.0], (0.0, 10.0)) is None
+    assert as_bounds({"lo": 0.0, "hi": 1.0}) == (0.0, 1.0)
+    assert as_bounds((2, 3)) == (2.0, 3.0)
+    assert as_bounds(None) is None
+    assert as_bounds({"lo": 5.0, "hi": 1.0}) is None  # inverted
+    assert as_bounds({"lo": "x", "hi": 1.0}) is None
+    assert as_bounds({"lo": np.nan, "hi": 1.0}) is None
+
+
+# -- registry canary lifecycle ---------------------------------------------
+
+
+def test_canary_start_validations(fitted_model, second_model):
+    store = make_memory_store()
+    store.put_bytes(KEY1, save_model_bytes(fitted_model))
+    store.put_bytes(KEY2, save_model_bytes(second_model))
+    register_candidate(store, KEY1, day=D1)
+    register_candidate(store, KEY2, day=D2)
+    registry = ModelRegistry(store)
+    # no production baseline yet
+    with pytest.raises(RegistryError, match="baseline"):
+        registry.canary_start(KEY2)
+    registry.promote(KEY1, day=D1)
+    with pytest.raises(RegistryError, match="fraction"):
+        registry.canary_start(KEY2, fraction=0.0)
+    with pytest.raises(RegistryError, match="unregistered"):
+        registry.canary_start("models/regressor-2030-01-01.npz")
+    with pytest.raises(RegistryError, match="already is production"):
+        registry.canary_start(KEY1)
+    registry.demote(KEY2, reason="test")
+    with pytest.raises(RegistryError, match="rejected"):
+        registry.canary_start(KEY2)
+
+
+def test_canary_lifecycle_one_cas_each(fitted_model, second_model):
+    """start, abort, restart, promote — each transition is exactly ONE
+    alias CAS and zero raw alias writes (the CountingStore witness)."""
+    store = _registry_store(fitted_model, second_model)
+    counting = make_counting_store(store)
+    registry = ModelRegistry(counting)
+
+    doc = registry.canary_start(KEY2, fraction=0.25, seed=9, day=D2)
+    assert doc["canary"] == KEY2
+    assert doc["canary_fraction"] == 0.25 and doc["canary_seed"] == 9
+    assert counting.by_key[("put_bytes_if_match", REGISTRY_ALIAS_KEY)] == 1
+    assert counting.by_key.get(("put_bytes", REGISTRY_ALIAS_KEY), 0) == 0
+    # a second canary while one is live is refused
+    with pytest.raises(RegistryError, match="already live"):
+        registry.canary_start(KEY2)
+    state, dangling = resolve_canary(counting)
+    assert dangling is None and state["key"] == KEY2
+    assert state["fraction"] == 0.25 and state["seed"] == 9
+    assert state["bounds"] == BOUNDS
+
+    counting.reset_counts()
+    doc = registry.canary_abort(day=D2, reason="test abort")
+    assert doc is not None and "canary" not in doc  # slot gone
+    assert counting.by_key[("put_bytes_if_match", REGISTRY_ALIAS_KEY)] == 1
+    record = load_record(counting, KEY2)
+    assert record["status"] == "rejected"
+    assert record["history"][-1]["event"] == "canary_aborted"
+    # idempotent: nothing live -> None, no CAS
+    counting.reset_counts()
+    assert registry.canary_abort() is None
+    assert counting.by_key.get(("put_bytes_if_match", REGISTRY_ALIAS_KEY), 0) == 0
+
+    # an aborted (rejected) canary can be re-registered and re-canaried
+    register_candidate(store, KEY2, day=D2, model_bytes=b"retrained")
+    registry.canary_start(KEY2, fraction=0.5, day=D2)
+    counting.reset_counts()
+    doc = registry.canary_promote(day=D2)
+    assert counting.by_key[("put_bytes_if_match", REGISTRY_ALIAS_KEY)] == 1
+    assert doc["production"] == KEY2 and doc["previous"] == KEY1
+    assert "canary" not in doc and doc["last_op"] == "canary_promote"
+    assert load_record(counting, KEY2)["status"] == "production"
+    assert load_record(counting, KEY1)["status"] == "archived"
+    # promote with no canary refused
+    with pytest.raises(RegistryError, match="no live canary"):
+        registry.canary_promote()
+
+
+def test_ordinary_promote_and_rollback_preserve_canary_slot(
+    fitted_model, second_model
+):
+    """The daily gate promoting a NEW production must not silently drop
+    a live canary slot — and promoting the canary key itself graduates
+    (clears) it."""
+    store = _registry_store(fitted_model, second_model)
+    store.put_bytes("models/regressor-2026-07-03.npz", b"third")
+    register_candidate(store, "models/regressor-2026-07-03.npz",
+                       day=date(2026, 7, 3))
+    registry = ModelRegistry(store)
+    registry.canary_start(KEY2, fraction=0.2, day=D2)
+    registry.promote("models/regressor-2026-07-03.npz", day=date(2026, 7, 3))
+    doc = read_aliases(store)
+    assert doc["production"] == "models/regressor-2026-07-03.npz"
+    assert doc["canary"] == KEY2  # survived the baseline change
+    registry.rollback(day=date(2026, 7, 4))
+    doc = read_aliases(store)
+    assert doc["production"] == KEY1 and doc["canary"] == KEY2
+    # promoting the canary key itself clears the slot
+    registry.promote(KEY2, day=date(2026, 7, 5))
+    doc = read_aliases(store)
+    assert doc["production"] == KEY2 and "canary" not in doc
+
+
+def test_resolve_canary_dangling_reasons(fitted_model, second_model):
+    store = _registry_store(fitted_model, second_model)
+    registry = ModelRegistry(store)
+    registry.canary_start(KEY2, fraction=0.3, day=D2)
+    # deleted checkpoint -> dangling, not an exception
+    store.delete(KEY2)
+    state, reason = resolve_canary(store)
+    assert state is None and "missing" in reason
+    # restored checkpoint but rejected record -> dangling
+    store.put_bytes(KEY2, save_model_bytes(second_model))
+    from bodywork_tpu.registry.records import append_event
+
+    append_event(store, KEY2, {"event": "x"}, status="rejected")
+    state, reason = resolve_canary(store)
+    assert state is None and "rejected" in reason
+    # the boot resolver reports production + the dangling reason and
+    # NEVER wedges (the ISSUE 8 bugfix)
+    key, source, canary_state, dangling = resolve_serving_state(store)
+    assert key == KEY1 and source == "production"
+    assert canary_state is None and "rejected" in dangling
+
+
+# -- serving: firewall, header, healthz ------------------------------------
+
+
+def _app_with_canary(production, canary_model, fraction=1.0,
+                     bounds=BOUNDS, canary_bounds=BOUNDS):
+    app = create_app(
+        production, D1, buckets=(1, 8), warmup=False,
+        model_key=KEY1, model_source="production", model_bounds=bounds,
+    )
+    app.set_canary(
+        canary_model, D2, model_key=KEY2, fraction=fraction, seed=0,
+        bounds=canary_bounds,
+    )
+    return app
+
+
+def test_nan_canary_falls_back_to_production(fitted_model, nan_model):
+    """The prediction-sanity firewall: a canary emitting NaN answers
+    from production — 200, finite, attributed to production — and the
+    violation is counted for the watchdog."""
+    from bodywork_tpu.obs import get_registry
+
+    app = _app_with_canary(fitted_model, nan_model)
+    client = app.test_client()
+    violations = get_registry().counter(
+        "bodywork_tpu_serve_sanity_violations_total", ""
+    )
+    before = violations.value(
+        model_key=KEY2, stream="canary", reason="non_finite"
+    )
+    response = client.post("/score/v1", json={"X": 50})
+    assert response.status_code == 200
+    body = response.get_json()
+    assert np.isfinite(body["prediction"])
+    assert body["prediction"] == pytest.approx(26.0, abs=2.0)
+    assert body["model_info"] == fitted_model.info  # production answered
+    assert response.headers[MODEL_KEY_HEADER] == KEY1
+    assert violations.value(
+        model_key=KEY2, stream="canary", reason="non_finite"
+    ) == before + 1
+    # batch route rides the same firewall
+    response = client.post("/score/v1/batch", json={"X": [1.0, 2.0, 3.0]})
+    assert response.status_code == 200
+    assert all(np.isfinite(p) for p in response.get_json()["predictions"])
+    assert response.headers[MODEL_KEY_HEADER] == KEY1
+
+
+def test_out_of_range_canary_falls_back(fitted_model, second_model):
+    # a band the canary's real predictions must exceed
+    app = _app_with_canary(
+        fitted_model, second_model, canary_bounds={"lo": -1.0, "hi": 1.0}
+    )
+    response = app.test_client().post("/score/v1", json={"X": 80})
+    assert response.status_code == 200
+    assert response.headers[MODEL_KEY_HEADER] == KEY1  # fell back
+
+
+def test_production_non_finite_is_500_never_serialized(nan_model):
+    app = create_app(nan_model, D1, buckets=(1,), warmup=False,
+                     model_key=KEY1, model_source="production")
+    response = app.test_client().post("/score/v1", json={"X": 50})
+    assert response.status_code == 500
+    assert "nan" not in response.get_data(as_text=True).lower()
+
+
+def test_model_key_header_and_healthz_canary_channel(
+    fitted_model, second_model
+):
+    app = create_app(fitted_model, D1, buckets=(1,), warmup=False,
+                     model_key=KEY1, model_source="production")
+    client = app.test_client()
+    # header present on scoring responses, absent without a known key
+    assert client.post("/score/v1", json={"X": 1}).headers[
+        MODEL_KEY_HEADER
+    ] == KEY1
+    body = client.get("/healthz").get_json()
+    assert body["canary_key"] is None and body["watchdog"] is None
+    app.set_canary(second_model, D2, model_key=KEY2, fraction=0.4, seed=1,
+                   bounds=BOUNDS)
+    app.slo_state = {"state": "watching"}
+    body = client.get("/healthz").get_json()
+    assert body["canary_key"] == KEY2
+    assert body["canary_fraction"] == 0.4
+    assert body["watchdog"] == {"state": "watching"}
+    # fraction 0.4, seed 1: SOME requests carry the canary key
+    keys = {
+        client.post("/score/v1", json={"X": float(x)}).headers[
+            MODEL_KEY_HEADER
+        ]
+        for x in range(30)
+    }
+    assert keys == {KEY1, KEY2}
+    app.clear_canary()
+    assert client.get("/healthz").get_json()["canary_key"] is None
+
+
+def test_no_header_when_key_unknown(fitted_model):
+    app = create_app(fitted_model, D1, buckets=(1,), warmup=False)
+    response = app.test_client().post("/score/v1", json={"X": 1})
+    assert MODEL_KEY_HEADER not in response.headers
+
+
+# -- SLO policy + watchdog --------------------------------------------------
+
+
+def test_slo_policy_verdict_pure_function():
+    from bodywork_tpu.ops.slo import SloPolicy
+
+    policy = SloPolicy(window_requests=100, min_requests=20,
+                       max_error_rate=0.05, max_p99_latency_ratio=3.0,
+                       min_latency_samples=10, max_sanity_violations=0)
+    base = {
+        "requests": 50, "errors": 0, "violations": 0,
+        "canary_p99_s": 0.002, "production_p99_s": 0.002,
+        "canary_latency_samples": 50, "production_latency_samples": 50,
+    }
+    assert policy.verdict(base) is None
+    assert policy.verdict({**base, "violations": 1}) == "sanity"
+    assert policy.verdict({**base, "errors": 3}) == "error_budget"
+    # below min_requests the error budget cannot fire
+    assert policy.verdict({**base, "requests": 10, "errors": 10}) is None
+    # but sanity can (a NaN canary must die fast)
+    assert policy.verdict(
+        {**base, "requests": 1, "violations": 1}
+    ) == "sanity"
+    assert policy.verdict({**base, "canary_p99_s": 0.01}) == "latency"
+    # latency needs samples on BOTH streams
+    assert policy.verdict(
+        {**base, "canary_p99_s": 0.01, "production_latency_samples": 2}
+    ) is None
+    # no production latency at all -> ratio cannot fire
+    assert policy.verdict(
+        {**base, "canary_p99_s": 0.01, "production_p99_s": None}
+    ) is None
+
+
+def test_latency_breach_requires_consecutive_polls(
+    fitted_model, second_model, monkeypatch
+):
+    """A one-poll p99 spike (scheduling noise) must NOT abort; the same
+    verdict on `latency_breach_polls` consecutive polls must. Verdict
+    inputs are injected at the window layer so the test drives the
+    persistence logic, not the histogram."""
+    from bodywork_tpu.ops.slo import SloPolicy, SloWatchdog
+
+    store = _registry_store(fitted_model, second_model)
+    ModelRegistry(store).canary_start(KEY2, fraction=1.0, day=D2)
+    app = create_app(fitted_model, D1, buckets=(1,), warmup=False,
+                     model_key=KEY1, model_source="production")
+    app.set_canary(second_model, D2, model_key=KEY2, fraction=1.0,
+                   seed=0, bounds=BOUNDS)
+    policy = SloPolicy(min_latency_samples=1, latency_breach_polls=2,
+                       min_requests=1)
+    watchdog = SloWatchdog(store, [app], policy=policy)
+    breaching = {
+        "requests": 10, "errors": 0, "violations": 0,
+        "canary_p99_s": 1.0, "production_p99_s": 0.001,
+        "canary_latency_samples": 10, "production_latency_samples": 10,
+    }
+    healthy = {**breaching, "canary_p99_s": 0.001}
+    windows = iter([breaching, healthy, breaching, breaching])
+    monkeypatch.setattr(
+        SloWatchdog, "_window_deltas",
+        staticmethod(lambda base, now: next(windows)),
+    )
+    assert watchdog.poll() is None          # baseline
+    assert watchdog.poll() is None          # breach #1: streak, no abort
+    assert app.slo_state["window"]["latency_breach_streak"] == 1
+    assert watchdog.poll() is None          # healthy: streak resets
+    assert watchdog.poll() is None          # breach #1 again
+    assert watchdog.poll() == "abort"       # breach #2: abort
+    assert app.canary_key is None
+
+
+def test_mid_streak_latency_breach_blocks_promotion(
+    fitted_model, second_model, monkeypatch
+):
+    """A canary whose latency verdict is mid-streak must NOT auto-promote
+    even when its exposure crosses the threshold on the same poll — the
+    outstanding verdict defers promotion to the next poll's
+    abort-or-clear decision."""
+    from bodywork_tpu.ops.slo import SloPolicy, SloWatchdog
+
+    store = _registry_store(fitted_model, second_model)
+    ModelRegistry(store).canary_start(KEY2, fraction=1.0, day=D2)
+    app = create_app(fitted_model, D1, buckets=(1,), warmup=False,
+                     model_key=KEY1, model_source="production")
+    app.set_canary(second_model, D2, model_key=KEY2, fraction=1.0,
+                   seed=0, bounds=BOUNDS)
+    policy = SloPolicy(min_latency_samples=1, latency_breach_polls=2,
+                       min_requests=1, promote_after_requests=5)
+    watchdog = SloWatchdog(store, [app], policy=policy)
+    breaching = {
+        "requests": 50, "errors": 0, "violations": 0,
+        "canary_p99_s": 1.0, "production_p99_s": 0.001,
+        "canary_latency_samples": 50, "production_latency_samples": 50,
+    }
+    monkeypatch.setattr(
+        SloWatchdog, "_window_deltas",
+        staticmethod(lambda base, now: dict(breaching)),
+    )
+    # force the exposure past promote_after on every poll
+    watchdog._exposure_floor = -100.0
+    assert watchdog.poll() is None           # baseline
+    assert watchdog.poll() is None           # breach #1: NO promote
+    assert app.canary_key == KEY2            # still a canary
+    assert read_aliases(store)["canary"] == KEY2
+    assert watchdog.poll() == "abort"        # breach #2: abort wins
+    assert "canary" not in read_aliases(store)
+
+
+def test_production_change_mid_canary_restarts_window(
+    fitted_model, second_model
+):
+    """An ordinary gate promote under a live canary changes the
+    production baseline: the watchdog must restart its breach window
+    instead of subtracting the old key's cumulative histogram from the
+    new key's (negative deltas would silently disable the latency
+    verdict)."""
+    from bodywork_tpu.ops.slo import SloPolicy, SloWatchdog
+
+    store = _registry_store(fitted_model, second_model)
+    store.put_bytes("models/regressor-2026-07-03.npz",
+                    save_model_bytes(second_model))
+    register_candidate(store, "models/regressor-2026-07-03.npz",
+                       day=date(2026, 7, 3))
+    registry = ModelRegistry(store)
+    registry.canary_start(KEY2, fraction=1.0, day=D2)
+    app = create_app(fitted_model, D1, buckets=(1,), warmup=False,
+                     model_key=KEY1, model_source="production",
+                     model_bounds=BOUNDS)
+    app.set_canary(second_model, D2, model_key=KEY2, fraction=1.0,
+                   seed=0, bounds=BOUNDS)
+    watchdog = SloWatchdog(store, [app], policy=SloPolicy(
+        min_requests=1, promote_after_requests=10_000,
+    ))
+    client = app.test_client()
+    assert watchdog.poll() is None  # baseline on (canary, KEY1)
+    for x in range(3):
+        client.post("/score/v1", json={"X": float(x)})
+    assert watchdog.poll() is None
+    assert len(watchdog._snapshots) >= 2
+    # the gate promotes a NEW production; the canary slot survives
+    registry.promote("models/regressor-2026-07-03.npz",
+                     day=date(2026, 7, 3))
+    app.swap_model(second_model, date(2026, 7, 3),
+                   model_key="models/regressor-2026-07-03.npz",
+                   model_source="production")
+    assert watchdog.poll() is None
+    # window restarted on the new baseline: every retained snapshot
+    # belongs to the new production key, and deltas are non-negative
+    assert all(
+        s["production_key"] == "models/regressor-2026-07-03.npz"
+        for s in watchdog._snapshots
+    )
+    window = (app.slo_state or {}).get("window", {})
+    assert window.get("requests", 0) >= 0
+
+
+def test_histogram_quantile():
+    from bodywork_tpu.ops.slo import histogram_quantile
+
+    bounds = [0.001, 0.01, 0.1]
+    assert histogram_quantile(bounds, [0, 0, 0, 0], 0.99) is None
+    assert histogram_quantile(bounds, [100, 0, 0, 0], 0.99) == 0.001
+    # nearest-rank: rank 99 of 100 sits in the first bucket here…
+    assert histogram_quantile(bounds, [99, 0, 1, 0], 0.99) == 0.001
+    # …but two tail samples push rank 99 into the 0.1 bucket
+    assert histogram_quantile(bounds, [98, 0, 2, 0], 0.99) == 0.1
+    assert histogram_quantile(bounds, [0, 0, 0, 5], 0.5) == float("inf")
+    assert histogram_quantile(bounds, [50, 50, 0, 0], 0.5) == 0.001
+
+
+def test_slo_policy_from_env(monkeypatch):
+    from bodywork_tpu.ops.slo import SloPolicy, policy_from_env
+
+    # unset -> defaults
+    for name in ("BODYWORK_TPU_SLO_WINDOW_REQUESTS",
+                 "BODYWORK_TPU_SLO_MAX_ERROR_RATE",
+                 "BODYWORK_TPU_SLO_MAX_P99_RATIO",
+                 "BODYWORK_TPU_SLO_MAX_SANITY_VIOLATIONS"):
+        monkeypatch.delenv(name, raising=False)
+    assert policy_from_env() == SloPolicy()
+    # well-formed values land
+    monkeypatch.setenv("BODYWORK_TPU_SLO_WINDOW_REQUESTS", "300")
+    monkeypatch.setenv("BODYWORK_TPU_SLO_MAX_ERROR_RATE", "0.1")
+    monkeypatch.setenv("BODYWORK_TPU_SLO_MAX_SANITY_VIOLATIONS", "2")
+    policy = policy_from_env()
+    assert policy.window_requests == 300
+    assert policy.max_error_rate == 0.1
+    assert policy.max_sanity_violations == 2
+    # malformed values degrade to defaults with a warning, never crash
+    monkeypatch.setenv("BODYWORK_TPU_SLO_WINDOW_REQUESTS", "banana")
+    monkeypatch.setenv("BODYWORK_TPU_SLO_MAX_ERROR_RATE", "-3")
+    policy = policy_from_env()
+    assert policy.window_requests == SloPolicy().window_requests
+    assert policy.max_error_rate == SloPolicy().max_error_rate
+
+
+def _watched_serving(store, app, policy):
+    """A CheckpointWatcher + SloWatchdog over a CountingStore, as the
+    serve entrypoints wire them."""
+    from bodywork_tpu.ops.slo import SloWatchdog
+    from bodywork_tpu.serve.reload import CheckpointWatcher
+
+    counting = make_counting_store(store)
+    watchdog = SloWatchdog(counting, [app], policy=policy)
+    watcher = CheckpointWatcher(
+        app, counting, poll_interval_s=3600.0, served_key=KEY1,
+        buckets=(1, 8), slo_watchdog=watchdog,
+    )
+    return counting, watchdog, watcher
+
+
+def test_watchdog_auto_aborts_nan_canary(fitted_model, nan_model):
+    """E2E: a NaN canary started through the registry is loaded by the
+    watcher, trips the firewall on live requests, and the watchdog
+    aborts it with EXACTLY one alias CAS — with zero insane responses
+    ever serialized."""
+    from bodywork_tpu.ops.slo import SloPolicy
+
+    store = _registry_store(fitted_model, fitted_model)
+    # overwrite the canary checkpoint with the NaN model's bytes
+    store.put_bytes(KEY2, save_model_bytes(nan_model))
+    register_candidate(store, KEY2, day=D2, prediction_bounds=BOUNDS)
+    ModelRegistry(store).canary_start(KEY2, fraction=1.0, seed=0, day=D2)
+    app = create_app(fitted_model, D1, buckets=(1, 8), warmup=False,
+                     model_key=KEY1, model_source="production",
+                     model_bounds=BOUNDS)
+    policy = SloPolicy(window_requests=50, min_requests=5,
+                       min_latency_samples=5, promote_after_requests=50)
+    counting, watchdog, watcher = _watched_serving(store, app, policy)
+    watcher.check_once()  # loads the canary, arms the watchdog
+    assert app.canary_key == KEY2
+    counting.reset_counts()
+    client = app.test_client()
+    responses = [
+        client.post("/score/v1", json={"X": float(x)}) for x in range(8)
+    ]
+    assert all(r.status_code == 200 for r in responses)
+    assert all(
+        np.isfinite(r.get_json()["prediction"]) for r in responses
+    )
+    # every response was answered from production (firewall fallback)
+    assert {r.headers[MODEL_KEY_HEADER] for r in responses} == {KEY1}
+    assert watcher.check_once() is False  # poll: watchdog fires inside
+    assert app.canary_key is None
+    assert counting.by_key[("put_bytes_if_match", REGISTRY_ALIAS_KEY)] == 1
+    assert counting.by_key.get(("put_bytes", REGISTRY_ALIAS_KEY), 0) == 0
+    doc = read_aliases(store)
+    assert "canary" not in doc and doc["last_op"] == "canary_abort"
+    assert doc["production"] == KEY1  # production never moved
+    record = load_record(store, KEY2)
+    assert record["status"] == "rejected"
+    assert record["history"][-1]["event"] == "canary_aborted"
+    assert "sanity" in record["history"][-1]["reason"]
+    body = app.test_client().get("/healthz").get_json()
+    assert body["canary_key"] is None
+    assert body["watchdog"]["state"] == "breached"
+    assert body["watchdog"]["verdict"] == "sanity"
+
+
+def test_watchdog_auto_promotes_healthy_canary(fitted_model, second_model):
+    from bodywork_tpu.ops.slo import SloPolicy
+
+    store = _registry_store(fitted_model, second_model)
+    ModelRegistry(store).canary_start(KEY2, fraction=1.0, seed=0, day=D2)
+    app = create_app(fitted_model, D1, buckets=(1, 8), warmup=False,
+                     model_key=KEY1, model_source="production",
+                     model_bounds=BOUNDS)
+    policy = SloPolicy(window_requests=60, min_requests=5,
+                       min_latency_samples=5, promote_after_requests=10)
+    counting, watchdog, watcher = _watched_serving(store, app, policy)
+    watcher.check_once()
+    assert app.canary_key == KEY2
+    client = app.test_client()
+    for x in range(12):
+        assert client.post(
+            "/score/v1", json={"X": float(x)}
+        ).status_code == 200
+    counting.reset_counts()
+    watcher.check_once()
+    # promoted: alias flipped in ONE CAS, warm bundle serving production
+    assert counting.by_key[("put_bytes_if_match", REGISTRY_ALIAS_KEY)] == 1
+    doc = read_aliases(store)
+    assert doc["production"] == KEY2 and doc["previous"] == KEY1
+    assert "canary" not in doc and doc["last_op"] == "canary_promote"
+    assert app.canary_key is None
+    assert app.model_key == KEY2 and app.model_source == "production"
+    assert load_record(store, KEY2)["status"] == "production"
+    assert load_record(store, KEY1)["status"] == "archived"
+    body = app.test_client().get("/healthz").get_json()
+    assert body["model_key"] == KEY2
+    assert body["watchdog"]["state"] == "promoted"
+    # the answering header follows the promotion
+    response = app.test_client().post("/score/v1", json={"X": 1.0})
+    assert response.headers[MODEL_KEY_HEADER] == KEY2
+    # a later poll does NOT reload the checkpoint the apps already serve
+    assert watcher.check_once() is False
+
+
+def test_watcher_repairs_dangling_canary(fitted_model, second_model):
+    """ISSUE 8 bugfix: a stale canary slot left by a crashed watchdog
+    (checkpoint deleted) must not wedge serving — the watcher falls back
+    to production, repairs the slot in one CAS, and records the repair
+    event."""
+    from bodywork_tpu.ops.slo import SloPolicy
+
+    store = _registry_store(fitted_model, second_model)
+    ModelRegistry(store).canary_start(KEY2, fraction=0.5, day=D2)
+    store.delete(KEY2)  # the dangling slot
+    app = create_app(fitted_model, D1, buckets=(1, 8), warmup=False,
+                     model_key=KEY1, model_source="production")
+    counting, watchdog, watcher = _watched_serving(
+        store, app, SloPolicy()
+    )
+    counting.reset_counts()
+    assert watcher.check_once() is False  # production untouched, no wedge
+    assert app.canary_key is None
+    doc = read_aliases(store)
+    assert "canary" not in doc and doc["last_op"] == "canary_repair"
+    assert counting.by_key[("put_bytes_if_match", REGISTRY_ALIAS_KEY)] == 1
+    record = load_record(store, KEY2)
+    assert record["history"][-1]["event"] == "canary_repaired"
+    assert record["status"] == "candidate"  # repair never adjudicates
+    # scoring keeps working throughout
+    assert app.test_client().post(
+        "/score/v1", json={"X": 5}
+    ).status_code == 200
+
+
+def test_watcher_loads_canary_and_routes_fraction(
+    fitted_model, second_model
+):
+    from bodywork_tpu.ops.slo import SloPolicy
+
+    store = _registry_store(fitted_model, second_model)
+    ModelRegistry(store).canary_start(KEY2, fraction=0.5, seed=2, day=D2)
+    app = create_app(fitted_model, D1, buckets=(1, 8), warmup=False,
+                     model_key=KEY1, model_source="production")
+    counting, watchdog, watcher = _watched_serving(
+        store, app, SloPolicy()
+    )
+    watcher.check_once()
+    assert app.canary_key == KEY2 and app.canary_fraction == 0.5
+    client = app.test_client()
+    keys = [
+        client.post("/score/v1", json={"X": float(x)}).headers[
+            MODEL_KEY_HEADER
+        ]
+        for x in range(40)
+    ]
+    assert set(keys) == {KEY1, KEY2}  # both streams take traffic
+    # the split is deterministic: replaying the same inputs re-routes
+    # identically
+    replay = [
+        client.post("/score/v1", json={"X": float(x)}).headers[
+            MODEL_KEY_HEADER
+        ]
+        for x in range(40)
+    ]
+    assert replay == keys
+    # stopping the canary through the registry clears routing next poll
+    ModelRegistry(store).canary_abort(day=D2, reason="operator stop")
+    watcher.check_once()
+    assert app.canary_key is None
+
+
+def test_aio_engine_routes_and_firewalls_identically(
+    fitted_model, nan_model, second_model
+):
+    """Cross-engine parity over real HTTP: the asyncio front-end routes
+    by the same request hash, attributes via the same header, and its
+    firewall keeps NaN canary output off the wire — response bodies are
+    byte-identical to the WSGI engine's for the same requests."""
+    import requests as rq
+
+    from bodywork_tpu.serve import AioServiceHandle
+
+    app = _app_with_canary(fitted_model, second_model, fraction=0.5)
+    wsgi_client = app.test_client()
+    handle = AioServiceHandle(app, "127.0.0.1", 0).start()
+    try:
+        base = f"http://127.0.0.1:{handle.port}"
+        for x in (1.0, 37.5, 80.0, 99.0):
+            aio_response = rq.post(
+                f"{base}/score/v1", json={"X": [x]}, timeout=10
+            )
+            wsgi_response = wsgi_client.post("/score/v1", json={"X": [x]})
+            assert aio_response.status_code == 200
+            assert aio_response.content == wsgi_response.get_data()
+            assert aio_response.headers[MODEL_KEY_HEADER] == (
+                wsgi_response.headers[MODEL_KEY_HEADER]
+            )
+        body = rq.get(f"{base}/healthz", timeout=10).json()
+        assert body["canary_key"] == KEY2
+        # NaN canary: the aio firewall answers from production too
+        app.set_canary(nan_model, D2, model_key=KEY2, fraction=1.0,
+                       seed=0, bounds=BOUNDS)
+        aio_response = rq.post(
+            f"{base}/score/v1", json={"X": [50.0]}, timeout=10
+        )
+        assert aio_response.status_code == 200
+        assert np.isfinite(aio_response.json()["prediction"])
+        assert aio_response.headers[MODEL_KEY_HEADER] == KEY1
+        batch = rq.post(
+            f"{base}/score/v1/batch", json={"X": [1.0, 2.0]}, timeout=10
+        )
+        assert batch.status_code == 200
+        assert all(np.isfinite(p) for p in batch.json()["predictions"])
+    finally:
+        handle.stop()
+
+
+# -- chaos acceptance (tier-1 smoke) ---------------------------------------
+
+
+def test_canary_chaos_nan_smoke(tmp_path):
+    """The seeded acceptance scenario at smoke scale: sabotaged canary
+    auto-aborts in one CAS, zero insane responses serialized, production
+    byte-identical to the canary-free twin — and the whole run is
+    reproducible from the seed (digest-pinned)."""
+    from bodywork_tpu.chaos import run_canary_chaos
+    from bodywork_tpu.store import open_store
+
+    summary = run_canary_chaos(
+        open_store(str(tmp_path / "nan")), "nan", seed=3,
+        n_requests=100, fraction=0.4, samples_per_day=64,
+    )
+    assert summary["ok"], summary
+    assert summary["alias_cas_writes"] == 1
+    assert summary["violating_responses_serialized"] == 0
+    assert summary["production_responses_mismatched"] == 0
+    # the abort budget is counted in CANARY-ROUTED requests — the unit
+    # the watchdog's breach window slides by
+    assert summary["canary_routed_at_abort"] <= (
+        summary["window_requests"] + 20
+    )
+    # reproducible from (seed, scenario) alone
+    replay = run_canary_chaos(
+        open_store(str(tmp_path / "nan2")), "nan", seed=3,
+        n_requests=100, fraction=0.4, samples_per_day=64,
+    )
+    assert replay["ok"]
+    assert replay["routing_digest"] == summary["routing_digest"]
+    assert replay["abort_at_request"] == summary["abort_at_request"]
+
+
+def test_canary_chaos_refuses_unusable_setups(tmp_path, fitted_model):
+    """The acceptance refuses a verdict it could not make meaningful:
+    too-small expected exposure (the healthy scenario could never reach
+    its promote threshold) and a non-fresh store (debris, not the
+    release loop, would decide PASS/FAIL)."""
+    from bodywork_tpu.chaos import run_canary_chaos
+    from bodywork_tpu.store import open_store
+
+    with pytest.raises(ValueError, match="exposure"):
+        run_canary_chaos(
+            open_store(str(tmp_path / "tiny")), "healthy",
+            n_requests=100, fraction=0.05,
+        )
+    dirty = make_memory_store()
+    dirty.put_bytes(KEY1, save_model_bytes(fitted_model))
+    with pytest.raises(ValueError, match="FRESH"):
+        run_canary_chaos(dirty, "nan")
+
+
+def test_slo_env_out_of_range_reverts_only_its_field(monkeypatch):
+    """A parseable-but-out-of-range env value reverts ITS knob only —
+    the operator's other valid overrides must survive (the per-knob
+    degrade contract)."""
+    from bodywork_tpu.ops.slo import SloPolicy, policy_from_env
+
+    monkeypatch.setenv("BODYWORK_TPU_SLO_WINDOW_REQUESTS", "500")
+    monkeypatch.setenv("BODYWORK_TPU_SLO_MAX_ERROR_RATE", "1.5")  # > 1
+    policy = policy_from_env()
+    assert policy.window_requests == 500  # survived
+    assert policy.max_error_rate == SloPolicy().max_error_rate  # reverted
+
+
+def test_healthz_degraded_boot_shows_live_canary(second_model):
+    """A degraded boot (no production model) can still hold a live
+    canary the watcher loaded — /healthz must show it."""
+    app = create_app(None)
+    app.set_canary(second_model, D2, model_key=KEY2, fraction=0.2,
+                   seed=0, bounds=BOUNDS)
+    body = app.test_client().get("/healthz").get_json()
+    assert body["status"] == "no model loaded"
+    assert body["canary_key"] == KEY2 and body["canary_fraction"] == 0.2
+
+
+def test_canary_chaos_healthy_promotes_smoke(tmp_path):
+    from bodywork_tpu.chaos import run_canary_chaos
+    from bodywork_tpu.store import open_store
+
+    summary = run_canary_chaos(
+        open_store(str(tmp_path / "healthy")), "healthy", seed=5,
+        n_requests=100, fraction=0.5, samples_per_day=64,
+    )
+    assert summary["ok"], summary
+    assert summary["promoted"] and not summary["aborted"]
+    assert summary["alias_cas_writes"] == 1
+    assert summary["canary_record_status"] == "production"
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_canary_chaos_latency_scenario(tmp_path):
+    """Injected latency addressed to the canary stream only trips the
+    p99-ratio breach — production keeps its latency profile and its
+    bytes."""
+    from bodywork_tpu.chaos import run_canary_chaos
+    from bodywork_tpu.store import open_store
+
+    summary = run_canary_chaos(
+        open_store(str(tmp_path / "latency")), "latency", seed=7,
+        n_requests=240, fraction=0.35, samples_per_day=96,
+    )
+    assert summary["ok"], summary
+    assert summary["abort_at_request"] is not None
+
+
+def test_canary_latency_plan_field_validates():
+    from bodywork_tpu.chaos import FaultPlan
+
+    plan = FaultPlan(seed=1, canary_latency_p=1.0, canary_latency_s=0.01)
+    assert plan.canary_latency_delay("models/x.npz") == 0.01
+    # decide-only and blocking forms share one draw stream
+    plan2 = FaultPlan(seed=1, canary_latency_p=0.5)
+    draws_a = [plan2.canary_latency_delay("k") is not None
+               for _ in range(32)]
+    plan2.reset()
+    draws_b = [plan2.canary_latency_delay("k") is not None
+               for _ in range(32)]
+    assert draws_a == draws_b  # seeded determinism
+    with pytest.raises(ValueError, match="probability"):
+        FaultPlan(canary_latency_p=1.5)
+    # unknown-field rejection still covers the new knob's family
+    with pytest.raises(ValueError, match="unknown"):
+        FaultPlan.from_dict({"canary_latency_probability": 0.5})
+
+
+# -- guards (CLI == manager == docs; CAS-only mutation; obs lint) ----------
+
+
+def test_canary_actions_pinned_cli_manager_docs():
+    """The three canary vocabularies cannot drift: CLI subcommand names
+    == registry.CANARY_ACTIONS == the manager methods, and every action
+    and state is documented in docs/REGISTRY.md."""
+    from pathlib import Path
+
+    from bodywork_tpu.cli import build_parser
+
+    parser = build_parser()
+    registry_parser = next(
+        a for a in parser._subparsers._group_actions
+    ).choices["registry"]
+    canary_parser = next(
+        a for a in registry_parser._subparsers._group_actions
+    ).choices["canary"]
+    cli_actions = tuple(
+        next(a for a in canary_parser._subparsers._group_actions).choices
+    )
+    assert cli_actions == CANARY_ACTIONS
+    registry = ModelRegistry(make_memory_store())
+    for action, method in CANARY_ACTION_METHODS.items():
+        assert action in CANARY_ACTIONS
+        assert callable(getattr(registry, method)), method
+    doc = Path(__file__).resolve().parents[1] / "docs" / "REGISTRY.md"
+    text = doc.read_text()
+    for action in CANARY_ACTIONS:
+        assert f"canary {action}" in text, (
+            f"docs/REGISTRY.md must document `registry canary {action}`"
+        )
+
+
+def test_chaos_canary_scenarios_pinned_to_cli():
+    from bodywork_tpu.chaos import CANARY_SCENARIOS
+    from bodywork_tpu.cli import build_parser
+
+    parser = build_parser()
+    chaos_parser = next(
+        a for a in parser._subparsers._group_actions
+    ).choices["chaos"]
+    canary_parser = next(
+        a for a in chaos_parser._subparsers._group_actions
+    ).choices["canary"]
+    scenario_action = next(
+        a for a in canary_parser._actions if a.dest == "scenario"
+    )
+    assert tuple(scenario_action.choices) == CANARY_SCENARIOS
+
+
+def test_canary_alias_mutations_ride_cas_static():
+    """Static guard: every canary lifecycle mutation in the manager goes
+    through records.write_aliases (the CAS-only writer) — no raw
+    put_bytes/put_text anywhere in the manager, and each canary method
+    either writes via the shared CAS helpers or only reads."""
+    import inspect
+
+    from bodywork_tpu.registry import manager
+
+    src = inspect.getsource(manager)
+    assert "put_bytes(" not in src and "put_text(" not in src
+    for method in ("canary_start", "_canary_clear", "canary_promote"):
+        body = inspect.getsource(getattr(manager.ModelRegistry, method))
+        assert "write_aliases" in body, (
+            f"{method} must mutate the alias document via the CAS writer"
+        )
+
+
+def test_new_metric_families_pass_name_lint():
+    """ISSUE 8 satellite: the obs name lint covers the new families —
+    `_ratio` gauges and the violations/breach counters."""
+    from bodywork_tpu.obs.registry import validate_metric_name
+
+    validate_metric_name("bodywork_tpu_slo_burn_rate_ratio", "gauge")
+    validate_metric_name("bodywork_tpu_slo_p99_latency_ratio", "gauge")
+    validate_metric_name("bodywork_tpu_slo_watchdog_state", "gauge")
+    validate_metric_name(
+        "bodywork_tpu_serve_sanity_violations_total", "counter"
+    )
+    validate_metric_name("bodywork_tpu_slo_breaches_total", "counter")
+    validate_metric_name(
+        "bodywork_tpu_serve_model_latency_seconds", "histogram"
+    )
+    with pytest.raises(ValueError):
+        validate_metric_name("bodywork_tpu_slo_burn_rate", "gauge")
+
+
+def test_train_records_prediction_bounds(tmp_path):
+    """Training registers its candidate with a label-derived sanity band
+    wide enough for the healthy model, and serving resolves it into the
+    app's firewall bounds."""
+    from bodywork_tpu.data import Dataset, generate_day, persist_dataset
+    from bodywork_tpu.data.drift_config import DriftConfig
+    from bodywork_tpu.serve.server import _registry_bounds
+    from bodywork_tpu.store import open_store
+    from bodywork_tpu.train import train_on_history
+
+    store = open_store(str(tmp_path / "artefacts"))
+    day = date(2026, 3, 1)
+    X, y = generate_day(day, DriftConfig(n_samples=64))
+    persist_dataset(store, Dataset(X, y, day))
+    result = train_on_history(store, "linear", rows_per_day=64)
+    assert result.prediction_bounds is not None
+    record = load_record(store, result.model_artefact_key)
+    bounds = record["prediction_bounds"]
+    assert bounds == result.prediction_bounds
+    assert bounds["lo"] < float(np.min(y)) <= float(np.max(y)) < bounds["hi"]
+    assert _registry_bounds(store, result.model_artefact_key) == bounds
+    # the healthy model's own predictions sit inside its band
+    predictions = result.model.predict(np.asarray(X, dtype=np.float32))
+    assert sanity_violation(predictions, as_bounds(bounds)) is None

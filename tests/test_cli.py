@@ -497,6 +497,77 @@ def test_registry_cli_arg_validation(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_registry_canary_cli_smoke(tmp_path, capsys):
+    """registry canary start/status/stop/promote over a real store: the
+    live release-loop lifecycle, each transition one CAS (semantics
+    unit-tested in test_canary.py — this pins the CLI wiring + exit
+    codes)."""
+    from bodywork_tpu.registry import read_aliases, resolve_alias
+    from bodywork_tpu.store import open_store
+
+    store = str(tmp_path / "artefacts")
+    assert main(["generate", "--store", store, "--date", "2026-01-01"]) == 0
+    assert main(["train", "--store", store]) == 0
+    # no production baseline yet: start refused with a clean exit 1
+    assert main(["registry", "canary", "start", "--store", store]) == 1
+    assert main(["registry", "gate", "--store", store,
+                 "--date", "2026-01-01"]) == 0
+    # no candidate left (the gate promoted it): clean exit 1
+    assert main(["registry", "canary", "start", "--store", store]) == 1
+    assert main(["generate", "--store", store, "--date", "2026-01-02"]) == 0
+    assert main(["train", "--store", store]) == 0
+    capsys.readouterr()
+    # defaulting to the newest candidate
+    assert main(["registry", "canary", "start", "--store", store,
+                 "--fraction", "0.25", "--seed", "7",
+                 "--date", "2026-01-02"]) == 0
+    out = capsys.readouterr().out
+    assert "regressor-2026-01-02.npz" in out and "0.25" in out
+    doc = read_aliases(open_store(store))
+    assert doc["canary"] == "models/regressor-2026-01-02.npz"
+    assert doc["canary_fraction"] == 0.25 and doc["canary_seed"] == 7
+    assert main(["registry", "canary", "status", "--store", store]) == 0
+    status = capsys.readouterr().out
+    assert '"live": true' in status
+    # stop clears the slot; a second stop is a clean error
+    assert main(["registry", "canary", "stop", "--store", store,
+                 "--date", "2026-01-02"]) == 0
+    assert "canary" not in read_aliases(open_store(store))
+    assert main(["registry", "canary", "stop", "--store", store]) == 1
+    # a BYTE-IDENTICAL retrain of the aborted key stays rejected (same
+    # bytes, same verdict), so the next canary comes from a new day's
+    # genuinely different checkpoint
+    assert main(["train", "--store", store]) == 0
+    assert main(["registry", "canary", "start", "--store", store]) == 1
+    assert main(["generate", "--store", store, "--date", "2026-01-03"]) == 0
+    assert main(["train", "--store", store]) == 0
+    assert main(["registry", "canary", "start", "--store", store,
+                 "--date", "2026-01-03"]) == 0
+    assert main(["registry", "canary", "promote", "--store", store,
+                 "--date", "2026-01-04"]) == 0
+    assert resolve_alias(open_store(store), "production") == (
+        "models/regressor-2026-01-03.npz"
+    )
+    capsys.readouterr()
+
+
+def test_registry_canary_fraction_is_usage_error(tmp_path):
+    # a fraction outside (0, 1] is an argparse usage error (exit 2),
+    # caught before any store I/O
+    with pytest.raises(SystemExit) as excinfo:
+        main(["registry", "canary", "start", "--store", str(tmp_path),
+              "--fraction", "0"])
+    assert excinfo.value.code == 2
+    with pytest.raises(SystemExit) as excinfo:
+        main(["registry", "canary", "start", "--store", str(tmp_path),
+              "--fraction", "1.5"])
+    assert excinfo.value.code == 2
+
+
+def test_chaos_canary_refuses_gcs(capsys):
+    assert main(["chaos", "canary", "--store", "gs://bucket/x"]) == 1
+
+
 def test_train_mesh_flags_reach_sharded_path(tmp_path, capsys):
     # `train --mesh-data/--mesh-model` arg wiring: rejects linear (the
     # sharded path is MLP-only), exit-code contract intact

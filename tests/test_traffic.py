@@ -21,6 +21,7 @@ from bodywork_tpu.traffic import (
     write_request_log,
 )
 from bodywork_tpu.traffic.generator import ARRIVAL_PROCESSES, LOG_SCHEMA, Request
+from bodywork_tpu.traffic.runner import format_report
 
 
 # -- seeded determinism ------------------------------------------------------
@@ -202,6 +203,63 @@ def test_report_accounting():
     assert report.retry_after["max_s"] == 5.0
     assert report.latency["p50_s"] is not None
     assert report.max_in_flight >= 1
+    # a 2-tuple transport (no attribution header) buckets every OK
+    # response under "unknown" — the pre-canary server shape
+    assert set(report.per_model_key) == {"unknown"}
+    assert report.per_model_key["unknown"]["ok"] == counts[0]
+
+
+def _attributing_transport(statuses, model_keys):
+    """A canned transport returning the 3-tuple shape the HTTP transport
+    produces: (status, retry_after, responding model key)."""
+    counter = {"i": 0}
+
+    async def transport(req: Request):
+        i = counter["i"]
+        counter["i"] += 1
+        return (
+            statuses[i % len(statuses)],
+            None,
+            model_keys[i % len(model_keys)],
+        )
+
+    return transport
+
+
+def test_per_model_key_breakdown():
+    """ISSUE 8 satellite: the report attributes latency/goodput per
+    RESPONDING model key (the X-Bodywork-Model-Key header) so canary
+    sweeps are measurable with this harness; OK responses without the
+    header land in the 'unknown' bucket."""
+    cfg = TrafficConfig(rate_rps=600.0, duration_s=0.5, seed=4)
+    requests = generate_request_log(cfg)
+    production = "models/regressor-2026-01-01.npz"
+    canary = "models/regressor-2026-01-02.npz"
+    # cycle: production-OK, canary-OK, headerless-OK, canary-429
+    report = run_open_loop(
+        "http://x", requests,
+        transport=_attributing_transport(
+            statuses=[200, 200, 200, 429],
+            model_keys=[production, canary, None, canary],
+        ),
+    )
+    n = len(requests)
+    counts = [len(range(k, n, 4)) for k in range(4)]
+    assert set(report.per_model_key) == {production, canary, "unknown"}
+    assert report.per_model_key[production]["ok"] == counts[0]
+    assert report.per_model_key[canary]["ok"] == counts[1]  # 429 excluded
+    assert report.per_model_key["unknown"]["ok"] == counts[2]
+    for entry in report.per_model_key.values():
+        assert entry["ok_in_window"] <= entry["ok"]
+        assert entry["goodput_rps"] > 0
+        assert entry["latency"]["p50_s"] is not None
+        assert entry["latency"]["p99_s"] is not None
+    # per-key goodput decomposes total goodput
+    assert sum(
+        e["ok"] for e in report.per_model_key.values()
+    ) == report.ok
+    # the breakdown rides the JSON report (the CLI's stdout contract)
+    assert "per_model_key" in json.loads(format_report(report))
 
 
 def test_empty_log_is_an_error():
