@@ -42,6 +42,14 @@ under ``"configs"``. ``--config N`` runs a single config:
    admitted responses (measured from scheduled arrival), shed fraction,
    plus an MMPP burst point and a cross-engine byte-identity check.
    CPU-safe: the mechanism is front-end queueing/admission control
+10. incremental training (``train/incremental.py``): a >=90-day per-day
+    TRAIN-wall series per mode (full refit vs incremental), linear and
+    MLP — last-third/first-third flatness vs the measured 1.21
+    full-refit baseline (SCALE_DEV_r05_cpu.json), rows touched per day,
+    the linear coefficient-exactness check against an independent
+    float64 full refit on the same per-day splits, and the MLP shadow
+    quality check against the gate's promotion bound. CPU-safe: the
+    mechanism is compute avoidance — O(tail) rows instead of O(history)
 
 Protocol (configs 2/3/5): bootstrap a fresh store, run the multi-day
 simulation, report the mean wall-clock of the steady-state days (day 1
@@ -86,7 +94,7 @@ from datetime import date
 BASELINE_DAY_S = 1317 * 0.00822  # reference stage-4 scoring loop, see above
 BASELINE_REQUEST_S = 0.00822  # reference per-request scoring latency
 
-ALL_CONFIGS = (1, 2, 3, 4, 5, 6, 7, 8, 9)
+ALL_CONFIGS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10)
 HEADLINE_CONFIG = 2  # the north-star day loop
 
 # -- config 6: the "wide" workload (no reference analogue) -------------------
@@ -1866,6 +1874,438 @@ def bench_open_loop_serving(
 
 
 #: the all-configs run list: every entry here must also carry a
+# -- config 10: incremental training flatness --------------------------------
+
+#: the committed-record protocol: >= 90 days (the SCALE_DEV horizon the
+#: 1.21 full-refit baseline ratio was measured over), both model types.
+#: 1440 rows/day is the reference generator's REAL day size
+#: (DriftConfig.n_samples) — reference-parity workload, and large
+#: enough that each day's CPU cost (~100 ms+) stands clear of the
+#: kernel's 10 ms CPU-time accounting quantum and of the O(days)
+#: listing/trainstate metadata (~1-2 ms at day 90)
+INCREMENTAL_DAYS = 90
+INCREMENTAL_ROWS_PER_DAY = 1440
+#: measured full-refit MLP last-third/first-third ratio over 90 days
+#: (SCALE_DEV_r05_cpu.json) — the baseline config 10 exists to beat
+INCREMENTAL_BASELINE_RATIO = 1.21
+#: MLP sized for a CPU-tractable 90-day x 2-mode sweep; the mechanism
+#: under test is O(history)-vs-O(tail) row footprint, not model scale
+INCREMENTAL_MLP_KWARGS = {"hidden": [32, 32], "n_steps": 400}
+
+
+def _flatness(series: list, warmup_days: int = 1) -> dict:
+    """Last-third/first-third mean ratio + horizon slope over the STEADY
+    days. ``warmup_days`` are excluded from the front: day 1 pays the
+    trainstate/donor bootstrap (config 2/3's ``_steady_days``
+    convention), and config 10 additionally excludes the tail-window
+    RAMP (days 2..TAIL_DAYS, whose replay/eval windows are still
+    growing toward the tail width — genuinely cheaper days that would
+    inflate the ratio of a series that is flat from the moment the
+    window fills)."""
+    steady = series[warmup_days:] if len(series) > warmup_days else list(series)
+    n = len(steady)
+    third = max(n // 3, 1)
+
+    def trimmed_mean(xs):
+        # 10% symmetric trim: an environment stall long enough to span
+        # every min-of-N attempt of one day (two ~0.3 s disk stalls on
+        # a ~0.05 s fit were measured doing exactly this) must not
+        # decide a third's mean — the ratio compares typical days
+        xs = sorted(xs)
+        k = len(xs) // 10 if len(xs) >= 5 else 0
+        return sum(xs[k:len(xs) - k] if k else xs) / (len(xs) - 2 * k)
+
+    first = trimmed_mean(steady[:third])
+    last = trimmed_mean(steady[-third:])
+    mean_y = sum(steady) / n
+    xs = range(n)
+    mean_x = sum(xs) / n
+    var_x = sum((x - mean_x) ** 2 for x in xs)
+    slope = (
+        sum((x - mean_x) * (y - mean_y) for x, y in zip(xs, steady)) / var_x
+        if var_x else 0.0
+    )
+    return {
+        "last_third_over_first_third": round(last / first, 4) if first else None,
+        "steady_mean_s": round(mean_y, 5),
+        "slope_s_per_day": round(slope, 7),
+    }
+
+
+#: per-day repeat count for config 10: each day's train is measured
+#: min-of-N (the standard noise-robust estimator) — single-shot series
+#: on a shared box flipped their apparent slope sign between captures
+INCREMENTAL_ATTEMPTS = 3
+
+
+def _date_cutoff_store(root: str, day):
+    """A COLD store handle whose date-keyed listings are truncated to
+    reconstruct the store as day ``day``'s train saw it: ``datasets/``
+    up to and including ``day`` (the rows that train consumes),
+    everything else — checkpoints, metrics, registry records — up to
+    the day BEFORE (the MLP warm start's donor must resolve to
+    yesterday's checkpoint, exactly as it did live). Access by explicit
+    key passes through; only listing-driven discovery is cut."""
+    import datetime as _dt
+
+    from bodywork_tpu.store import FilesystemStore
+    from bodywork_tpu.store.base import DelegatingStore
+    from bodywork_tpu.store.schema import DATASETS_PREFIX
+    from bodywork_tpu.utils.dates import date_from_key
+
+    class _CutoffStore(DelegatingStore):
+        def list_keys(self, prefix: str = "") -> list[str]:
+            out = []
+            for key in self.inner.list_keys(prefix):
+                d = date_from_key(key)
+                if d is None:
+                    out.append(key)
+                    continue
+                limit = day if key.startswith(DATASETS_PREFIX) else (
+                    day - _dt.timedelta(days=1)
+                )
+                if d <= limit:
+                    out.append(key)
+            return out
+
+    return _CutoffStore(FilesystemStore(root))
+
+
+def _measure_train_day(root: str, day, pre_trainstate, model_type: str,
+                       mode: str, model_kwargs, rows_per_day: int,
+                       attempts: int = INCREMENTAL_ATTEMPTS) -> tuple:
+    """Measure ONE day's train cost against its reconstructed store
+    state, robustly: ``attempts`` repeats, each from a COLD cutoff
+    handle (fresh caches — the per-day-pod regime, config 8's
+    convention), min over attempts on wall seconds. Repeats are made
+    honest by restoring the PRE-day trainstate document
+    (``pre_trainstate`` bytes, None = absent) before every attempt, so
+    each attempt performs the same fold; the raw ``put_bytes`` reset is
+    harness-level state surgery — product code only ever CAS-writes the
+    key. All other writes re-put byte-identical artefacts (training is
+    deterministic)."""
+    from bodywork_tpu.store import FilesystemStore
+    from bodywork_tpu.store.base import ArtefactNotFound
+    from bodywork_tpu.store.schema import trainstate_key
+    from bodywork_tpu.train import train_on_history
+
+    ts_key = trainstate_key(model_type)
+    admin = FilesystemStore(root)
+    best = None
+    result = None
+    for _attempt in range(max(attempts, 1)):
+        if pre_trainstate is None:
+            try:
+                admin.delete(ts_key)
+            except ArtefactNotFound:
+                pass
+        else:
+            admin.put_bytes(ts_key, pre_trainstate)
+        view = _date_cutoff_store(root, day)
+        t0 = time.perf_counter()
+        c0 = time.process_time()
+        result = train_on_history(
+            view, model_type, model_kwargs=model_kwargs, mode=mode,
+            rows_per_day=rows_per_day,
+        )
+        wall = time.perf_counter() - t0
+        cpu = time.process_time() - c0
+        if best is None or wall < best["s"]:
+            best = {"s": round(wall, 5), "cpu_s": round(cpu, 5)}
+    return best, result
+
+
+def _incremental_day_series(model_type: str, mode: str, days: int,
+                            rows_per_day: int, model_kwargs) -> tuple:
+    """One (model, mode) run, in two passes. Returns
+    ``(store_root, per_day list, final-day TrainResult)``.
+
+    **Build pass (untimed):** generate every dataset, prewarm the FULL
+    mode's bucket-crossing XLA compiles (the pipeline runner's
+    ``_prewarm_horizon`` behaviour — the series must measure data-plane
+    growth, not compile placement), run a (tail+1)-day scratch warmup
+    (covers the incremental paths' window-ramp compiles and the fresh
+    process' first-execution slowness, measured 0.12 s -> 0.02 s for
+    the same compiled fit), then run the whole horizon sequentially,
+    capturing each day's PRE-fold trainstate bytes.
+
+    **Measurement pass:** re-measure every day's train against its
+    reconstructed store state (date-cutoff view + trainstate restore —
+    :func:`_measure_train_day`) in SEEDED-SHUFFLED day order. Shuffling
+    is what makes the flatness ratio trustworthy on a shared box:
+    machine-speed drift over the capture's minutes lands uniformly
+    across history lengths instead of systematically inflating (or
+    deflating) the last third — sequential single-shot captures
+    measured ratios from 0.5 to 1.75 for the SAME workload."""
+    import random as _random
+    from datetime import timedelta
+
+    from bodywork_tpu.data import Dataset, generate_day, persist_dataset
+    from bodywork_tpu.data.drift_config import DriftConfig
+    from bodywork_tpu.store import FilesystemStore
+    from bodywork_tpu.store.base import ArtefactNotFound
+    from bodywork_tpu.store.schema import trainstate_key
+    from bodywork_tpu.train import train_on_history
+    from bodywork_tpu.train.incremental import TAIL_DAYS
+
+    root = tempfile.mkdtemp(prefix=f"bench-inc-{model_type}-{mode}-")
+    store = FilesystemStore(root)
+    drift = DriftConfig(n_samples=rows_per_day)
+    datasets = []
+    for i in range(days):
+        d = date(2026, 1, 1) + timedelta(days=i)
+        X, y = generate_day(d, drift)
+        datasets.append(Dataset(X, y, d))
+    if mode == "full":
+        from bodywork_tpu.train.prewarm import prewarm_async, wait_idle
+
+        cum = 0
+        for ds in datasets:
+            cum += len(ds)
+            prewarm_async(model_type, model_kwargs, cum,
+                          n_features=ds.X.shape[1])
+        wait_idle()
+    scratch = FilesystemStore(tempfile.mkdtemp(prefix="bench-inc-warm-"))
+    for ds in datasets[:TAIL_DAYS + 1]:
+        persist_dataset(scratch, ds)
+        train_on_history(scratch, model_type, model_kwargs=model_kwargs,
+                         mode=mode, rows_per_day=rows_per_day)
+    # build pass: sequential, untimed; capture pre-fold trainstate bytes
+    ts_key = trainstate_key(model_type)
+    pre_state: list = []
+    for ds in datasets:
+        try:
+            pre_state.append(store.get_bytes(ts_key))
+        except ArtefactNotFound:
+            pre_state.append(None)
+        persist_dataset(store, ds)
+        train_on_history(store, model_type, model_kwargs=model_kwargs,
+                         mode=mode, rows_per_day=rows_per_day)
+    # measurement pass: shuffled day order
+    order = list(range(days))
+    _random.Random(93).shuffle(order)
+    per_day: list = [None] * days
+    final_result = None
+    for i in order:
+        measured, result = _measure_train_day(
+            root, datasets[i].date, pre_state[i], model_type, mode,
+            model_kwargs, rows_per_day,
+        )
+        per_day[i] = {
+            **measured,
+            "rows_touched": result.rows_touched,
+            **({"fallback": result.fallback_reason}
+               if result.fallback_reason else {}),
+        }
+        if i == days - 1:
+            final_result = result
+    print(
+        f"  {model_type}/{mode}: {days}d, day1 {per_day[0]['s']:.3f}s -> "
+        f"day{days} {per_day[-1]['s']:.3f}s, rows "
+        f"{per_day[0]['rows_touched']} -> {per_day[-1]['rows_touched']}",
+        file=sys.stderr,
+    )
+    return root, per_day, final_result
+
+
+def _linear_coefficient_check(root: str, result, atol: float = 1e-4) -> dict:
+    """The exactness proof: the incremental solution vs an INDEPENDENT
+    float64 least-squares refit on the union of the same per-day train
+    splits (the statistics' defining identity), and vs the float32
+    device fit on those rows (the executable full refit)."""
+    import numpy as np
+
+    from bodywork_tpu.data.io import load_dataset
+    from bodywork_tpu.models import LinearRegressor
+    from bodywork_tpu.store import FilesystemStore
+    from bodywork_tpu.store.schema import DATASETS_PREFIX
+    from bodywork_tpu.train.incremental import day_split_indices
+
+    store = FilesystemStore(root)
+    Xs, ys = [], []
+    for key, d in store.history(DATASETS_PREFIX):
+        ds = load_dataset(store, key)
+        train_idx, _ = day_split_indices(len(ds), d, 0.2, 42)
+        Xs.append(ds.X[train_idx])
+        ys.append(ds.y[train_idx])
+    X = np.concatenate(Xs).astype(np.float64)
+    y = np.concatenate(ys).astype(np.float64)
+    A = np.concatenate([X, np.ones((len(y), 1))], axis=1)
+    theta64, *_ = np.linalg.lstsq(A, y, rcond=None)
+    inc = result.model.host_params()
+    inc_theta = np.concatenate(
+        [np.asarray(inc["w"]).ravel(), [float(inc["b"])]]
+    )
+    fit32 = LinearRegressor().fit(X.astype(np.float32), y.astype(np.float32))
+    h32 = fit32.host_params()
+    theta32 = np.concatenate(
+        [np.asarray(h32["w"]).ravel(), [float(np.asarray(h32["b"]))]]
+    )
+    diff64 = float(np.max(np.abs(inc_theta - theta64)))
+    diff32 = float(np.max(np.abs(inc_theta - theta32)))
+    return {
+        "coefficients": [round(float(v), 8) for v in inc_theta],
+        "max_abs_diff_vs_float64_refit": diff64,
+        "max_abs_diff_vs_float32_device_refit": diff32,
+        "atol": atol,
+        "within_atol": diff64 <= atol,
+        "rows": int(len(y)),
+    }
+
+
+def _mlp_shadow_gate_check(root: str, result, model_kwargs,
+                           rows_per_day: int) -> dict:
+    """The quality proof: the final incremental candidate's shadow-window
+    MAPE vs a same-store full refit's, against the gate's promotion
+    ceiling (``GatePolicy.shadow_max_mape_ratio`` + slack) — the bound
+    the runner's shadow-armed gate enforces every incremental day."""
+    import numpy as np
+
+    from bodywork_tpu.data.io import load_dataset
+    from bodywork_tpu.registry.gates import GatePolicy
+    from bodywork_tpu.store import FilesystemStore
+    from bodywork_tpu.store.schema import DATASETS_PREFIX
+    from bodywork_tpu.train import train_on_history
+    from bodywork_tpu.train.incremental import INCREMENTAL_SHADOW_DAYS
+
+    store = FilesystemStore(root)
+    full = train_on_history(
+        store, "mlp", model_kwargs=model_kwargs, mode="full", persist=False,
+        rows_per_day=rows_per_day,
+    )
+    window = store.history(DATASETS_PREFIX)[-INCREMENTAL_SHADOW_DAYS:]
+    eps = 2.220446049250313e-16
+    mapes = {}
+    for name, model in (("candidate", result.model), ("full_refit", full.model)):
+        errs, denoms = [], []
+        for key, _d in window:
+            ds = load_dataset(store, key)
+            pred = np.asarray(model.predict_padded(ds.X), dtype=np.float64)
+            errs.append(np.abs(pred - ds.y))
+            denoms.append(np.maximum(np.abs(ds.y), eps))
+        mapes[name] = float(
+            np.mean(np.concatenate(errs) / np.concatenate(denoms))
+        )
+    policy = GatePolicy()
+    ceiling = mapes["full_refit"] * policy.shadow_max_mape_ratio + policy.mape_slack
+    return {
+        "shadow_days": INCREMENTAL_SHADOW_DAYS,
+        "candidate_mape": round(mapes["candidate"], 6),
+        "full_refit_mape": round(mapes["full_refit"], 6),
+        "gate_ceiling": round(ceiling, 6),
+        "within_gate": mapes["candidate"] <= ceiling,
+    }
+
+
+def bench_incremental_train(
+    days: int = INCREMENTAL_DAYS,
+    rows_per_day: int = INCREMENTAL_ROWS_PER_DAY,
+    model_types=("linear", "mlp"),
+) -> dict:
+    """Config 10: per-day TRAIN cost vs history length, full refit vs
+    incremental (docs/PERF.md has the full protocol).
+
+    For each (model, mode): a fresh store runs ``days`` simulated days
+    of generate-then-train with ONLY the train call timed — the exact
+    compute config 10 exists to flatten (SCALE_DEV_r05_cpu.json showed
+    the pipeline's residual growth is all here). The incremental runs
+    also commit the two safety proofs: linear coefficients vs an
+    independent float64 full refit on the same per-day splits (exactness)
+    and the MLP candidate's shadow-window MAPE vs the gate's promotion
+    ceiling (bounded approximation). CPU-safe end to end: the mechanism
+    is rows-not-touched, not device speed."""
+    models: dict = {}
+    for model_type in model_types:
+        kwargs = INCREMENTAL_MLP_KWARGS if model_type == "mlp" else None
+        entry: dict = {}
+        roots: dict = {}
+        for mode in ("full", "incremental"):
+            root, per_day, result = _incremental_day_series(
+                model_type, mode, days, rows_per_day, kwargs
+            )
+            roots[mode] = (root, result)
+            fallbacks: dict = {}
+            for p in per_day:
+                if "fallback" in p:
+                    fallbacks[p["fallback"]] = fallbacks.get(p["fallback"], 0) + 1
+            from bodywork_tpu.train.incremental import TAIL_DAYS
+
+            # steady state starts once the tail window has filled: days
+            # 1..TAIL_DAYS pay bootstrap + a growing window (see
+            # _flatness); applied to BOTH modes for comparability
+            warmup = min(TAIL_DAYS, max(len(per_day) - 3, 1))
+            entry[mode] = {
+                # flatness basis: min-of-N wall seconds measured in
+                # SHUFFLED day order (drift-decorrelated — see
+                # _incremental_day_series); per-day CPU seconds ride
+                # alongside but the kernel accounts them in 10 ms
+                # jiffies, too coarse to headline a ~50 ms fit
+                "flatness": _flatness(
+                    [p["s"] for p in per_day], warmup_days=warmup
+                ),
+                "cpu_flatness": _flatness(
+                    [p["cpu_s"] for p in per_day], warmup_days=warmup
+                ),
+                "steady_from_day": warmup + 1,
+                "rows_touched_final_day": per_day[-1]["rows_touched"],
+                "fallbacks": fallbacks,
+                "per_day": per_day,
+            }
+        root_inc, result_inc = roots["incremental"]
+        if model_type == "linear":
+            entry["coefficient_check"] = _linear_coefficient_check(
+                root_inc, result_inc
+            )
+        else:
+            entry["shadow_gate"] = _mlp_shadow_gate_check(
+                root_inc, result_inc, kwargs, rows_per_day
+            )
+        models[model_type] = entry
+    headline_model = "mlp" if "mlp" in models else next(iter(models))
+    inc_flat = models[headline_model]["incremental"]["flatness"]
+    return {
+        "metric": "incremental_train_flatness",
+        # headline: the incremental mode's last-third/first-third per-day
+        # train-wall ratio at the largest model — 1.0 is perfectly flat,
+        # the measured full-refit baseline is 1.21
+        "value": inc_flat["last_third_over_first_third"],
+        "unit": "last-third/first-third train wall ratio",
+        "vs_baseline": INCREMENTAL_BASELINE_RATIO,
+        "baseline_note": (
+            "baseline is the measured full-refit MLP ratio over the same "
+            "90-day horizon (SCALE_DEV_r05_cpu.json "
+            "last_third_over_first_third=1.21, a WARM in-process loop); "
+            "this record's own 'full' series re-measures the full refit "
+            "under THIS protocol's cold-handle per-day-pod regime, where "
+            "the O(history) reload makes the growth steeper — compare "
+            "incremental against the in-record full series first"
+        ),
+        "days": days,
+        "rows_per_day": rows_per_day,
+        "headline_model": headline_model,
+        "models": models,
+        "protocol": (
+            "fresh store per (model, mode); two passes: an UNTIMED "
+            "sequential build (datasets pre-generated; full-mode "
+            "bucket-crossing XLA compiles prewarmed; (tail+1)-day "
+            "scratch warmup covers the incremental window-ramp compiles "
+            "and fresh-process slowness; per-day pre-fold trainstate "
+            "captured), then a measurement pass re-running every day's "
+            "train against its reconstructed store state (date-cutoff "
+            "listing view + trainstate restore) in SEEDED-SHUFFLED day "
+            "order so machine-speed drift cannot masquerade as growth; "
+            f"min-of-{INCREMENTAL_ATTEMPTS} wall seconds per day, each "
+            "attempt from a COLD handle (per-day-pod regime); per-day "
+            "CPU seconds recorded alongside (cpu_flatness; 10 ms kernel "
+            "accounting quantum); steady days exclude day 1 (trainstate/"
+            "donor bootstrap); incremental proofs: linear coefficients "
+            "vs independent float64 lstsq on the union of per-day train "
+            "splits, mlp shadow-window MAPE vs the gate ceiling "
+            "(registry.gates.GatePolicy)"
+        ),
+    }
+
+
 #: CONFIG_TIMEOUT_S budget and appear in ALL_CONFIGS — pinned by
 #: tests/test_bench.py::test_config_registry_sync so a new config can
 #: never silently miss one of the three tables (config 7 was once wired
@@ -1882,6 +2322,7 @@ CONFIG_BENCHES = {
     7: lambda: bench_single_row_scoring(),
     8: lambda: bench_history_cold_start(),
     9: lambda: bench_open_loop_serving(),
+    10: lambda: bench_incremental_train(),
 }
 
 
@@ -1940,9 +2381,12 @@ RESUME_MAX_AGE_S = 6 * 3600
 #: config 9 is host-side open-loop HTTP around tiny device calls — the
 #: budget covers JAX init + two engines x (capacity probe + 3 timed
 #: sweep points + the aio MMPP point) at ~4 s per point
+#: config 10 is 2 models x 2 modes x a 90-day train loop of small fits
+#: (the full-mode MLP series dominates at ~1-2 s/day on CPU) plus the
+#: exactness/shadow proof refits — host-compute-bound, generously sized
 CONFIG_TIMEOUT_S = {
     1: 300, 2: 300, 3: 600, 4: 600, 5: 450, 6: 1200, 7: 600, 8: 300,
-    9: 600,
+    9: 600, 10: 1800,
 }
 
 
@@ -2232,6 +2676,11 @@ def compact_output(records: list[dict], backend: str,
                 f"config {HEADLINE_CONFIG} failed; headline is "
                 f"config {headline['config']}"
             )
+    def _sig(v):
+        # 5 significant digits is plenty for a one-liner (the full
+        # record keeps full precision) and buys line budget at 10 configs
+        return float(f"{v:.5g}") if isinstance(v, float) else v
+
     out["backend"] = backend
     out["schema"] = SCHEMA_VERSION
     out["configs"] = [
@@ -2239,13 +2688,14 @@ def compact_output(records: list[dict], backend: str,
             # error messages are truncated: a multi-KB JAX traceback in
             # one config would push this line past the driver's tail and
             # recreate the parsed-as-null failure (full text is in the
-            # full record). 120 chars each keeps the worst case — every
-            # config errored AND flagged — under the 2000-char tail now
-            # that the run list holds 9 configs; per-config `unit` is
-            # dropped from the one-liners for the same budget (the
-            # headline keeps its unit, the full record has them all)
-            k: (r[k][:120] if k in ("error", "cpu_scaled_protocol",
-                                    "timing_anomaly") else r[k])
+            # full record). 80 chars each (plus the float rounding) keeps
+            # the worst case — a failed config AND flagged configs — under
+            # the 2000-char tail now that the run list holds 10 configs;
+            # per-config `unit` is dropped from the one-liners for the
+            # same budget (the headline keeps its unit, the full record
+            # has them all)
+            k: (r[k][:80] if k in ("error", "cpu_scaled_protocol",
+                                   "timing_anomaly") else _sig(r[k]))
             for k in ("config", "metric", "value", "vs_baseline",
                       "backend", "elapsed_s", "resumed", "error",
                       "cpu_scaled_protocol", "timing_anomaly")

@@ -91,9 +91,15 @@ class FaultPlan:
     #: document raises and callers keep current state; see
     #: ``registry/records.py``). The cap (default 2) below the registry
     #: read budget (3 attempts) is what keeps chaos-run gate decisions
-    #: byte-identical to the fault-free twin's.
+    #: byte-identical to the fault-free twin's. ``trainstate/`` readers
+    #: (``train/incremental.py``) digest-verify the document under the
+    #: same 3-attempt budget; past it they degrade to a full-refit
+    #: rebuild — derived state, so corruption can cost one O(history)
+    #: day but never a wrong model.
     corrupt_read_p: float = 0.0
-    corrupt_prefixes: tuple[str, ...] = ("snapshots/", "registry/", "runs/")
+    corrupt_prefixes: tuple[str, ...] = (
+        "snapshots/", "registry/", "runs/", "trainstate/"
+    )
     #: scoring service /score/v1* requests: answer 503 or 429 (split
     #: evenly, deterministically) with a Retry-After header
     http_error_p: float = 0.0

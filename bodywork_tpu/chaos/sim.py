@@ -68,14 +68,36 @@ _RETRY_COUNTERS = (
 )
 
 
+def _apply_train_mode(spec, train_mode: str):
+    """PIN the train stages' mode arg — always set explicitly, even for
+    ``full``: an absent arg falls through to BODYWORK_TPU_TRAIN_MODE in
+    ``train_stage``, and an exported env knob silently overriding an
+    explicit ``--train-mode full`` would soak (and report PASS for) the
+    wrong mode."""
+    from bodywork_tpu.train.trainer import TRAIN_MODES
+
+    if train_mode not in TRAIN_MODES:
+        raise ValueError(
+            f"unknown train mode {train_mode!r}; expected one of "
+            f"{TRAIN_MODES}"
+        )
+    for stage in spec.stages.values():
+        if stage.executable.endswith(":train_stage"):
+            stage.args = {**stage.args, "mode": train_mode}
+    return spec
+
+
 def chaos_pipeline_spec(model_type: str = "linear",
-                        scoring_mode: str = "batch"):
+                        scoring_mode: str = "batch",
+                        train_mode: str = "full"):
     """The canonical daily pipeline with the serve stage swapped for the
     flaky-mode wrapper (identical spec otherwise, so the faulted run's
     work plan matches the baseline's exactly)."""
     from bodywork_tpu.pipeline import default_pipeline
 
-    spec = default_pipeline(model_type, scoring_mode)
+    spec = _apply_train_mode(
+        default_pipeline(model_type, scoring_mode), train_mode
+    )
     spec.stages["stage-2-serve-model"].executable = (
         "bodywork_tpu.chaos.http:flaky_serve_stage"
     )
@@ -224,10 +246,18 @@ def run_chaos_sim(
     model_type: str = "linear",
     scoring_mode: str = "batch",
     drift=None,
+    train_mode: str = "full",
 ) -> dict:
     """Run the baseline and faulted simulations under ``root`` (in
     ``baseline/`` and ``chaos/`` subdirectories, which must not already
-    hold artefacts) and return the comparison + fault/retry summary."""
+    hold artefacts) and return the comparison + fault/retry summary.
+
+    ``train_mode="incremental"`` runs BOTH twins through the
+    incremental-training path (``train/incremental.py``), putting the
+    ``trainstate/`` sufficient-statistics artefact in the byte-identity
+    comparison's scope — corrupt reads of it (it is in the default
+    ``corrupt_prefixes``) must degrade to a rebuild that converges to
+    the same bytes as the fault-free twin's."""
     from bodywork_tpu.pipeline import LocalRunner, default_pipeline
 
     root = Path(root)
@@ -247,7 +277,10 @@ def run_chaos_sim(
     log.info(f"chaos sim: baseline run ({days} day(s)) -> {baseline_dir}")
     baseline_store = FilesystemStore(baseline_dir)
     LocalRunner(
-        default_pipeline(model_type, scoring_mode), baseline_store,
+        _apply_train_mode(
+            default_pipeline(model_type, scoring_mode), train_mode
+        ),
+        baseline_store,
         drift=drift,
     ).run_simulation(start, days)
 
@@ -258,7 +291,8 @@ def run_chaos_sim(
     wrapped = ResilientStore(FaultInjectingStore(real_store, plan))
     with activate(plan):
         LocalRunner(
-            chaos_pipeline_spec(model_type, scoring_mode), wrapped,
+            chaos_pipeline_spec(model_type, scoring_mode, train_mode),
+            wrapped,
             drift=drift,
         ).run_simulation(start, days)
 

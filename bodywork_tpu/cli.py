@@ -5,7 +5,7 @@ pipeline is driven by the external ``bodywork`` tool. Here the framework is
 its own driver:
 
     python -m bodywork_tpu.cli generate  --store DIR [--date D]
-    python -m bodywork_tpu.cli train     --store DIR [--model linear|mlp]
+    python -m bodywork_tpu.cli train     --store DIR [--model linear|mlp] [--mode full|incremental]
     python -m bodywork_tpu.cli serve     --store DIR [--port P]
     python -m bodywork_tpu.cli test      --store DIR --scoring-url URL
     python -m bodywork_tpu.cli run-day   --store DIR [--date D]
@@ -86,10 +86,15 @@ def cmd_train(args) -> int:
             args.model,
             mesh_data=args.mesh_data,
             mesh_model=args.mesh_model,
+            mode=args.mode,
         )
+    fallback = (
+        f" fallback={result.fallback_reason}" if result.fallback_reason else ""
+    )
     print(
         f"{result.model_artefact_key} MAPE={result.metrics['MAPE']:.4f} "
-        f"r2={result.metrics['r_squared']:.4f}"
+        f"r2={result.metrics['r_squared']:.4f} mode={result.mode} "
+        f"rows_touched={result.rows_touched}{fallback}"
     )
     return 0
 
@@ -712,6 +717,7 @@ def cmd_chaos_run_sim(args) -> int:
     summary = run_chaos_sim(
         args.store, _date(args), args.days, plan,
         model_type=args.model, scoring_mode=args.mode, drift=drift,
+        train_mode=args.train_mode,
     )
     faults = summary["faults_injected"]
     print(
@@ -1110,6 +1116,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--store", **common_store)
     p.add_argument("--model", default="linear", choices=["linear", "mlp"])
     p.add_argument(
+        # choices hardcoded to keep parser construction import-light;
+        # pinned == train.TRAIN_MODES == the train_stage env parsing by
+        # tests/test_incremental.py
+        "--mode", default=_env_choice(
+            "BODYWORK_TPU_TRAIN_MODE", ("full", "incremental"), "full"
+        ),
+        choices=["full", "incremental"],
+        help="'full' refits on all history (default; env "
+             "BODYWORK_TPU_TRAIN_MODE overrides); 'incremental' folds "
+             "in only the new day — exact persisted sufficient "
+             "statistics for the linear model, warm-start + replay "
+             "fine-tune for the mlp, both falling back to a full refit "
+             "(with the reason counted and printed) when the store "
+             "lacks what they need",
+    )
+    p.add_argument(
         "--mesh-data", type=int, default=None,
         help="data-parallel mesh axis for sharded training (mlp only)",
     )
@@ -1396,6 +1418,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "works too (docs/RESILIENCE.md §crash-resume)")
     p.add_argument("--model", default="linear", choices=["linear", "mlp"])
     p.add_argument("--mode", default="batch", choices=["single", "batch"])
+    p.add_argument(
+        "--train-mode", default="full", choices=["full", "incremental"],
+        help="run BOTH twins through this training mode; 'incremental' "
+             "puts the trainstate/ sufficient-statistics artefact in "
+             "the byte-identity comparison's scope "
+             "(train/incremental.py)",
+    )
 
     p = chaos_sub.add_parser(
         "canary",

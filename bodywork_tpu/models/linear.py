@@ -74,6 +74,52 @@ def _ols_fit_eval(Xtr, ytr, wtr, Xte, yte, wte, l2, fit_intercept: bool = True):
     return params, pack_tree_with_tail(params, m)
 
 
+def gram_stats(X: np.ndarray, y: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Float64 sufficient statistics ``(G, c)`` of one row block for the
+    normal equations, over the intercept-augmented design ``A = [X | 1]``:
+    ``G = AᵀA`` (d+1, d+1) and ``c = Aᵀy`` (d+1,).
+
+    These are the additive state behind incremental training
+    (:mod:`bodywork_tpu.train.incremental`): the statistics of a multi-day
+    history are the SUM of each day's, so folding in one new day and
+    solving :func:`solve_normal_eq` reproduces the full refit's
+    coefficients exactly — O(new rows) work instead of O(history).
+    Host float64 on purpose: the blocks are tiny (d = 2 here), the sum
+    must be exact enough to survive hundreds of days of accumulation,
+    and the serialized statistics must be bit-deterministic so chaos
+    twins' ``trainstate/`` documents stay byte-identical."""
+    X = np.asarray(X, dtype=np.float64)
+    if X.ndim == 1:
+        X = X[:, None]
+    y = np.asarray(y, dtype=np.float64).ravel()
+    A = np.concatenate([X, np.ones((X.shape[0], 1))], axis=1)
+    return A.T @ A, A.T @ y
+
+
+def solve_normal_eq(
+    G: np.ndarray, c: np.ndarray, config: LinearConfig | None = None
+) -> dict:
+    """Solve summed :func:`gram_stats` statistics into host float32
+    params ``{"w", "b"}`` — the same math as ``_ols_core`` (l2 ridge on
+    the full augmented diagonal, intercept as the last column), computed
+    in float64 on the host. The no-intercept variant drops the augmented
+    row/column, mirroring ``_ols_no_intercept_core``."""
+    config = config or LinearConfig()
+    G = np.asarray(G, dtype=np.float64)
+    c = np.asarray(c, dtype=np.float64)
+    if config.fit_intercept:
+        theta = np.linalg.solve(G + config.l2 * np.eye(G.shape[0]), c)
+        w, b = theta[:-1], theta[-1]
+    else:
+        Gs = G[:-1, :-1] + config.l2 * np.eye(G.shape[0] - 1)
+        theta = np.linalg.solve(Gs, c[:-1])
+        w, b = theta, 0.0
+    return {
+        "w": np.asarray(w, dtype=np.float32),
+        "b": np.float32(b),
+    }
+
+
 def linear_apply(params, X: jax.Array) -> jax.Array:
     # plain (unjitted) pure function: the per-class jitted version lives in
     # base._APPLY_FNS (one compiled apply per class), and fused programs

@@ -219,6 +219,44 @@ class MLPRegressor(Regressor):
         fitted.final_loss = float(tail[3])
         return fitted, metrics_dict(tail)
 
+    def fine_tune(
+        self, X: np.ndarray, y: np.ndarray, n_steps: int,
+        seed: int | None = None,
+    ) -> "MLPRegressor":
+        """Warm-started continuation: resume Adam from THIS model's
+        fitted params for ``n_steps`` on (X, y) — the incremental-retrain
+        path (:mod:`bodywork_tpu.train.incremental`), where the donor is
+        the production checkpoint and (X, y) is a replay window instead
+        of all history. The donor's folded-in scaler is KEPT (the net's
+        input distribution must not shift under it mid-descent; replay
+        windows are too small to re-estimate it anyway), so predictions
+        stay continuous with the donor's. The optimizer state restarts
+        fresh — checkpoints deliberately hold params only."""
+        assert self.params is not None, "cannot fine-tune an unfitted model"
+        cfg = dataclasses.replace(self.config, n_steps=n_steps)
+        X = np.asarray(X, dtype=np.float32)
+        if X.ndim == 1:
+            X = X[:, None]
+        y = np.asarray(y, dtype=np.float32).ravel()
+        Xp, yp, w = pad_rows(X, y)
+        host = self.host_params()
+        s = host["scaler"]
+        # standardise with the DONOR's scaler, on the host (O(rows), and
+        # the zero-padding rows stay harmless: weight 0 in the loss)
+        Xs = jnp.asarray((Xp - s["x_mean"]) / s["x_std"])
+        ys = jnp.asarray((yp - s["y_mean"]) / s["y_std"])
+        key = jax.random.PRNGKey(self.config.seed if seed is None else seed)
+        # a FRESH device copy of the net: _train donates its params
+        # argument, and the donor may still be serving traffic
+        net = jax.device_put(jax.tree_util.tree_map(np.asarray, host["net"]))
+        net, losses = _train(net, Xs, ys, jnp.asarray(w), key, cfg)
+        params = {"net": net, "scaler": jax.device_put(host["scaler"])}
+        # the ORIGINAL config rides the checkpoint: n_steps was a detail
+        # of this continuation, not of the architecture being served
+        tuned = MLPRegressor(self.config, params)
+        tuned.final_loss = float(losses[-1])
+        return tuned
+
     @property
     def n_features(self) -> int | None:
         if self.params is None:

@@ -630,6 +630,13 @@ def generate_manifests(
         # and use an honest name.
         run_day_stage = dataclasses.replace(
             first_stage, name="daily-loop", image=None, requirements=[],
+            # the train-mode knob (pipeline/stages._train_env_mode),
+            # materialised like the serve Deployment's engine/admission
+            # knobs: an operator flips the deployed retrain between the
+            # full refit and the O(1)-per-day incremental path
+            # (train/incremental.py) with one `kubectl set env` — no
+            # image rebuild. The default preserves deployed behaviour.
+            env={"BODYWORK_TPU_TRAIN_MODE": "full", **first_stage.env},
         )
         run_day_command = [
             "python", "-m", "bodywork_tpu.cli", "run-day",

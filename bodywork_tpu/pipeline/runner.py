@@ -315,6 +315,16 @@ class LocalRunner:
             return
         stage_seconds[stage_name] = time.perf_counter() - t0
         extra = {}
+        if stage.kind == "batch" and getattr(result, "mode", None) is not None \
+                and getattr(result, "rows_touched", None) is not None:
+            # a TrainResult: the span records HOW the model was produced
+            # (full vs incremental), the data footprint that cost, and
+            # any degradation — the trace answers "why was this day
+            # O(history)" without correlating against logs
+            extra["train_mode"] = result.mode
+            extra["rows_touched"] = result.rows_touched
+            if getattr(result, "fallback_reason", None):
+                extra["fallback_reason"] = result.fallback_reason
         if stage.kind == "service":
             # the serve span records WHAT went live and under whose
             # authority (registry production vs latest-checkpoint
@@ -333,7 +343,47 @@ class LocalRunner:
             f"[{today}] {stage_name} done in {stage_seconds[stage_name]:.3f}s"
         )
 
-    def _run_registry_gate(self, today: date, stage_results: dict) -> None:
+    def _full_refit_fallback(self, today: date, ctx, journal,
+                             stage_names: list[str]) -> None:
+        """The registry gate REJECTED this day's incremental candidate:
+        re-run the train stage(s) as a FULL refit immediately — the day
+        must still end with a gateable, trustworthy candidate, not with
+        yesterday's model and a rejected fine-tune. The retrain
+        re-registers the same date-keyed checkpoint with new bytes
+        (records.register_candidate flips the rejected record back to
+        candidate on a digest change), and the caller re-gates it under
+        the standard policy. The journal's train-stage artefact digests
+        are re-recorded so a crash-resume verifies the FULL refit's
+        bytes, not the rejected incremental's."""
+        import dataclasses as _dc
+
+        from bodywork_tpu.train.incremental import count_fallback
+
+        # the lookahead handoff (if any) was already consumed by the
+        # original train run — and it computed the INCREMENTAL result;
+        # the fallback must genuinely retrain
+        ctx.prefetched_train = None
+        for name in stage_names:
+            count_fallback("gate_rejected")
+            log.warning(
+                f"[{today}] incremental candidate rejected by the gate; "
+                f"re-running {name} as a full refit"
+            )
+            stage = self.spec.stages[name]
+            fn = resolve_executable(stage.executable)
+            with self.recorder.span(f"full-refit-fallback-{name}", "gate",
+                                    day=str(today)):
+                with _device_ctx(self.device):
+                    result = fn(ctx, **{**stage.args, "mode": "full"})
+            result = _dc.replace(result, fallback_reason="gate_rejected")
+            ctx.stage_results[name] = result
+            if journal is not None:
+                completes = self._journal_artefacts([name], ctx)
+                if completes:
+                    journal.record_completes(completes)
+
+    def _run_registry_gate(self, today: date, ctx, journal=None,
+                           train_stages: set | None = None) -> None:
         """The promotion-gate step between train and serve
         (``bodywork_tpu.registry``): adjudicate the candidate the train
         step just registered — promote it to the ``production`` alias or
@@ -344,15 +394,80 @@ class LocalRunner:
         ``stage_seconds``, which stays exactly the user's DECLARED DAG.
         No retries; a gate FAILURE (as opposed to a rejection) only
         logs — serving then keeps the current production (or the
-        latest-checkpoint fallback on a store that has never promoted)."""
+        latest-checkpoint fallback on a store that has never promoted).
+
+        INCREMENTAL candidates (``train/incremental.py``) get two extra
+        behaviours: the gate policy arms shadow evaluation
+        (``INCREMENTAL_SHADOW_DAYS`` — the approximate MLP path is only
+        safe because a degraded fine-tune is caught here), and a
+        rejection triggers the same-day full-refit fallback
+        (:meth:`_full_refit_fallback`) followed by a re-gate under the
+        standard policy."""
+        stage_results = ctx.stage_results
         start_rel = self.recorder.now()
         t0 = time.perf_counter()
         failed = False
+        fallback = False
         verdict = None
         try:
             from bodywork_tpu.registry import ModelRegistry
 
-            decision = ModelRegistry(self.store).gate(day=today)
+            def _result_mode(name):
+                result = stage_results.get(name)
+                mode = getattr(result, "mode", None)
+                if mode is not None:
+                    return mode
+                # a journal-SKIPPED train stage leaves its journal entry
+                # dict (not a TrainResult) in stage_results: resolve the
+                # mode the stage ran with (spec arg, else the pod env
+                # knob) — a crash resumed between train-complete and the
+                # gate must not silently adjudicate an incremental
+                # candidate under the default policy, dropping the
+                # shadow check and the full-refit fallback
+                from bodywork_tpu.pipeline.stages import _train_env_mode
+
+                return (
+                    self.spec.stages[name].args.get("mode")
+                    or _train_env_mode()
+                )
+
+            incremental_stages = [
+                n for n in (train_stages or ())
+                if _result_mode(n) == "incremental"
+            ]
+            if incremental_stages:
+                from bodywork_tpu.registry.gates import GatePolicy
+                from bodywork_tpu.train.incremental import (
+                    INCREMENTAL_SHADOW_DAYS,
+                )
+
+                registry = ModelRegistry(
+                    self.store,
+                    policy=GatePolicy(shadow_days=INCREMENTAL_SHADOW_DAYS),
+                )
+            else:
+                registry = ModelRegistry(self.store)
+            decision = registry.gate(day=today)
+            if decision is not None and not decision.promote:
+
+                def _produced(name, model_key):
+                    result = stage_results.get(name)
+                    if getattr(result, "model_artefact_key", None) == model_key:
+                        return True
+                    # journal-skipped stage: the entry's artefact digest
+                    # map names what the stage produced
+                    return isinstance(result, dict) and model_key in (
+                        result.get("artefacts") or {}
+                    )
+
+                rejected = [
+                    n for n in incremental_stages
+                    if _produced(n, decision.model_key)
+                ]
+                if rejected:
+                    fallback = True
+                    self._full_refit_fallback(today, ctx, journal, rejected)
+                    decision = ModelRegistry(self.store).gate(day=today)
             stage_results["registry-gate"] = decision
             if decision is not None:
                 verdict = "promoted" if decision.promote else "rejected"
@@ -364,6 +479,8 @@ class LocalRunner:
             failed = True
             log.error(f"registry gate failed (non-fatal): {exc!r}")
         extra = {"verdict": verdict} if verdict else {}
+        if fallback:
+            extra["full_refit_fallback"] = True
         if failed:
             extra["failed"] = True
         self.recorder.add("registry-gate", "gate", start_rel,
@@ -705,7 +822,9 @@ class LocalRunner:
                 # before any later step resolves what to serve), the gate
                 # promotes or rejects it
                 if gate_pending and train_stages <= set(stage_results):
-                    self._run_registry_gate(today, stage_results)
+                    self._run_registry_gate(
+                        today, ctx, journal, train_stages=train_stages
+                    )
                     gate_pending = False
                 # tomorrow's training set is complete once every generate
                 # stage has persisted: overlap tomorrow's train with the
@@ -794,9 +913,17 @@ class LocalRunner:
             # sharded training dispatches mesh programs the single-device
             # prewarm cannot represent (and mesh_* are not model kwargs)
             return
+        from bodywork_tpu.pipeline.stages import _train_env_mode
+
+        if (stage.args.get("mode") or _train_env_mode()) == "incremental":
+            # the incremental path never dispatches the fused full-fit
+            # programs this warms (its eval buckets are tail-sized and
+            # constant); the rare full-refit fallback pays its own
+            # compile instead of every sim bootstrap paying all of them
+            return
         model_kwargs = {
             k: v for k, v in stage.args.items()
-            if k not in ("model_type", "mesh_data", "mesh_model")
+            if k not in ("model_type", "mode", "mesh_data", "mesh_model")
         } or None
         # Base the estimate on the ACTUAL persisted history size (the y>=0
         # filter drops a sigma-dependent fraction of n_samples, so counting

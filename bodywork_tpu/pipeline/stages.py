@@ -107,6 +107,11 @@ def stage_artefact_keys(stage_spec, result, ctx: StageContext) -> list[str]:
         keys = [
             getattr(result, "model_artefact_key", None),
             getattr(result, "metrics_artefact_key", None),
+            # an incremental train's sufficient-statistics document
+            # (train/incremental.py) is journalled too: a resumed run
+            # re-verifies its digest, and a mismatch re-runs the stage,
+            # which rebuilds or re-folds it — never trusts it blindly
+            getattr(result, "trainstate_artefact_key", None),
         ]
         return [k for k in keys if k]
     if executable.endswith(":test_stage"):
@@ -152,14 +157,41 @@ def generate_stage(ctx: StageContext, offset_days: int = 1) -> str:
     return key
 
 
+def _train_env_mode() -> str:
+    """The deployed train mode from the pod environment
+    (``BODYWORK_TPU_TRAIN_MODE``): an operator flips the daily retrain
+    between the full refit and the O(1)-per-day incremental path
+    (``train/incremental.py``) without a spec change. Malformed values
+    degrade to ``full`` with a warning (the same contract as
+    :func:`_serve_env_knobs` — a typo must never crash the pod); pinned
+    against the ``cli train --mode`` choices by tests/test_incremental.py."""
+    import os
+
+    from bodywork_tpu.train.trainer import TRAIN_MODES
+
+    raw = os.environ.get("BODYWORK_TPU_TRAIN_MODE", "").strip()
+    if raw and raw not in TRAIN_MODES:
+        log.warning(
+            f"ignoring BODYWORK_TPU_TRAIN_MODE={raw!r} "
+            f"(expected one of {TRAIN_MODES})"
+        )
+        raw = ""
+    return raw or "full"
+
+
 def train_stage(
     ctx: StageContext,
     model_type: str = "linear",
+    mode: str | None = None,
     mesh_data: int | None = None,
     mesh_model: int = 1,
     **model_kwargs,
 ):
     """Train on all data to date, persist model + metrics (reference stage 1).
+
+    ``mode`` picks the full refit vs the incremental O(1)-per-day path
+    (spec args or ``cli train --mode``; None defaults from the pod
+    environment via :func:`_train_env_mode`).
 
     ``mesh_data``/``mesh_model`` > 1 (spec args or ``train --mesh-data``)
     run the fit as the dp x tp sharded training step over a device mesh —
@@ -195,6 +227,7 @@ def train_stage(
         persist=not ctx.defer_artefacts,
         mesh_data=mesh_data,
         mesh_model=mesh_model,
+        mode=mode if mode is not None else _train_env_mode(),
     )
 
 

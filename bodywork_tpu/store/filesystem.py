@@ -142,8 +142,19 @@ class FilesystemStore(ArtefactStore):
             raise ArtefactNotFound(key) from None
 
     def list_keys(self, prefix: str = "") -> list[str]:
+        # Walk only the prefix's directory subtree. Prefixes map to
+        # directories (schema.ALL_PREFIXES), and walking the WHOLE root
+        # per listing made every history()/latest() call O(total
+        # artefacts ever written): on a 90-day store each day's
+        # incremental retrain paid ~5x the listing it asked for, and the
+        # cost grew forever (measured as the dominant term in the
+        # config-10 flatness profile).
+        dir_part, _, _name_part = prefix.rpartition("/")
+        base = self.root / dir_part if dir_part else self.root
+        if not base.is_dir():
+            return []
         keys = []
-        for dirpath, _dirnames, filenames in os.walk(self.root):
+        for dirpath, _dirnames, filenames in os.walk(base):
             for name in filenames:
                 if name.startswith(".tmp-"):
                     continue

@@ -27,6 +27,19 @@ to clear. Like the alias document, journals are mutated EXCLUSIVELY
 through ``ArtefactStore.put_bytes_if_match`` (never a raw ``put_bytes``)
 — the lease protocol is only sound if every writer rides the CAS.
 
+``trainstate/`` holds persisted training state for incremental retrains
+(``train/incremental.py``): per-model-type JSON documents of per-day
+sufficient statistics (the linear model's Gram matrix/moment vector,
+row counts, label ranges) that let each day's retrain fold in only the
+new day instead of refitting on all history. Delete safety: trainstate
+is DERIVED state — every entry is a pure function of the persisted
+datasets — so deleting the prefix is always safe; the only cost is one
+full refit on the next training run, which rebuilds it. Like the alias
+document and run journals, trainstate is mutated EXCLUSIVELY through
+``ArtefactStore.put_bytes_if_match`` (never a raw ``put_bytes``), and
+every document embeds a content digest its readers verify — a corrupt
+or torn read degrades to the full-refit rebuild, never a wrong model.
+
 ``registry/`` holds the model-registry release-management layer
 (``bodywork_tpu/registry/``): date-keyed per-model records under
 ``registry/records/`` plus the single alias document
@@ -49,6 +62,7 @@ MODELS_PREFIX = "models/"
 MODEL_METRICS_PREFIX = "model-metrics/"
 TEST_METRICS_PREFIX = "test-metrics/"
 SNAPSHOTS_PREFIX = "snapshots/"
+TRAINSTATE_PREFIX = "trainstate/"
 RUNS_PREFIX = "runs/"
 REGISTRY_PREFIX = "registry/"
 REGISTRY_RECORDS_PREFIX = "registry/records/"
@@ -64,6 +78,7 @@ ALL_PREFIXES = (
     MODEL_METRICS_PREFIX,
     TEST_METRICS_PREFIX,
     SNAPSHOTS_PREFIX,
+    TRAINSTATE_PREFIX,
     RUNS_PREFIX,
     REGISTRY_PREFIX,
 )
@@ -101,6 +116,15 @@ def run_journal_key(d: date) -> str:
     to the standard date-key protocol for retention tooling, while the
     per-day subdirectory leaves room for future per-run attachments."""
     return f"{RUNS_PREFIX}{d}/journal.json"
+
+
+def trainstate_key(model_type: str) -> str:
+    """The persisted-sufficient-statistics document for one model type
+    (``train/incremental.py``). One document per model type, no embedded
+    date — like the alias document it is a live, CAS-mutated pointer
+    into history, not a date-keyed artefact, so it stays invisible to
+    the ``history``/``latest`` protocol by design."""
+    return f"{TRAINSTATE_PREFIX}{model_type}-suffstats.json"
 
 
 def snapshot_key(d: date) -> str:

@@ -1,5 +1,6 @@
 from bodywork_tpu.train.prewarm import prewarm_async
 from bodywork_tpu.train.trainer import (
+    TRAIN_MODES,
     TrainResult,
     persist_metrics,
     persist_train_result,
@@ -7,6 +8,7 @@ from bodywork_tpu.train.trainer import (
 )
 
 __all__ = [
+    "TRAIN_MODES",
     "TrainResult",
     "persist_metrics",
     "persist_train_result",

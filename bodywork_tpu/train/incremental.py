@@ -1,0 +1,683 @@
+"""Incremental training: O(1)-per-day retrain instead of O(history).
+
+The daily trainer refits on ALL history every simulated day, and the
+committed 90-day flatness record (``SCALE_DEV_r05_cpu.json``) attributes
+the residual per-day wall-clock growth exactly to that O(history)
+train/eval compute (+26.9% over the horizon for the MLP, last-third/
+first-third 1.21). This module makes the per-day cost flat in history
+length (ROADMAP item 3), which is what unlocks hourly/minute retrain
+cadence — the registry gate (PR 5) and canary watchdog (PR 8) already
+make fast-cadence promotion safe; this makes it affordable. Two
+mechanisms, matched to each model's math:
+
+**Linear — exact.** The OLS fit is the normal equations over the
+intercept-augmented design, and its sufficient statistics are ADDITIVE
+over row blocks: ``G = Σ_day G_day``, ``c = Σ_day c_day``
+(:func:`bodywork_tpu.models.linear.gram_stats`). The RUNNING cumulative
+sums (plus tiny per-day scalars for staleness detection and the
+prediction-bounds band) are persisted in a digest-verified,
+O(1)-per-day ``trainstate/`` document
+(:func:`bodywork_tpu.store.schema.trainstate_key` — deliberately not
+per-day Gram blocks: the document is reread and rewritten every day,
+and an O(days) payload was a measured per-day growth term), so a
+retrain folds in ONLY the new day's rows and solves in closed form
+(:func:`~bodywork_tpu.models.linear.solve_normal_eq`) — provably
+coefficient-identical (within float tolerance) to a full refit on the
+same rows, under any day ordering (new entries are accumulated in
+sorted-day order; the hypothesis property test pins the equivalence
+over permuted/partial day sequences). Held-out metrics come from
+per-day deterministic splits (seeded by the day, so a day's train/test
+membership never changes as history grows — the precondition for
+per-day statistics to be exact) evaluated over the tail window: O(tail)
+rows, not O(history).
+
+**MLP — approximate.** No finite sufficient statistics exist for the
+net, so the incremental path warm-starts from the checkpoint serving
+would load (``resolve_serving_key`` — the gate-promoted production on a
+registry store, the newest checkpoint otherwise; the donor-checkpoint
+reuse practice of PAPERS.md's pjit-era training) and fine-tunes on a
+replay buffer of the tail window (:meth:`MLPRegressor.fine_tune`). The
+result is a CANDIDATE like any other: the runner arms the registry
+gate's shadow evaluation for incremental candidates
+(``INCREMENTAL_SHADOW_DAYS``), so a degraded incremental retrain is
+auto-rejected and the runner falls back to a full refit THAT SAME DAY
+(``LocalRunner._full_refit_fallback``) — approximation error is bounded
+by the release gate, not by hope.
+
+**Fallback, never a wedged pipeline.** Every incapacity degrades to the
+full refit with the reason counted on
+``bodywork_tpu_train_fallbacks_total{reason}``: a missing or
+shape-incompatible donor checkpoint (``no_donor`` /
+``donor_incompatible``), an absent/corrupt-past-retry-budget/stale
+trainstate document (``trainstate_absent`` / ``trainstate_corrupt`` /
+``trainstate_stale`` — the linear path rebuilds its statistics from all
+history in the same call, re-seeding O(1) behaviour for the next day),
+and the gate rejection above (``gate_rejected``).
+
+Determinism: trainstate documents are pure functions of the persisted
+dataset bytes and the split parameters — canonical JSON, embedded
+content digest, no wall clock, no backend tokens — and are mutated
+EXCLUSIVELY through ``ArtefactStore.put_bytes_if_match``, so the chaos
+harness's byte-identical twin guarantee extends over ``trainstate/``
+and concurrent writers (a runner racing a rescheduled pod) can never
+tear the document.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from time import perf_counter
+
+import numpy as np
+
+from bodywork_tpu.store.base import ArtefactNotFound, ArtefactStore, CasConflict
+from bodywork_tpu.store.schema import DATASETS_PREFIX, trainstate_key
+from bodywork_tpu.train.trainer import (
+    TRAIN_MODES,
+    TrainResult,
+    _record_train_metrics,
+    make_model,
+)
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("train.incremental")
+
+__all__ = [
+    "INCREMENTAL_SHADOW_DAYS",
+    "IncrementalUnavailable",
+    "TAIL_DAYS",
+    "TRAIN_MODES",
+    "count_fallback",
+    "persist_trainstate",
+    "read_trainstate",
+    "train_incremental",
+]
+
+TRAINSTATE_SCHEMA = "bodywork_tpu.trainstate/1"
+
+#: tail window (days) for held-out evaluation (linear) and the MLP
+#: replay buffer — the incremental day's data footprint
+TAIL_DAYS = 7
+
+#: shadow-evaluation window the runner's registry gate arms for
+#: INCREMENTAL candidates (docs/REGISTRY.md): the approximate MLP path
+#: is only safe because a degraded fine-tune is auto-rejected there
+INCREMENTAL_SHADOW_DAYS = 3
+
+#: MLP fine-tune budget: this fraction of the config's full n_steps,
+#: floored at MIN_FINE_TUNE_STEPS
+FINE_TUNE_STEPS_FRACTION = 0.25
+MIN_FINE_TUNE_STEPS = 100
+
+#: trainstate read retry budget: 1 + retries attempts, kept ABOVE the
+#: chaos plan's default ``max_consecutive`` cap of 2 (same contract as
+#: registry/records.py) so a seeded soak's corrupt reads never escalate
+#: to a full-refit rebuild that would diverge from the fault-free twin
+CORRUPT_READ_RETRIES = 2
+
+
+class IncrementalUnavailable(RuntimeError):
+    """The incremental path cannot run for a structural reason; the
+    dispatcher degrades to a full refit with ``reason`` counted."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(detail or reason)
+        self.reason = reason
+
+
+def count_fallback(reason: str) -> None:
+    from bodywork_tpu.obs import get_registry
+
+    get_registry().counter(
+        "bodywork_tpu_train_fallbacks_total",
+        "Incremental-train degradations to a full refit, by reason",
+    ).inc(reason=reason)
+
+
+# -- per-day deterministic splits ------------------------------------------
+
+
+def day_split_indices(
+    n: int, day, test_size: float, seed: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """``(train_idx, test_idx)`` for one day's ``n`` rows, seeded by
+    ``(seed, day)`` — each day's split membership is fixed forever,
+    independent of every other day. That per-day determinism is what
+    makes per-day sufficient statistics EXACT: under the global split
+    (``models.base.train_test_split``) adding a day reshuffles every
+    earlier row's membership, so no per-day state could be additive.
+    Same convention as the global split (first ``round(n*test_size)``
+    permuted indices are the test rows)."""
+    rng = np.random.default_rng(np.random.SeedSequence((seed, day.toordinal())))
+    perm = rng.permutation(n)
+    n_test = int(round(n * test_size))
+    return perm[n_test:], perm[:n_test]
+
+
+def _window_eval_arrays(parts, window_keys, dates, test_size: float, seed: int):
+    """Concatenated HELD-OUT (per-day test split) rows over the tail
+    window, oldest first. Degenerate windows whose per-day test splits
+    are all empty (tiny day sizes) fall back to the window's full rows —
+    an in-sample metric beats a NaN one that would wedge the gate."""
+    Xs, ys = [], []
+    for key in window_keys:
+        ds = parts[key]
+        _train_idx, test_idx = day_split_indices(
+            len(ds), dates[key], test_size, seed
+        )
+        if len(test_idx):
+            Xs.append(ds.X[test_idx])
+            ys.append(ds.y[test_idx])
+    if not Xs:
+        Xs = [parts[k].X for k in window_keys]
+        ys = [parts[k].y for k in window_keys]
+    return np.concatenate(Xs), np.concatenate(ys)
+
+
+# -- the trainstate document -----------------------------------------------
+
+
+def _payload_digest(doc: dict) -> str:
+    payload = json.dumps(
+        [doc["model_type"], doc["feature_dim"], doc["split"],
+         doc["cum_g"], doc["cum_c"], doc["days"]],
+        sort_keys=True,
+    ).encode("utf-8")
+    return "sha256:" + hashlib.sha256(payload).hexdigest()
+
+
+def _build_doc(model_type: str, feature_dim: int, split: dict,
+               days: dict, cum_g, cum_c) -> dict:
+    """The trainstate document: the RUNNING cumulative statistics
+    (``cum_g``/``cum_c`` — float64 sums over every covered day's train
+    split, in day order) plus tiny per-day scalars (row counts + label
+    range, for staleness detection and the prediction-bounds band).
+    Deliberately O(1)-sized per day, not per-day Gram blocks: the
+    document is read, digest-verified, and rewritten EVERY day, and an
+    O(days)-sized payload made that a measured per-day growth term —
+    the very thing incremental training exists to remove."""
+    doc = {
+        "schema": TRAINSTATE_SCHEMA,
+        "model_type": model_type,
+        "feature_dim": int(feature_dim),
+        "split": split,
+        "days": days,
+        "cum_g": [[float(v) for v in row] for row in cum_g],
+        "cum_c": [float(v) for v in cum_c],
+    }
+    doc["digest"] = _payload_digest(doc)
+    return doc
+
+
+def _count_corrupt() -> None:
+    from bodywork_tpu.obs import get_registry
+
+    get_registry().counter(
+        "bodywork_tpu_train_trainstate_corrupt_total",
+        "Trainstate reads that failed JSON/schema/digest validation",
+    ).inc()
+
+
+def read_trainstate(store: ArtefactStore, model_type: str):
+    """``(doc, version_token, reason)`` for the model type's trainstate
+    document. ``doc`` is None when the key is absent
+    (``reason="trainstate_absent"``) or stays invalid past the retry
+    budget (``reason="trainstate_corrupt"`` — the token is KEPT so the
+    rebuilding writer's CAS is a repair overwrite). Validation is
+    schema + embedded content digest: a torn or corrupted document can
+    only ever cost one full-refit rebuild, never a wrong model."""
+    key = trainstate_key(model_type)
+    token = store.version_token(key)
+    corrupt = False
+    for _attempt in range(1 + CORRUPT_READ_RETRIES):
+        try:
+            raw = store.get_bytes(key)
+        except ArtefactNotFound:
+            return None, None, "trainstate_absent"
+        try:
+            doc = json.loads(raw.decode("utf-8"))
+            if (
+                isinstance(doc, dict)
+                and doc.get("schema") == TRAINSTATE_SCHEMA
+                and isinstance(doc.get("days"), dict)
+                and isinstance(doc.get("cum_g"), list)
+                and isinstance(doc.get("cum_c"), list)
+                and doc.get("digest") == _payload_digest(doc)
+            ):
+                return doc, token, None
+        except (UnicodeDecodeError, ValueError, KeyError, TypeError):
+            pass
+        corrupt = True
+        _count_corrupt()
+        log.warning(f"corrupt trainstate document at {key!r}; re-reading")
+    assert corrupt
+    return None, token, "trainstate_corrupt"
+
+
+_UNSET = object()
+
+
+def persist_trainstate(
+    store: ArtefactStore,
+    model_type: str,
+    doc: dict,
+    expected_token=_UNSET,
+    attempts: int = 4,
+) -> str:
+    """CAS-write one trainstate document: LAST WRITER WINS. A lost race
+    re-reads the current token and overwrites. Two divergent cumulative
+    sums are never merged (they cannot be reconciled without per-day
+    blocks); instead, convergence is by REFOLD: any day the final
+    document does not cover reads as "new" on the next retrain and is
+    folded back in — and a REBUILD (stale statistics) must overwrite a
+    richer-looking incumbent unconditionally, because the incumbent's
+    extra days are exactly what went stale. The CAS still guarantees the
+    document never tears under concurrent writers. ``expected_token``
+    lets the caller reuse the token its read was taken under; omitted,
+    the current token is read first. The ONLY writer of ``trainstate/``
+    — the prefix is never touched by a raw ``put_bytes``."""
+    key = trainstate_key(model_type)
+    last: CasConflict | None = None
+    for _attempt in range(attempts):
+        if expected_token is _UNSET:
+            # the token alone (same metadata probe the alias writer
+            # uses): last-writer-wins needs no payload read
+            token = store.version_token(key)
+        else:
+            token = expected_token
+            expected_token = _UNSET  # any retry re-reads
+        # compact separators, NO indent: indent forces json's pure-Python
+        # encoder — machine state, not a human-facing record (registry
+        # records keep their indent)
+        data = json.dumps(
+            doc, sort_keys=True, separators=(",", ":")
+        ).encode("utf-8")
+        try:
+            store.put_bytes_if_match(key, data, token)
+            return key
+        except CasConflict as exc:
+            last = exc  # concurrent writer: re-read the token, retry
+    raise last
+
+
+# -- linear: exact sufficient statistics -----------------------------------
+
+
+def _day_entry(ds, test_size: float, seed: int) -> dict:
+    """One day's additive statistics: the train split's Gram blocks plus
+    the FULL day's row count and label range (bounds must match the full
+    refit's, which sees every row)."""
+    from bodywork_tpu.models.linear import gram_stats
+
+    X = np.asarray(ds.X, dtype=np.float64)
+    y = np.asarray(ds.y, dtype=np.float64).ravel()
+    train_idx, _test_idx = day_split_indices(len(y), ds.date, test_size, seed)
+    G, c = gram_stats(X[train_idx], y[train_idx])
+    return {
+        "g": G.tolist(),
+        "c": c.tolist(),
+        "n_rows": int(len(y)),
+        "n_train": int(len(train_idx)),
+        "y_min": float(np.min(y)),
+        "y_max": float(np.max(y)),
+    }
+
+
+def accumulate_entries(entries: dict, cum_g=None, cum_c=None):
+    """Fold per-day :func:`_day_entry` statistics onto a cumulative
+    ``(G, c)`` pair, adding the new entries IN SORTED-DAY ORDER
+    (sequential float64 accumulation — the same operation every prior
+    day's fold performed, so a rebuild from scratch reproduces the
+    incrementally-grown sums bit-for-bit when days arrive in order, and
+    within float tolerance under any arrival order)."""
+    first = next(iter(entries.values()))
+    dim = len(first["c"])
+    G = (np.zeros((dim, dim)) if cum_g is None
+         else np.asarray(cum_g, dtype=np.float64).copy())
+    c = (np.zeros(dim) if cum_c is None
+         else np.asarray(cum_c, dtype=np.float64).copy())
+    for key in sorted(entries):
+        entry = entries[key]
+        G += np.asarray(entry["g"], dtype=np.float64)
+        c += np.asarray(entry["c"], dtype=np.float64)
+    return G, c
+
+
+def solve_from_days(days: dict, config=None) -> dict:
+    """Accumulate per-day statistics (sorted-day order) and solve the
+    normal equations — the pure-function core the property tests pin
+    against an independent full refit."""
+    from bodywork_tpu.models.linear import solve_normal_eq
+
+    G, c = accumulate_entries(days)
+    return solve_normal_eq(G, c, config)
+
+
+def _bounds_from_days(days: dict) -> dict:
+    """The serving-side sanity band from per-day label ranges — the same
+    formula as ``trainer._prediction_bounds`` over all history's rows
+    (a global min/max decomposes over days exactly)."""
+    lo = min(e["y_min"] for e in days.values())
+    hi = max(e["y_max"] for e in days.values())
+    span = max(hi - lo, 1e-6)
+    margin = 0.5 * span
+    return {"lo": lo - margin, "hi": hi + margin}
+
+
+def _load_parts(store: ArtefactStore, hist, keys):
+    """Parsed datasets for ``keys`` through the standard three-tier
+    loader (parsed cache -> snapshot slices -> batched fetch) — the
+    incremental path reads O(tail) days through the same machinery the
+    full path reads O(history) through."""
+    from bodywork_tpu.data.io import load_history_parts
+
+    subset = [(k, d) for k, d in hist if k in keys]
+    tokens = store.version_tokens([k for k, _d in subset])
+    return load_history_parts(store, subset, tokens)
+
+
+def incremental_train_linear(
+    store: ArtefactStore,
+    model_kwargs: dict | None = None,
+    test_size: float = 0.2,
+    split_seed: int = 42,
+    tail_days: int = TAIL_DAYS,
+    persist: bool = True,
+) -> TrainResult:
+    """The exact incremental linear retrain (module docstring §linear).
+    An absent/corrupt/stale trainstate document degrades IN-CALL to the
+    full-statistics rebuild — O(history) once, with the reason counted
+    and recorded on the result — and re-seeds O(tail) behaviour for
+    every following day."""
+    import jax
+
+    from bodywork_tpu.models import LinearRegressor
+
+    model = make_model("linear", **(model_kwargs or {}))
+    hist = store.history(DATASETS_PREFIX)
+    if not hist:
+        raise ArtefactNotFound(f"no datasets under '{DATASETS_PREFIX}'")
+    dates = dict(hist)
+    hist_keys = [k for k, _d in hist]
+    data_date = hist[-1][1]
+    split = {"test_size": test_size, "seed": split_seed}
+
+    t0 = perf_counter()
+    doc, _token, reason = read_trainstate(store, "linear")
+    days: dict = {}
+    cum_g = cum_c = None
+    if doc is not None:
+        day_set = {str(d) for _k, d in hist}
+        if doc.get("split") != split:
+            reason = "trainstate_stale"
+        elif not set(doc["days"]) <= day_set:
+            # a covered day's dataset was DELETED: the cumulative sum
+            # would include rows that no longer exist — rebuild from
+            # what does
+            reason = "trainstate_stale"
+        else:
+            days = dict(doc["days"])
+            cum_g, cum_c = doc["cum_g"], doc["cum_c"]
+    new_keys = [k for k in hist_keys if str(dates[k]) not in days]
+    tail_keys = hist_keys[-max(tail_days, 1):]
+    needed = list(dict.fromkeys(new_keys + tail_keys))
+    parts = _load_parts(store, hist, set(needed))
+    feature_dim = parts[needed[0]].X.shape[1]
+    stale = None
+    if days and doc.get("feature_dim") != feature_dim:
+        # schema change under the statistics: the stored cumulative Gram
+        # has the wrong shape
+        stale = "feature dimension changed"
+    elif days:
+        # covered days whose datasets were OVERWRITTEN since folding
+        # (same date, different contents) would keep stale sums
+        # silently. The tail window's rows are already loaded, so its
+        # covered days get a free consistency check against the stored
+        # scalars (computed exactly as _day_entry computed them).
+        # Overwrites of PRE-tail days that preserve row count and label
+        # range are the residual blind spot — deletion, the common
+        # retention operation, is caught by the day-set check above.
+        for key in tail_keys:
+            meta = days.get(str(dates[key]))
+            if meta is None:
+                continue
+            y64 = np.asarray(parts[key].y, dtype=np.float64).ravel()
+            if (
+                meta.get("n_rows") != len(y64)
+                or meta.get("y_min") != float(np.min(y64))
+                or meta.get("y_max") != float(np.max(y64))
+            ):
+                stale = f"covered day {dates[key]} was overwritten"
+                break
+    if stale is not None:
+        log.warning(f"linear trainstate stale ({stale}); rebuilding")
+        reason = "trainstate_stale"
+        days = {}
+        cum_g = cum_c = None
+        new_keys = hist_keys
+        needed = list(dict.fromkeys(new_keys + tail_keys))
+        parts = _load_parts(store, hist, set(needed))
+    if reason is not None:
+        count_fallback(reason)
+        log.warning(
+            f"linear trainstate {reason}: rebuilding statistics from all "
+            f"{len(new_keys)} day(s) (full-refit-cost day; next day is "
+            "O(tail) again)"
+        )
+    if new_keys:
+        new_entries = {
+            str(dates[key]): _day_entry(parts[key], test_size, split_seed)
+            for key in new_keys
+        }
+        cum_g, cum_c = accumulate_entries(new_entries, cum_g, cum_c)
+        for day_str, entry in new_entries.items():
+            # the document keeps per-day SCALARS only (staleness
+            # detection + the bounds band); the Gram blocks live in the
+            # cumulative sum — see _build_doc
+            days[day_str] = {
+                k: entry[k] for k in ("n_rows", "n_train", "y_min", "y_max")
+            }
+
+    from bodywork_tpu.models.linear import solve_normal_eq
+
+    host_params = solve_normal_eq(cum_g, cum_c, model.config)
+    fitted = LinearRegressor(model.config, jax.device_put(host_params))
+    fitted._host_params = host_params
+    X_eval, y_eval = _window_eval_arrays(
+        parts, tail_keys, dates, test_size, split_seed
+    )
+    metrics = fitted.evaluate(X_eval, y_eval)
+    n_rows = sum(e["n_rows"] for e in days.values())
+    rows_touched = sum(len(parts[k]) for k in needed)
+    _record_train_metrics(
+        fitted, metrics, perf_counter() - t0, n_rows,
+        mode="incremental", rows_touched=rows_touched,
+    )
+    log.info(
+        f"incremental linear fold: {len(new_keys)} new day(s) into "
+        f"{len(days)} covered, {rows_touched} rows touched of {n_rows} "
+        f"total: MAPE={metrics['MAPE']:.4f} r2={metrics['r_squared']:.4f}"
+    )
+    bounds = _bounds_from_days(days)
+    result = TrainResult(
+        fitted, metrics, data_date, None, None, n_rows,
+        prediction_bounds=bounds, mode="incremental",
+        rows_touched=rows_touched, fallback_reason=reason,
+        pending_trainstate=_build_doc(
+            "linear", feature_dim, split, days, cum_g, cum_c
+        ),
+    )
+    if persist:
+        # ONE owner of the persistence protocol (model + metrics +
+        # candidate registration + the pending trainstate CAS):
+        # trainer.persist_train_result — the same path the deferred
+        # lookahead collection takes
+        from bodywork_tpu.train.trainer import persist_train_result
+
+        result = persist_train_result(store, result)
+    return result
+
+
+# -- mlp: warm-start + replay buffer ---------------------------------------
+
+
+def _load_donor(store: ArtefactStore):
+    """The warm-start donor: exactly the checkpoint serving would load
+    (production alias on a registry store, newest checkpoint otherwise).
+    ANY failure — no checkpoint, corrupt alias, unreadable bytes — is an
+    IncrementalUnavailable, never a wedged pipeline."""
+    from bodywork_tpu.models.checkpoint import load_model
+
+    try:
+        model, _d = load_model(store, None, device=False)
+        return model
+    except Exception as exc:
+        raise IncrementalUnavailable(
+            "no_donor", f"no donor checkpoint for warm start: {exc!r}"
+        ) from exc
+
+
+def incremental_train_mlp(
+    store: ArtefactStore,
+    model_kwargs: dict | None = None,
+    test_size: float = 0.2,
+    split_seed: int = 42,
+    fit_seed: int | None = None,
+    tail_days: int = TAIL_DAYS,
+    persist: bool = True,
+) -> TrainResult:
+    """The approximate incremental MLP retrain (module docstring §mlp):
+    warm-start from the serving checkpoint, fine-tune on the tail-window
+    replay buffer, evaluate on the window's held-out splits. The result
+    is a candidate gated WITH shadow evaluation by the runner — quality
+    is enforced at the release gate, not assumed here."""
+    template = make_model("mlp", **(model_kwargs or {}))
+    cfg = template.config
+    hist = store.history(DATASETS_PREFIX)
+    if not hist:
+        raise ArtefactNotFound(f"no datasets under '{DATASETS_PREFIX}'")
+    dates = dict(hist)
+    data_date = hist[-1][1]
+
+    t0 = perf_counter()
+    donor = _load_donor(store)
+    if donor.model_type != "mlp":
+        raise IncrementalUnavailable(
+            "donor_incompatible",
+            f"donor is {donor.model_type!r}, cannot warm-start an mlp",
+        )
+    if tuple(donor.config.hidden) != tuple(cfg.hidden):
+        raise IncrementalUnavailable(
+            "donor_incompatible",
+            f"donor hidden={list(donor.config.hidden)} != "
+            f"requested {list(cfg.hidden)}",
+        )
+    window_keys = [k for k, _d in hist[-max(tail_days, 1):]]
+    parts = _load_parts(store, hist, set(window_keys))
+    feature_dim = parts[window_keys[0]].X.shape[1]
+    if donor.n_features != feature_dim:
+        raise IncrementalUnavailable(
+            "donor_incompatible",
+            f"donor expects {donor.n_features} feature(s), data has "
+            f"{feature_dim}",
+        )
+    Xs, ys = [], []
+    for key in window_keys:
+        ds = parts[key]
+        train_idx, _test_idx = day_split_indices(
+            len(ds), dates[key], test_size, split_seed
+        )
+        Xs.append(ds.X[train_idx])
+        ys.append(ds.y[train_idx])
+    X_train, y_train = np.concatenate(Xs), np.concatenate(ys)
+    X_eval, y_eval = _window_eval_arrays(
+        parts, window_keys, dates, test_size, split_seed
+    )
+    ft_steps = max(MIN_FINE_TUNE_STEPS,
+                   int(cfg.n_steps * FINE_TUNE_STEPS_FRACTION))
+    # deterministic per (config seed, day): chaos twins replay the same
+    # minibatch draws, and successive days still see fresh randomness
+    base_seed = cfg.seed if fit_seed is None else fit_seed
+    tuned = donor.fine_tune(
+        X_train, y_train, n_steps=ft_steps,
+        seed=int(base_seed) + data_date.toordinal(),
+    )
+    metrics = tuned.evaluate(X_eval, y_eval)
+    rows_touched = sum(len(parts[k]) for k in window_keys)
+    _record_train_metrics(
+        tuned, metrics, perf_counter() - t0, rows_touched,
+        mode="incremental", rows_touched=rows_touched,
+    )
+    log.info(
+        f"incremental mlp fine-tune: {ft_steps} step(s) from donor "
+        f"{donor.info} on {len(window_keys)}-day replay "
+        f"({rows_touched} rows): MAPE={metrics['MAPE']:.4f} "
+        f"r2={metrics['r_squared']:.4f}"
+    )
+    from bodywork_tpu.train.trainer import _prediction_bounds
+
+    # the sanity band comes from the replay window's labels (ALL rows,
+    # like the full path over its history) — under drift the recent
+    # window is the honest range for what this candidate will serve
+    bounds = _prediction_bounds(
+        np.concatenate([parts[k].y for k in window_keys])
+    )
+    result = TrainResult(
+        tuned, metrics, data_date, None, None, rows_touched,
+        prediction_bounds=bounds, mode="incremental",
+        rows_touched=rows_touched,
+    )
+    if persist:
+        # ONE owner of the persistence protocol — see the linear path
+        from bodywork_tpu.train.trainer import persist_train_result
+
+        result = persist_train_result(store, result)
+    return result
+
+
+# -- dispatch --------------------------------------------------------------
+
+
+def train_incremental(
+    store: ArtefactStore,
+    model_type: str = "linear",
+    model_kwargs: dict | None = None,
+    test_size: float = 0.2,
+    split_seed: int = 42,
+    fit_seed: int | None = None,
+    persist: bool = True,
+    tail_days: int = TAIL_DAYS,
+) -> TrainResult:
+    """Mode dispatcher with the degradation contract: any structural
+    incapacity of the incremental path falls back to the full refit with
+    the reason counted and recorded on the result — a missing donor can
+    cost one O(history) day, never a failed pipeline."""
+    try:
+        if model_type == "linear":
+            return incremental_train_linear(
+                store, model_kwargs=model_kwargs, test_size=test_size,
+                split_seed=split_seed, tail_days=tail_days, persist=persist,
+            )
+        if model_type == "mlp":
+            return incremental_train_mlp(
+                store, model_kwargs=model_kwargs, test_size=test_size,
+                split_seed=split_seed, fit_seed=fit_seed,
+                tail_days=tail_days, persist=persist,
+            )
+        raise IncrementalUnavailable(
+            "unsupported_model", f"no incremental path for {model_type!r}"
+        )
+    except IncrementalUnavailable as exc:
+        count_fallback(exc.reason)
+        log.warning(
+            f"incremental {model_type} train unavailable "
+            f"({exc.reason}: {exc}); falling back to a full refit"
+        )
+        from bodywork_tpu.train.trainer import train_on_history
+
+        result = train_on_history(
+            store, model_type, test_size=test_size, split_seed=split_seed,
+            fit_seed=fit_seed, model_kwargs=model_kwargs, persist=persist,
+        )
+        return dataclasses.replace(result, fallback_reason=exc.reason)

@@ -31,6 +31,16 @@ from bodywork_tpu.utils.logging import get_logger
 
 log = get_logger("train")
 
+#: the training modes ``train_on_history`` dispatches between: ``full``
+#: refits on all history (the reference's semantics, the default);
+#: ``incremental`` folds in only the new day
+#: (:mod:`bodywork_tpu.train.incremental` — exact for the linear model
+#: via persisted sufficient statistics, warm-start + replay for the
+#: MLP, both degrading to ``full`` rather than failing). Pinned equal
+#: to the ``cli train --mode`` choices and the stage env parsing by
+#: tests/test_incremental.py.
+TRAIN_MODES = ("full", "incremental")
+
 
 @dataclasses.dataclass
 class TrainResult:
@@ -46,6 +56,29 @@ class TrainResult:
     #: — recorded on the registry candidate so the prediction-sanity
     #: firewall (serve.app) can catch absurd outputs before serialization
     prediction_bounds: dict | None = None
+    #: how this model was produced: ``full`` refit or ``incremental``
+    #: (train/incremental.py). An incremental request that fell back to
+    #: a full refit reports ``full`` + a ``fallback_reason``.
+    mode: str = "full"
+    #: dataset rows actually READ to produce this result — the
+    #: incremental path's O(tail) vs the full path's O(history), and the
+    #: number the run-day train span + rows-touched counter record
+    rows_touched: int | None = None
+    #: why an incremental request did not run (or ran at full-refit
+    #: cost): trainstate_absent/corrupt/stale, no_donor,
+    #: donor_incompatible, gate_rejected (set by the runner's same-day
+    #: fallback). None = no degradation.
+    fallback_reason: str | None = None
+    #: the ``trainstate/`` document this run wrote (incremental linear;
+    #: journalled by ``stages.stage_artefact_keys`` so crash-resume
+    #: re-verifies or rebuilds it)
+    trainstate_artefact_key: str | None = None
+    #: deferred trainstate document (lookahead trains must not write
+    #: before their stage's DAG position; ``persist_train_result``
+    #: CAS-writes it)
+    pending_trainstate: dict | None = dataclasses.field(
+        default=None, repr=False
+    )
 
 
 def _prediction_bounds(y) -> dict:
@@ -102,27 +135,46 @@ def persist_train_result(store: ArtefactStore, result: TrainResult) -> TrainResu
         store, model_key_, metrics_key, result.data_date, data,
         prediction_bounds=result.prediction_bounds,
     )
+    trainstate_key_ = result.trainstate_artefact_key
+    if result.pending_trainstate is not None:
+        # a deferred incremental fold: CAS-write the statistics at this
+        # stage's DAG position, like the model/metrics above
+        from bodywork_tpu.train.incremental import persist_trainstate
+
+        trainstate_key_ = persist_trainstate(
+            store, result.model.model_type, result.pending_trainstate
+        )
     return dataclasses.replace(
         result,
         model_artefact_key=model_key_,
         metrics_artefact_key=metrics_key,
+        trainstate_artefact_key=trainstate_key_,
+        pending_trainstate=None,
     )
 
 
 def _record_train_metrics(
-    fitted, metrics: dict[str, float], fit_s: float, n_rows: int
+    fitted, metrics: dict[str, float], fit_s: float, n_rows: int,
+    mode: str = "full", rows_touched: int | None = None,
 ) -> None:
     """Export training telemetry through the shared obs registry, so the
     day loop's train signal and the serving hot path land on the same
     ``/metrics`` surface (a run-day pod or in-process runner scrape shows
     fit time, step time, loss, and held-out quality next to the serving
-    histograms)."""
+    histograms). ``rows_touched`` (default: all of history, the full
+    path's footprint) feeds the per-mode counter the incremental-train
+    flatness claim is monitored by (docs/OBSERVABILITY.md)."""
     from bodywork_tpu.obs import get_registry
 
     reg = get_registry()
     reg.counter(
         "bodywork_tpu_train_runs_total", "Completed training runs"
     ).inc()
+    reg.counter(
+        "bodywork_tpu_train_rows_touched_total",
+        "Dataset rows read to produce each training run's model, by "
+        "train mode (full = O(history) per run, incremental = O(tail))",
+    ).inc(n_rows if rows_touched is None else rows_touched, mode=mode)
     reg.histogram(
         "bodywork_tpu_train_fit_seconds",
         "Fit + held-out eval wall-clock per training run",
@@ -236,8 +288,17 @@ def train_on_history(
     persist: bool = True,
     mesh_data: int | None = None,
     mesh_model: int = 1,
+    mode: str = "full",
 ) -> TrainResult:
     """Run the full train stage against an artefact store.
+
+    ``mode="incremental"`` routes to the O(1)-per-day path
+    (:mod:`bodywork_tpu.train.incremental`): exact persisted sufficient
+    statistics for the linear model, warm-start + replay fine-tuning
+    for the MLP — both degrading to this full refit (reason counted on
+    ``bodywork_tpu_train_fallbacks_total``) rather than failing. The
+    default ``full`` refit on all history is byte-identical to the
+    pre-incremental behaviour.
 
     With ``prewarm_next``, tomorrow's padded-row buckets are compiled on a
     background thread after training, so the days whose grown history first
@@ -258,7 +319,25 @@ def train_on_history(
     hosts. The fitted model checkpoints and serves exactly like the
     single-device one.
     """
+    if mode not in TRAIN_MODES:
+        raise ValueError(
+            f"unknown train mode {mode!r}; expected one of {TRAIN_MODES}"
+        )
     use_mesh = (mesh_data or 0) > 1 or mesh_model > 1
+    if mode == "incremental":
+        if use_mesh:
+            raise ValueError(
+                "incremental training does not support a device mesh "
+                "(the fold/fine-tune workloads are O(tail); shard the "
+                "full refit instead)"
+            )
+        from bodywork_tpu.train.incremental import train_incremental
+
+        return train_incremental(
+            store, model_type, model_kwargs=model_kwargs,
+            test_size=test_size, split_seed=split_seed, fit_seed=fit_seed,
+            persist=persist,
+        )
     ds = load_all_datasets(store)
     split = train_test_split(ds.X, ds.y, test_size=test_size, seed=split_seed)
     model = make_model(model_type, **(model_kwargs or {}))
@@ -328,5 +407,5 @@ def train_on_history(
             )
     return TrainResult(
         fitted, metrics, ds.date, model_key_, metrics_key, len(ds),
-        prediction_bounds=bounds,
+        prediction_bounds=bounds, rows_touched=len(ds),
     )

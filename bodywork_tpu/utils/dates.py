@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import re
 from datetime import date, datetime, timedelta
+from functools import lru_cache
 
 # Same date grammar as the reference's regex: years 2020-2099.
 DATE_PATTERN = re.compile(r"20[2-9][0-9]-[0-1][0-9]-[0-3][0-9]")
@@ -19,6 +20,7 @@ def parse_date(date_string: str) -> date:
     return datetime.strptime(date_string, "%Y-%m-%d").date()
 
 
+@lru_cache(maxsize=8192)
 def date_from_key(key: str) -> date | None:
     """Extract the (first) embedded date from an artefact key, if any.
 
@@ -26,6 +28,12 @@ def date_from_key(key: str) -> date | None:
     match is not a real calendar date (the regex admits e.g. month 15) —
     such keys are ignored by the versioning protocol rather than crashing
     every store consumer.
+
+    Memoised: keys are immutable strings and every ``history()`` call
+    re-parses its whole listing, so a long-horizon store paid O(days)
+    strptime per listing per day, forever (a measured growth term in the
+    config-10 flatness profile). ``date`` objects are immutable, so the
+    shared cache is safe.
     """
     match = DATE_PATTERN.search(key)
     if match is None:

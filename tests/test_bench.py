@@ -203,6 +203,45 @@ def test_bench_history_cold_start_record_shape(tmp_path):
     assert record["value"] == record["points"][-1]["snapshot_on"]["cold_load_s"]
 
 
+def test_bench_incremental_train_record_shape():
+    """Config 10 at smoke scale (tier-1, seconds): the per-mode series,
+    flatness blocks, rows-touched O(tail) signature, and the linear
+    coefficient-exactness proof — all in one CPU-safe record. The full
+    >= 90-day horizon is the slow-marked acceptance run
+    (tests/test_incremental.py::test_incremental_flatness_long_horizon)
+    and the committed BENCH_r07_config10.json."""
+    record = bench.bench_incremental_train(
+        days=6, rows_per_day=40, model_types=("linear",)
+    )
+    assert record["metric"] == "incremental_train_flatness"
+    assert record["vs_baseline"] == bench.INCREMENTAL_BASELINE_RATIO
+    linear = record["models"]["linear"]
+    for mode in ("full", "incremental"):
+        entry = linear[mode]
+        assert len(entry["per_day"]) == 6
+        assert all(p["s"] > 0 for p in entry["per_day"])
+        assert entry["flatness"]["last_third_over_first_third"] > 0
+    # the O(history)-vs-O(tail) signature: full touches every row ever,
+    # incremental only the new day + tail (6 days < TAIL_DAYS here, so
+    # its final-day footprint is at most the full one)
+    assert (
+        linear["full"]["rows_touched_final_day"]
+        == linear["full"]["per_day"][-1]["rows_touched"]
+    )
+    assert (
+        linear["incremental"]["rows_touched_final_day"]
+        <= linear["full"]["rows_touched_final_day"]
+    )
+    assert linear["incremental"]["fallbacks"] == {"trainstate_absent": 1}
+    check = linear["coefficient_check"]
+    assert check["within_atol"]
+    assert check["max_abs_diff_vs_float64_refit"] <= check["atol"]
+    assert record["headline_model"] == "linear"
+    assert record["value"] == (
+        linear["incremental"]["flatness"]["last_third_over_first_third"]
+    )
+
+
 def test_percentile_nearest_rank():
     vals = [1.0, 2.0, 3.0, 4.0]
     assert bench._percentile(vals, 0) == 1.0
@@ -347,7 +386,9 @@ def test_compact_output_fits_driver_tail():
         })
     out = bench.compact_output(records, "tpu", "bench_full.json")
     line = _json.dumps(out)
-    assert len(line) < 1700, len(line)
+    # 10 configs of fully-populated one-liners measure ~1.72k; the
+    # archived tail is 2000 — keep a real margin under it
+    assert len(line) < 1800, len(line)
     assert out["metric"] == "e2e_day_wallclock_config_%d" % bench.HEADLINE_CONFIG
     assert out["full_record"] == "bench_full.json"
     assert len(out["configs"]) == len(bench.ALL_CONFIGS)
@@ -360,16 +401,16 @@ def test_compact_output_fits_driver_tail():
     out = bench.compact_output(records, "mixed", "bench_full.json")
     assert out["headline_fallback"].startswith("config 2 failed")
     assert out["configs"][1]["error"].startswith("boom")
-    assert len(out["configs"][1]["error"]) <= 120
-    assert len(_json.dumps(out)) < 1800
+    assert len(out["configs"][1]["error"]) <= 80
+    assert len(_json.dumps(out)) < 1900
 
     # the scaled-protocol and anomaly markers ride the compact line too
     # (truncated), so the driver's archived tail is self-describing
     records[5]["cpu_scaled_protocol"] = "scaled " * 60
     records[5]["timing_anomaly"] = "impossible " * 40
     out = bench.compact_output(records, "mixed", "bench_full.json")
-    assert len(out["configs"][5]["cpu_scaled_protocol"]) <= 120
-    assert len(out["configs"][5]["timing_anomaly"]) <= 120
+    assert len(out["configs"][5]["cpu_scaled_protocol"]) <= 80
+    assert len(out["configs"][5]["timing_anomaly"]) <= 80
     assert len(_json.dumps(out)) < 2000
 
 

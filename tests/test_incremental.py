@@ -1,0 +1,727 @@
+"""Incremental training (ISSUE 9): persisted sufficient statistics,
+warm-start fine-tuning, and the degradation contract.
+
+The load-bearing claims, each pinned here:
+
+- EXACTNESS: the linear incremental solution (summed per-day Gram
+  statistics, ``trainstate/``) reproduces the full-refit solution on the
+  same per-day train splits, under ANY day ordering (hypothesis property
+  over permuted/partial sequences).
+- O(TAIL): an incremental day's store reads do not grow with history
+  length (CountingStore budget pinned at two history lengths), and the
+  trainstate document is mutated through CAS only.
+- NEVER WEDGED: absent/corrupt/stale trainstate, missing or
+  shape-incompatible donors, and gate-rejected incremental candidates
+  all degrade to a full refit (reason counted) — the runner's same-day
+  fallback re-gates a trustworthy candidate.
+- COVERED: the run journal digests the trainstate artefact (tamper =>
+  re-run), and the chaos byte-identity soak passes with ``trainstate/``
+  in scope.
+"""
+import json
+from datetime import date, timedelta
+
+import numpy as np
+import pytest
+
+from helpers import make_counting_store, make_memory_store
+
+from bodywork_tpu.data import Dataset, generate_day, persist_dataset
+from bodywork_tpu.data.drift_config import DriftConfig
+from bodywork_tpu.store.schema import (
+    DATASETS_PREFIX,
+    dataset_key,
+    trainstate_key,
+)
+from bodywork_tpu.train import TRAIN_MODES, train_on_history
+from bodywork_tpu.train.incremental import (
+    TAIL_DAYS,
+    day_split_indices,
+    persist_trainstate,
+    read_trainstate,
+    solve_from_days,
+)
+
+START = date(2026, 3, 1)
+DRIFT = DriftConfig(n_samples=50)
+TS_KEY = trainstate_key("linear")
+MLP_KW = {"hidden": [8, 8], "n_steps": 60}
+
+
+def _seed_days(store, days, start=START, drift=DRIFT):
+    for i in range(days):
+        d = start + timedelta(days=i)
+        X, y = generate_day(d, drift)
+        persist_dataset(store, Dataset(X, y, d))
+
+
+def _counter(name, **labels):
+    from bodywork_tpu.obs import get_registry
+
+    metric = get_registry().get(name)
+    if metric is None:
+        return 0.0
+    return sum(
+        s["value"]
+        for s in metric.snapshot_samples()
+        if all(s["labels"].get(k) == v for k, v in labels.items())
+    )
+
+
+def _union_train_rows(store):
+    """The union of every day's deterministic train split — the row set
+    the incremental statistics are defined over."""
+    from bodywork_tpu.data.io import load_dataset
+
+    Xs, ys = [], []
+    for key, d in store.history(DATASETS_PREFIX):
+        ds = load_dataset(store, key)
+        train_idx, _ = day_split_indices(len(ds), d, 0.2, 42)
+        Xs.append(ds.X[train_idx])
+        ys.append(ds.y[train_idx])
+    return (
+        np.concatenate(Xs).astype(np.float64),
+        np.concatenate(ys).astype(np.float64),
+    )
+
+
+def _lstsq_theta(X, y):
+    A = np.concatenate([X, np.ones((len(y), 1))], axis=1)
+    theta, *_ = np.linalg.lstsq(A, y, rcond=None)
+    return theta
+
+
+# -- exactness -------------------------------------------------------------
+
+
+def test_incremental_linear_matches_full_refit(store):
+    """Day-by-day incremental folding ends at the same coefficients as
+    one independent float64 full refit over the union of the per-day
+    train splits — the sufficient-statistics identity, end to end
+    through the store."""
+    result = None
+    for i in range(4):
+        _seed_days(store, 1, start=START + timedelta(days=i))
+        result = train_on_history(store, "linear", mode="incremental")
+    assert result.mode == "incremental"
+    theta = _lstsq_theta(*_union_train_rows(store))
+    host = result.model.host_params()
+    got = np.concatenate([np.asarray(host["w"]).ravel(), [float(host["b"])]])
+    np.testing.assert_allclose(got, theta, atol=1e-4)
+    # metrics are finite and sane (the gate consumes them)
+    assert np.isfinite(list(result.metrics.values())).all()
+    assert result.trainstate_artefact_key == TS_KEY
+    # bounds match the full path's formula over all labels
+    from bodywork_tpu.data.io import load_all_datasets
+    from bodywork_tpu.train.trainer import _prediction_bounds
+
+    assert result.prediction_bounds == pytest.approx(
+        _prediction_bounds(load_all_datasets(store).y)
+    )
+
+
+@pytest.mark.filterwarnings("ignore::DeprecationWarning")
+def test_suffstats_solution_order_independent_property():
+    """Hypothesis: for random multi-day data, folding the days in ANY
+    order (and any non-empty prefix subset) solves to the float64 full
+    refit on exactly those days' train splits."""
+    pytest.importorskip("hypothesis")
+    from hypothesis import given, settings, strategies as st
+
+    from bodywork_tpu.train.incremental import _day_entry
+
+    day_data = st.lists(
+        st.integers(min_value=5, max_value=40), min_size=1, max_size=5
+    )
+
+    @settings(max_examples=25, deadline=None)
+    @given(day_data, st.randoms(use_true_random=False))
+    def run(sizes, pyrandom):
+        entries = {}
+        union_X, union_y = [], []
+        for i, n in enumerate(sizes):
+            d = START + timedelta(days=i)
+            rng = np.random.default_rng(1000 + i)
+            X = rng.uniform(0, 100, (n, 1))
+            y = 2.0 + 0.5 * X[:, 0] + rng.normal(0, 3, n)
+            ds = Dataset(X, y, d)
+            entries[str(d)] = _day_entry(ds, 0.2, 42)
+            train_idx, _ = day_split_indices(n, d, 0.2, 42)
+            union_X.append(np.asarray(ds.X, np.float64)[train_idx])
+            union_y.append(np.asarray(ds.y, np.float64)[train_idx])
+        # fold in a random ORDER: dict insertion order must not matter
+        keys = list(entries)
+        pyrandom.shuffle(keys)
+        shuffled = {k: entries[k] for k in keys}
+        total_train = sum(e["n_train"] for e in entries.values())
+        if total_train < 3:
+            return  # underdetermined systems are not the claim
+        params = solve_from_days(shuffled)
+        theta = _lstsq_theta(np.concatenate(union_X), np.concatenate(union_y))
+        got = np.concatenate(
+            [np.asarray(params["w"], np.float64).ravel(),
+             [float(params["b"])]]
+        )
+        np.testing.assert_allclose(got, theta, atol=1e-4)
+
+    run()
+
+
+def test_suffstats_order_independent_deterministic():
+    """The non-hypothesis floor of the property above (runs on bare
+    installs where the dev extra is absent): every permutation of a
+    3-day fold solves to identical coefficients, equal to the union
+    refit."""
+    import itertools
+
+    from bodywork_tpu.train.incremental import _day_entry
+
+    entries, union_X, union_y = {}, [], []
+    for i, n in enumerate((12, 30, 21)):
+        d = START + timedelta(days=i)
+        rng = np.random.default_rng(2000 + i)
+        X = rng.uniform(0, 100, (n, 1))
+        y = 2.0 + 0.5 * X[:, 0] + rng.normal(0, 3, n)
+        ds = Dataset(X, y, d)
+        entries[str(d)] = _day_entry(ds, 0.2, 42)
+        train_idx, _ = day_split_indices(n, d, 0.2, 42)
+        union_X.append(np.asarray(ds.X, np.float64)[train_idx])
+        union_y.append(np.asarray(ds.y, np.float64)[train_idx])
+    theta = _lstsq_theta(np.concatenate(union_X), np.concatenate(union_y))
+    solutions = set()
+    for perm in itertools.permutations(entries):
+        params = solve_from_days({k: entries[k] for k in perm})
+        got = np.concatenate(
+            [np.asarray(params["w"], np.float64).ravel(),
+             [float(params["b"])]]
+        )
+        np.testing.assert_allclose(got, theta, atol=1e-4)
+        solutions.add(got.tobytes())  # bitwise identical across orders
+    assert len(solutions) == 1
+
+
+def test_day_split_is_stable_and_day_local():
+    """A day's split membership depends only on (day, seed, n) — never
+    on other days — and is exhaustive/disjoint."""
+    d1, d2 = START, START + timedelta(days=1)
+    tr_a, te_a = day_split_indices(100, d1, 0.2, 42)
+    tr_b, te_b = day_split_indices(100, d1, 0.2, 42)
+    assert np.array_equal(tr_a, tr_b) and np.array_equal(te_a, te_b)
+    assert sorted(np.concatenate([tr_a, te_a])) == list(range(100))
+    assert len(te_a) == 20
+    tr_c, _ = day_split_indices(100, d2, 0.2, 42)
+    assert not np.array_equal(tr_a, tr_c)  # fresh draw per day
+
+
+# -- O(tail) store budget ---------------------------------------------------
+
+
+def _one_cold_incremental_day(days):
+    """Seed ``days`` of trained history, then count a COLD handle's ops
+    for ONE further incremental day."""
+    inner = make_memory_store()
+    store = make_counting_store(inner)
+    for i in range(days):
+        _seed_days(store, 1, start=START + timedelta(days=i))
+        train_on_history(store, "linear", mode="incremental")
+    d = START + timedelta(days=days)
+    cold = make_counting_store(inner)  # fresh caches: per-day-pod regime
+    X, y = generate_day(d, DRIFT)
+    persist_dataset(cold, Dataset(X, y, d))
+    cold.reset_counts()
+    result = train_on_history(cold, "linear", mode="incremental")
+    assert result.fallback_reason is None
+    return cold, result
+
+
+def test_incremental_day_is_o_tail_store_reads():
+    """The whole point: an incremental day's GET count is pinned by the
+    tail window, NOT by history length — identical at 12 and 25 days of
+    history (a full-history fetch would differ by 13)."""
+    budgets = {}
+    for days in (12, 25):
+        counting, _result = _one_cold_incremental_day(days)
+        gets = [k for (op, k), _n in counting.by_key.items()
+                if op == "get_bytes"]
+        dataset_gets = [k for k in gets if k.startswith(DATASETS_PREFIX)]
+        assert len(dataset_gets) <= TAIL_DAYS
+        budgets[days] = counting.ops.get("get_bytes", 0)
+        # trainstate is CAS-mutated only: zero raw put_bytes ever
+        assert ("put_bytes", TS_KEY) not in counting.by_key
+        assert counting.by_key.get(("put_bytes_if_match", TS_KEY)) == 1
+    assert budgets[12] == budgets[25]
+    # tail-day datasets + the trainstate doc + the day's registry record
+    # (+1 slack) — the equality above is the O(tail) proof, this bound
+    # pins the constant
+    assert budgets[25] <= TAIL_DAYS + 3
+
+
+# -- degradation: trainstate ------------------------------------------------
+
+
+def test_trainstate_absent_rebuilds_with_reason(store):
+    _seed_days(store, 3)
+    before = _counter("bodywork_tpu_train_fallbacks_total",
+                      reason="trainstate_absent")
+    result = train_on_history(store, "linear", mode="incremental")
+    assert result.mode == "incremental"
+    assert result.fallback_reason == "trainstate_absent"
+    assert result.rows_touched == result.n_rows  # the rebuild day is O(history)
+    assert _counter("bodywork_tpu_train_fallbacks_total",
+                    reason="trainstate_absent") == before + 1
+    doc, _token, reason = read_trainstate(store, "linear")
+    assert reason is None and len(doc["days"]) == 3
+
+
+def test_trainstate_corrupt_past_budget_rebuilds(store):
+    _seed_days(store, 2)
+    train_on_history(store, "linear", mode="incremental")
+    store.put_bytes(TS_KEY, b"\x00garbage not json")
+    _seed_days(store, 1, start=START + timedelta(days=2))
+    result = train_on_history(store, "linear", mode="incremental")
+    assert result.fallback_reason == "trainstate_corrupt"
+    # the rebuild REPAIRED the document (CAS overwrite under the kept
+    # token) and the solution is still exact
+    doc, _token, reason = read_trainstate(store, "linear")
+    assert reason is None and len(doc["days"]) == 3
+    theta = _lstsq_theta(*_union_train_rows(store))
+    host = result.model.host_params()
+    np.testing.assert_allclose(
+        np.concatenate([np.asarray(host["w"]).ravel(), [float(host["b"])]]),
+        theta, atol=1e-4,
+    )
+
+
+def test_trainstate_stale_on_deleted_day_rebuilds(store):
+    _seed_days(store, 3)
+    train_on_history(store, "linear", mode="incremental")
+    store.delete(dataset_key(START))  # a covered day vanishes
+    result = train_on_history(store, "linear", mode="incremental")
+    assert result.fallback_reason == "trainstate_stale"
+    doc, _t, _r = read_trainstate(store, "linear")
+    assert sorted(doc["days"]) == [
+        str(START + timedelta(days=1)), str(START + timedelta(days=2))
+    ]
+
+
+def test_trainstate_overwritten_day_rebuilds(store):
+    """A covered tail-window day whose dataset was OVERWRITTEN (same
+    date, different contents) fails the stored-scalar consistency check
+    and rebuilds — stale cumulative sums must not survive silently."""
+    _seed_days(store, 3)
+    train_on_history(store, "linear", mode="incremental")
+    d2 = START + timedelta(days=1)
+    X, y = generate_day(d2, DriftConfig(n_samples=70, seed=9))
+    persist_dataset(store, Dataset(X, y, d2))  # regenerate day 2
+    result = train_on_history(store, "linear", mode="incremental")
+    assert result.fallback_reason == "trainstate_stale"
+    # the rebuilt solution matches a fresh refit on the CURRENT contents
+    theta = _lstsq_theta(*_union_train_rows(store))
+    host = result.model.host_params()
+    np.testing.assert_allclose(
+        np.concatenate([np.asarray(host["w"]).ravel(), [float(host["b"])]]),
+        theta, atol=1e-4,
+    )
+
+
+def test_trainstate_split_change_rebuilds(store):
+    _seed_days(store, 2)
+    train_on_history(store, "linear", mode="incremental")
+    from bodywork_tpu.train.incremental import incremental_train_linear
+
+    result = incremental_train_linear(store, split_seed=7)
+    assert result.fallback_reason == "trainstate_stale"
+    doc, _t, _r = read_trainstate(store, "linear")
+    assert doc["split"] == {"test_size": 0.2, "seed": 7}
+
+
+def test_persist_trainstate_cas_conflict_converges(store):
+    """A lost race never merges two divergent cumulative sums (they
+    cannot be reconciled without per-day blocks): LAST WRITER WINS — a
+    rebuild must be able to overwrite a richer-looking stale incumbent
+    unconditionally — and any day the final document lacks reads as
+    'new' on the next retrain and is folded back in."""
+    from bodywork_tpu.train.incremental import _build_doc
+
+    d1, d2, d3 = (str(START + timedelta(days=i)) for i in range(3))
+    meta = {"n_rows": 1, "n_train": 1, "y_min": 0.0, "y_max": 1.0}
+    split = {"test_size": 0.2, "seed": 42}
+
+    def doc_for(day_strs, scale):
+        g = [[scale, scale], [scale, scale]]
+        return _build_doc("linear", 1, split,
+                          {d: dict(meta) for d in day_strs}, g, [scale, scale])
+
+    persist_trainstate(store, "linear", doc_for([d1, d2, d3], 2.0))
+    # a stale-token writer holding fewer days overwrites cleanly (the
+    # rebuild-shrinks-the-day-set case) — no torn doc, no merge
+    persist_trainstate(store, "linear", doc_for([d1], 1.0),
+                       expected_token="stale-token")
+    doc, _t, reason = read_trainstate(store, "linear")
+    assert reason is None and sorted(doc["days"]) == [d1]
+    assert doc["cum_c"] == [1.0, 1.0]
+    # ...and the next incremental train converges to full coverage —
+    # here via the overwritten-day staleness check (the synthetic d1
+    # scalars cannot match the real dataset), exactly the rebuild the
+    # degradation contract promises; the solution is the fresh one
+    _seed_days(store, 3)
+    result = train_on_history(store, "linear", mode="incremental")
+    final, _t, _r = read_trainstate(store, "linear")
+    assert sorted(final["days"]) == [d1, d2, d3]
+    assert result.fallback_reason == "trainstate_stale"
+    theta = _lstsq_theta(*_union_train_rows(store))
+    host = result.model.host_params()
+    np.testing.assert_allclose(
+        np.concatenate([np.asarray(host["w"]).ravel(), [float(host["b"])]]),
+        theta, atol=1e-4,
+    )
+
+
+def test_deferred_persist_writes_trainstate_at_collect(store):
+    """The lookahead contract: persist=False computes but writes NOTHING
+    (no model, no trainstate); persist_train_result lands both."""
+    from bodywork_tpu.train import persist_train_result
+
+    _seed_days(store, 2)
+    result = train_on_history(store, "linear", mode="incremental",
+                              persist=False)
+    assert result.pending_trainstate is not None
+    assert not store.exists(TS_KEY)
+    assert not store.list_keys("models/")
+    persisted = persist_train_result(store, result)
+    assert persisted.trainstate_artefact_key == TS_KEY
+    assert persisted.pending_trainstate is None
+    doc, _t, reason = read_trainstate(store, "linear")
+    assert reason is None and len(doc["days"]) == 2
+
+
+# -- degradation: mlp donor -------------------------------------------------
+
+
+def test_mlp_without_donor_falls_back_full(store):
+    _seed_days(store, 2)
+    before = _counter("bodywork_tpu_train_fallbacks_total", reason="no_donor")
+    result = train_on_history(store, "mlp", mode="incremental",
+                              model_kwargs=MLP_KW)
+    assert result.mode == "full"
+    assert result.fallback_reason == "no_donor"
+    assert _counter("bodywork_tpu_train_fallbacks_total",
+                    reason="no_donor") == before + 1
+    assert store.exists(result.model_artefact_key)
+
+
+def test_mlp_incompatible_donor_falls_back_full(store):
+    _seed_days(store, 2)
+    # the newest checkpoint is a LINEAR model: not a warm-start donor
+    train_on_history(store, "linear")
+    result = train_on_history(store, "mlp", mode="incremental",
+                              model_kwargs=MLP_KW)
+    assert result.mode == "full" and result.fallback_reason == "donor_incompatible"
+    # now the newest is an (8,8) mlp; requesting a different architecture
+    # must also refuse the warm start
+    result = train_on_history(
+        store, "mlp", mode="incremental",
+        model_kwargs={"hidden": [4], "n_steps": 60},
+    )
+    assert result.fallback_reason == "donor_incompatible"
+
+
+def test_mlp_warm_start_keeps_donor_scaler(store):
+    _seed_days(store, 2)
+    donor_result = train_on_history(store, "mlp", model_kwargs=MLP_KW)
+    _seed_days(store, 1, start=START + timedelta(days=2))
+    result = train_on_history(store, "mlp", mode="incremental",
+                              model_kwargs=MLP_KW)
+    assert result.mode == "incremental" and result.fallback_reason is None
+    donor_scaler = donor_result.model.host_params()["scaler"]
+    tuned = result.model.host_params()
+    for k, v in donor_scaler.items():
+        np.testing.assert_array_equal(tuned["scaler"][k], np.asarray(v))
+    # ...but the net genuinely moved
+    donor_w0 = donor_result.model.host_params()["net"]["layers"][0]["w"]
+    assert not np.array_equal(tuned["net"]["layers"][0]["w"], donor_w0)
+    # replay footprint: the window, not all history
+    assert result.rows_touched <= DRIFT.n_samples * TAIL_DAYS
+
+
+# -- mode plumbing guards ---------------------------------------------------
+
+
+def test_cli_choices_match_stage_env_parsing():
+    """The three mode surfaces — ``cli train --mode`` choices, the
+    canonical TRAIN_MODES tuple, and the stage env parsing — can never
+    drift apart (the cli/chaos parsers hardcode choices to stay
+    import-light)."""
+    from bodywork_tpu.cli import build_parser
+    from bodywork_tpu.pipeline.stages import _train_env_mode
+
+    parser = build_parser()
+    sub = next(a for a in parser._subparsers._group_actions)
+    train_parser = sub.choices["train"]
+    mode_action = next(
+        a for a in train_parser._actions if "--mode" in a.option_strings
+    )
+    assert tuple(mode_action.choices) == TRAIN_MODES
+
+    chaos_parser = sub.choices["chaos"]
+    run_sim = next(
+        a for a in chaos_parser._subparsers._group_actions
+    ).choices["run-sim"]
+    tm_action = next(
+        a for a in run_sim._actions if "--train-mode" in a.option_strings
+    )
+    assert tuple(tm_action.choices) == TRAIN_MODES
+
+    import os
+    from unittest.mock import patch
+
+    for mode in TRAIN_MODES:
+        with patch.dict(os.environ, {"BODYWORK_TPU_TRAIN_MODE": mode}):
+            assert _train_env_mode() == mode
+    with patch.dict(os.environ, {"BODYWORK_TPU_TRAIN_MODE": "bogus"}):
+        assert _train_env_mode() == "full"  # degrade, never crash the pod
+    with patch.dict(os.environ, {}, clear=False):
+        os.environ.pop("BODYWORK_TPU_TRAIN_MODE", None)
+        assert _train_env_mode() == "full"
+
+
+def test_env_knob_drives_train_stage(store, monkeypatch):
+    from bodywork_tpu.pipeline.stages import StageContext, train_stage
+
+    _seed_days(store, 2)
+    monkeypatch.setenv("BODYWORK_TPU_TRAIN_MODE", "incremental")
+    result = train_stage(StageContext(store=store, today=START), "linear")
+    assert result.mode == "incremental"
+
+
+def test_unknown_mode_rejected(store, tmp_path):
+    with pytest.raises(ValueError, match="unknown train mode"):
+        train_on_history(store, "linear", mode="weekly")
+    from bodywork_tpu.chaos.sim import _apply_train_mode, chaos_pipeline_spec
+
+    with pytest.raises(ValueError, match="unknown train mode"):
+        chaos_pipeline_spec(train_mode="weekly")
+    # the soak PINS the mode even for 'full': an exported
+    # BODYWORK_TPU_TRAIN_MODE must not silently override the flag
+    from bodywork_tpu.pipeline import default_pipeline
+
+    spec = _apply_train_mode(default_pipeline(), "full")
+    assert spec.stages["stage-1-train-model"].args["mode"] == "full"
+
+
+def test_mesh_refused_in_incremental_mode(store):
+    with pytest.raises(ValueError, match="device mesh"):
+        train_on_history(store, "mlp", mode="incremental", mesh_data=2)
+
+
+def test_new_metric_names_pass_lint():
+    from bodywork_tpu.obs.registry import validate_metric_name
+
+    validate_metric_name("bodywork_tpu_train_rows_touched_total", "counter")
+    validate_metric_name("bodywork_tpu_train_fallbacks_total", "counter")
+    validate_metric_name(
+        "bodywork_tpu_train_trainstate_corrupt_total", "counter"
+    )
+
+
+# -- runner integration: span meta, gate fallback, journal ------------------
+
+
+def _train_only_spec(model_type="linear", args=None):
+    from bodywork_tpu.pipeline.spec import PipelineSpec, StageSpec
+
+    stage = StageSpec(
+        name="stage-1-train-model",
+        kind="batch",
+        executable="bodywork_tpu.pipeline.stages:train_stage",
+        args={"model_type": model_type, **(args or {})},
+        max_completion_time_s=120.0,
+    )
+    return PipelineSpec(
+        name="inc-test", dag=[["stage-1-train-model"]],
+        stages={"stage-1-train-model": stage},
+    )
+
+
+def test_train_span_records_mode_and_rows(store):
+    from bodywork_tpu.pipeline import LocalRunner
+
+    _seed_days(store, 1)
+    runner = LocalRunner(
+        _train_only_spec(args={"mode": "incremental"}), store, drift=DRIFT
+    )
+    result = runner.run_day(START, resume=False)
+    span = next(s for s in result.spans if s.name == "stage-1-train-model")
+    assert span.meta["train_mode"] == "incremental"
+    assert span.meta["rows_touched"] == result.stage_results[
+        "stage-1-train-model"
+    ].rows_touched
+    assert span.meta["fallback_reason"] == "trainstate_absent"
+
+
+def test_gate_rejected_incremental_full_refit_fallback(store, monkeypatch):
+    """The release-safety loop: a DEGRADED incremental fine-tune is
+    rejected by the shadow-armed gate, and the runner re-runs the train
+    stage as a full refit THE SAME DAY, re-gates, and promotes it — the
+    serving alias never points at the bad fine-tune."""
+    from bodywork_tpu.models.mlp import MLPRegressor
+    from bodywork_tpu.pipeline import LocalRunner
+    from bodywork_tpu.registry.records import resolve_alias
+
+    # 80 rows/day keeps full-refit metrics stable across days (probed
+    # r2 0.59-0.67), so only the SABOTAGED fine-tune can fail the gate
+    drift = DriftConfig(n_samples=80)
+    spec = _train_only_spec("mlp", {"mode": "incremental", **MLP_KW})
+    runner = LocalRunner(spec, store, drift=drift)
+    _seed_days(store, 2, drift=drift)
+    day1 = START + timedelta(days=1)
+    r1 = runner.run_day(day1, resume=False)
+    assert r1.stage_results["registry-gate"].promote  # day-1 full (no donor)
+
+    original_fine_tune = MLPRegressor.fine_tune
+
+    def garbage_fine_tune(self, X, y, n_steps, seed=None):
+        return original_fine_tune(
+            self, X, np.zeros_like(np.asarray(y)), n_steps, seed=seed
+        )
+
+    # sabotage the fine-tune: fitting all-zero labels produces an
+    # uncorrelated candidate the gate's absolute r2 floor rejects (the
+    # fallback full refit goes through fit_and_evaluate, untouched)
+    monkeypatch.setattr(MLPRegressor, "fine_tune", garbage_fine_tune)
+    before = _counter("bodywork_tpu_train_fallbacks_total",
+                      reason="gate_rejected")
+    _seed_days(store, 1, start=START + timedelta(days=2), drift=drift)
+    day2 = START + timedelta(days=2)
+    r2 = runner.run_day(day2, resume=False)
+    final = r2.stage_results["stage-1-train-model"]
+    assert final.mode == "full"
+    assert final.fallback_reason == "gate_rejected"
+    decision = r2.stage_results["registry-gate"]
+    assert decision.promote  # the re-gate adjudicated the full refit
+    assert _counter("bodywork_tpu_train_fallbacks_total",
+                    reason="gate_rejected") == before + 1
+    assert resolve_alias(store, "production") == final.model_artefact_key
+    gate_span = [s for s in r2.spans if s.name == "registry-gate"][-1]
+    assert gate_span.meta.get("full_refit_fallback") is True
+
+
+def test_gate_arms_shadow_for_journal_skipped_incremental(store, monkeypatch):
+    """A crash resumed between train-complete and the gate leaves the
+    journal entry DICT (not a TrainResult) in stage_results; the gate
+    must still resolve the stage's mode (spec arg / env) and adjudicate
+    the incremental candidate shadow-armed — a resume must not silently
+    drop the safety contract."""
+    from bodywork_tpu.pipeline import LocalRunner
+    from bodywork_tpu.pipeline.stages import StageContext
+    from bodywork_tpu.registry import ModelRegistry
+    from bodywork_tpu.train.incremental import INCREMENTAL_SHADOW_DAYS
+
+    spec = _train_only_spec(args={"mode": "incremental"})
+    runner = LocalRunner(spec, store, drift=DRIFT)
+    _seed_days(store, 2)
+    result = train_on_history(store, "linear", mode="incremental")
+
+    seen_shadow_days = []
+    orig_gate = ModelRegistry.gate
+
+    def spy_gate(self, *args, **kwargs):
+        seen_shadow_days.append(self.policy.shadow_days)
+        return orig_gate(self, *args, **kwargs)
+
+    monkeypatch.setattr(ModelRegistry, "gate", spy_gate)
+    ctx = StageContext(store=store, today=START + timedelta(days=1))
+    # what a journal-verified skip leaves behind: the entry dict with
+    # the artefact digest map
+    ctx.stage_results["stage-1-train-model"] = {
+        "state": "complete",
+        "artefacts": {result.model_artefact_key: "sha256:x",
+                      result.metrics_artefact_key: "sha256:y"},
+    }
+    runner._run_registry_gate(
+        START + timedelta(days=1), ctx, None,
+        train_stages={"stage-1-train-model"},
+    )
+    assert seen_shadow_days[0] == INCREMENTAL_SHADOW_DAYS
+
+
+def test_journal_covers_trainstate(store):
+    """Crash-resume re-verifies the trainstate artefact: the journal
+    records its digest; a tampered document re-runs the train stage
+    (rerun_mismatch), which repairs it."""
+    from bodywork_tpu.pipeline import LocalRunner
+    from bodywork_tpu.pipeline.stages import stage_artefact_keys
+    from bodywork_tpu.store.schema import run_journal_key
+
+    spec = _train_only_spec(args={"mode": "incremental"})
+    _seed_days(store, 2)
+    runner = LocalRunner(spec, store, drift=DRIFT)
+    result = runner.run_day(START + timedelta(days=1))
+    train_result = result.stage_results["stage-1-train-model"]
+    keys = stage_artefact_keys(
+        spec.stages["stage-1-train-model"], train_result, None
+    )
+    assert TS_KEY in keys
+    journal = json.loads(
+        store.get_bytes(run_journal_key(START + timedelta(days=1)))
+    )
+    artefacts = journal["stages"]["stage-1-train-model"]["artefacts"]
+    assert TS_KEY in artefacts
+    # resume of the completed day: everything verifies, nothing runs
+    noop = LocalRunner(spec, store, drift=DRIFT).run_day(
+        START + timedelta(days=1)
+    )
+    assert noop.noop
+    # tamper the trainstate: the digest mismatch re-runs the stage,
+    # which re-folds/rebuilds to a VALID document
+    store.put_bytes(TS_KEY, b"{}")
+    rerun = LocalRunner(spec, store, drift=DRIFT).run_day(
+        START + timedelta(days=1)
+    )
+    assert not rerun.noop and not rerun.skipped_stages
+    _doc, _t, reason = read_trainstate(store, "linear")
+    assert reason is None
+
+
+def test_chaos_soak_incremental_byte_identical(tmp_path):
+    """The PR 4 acceptance bar extended over ``trainstate/``: a seeded
+    faulted 2-day sim (transients, torn writes, corrupt trainstate
+    reads) converges to final artefacts byte-identical to the fault-free
+    twin — including the sufficient-statistics document itself."""
+    from bodywork_tpu.chaos import FaultPlan, run_chaos_sim
+
+    summary = run_chaos_sim(
+        tmp_path / "soak", date(2026, 3, 1), 2, FaultPlan.default(11),
+        # 80 rows/day keeps the day-1 candidate's tail-split r2 safely
+        # above the gate floor (probed: 0.55/0.72) so BOTH twins promote
+        model_type="linear", drift=DriftConfig(n_samples=80),
+        train_mode="incremental",
+    )
+    assert summary["ok"], summary["comparison"]
+    chaos_store_keys = [
+        k for k in summary["comparison"].get("missing", [])
+    ]
+    assert not chaos_store_keys
+    # the comparison actually covered the new artefact
+    from bodywork_tpu.store import FilesystemStore
+
+    assert FilesystemStore(tmp_path / "soak" / "baseline").exists(TS_KEY)
+    assert FilesystemStore(tmp_path / "soak" / "chaos").exists(TS_KEY)
+
+
+@pytest.mark.slow
+def test_incremental_flatness_long_horizon():
+    """The acceptance criterion at full scale (the committed
+    BENCH_r07_config10.json protocol): over >= 90 days at the reference
+    generator's 1440 rows/day, the incremental per-day train cost is
+    flat (last-third/first-third <= 1.05 vs the measured 1.21 full-refit
+    baseline) and the final coefficients still match the independent
+    float64 refit."""
+    import bench
+
+    record = bench.bench_incremental_train(
+        days=90, rows_per_day=1440, model_types=("linear",)
+    )
+    flat = record["models"]["linear"]["incremental"]["flatness"]
+    assert flat["last_third_over_first_third"] <= 1.05
+    assert record["models"]["linear"]["coefficient_check"]["within_atol"]
