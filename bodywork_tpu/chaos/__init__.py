@@ -29,6 +29,7 @@ from bodywork_tpu.chaos.canary import (
     run_canary_chaos,
     sabotage_checkpoint_nan,
 )
+from bodywork_tpu.chaos.bitrot import inject_bit_rot, run_bit_rot_sim
 from bodywork_tpu.chaos.sim import (
     chaos_pipeline_spec,
     compare_stores,
@@ -54,6 +55,8 @@ __all__ = [
     "flaky_serve_stage",
     "chaos_pipeline_spec",
     "compare_stores",
+    "inject_bit_rot",
+    "run_bit_rot_sim",
     "run_chaos_sim",
     "run_crash_sim",
     "sweep_points",

@@ -60,6 +60,7 @@ _PROBABILITY_FIELDS = (
     "store_latency_p",
     "torn_write_p",
     "corrupt_read_p",
+    "bit_rot_p",
     "http_error_p",
     "http_latency_p",
     "canary_latency_p",
@@ -96,10 +97,32 @@ class FaultPlan:
     #: same 3-attempt budget; past it they degrade to a full-refit
     #: rebuild — derived state, so corruption can cost one O(history)
     #: day but never a wrong model.
+    #:
+    #: Contrast with the AT-REST knob below: ``corrupt_prefixes`` scopes
+    #: IN-FLIGHT read corruption, so it stays restricted to prefixes
+    #: whose readers validate; ``bit_rot_prefixes`` scopes at-rest
+    #: corruption, whose detector is the fsck scrub — which audits
+    #: EVERY prefix — so its default is the whole store. The two knobs
+    #: share this one plan format (and the flag > plan > env
+    #: precedence), so a run-sim soak and an fsck soak reproduce from
+    #: the same JSON document.
     corrupt_read_p: float = 0.0
     corrupt_prefixes: tuple[str, ...] = (
         "snapshots/", "registry/", "runs/", "trainstate/"
     )
+    #: AT-REST bit rot (``chaos/bitrot.py``, ``cli chaos run-sim
+    #: --bit-rot``): per-KEY seeded decision over a FINISHED store's
+    #: artefacts — bytes flip on disk with timestamps preserved, so no
+    #: in-flight hook ever fires and only the integrity scrub
+    #: (``cli fsck``) can see it. ``bit_rot_p`` is the per-key rot
+    #: probability (the harness additionally forces at least one rotted
+    #: key per populated prefix so a sweep always exercises every
+    #: auditor); ``bit_rot_max_flips`` bounds the seeded byte flips per
+    #: rotted key; ``bit_rot_prefixes`` scopes the damage — empty means
+    #: every prefix in ``schema.ALL_PREFIXES``.
+    bit_rot_p: float = 0.0
+    bit_rot_max_flips: int = 3
+    bit_rot_prefixes: tuple[str, ...] = ()
     #: scoring service /score/v1* requests: answer 503 or 429 (split
     #: evenly, deterministically) with a Retry-After header
     http_error_p: float = 0.0
@@ -139,7 +162,10 @@ class FaultPlan:
                 )
         if self.max_consecutive < 0:
             raise ValueError("max_consecutive must be >= 0 (0 = unlimited)")
+        if self.bit_rot_max_flips < 1:
+            raise ValueError("bit_rot_max_flips must be >= 1")
         self.corrupt_prefixes = tuple(self.corrupt_prefixes)
+        self.bit_rot_prefixes = tuple(self.bit_rot_prefixes)
         if self.crash_schedule:
             from bodywork_tpu.chaos.kill import parse_schedule
 
@@ -287,6 +313,22 @@ class FaultPlan:
         if self._decide("corrupt", f"store|get_bytes|{key}", self.corrupt_read_p):
             return data[: max(1, len(data) // 2)]
         return data
+
+    # -- at-rest hooks (chaos.bitrot) --------------------------------------
+
+    def bit_rot_decision(self, key: str) -> bool:
+        """ONE seeded at-rest rot decision per stored key — consumed by
+        the bit-rot injector (``chaos.bitrot.inject_bit_rot``) over a
+        finished store, never by an in-flight op. Per-key streams, so a
+        sweep replays identically whatever order keys are visited in."""
+        prefixes = self.bit_rot_prefixes
+        if not prefixes:
+            from bodywork_tpu.store.schema import ALL_PREFIXES
+
+            prefixes = ALL_PREFIXES
+        if not key.startswith(tuple(prefixes)):
+            return False
+        return self._decide("bit_rot", f"atrest|{key}", self.bit_rot_p)
 
     # -- HTTP hooks (FlakyScoringMiddleware) -------------------------------
 

@@ -42,6 +42,8 @@ from bodywork_tpu.store.base import ArtefactStore
 from bodywork_tpu.store.filesystem import FilesystemStore
 from bodywork_tpu.store.resilient import ResilientStore
 from bodywork_tpu.store.schema import (
+    AUDIT_DIGESTS_PREFIX,
+    QUARANTINE_PREFIX,
     RUNS_PREFIX,
     SNAPSHOTS_PREFIX,
     TEST_METRICS_PREFIX,
@@ -155,17 +157,36 @@ def _journals_ok(store: ArtefactStore) -> bool:
 
 #: prefixes excluded from the byte-identity comparison: snapshots embed
 #: backend version tokens (coverage-compared instead), journals embed
-#: lease identities and wall-clocks (validity-checked instead)
-_COMPARE_EXCLUDED = (SNAPSHOTS_PREFIX, RUNS_PREFIX)
+#: lease identities and wall-clocks (validity-checked instead),
+#: quarantine/ holds per-incident evidence only one twin can have, and
+#: the audit sidecars OF test-metrics and snapshots record digests over
+#: bytes that embed a wall-clock column / backend tokens respectively
+#: (the metrics themselves are compared with the column stripped, the
+#: snapshots coverage-compared; their sidecars hash the raw bytes)
+_COMPARE_EXCLUDED = (
+    SNAPSHOTS_PREFIX,
+    RUNS_PREFIX,
+    QUARANTINE_PREFIX,
+    AUDIT_DIGESTS_PREFIX + TEST_METRICS_PREFIX,
+    AUDIT_DIGESTS_PREFIX + SNAPSHOTS_PREFIX,
+)
 
 
-def compare_stores(baseline: ArtefactStore, chaos: ArtefactStore) -> dict:
-    """Final-artefact comparison (module docstring has the rules)."""
+def compare_stores(
+    baseline: ArtefactStore,
+    chaos: ArtefactStore,
+    extra_excluded: tuple = (),
+) -> dict:
+    """Final-artefact comparison (module docstring has the rules).
+    ``extra_excluded`` adds caller-specific prefix exclusions (the
+    bit-rot soak excludes ``trainstate/`` when the repair policy for it
+    is drop-and-rebuild-next-run)."""
+    excluded = _COMPARE_EXCLUDED + tuple(extra_excluded)
     base_keys = [
-        k for k in baseline.list_keys() if not k.startswith(_COMPARE_EXCLUDED)
+        k for k in baseline.list_keys() if not k.startswith(excluded)
     ]
     chaos_keys = [
-        k for k in chaos.list_keys() if not k.startswith(_COMPARE_EXCLUDED)
+        k for k in chaos.list_keys() if not k.startswith(excluded)
     ]
     missing = sorted(set(base_keys) - set(chaos_keys))
     extra = sorted(set(chaos_keys) - set(base_keys))

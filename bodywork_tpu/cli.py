@@ -15,8 +15,9 @@ its own driver:
     python -m bodywork_tpu.cli report    --store DIR
     python -m bodywork_tpu.cli compact   --store DIR [--dry-run]
     python -m bodywork_tpu.cli deploy    --out DIR [--store-path P] [--image I]
-    python -m bodywork_tpu.cli chaos run-sim --store DIR --days N [--seed S] [--plan F]
+    python -m bodywork_tpu.cli chaos run-sim --store DIR --days N [--seed S] [--plan F] [--bit-rot]
     python -m bodywork_tpu.cli chaos canary  --store DIR --scenario nan|latency|healthy
+    python -m bodywork_tpu.cli fsck      --store DIR [--repair] [--json]
     python -m bodywork_tpu.cli registry list|show|promote|rollback|gate --store DIR ...
     python -m bodywork_tpu.cli registry canary start|stop|promote|status --store DIR ...
     python -m bodywork_tpu.cli traffic run --url URL [--rate R] [--duration S] ...
@@ -27,7 +28,9 @@ exit-code contract the reference implements per-script
 ``run-day`` extends it with documented non-error codes (docs/RESILIENCE.md):
 5 = run lease lost to another runner, 6 = resumed-noop (day already
 complete, journal-verified), 143 = graceful SIGTERM unwind; ``report
---fail-on-drift`` exits 4, and a chaos kill switch exits 86.
+--fail-on-drift`` exits 4, ``fsck`` exits 7 when actionable integrity
+findings remain, ``registry rollback`` exits 8 when the restore target
+fails pre-verification, and a chaos kill switch exits 86.
 """
 from __future__ import annotations
 
@@ -399,6 +402,22 @@ def cmd_run_day(args) -> int:
     print(f"day {d}: {result.wall_clock_s:.3f}s")
     for name, secs in result.stage_seconds.items():
         print(f"  {name}: {secs:.3f}s")
+    fsck_report = None
+    if args.scrub:
+        # detect-only integrity scrub after the day converges; findings
+        # ride the day report (and the audit counters) so the daily
+        # CronJob doubles as a scrub cadence without a second pod
+        from bodywork_tpu.audit import run_fsck
+
+        fsck_report = run_fsck(runner.store, repair=False)
+        by_sev = fsck_report["by_severity"]
+        print(
+            "fsck: "
+            + (
+                ", ".join(f"{s}={n}" for s, n in sorted(by_sev.items()))
+                or "clean"
+            )
+        )
     if args.trace_out or args.report_out:
         from bodywork_tpu.obs.spans import (
             day_report,
@@ -426,7 +445,9 @@ def cmd_run_day(args) -> int:
             )
             print(f"trace: {path}")
         report_path = report_out or _derived_report_path(trace_out)
-        path = write_day_report(report_path, day_report(result))
+        path = write_day_report(
+            report_path, day_report(result, fsck=fsck_report)
+        )
         print(f"report: {path}")
         # retention for date-templated outputs (the daily CronJob path):
         # keep the newest TRACE_RETENTION days, so the shared store
@@ -586,6 +607,19 @@ def cmd_wait_for(args) -> int:
 #: mistyped flag), or 3 (backend-unreachable, utils.watchdog).
 DRIFT_EXIT = 4
 
+#: ``fsck`` exit when ACTIONABLE integrity findings remain after the
+#: scan (and, with ``--repair``, after the repairs that could run) —
+#: the scrub CronJob's k8s-native alarm, distinct from every other
+#: documented code (docs/RESILIENCE.md §11).
+FSCK_FINDINGS_EXIT = 7
+
+#: ``registry rollback`` exit when the restore target fails
+#: pre-verification (missing ``previous`` checkpoint, or bytes that no
+#: longer match the record's lineage digest): the alias did NOT move.
+#: Distinct from 1 so an automated rollback wrapper can tell "refused
+#: for your protection — run fsck" from a generic failure.
+ROLLBACK_REFUSED_EXIT = 8
+
 
 def cmd_report(args) -> int:
     from bodywork_tpu.monitor import detect_drift, drift_report
@@ -675,6 +709,50 @@ def cmd_compact(args) -> int:
     return 0
 
 
+def cmd_fsck(args) -> int:
+    """Full-store integrity scrub (docs/RESILIENCE.md §11): walk every
+    prefix in ``schema.ALL_PREFIXES``, verify each artefact against its
+    write-time digest evidence and the cross-subsystem reference graph,
+    and (with ``--repair``) execute the safe repair subset — corrupt
+    bytes quarantined, derived artefacts rebuilt, digest-verified
+    replicas restored, dangling references demoted. Exit 0 when no
+    actionable findings remain, 7 otherwise, 1 on error."""
+    import json as _json
+
+    from bodywork_tpu.audit import run_fsck
+
+    # stdout carries exactly ONE JSON document with --json (the
+    # traffic/chaos CLI convention); logs go to stderr either way so
+    # the per-finding warnings never interleave with the report
+    configure_logger(stream=sys.stderr)
+    report = run_fsck(_store(args), repair=args.repair)
+    if args.json:
+        print(_json.dumps(report, indent=2, sort_keys=True))
+    else:
+        by_sev = report["by_severity"]
+        print(
+            f"scanned {report['keys_scanned']} artefact(s) across "
+            f"{len(report['prefixes'])} prefix(es): "
+            + (
+                ", ".join(f"{s}={n}" for s, n in sorted(by_sev.items()))
+                or "clean"
+            )
+        )
+        for finding in report["findings"]:
+            print(
+                f"  [{finding['severity']}] {finding['problem']} "
+                f"{finding['key']}: {finding['detail']}"
+            )
+        for entry in report["repairs"]:
+            print(
+                f"  repair {entry['action']} {entry['key']}: "
+                f"{entry['outcome']} — {entry['detail']}"
+            )
+        if report["residual"]:
+            print(f"{len(report['residual'])} actionable finding(s) remain")
+    return 0 if report["ok"] else FSCK_FINDINGS_EXIT
+
+
 def cmd_chaos_run_sim(args) -> int:
     """Seeded chaos soak (docs/RESILIENCE.md): run the N-day simulation
     fault-free AND under the fault plan, then require the faulted run's
@@ -708,7 +786,17 @@ def cmd_chaos_run_sim(args) -> int:
         seed = args.seed if args.seed is not None else env_seed
         plan = FaultPlan.default(seed if seed is not None else 0)
     if args.crash_schedule or plan.crash_schedule:
+        if args.bit_rot or plan.bit_rot_p > 0:
+            # the soaks are exclusive; a mixed plan must not silently
+            # drop half its adversity (the bit-rot branch warns in the
+            # other direction)
+            log.warning(
+                "crash soak selected; the plan's bit-rot knobs are "
+                "ignored here — run a separate chaos run-sim --bit-rot"
+            )
         return _chaos_crash_sim(args, plan)
+    if args.bit_rot or plan.bit_rot_p > 0:
+        return _chaos_bit_rot_sim(args, plan)
     drift = None
     if args.samples_per_day is not None:
         from bodywork_tpu.data.drift_config import DriftConfig
@@ -747,6 +835,83 @@ def cmd_chaos_run_sim(args) -> int:
         f"chaos soak FAILED: mismatched={comparison['mismatched']} "
         f"missing={comparison['missing']} extra={comparison['extra']} "
         f"torn={comparison['torn']} snapshot_ok={comparison['snapshot_ok']}"
+    )
+    return 1
+
+
+def _chaos_bit_rot_sim(args, plan) -> int:
+    """The at-rest bit-rot soak (``--bit-rot``): run the N-day sim into
+    two audited twins, flip seeded bytes across every populated prefix
+    of one (timestamps preserved — invisible to every read-time check),
+    then require fsck to detect and classify 100% of the injected
+    corruption and ``--repair`` to converge the store byte-identical to
+    the healthy twin outside ``quarantine/``. Knob precedence mirrors
+    the seed's: the ``--bit-rot`` flag arms a plan whose ``bit_rot_p``
+    is 0 at the stock probability; a plan file's own bit_rot fields are
+    never overridden by the flag."""
+    from bodywork_tpu.chaos import run_bit_rot_sim
+
+    if plan.bit_rot_p == 0:
+        plan.bit_rot_p = 0.25  # flag > plan default > env, like --seed
+    if any(
+        getattr(plan, f) for f in (
+            "store_transient_p", "store_latency_p", "torn_write_p",
+            "corrupt_read_p", "http_error_p", "http_latency_p",
+        )
+    ):
+        # mirror of the crash soak's warning: the soaks are exclusive,
+        # a mixed plan must not silently drop half its adversity
+        log.warning(
+            "bit-rot soak twins run WITHOUT in-flight fault injection; "
+            "the plan's in-flight probabilities are ignored here — run "
+            "a separate chaos run-sim for them"
+        )
+    drift = None
+    if args.samples_per_day is not None:
+        from bodywork_tpu.data.drift_config import DriftConfig
+
+        drift = DriftConfig(n_samples=args.samples_per_day)
+    summary = run_bit_rot_sim(
+        args.store, _date(args), args.days, plan,
+        model_type=args.model, scoring_mode=args.mode, drift=drift,
+        train_mode=args.train_mode,
+    )
+    print(
+        f"bit rot injected: {summary['injected']} key(s) across "
+        + ", ".join(
+            f"{p}={n}" for p, n in sorted(
+                summary["injected_by_prefix"].items()
+            )
+        )
+    )
+    print(
+        f"detected: {summary['detected']}/{summary['injected']} "
+        f"(severities: "
+        + (
+            ", ".join(
+                f"{s}={n}"
+                for s, n in sorted(summary["findings_by_severity"].items())
+            )
+            or "none"
+        )
+        + ")"
+    )
+    repaired = sum(
+        1 for r in summary["repairs"] if r["outcome"] == "repaired"
+    )
+    print(f"repairs: {repaired}/{len(summary['repairs'])} applied")
+    if summary["ok"]:
+        print(
+            f"PASS: {summary['injected']} injected corruption(s) all "
+            f"detected, classified, and repaired; store byte-identical "
+            f"to the healthy twin outside quarantine/ "
+            f"(seed={summary['seed']}, {args.days} day(s))"
+        )
+        return 0
+    log.error(
+        f"bit-rot soak FAILED: undetected={summary['undetected']} "
+        f"residual={[f['key'] for f in summary['post_repair_residual']]} "
+        f"comparison_ok={summary['comparison']['ok']}"
     )
     return 1
 
@@ -943,11 +1108,19 @@ def cmd_registry_promote(args) -> int:
 
 
 def cmd_registry_rollback(args) -> int:
-    from bodywork_tpu.registry import ModelRegistry
+    from bodywork_tpu.registry import ModelRegistry, RollbackBlocked
 
-    doc = ModelRegistry(_store(args)).rollback(
-        day=_date(args), reason="cli: operator rollback"
-    )
+    try:
+        doc = ModelRegistry(_store(args)).rollback(
+            day=_date(args), reason="cli: operator rollback"
+        )
+    except RollbackBlocked as exc:
+        log.error(
+            f"rollback refused: {exc} — the alias did not move; run "
+            "`cli fsck --repair` (or promote a known-good checkpoint) "
+            "and retry"
+        )
+        return ROLLBACK_REFUSED_EXIT
     print(f"production -> {doc['production']} (previous: {doc['previous']})")
     return 0
 
@@ -1269,6 +1442,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "the store are skipped; exit codes 5 (lease "
                         "lost) / 6 (resumed-noop) are documented in "
                         "docs/RESILIENCE.md")
+    p.add_argument("--scrub", action="store_true",
+                   help="run a detect-only integrity scrub (fsck) after "
+                        "the day converges; findings are printed, "
+                        "counted on bodywork_tpu_audit_* metrics, and "
+                        "embedded as the day report's fsck block "
+                        "(docs/RESILIENCE.md §11)")
 
     p = add("run-sim", cmd_run_sim, help="run an N-day drift simulation")
     p.add_argument("--spec", default=None, help="pipeline spec YAML (overrides --model/--mode)")
@@ -1407,6 +1586,17 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="N",
                    help="shrink the generator to N rows/day for quick "
                         "soaks (default: the full reference-parity 1440)")
+    p.add_argument("--bit-rot", action="store_true",
+                   help="run the AT-REST bit-rot soak instead: flip "
+                        "seeded bytes across every populated prefix of "
+                        "a finished sim's store (timestamps preserved — "
+                        "invisible to read-time checks), then require "
+                        "fsck to detect/classify 100%% of the damage "
+                        "and --repair to converge byte-identical to a "
+                        "healthy twin outside quarantine/ "
+                        "(docs/RESILIENCE.md §11). A --plan file's "
+                        "bit_rot_* fields arm this implicitly; the flag "
+                        "never overrides a plan's own bit_rot knobs")
     p.add_argument("--crash-schedule", default=None, metavar="SPEC",
                    help="run the crash-resume soak instead: kill+restart "
                         "a subprocess runner at these points and require "
@@ -1463,6 +1653,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "(default 96 — small; the scenario tests the "
                         "release loop, not the fit)")
 
+    p = add(
+        "fsck", cmd_fsck,
+        help="full-store integrity scrub: verify every prefix against "
+             "write-time digests + the cross-subsystem reference graph; "
+             "--repair executes the safe subset (quarantine, rebuild, "
+             "digest-verified restore) — docs/RESILIENCE.md §11",
+    )
+    p.add_argument("--store", **common_store)
+    p.add_argument("--repair", action="store_true",
+                   help="execute the safe repair subset: corrupt bytes "
+                        "move to quarantine/ (never deleted), derived "
+                        "artefacts rebuild, replicas restore "
+                        "digest-verified, dangling alias slots demote. "
+                        "Data-loss findings are quarantined and "
+                        "reported, never 'fixed'")
+    p.add_argument("--json", action="store_true",
+                   help="print the full report as exactly one JSON "
+                        "document on stdout (logs go to stderr) — the "
+                        "traffic/chaos CLI convention")
+
     p = sub.add_parser(
         "registry",
         help="model registry: gated promotion, shadow eval, rollback "
@@ -1501,7 +1711,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = registry_sub.add_parser(
         "rollback",
         help="ONE operation back to the previous production (a single "
-             "alias CAS flip; the checkpoint watcher swaps on next poll)",
+             "alias CAS flip; the checkpoint watcher swaps on next "
+             "poll). The restore target is pre-verified first — a "
+             "missing or digest-mismatched 'previous' refuses with "
+             "exit 8 instead of rolling back into a degraded boot",
     )
     p.set_defaults(fn=cmd_registry_rollback)
     p.add_argument("--store", **common_store)

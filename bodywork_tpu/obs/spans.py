@@ -142,19 +142,27 @@ class SpanRecorder:
             return list(self._spans)
 
 
-def day_report(result, spans: list[Span] | None = None) -> dict:
+def day_report(
+    result, spans: list[Span] | None = None, fsck: dict | None = None
+) -> dict:
     """Structured JSON-able run report for one ``DayResult``.
 
     ``spans`` defaults to ``result.spans`` (the runner attaches the
-    day-window slice). Schema (stable; tests/test_obs.py pins it)::
+    day-window slice). ``fsck`` (an integrity-scrub report from
+    ``audit.run_fsck`` — what ``cli run-day --scrub`` produces) adds a
+    findings block so the daily report carries the store's integrity
+    verdict next to its timings. Schema (stable; tests/test_obs.py pins
+    it)::
 
         {"schema": "bodywork_tpu.day_report/1",
          "day": "YYYY-MM-DD", "wall_clock_s": float,
          "stage_seconds": {stage: float},
-         "spans": [{name, category, start_s, duration_s, thread, meta?}]}
+         "spans": [{name, category, start_s, duration_s, thread, meta?}],
+         "fsck"?: {"clean", "ok", "keys_scanned", "by_severity",
+                   "findings": [...]}}
     """
     spans = result.spans if spans is None else spans
-    return {
+    report = {
         "schema": "bodywork_tpu.day_report/1",
         "day": str(result.day),
         "wall_clock_s": round(result.wall_clock_s, 6),
@@ -164,6 +172,15 @@ def day_report(result, spans: list[Span] | None = None) -> dict:
         },
         "spans": [s.to_dict() for s in spans],
     }
+    if fsck is not None:
+        report["fsck"] = {
+            "clean": fsck["clean"],
+            "ok": fsck["ok"],
+            "keys_scanned": fsck["keys_scanned"],
+            "by_severity": fsck["by_severity"],
+            "findings": fsck["findings"],
+        }
+    return report
 
 
 def write_day_report(path: str | Path, report: dict) -> Path:

@@ -53,7 +53,6 @@ and day-complete.
 """
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import socket
@@ -63,6 +62,7 @@ from datetime import date
 
 from bodywork_tpu.store.base import ArtefactNotFound, ArtefactStore, CasConflict
 from bodywork_tpu.store.schema import run_journal_key
+from bodywork_tpu.utils.integrity import stamp_doc, verify_doc
 from bodywork_tpu.utils.logging import get_logger
 
 log = get_logger("pipeline.journal")
@@ -125,8 +125,12 @@ def default_owner() -> str:
 def artefact_digest(data: bytes) -> str:
     """Content digest recorded per artefact — backend-independent (a
     version token would tie the journal to one backend instance) and
-    the thing resume verification re-hashes."""
-    return "sha256:" + hashlib.sha256(data).hexdigest()
+    the thing resume verification re-hashes. Delegates to the shared
+    format (``utils.integrity.sha256_digest``) so the integrity scrub
+    can cross-check it against sidecar and lineage evidence."""
+    from bodywork_tpu.utils.integrity import sha256_digest
+
+    return sha256_digest(data)
 
 
 def _count_corrupt() -> None:
@@ -227,7 +231,15 @@ class RunJournal:
                 return None, None, False
             try:
                 doc = json.loads(raw.decode("utf-8"))
-                if isinstance(doc, dict) and doc.get("schema") == JOURNAL_SCHEMA:
+                if (
+                    isinstance(doc, dict)
+                    and doc.get("schema") == JOURNAL_SCHEMA
+                    # embedded content digest (utils.integrity): a bit
+                    # flip that leaves the JSON parseable — a digit in a
+                    # recorded artefact digest, say — must still read as
+                    # corrupt, or resume would trust poisoned state
+                    and verify_doc(doc) is not False
+                ):
                     return doc, token, False
             except (UnicodeDecodeError, ValueError):
                 pass
@@ -478,4 +490,9 @@ class RunJournal:
 
 
 def _dumps(doc: dict) -> bytes:
-    return json.dumps(doc, sort_keys=True, indent=1).encode("utf-8")
+    # every write stamps the embedded content digest, so a journal's
+    # validity is verifiable without any out-of-band record — the
+    # property the integrity scrubber's runs/ auditor rides
+    return json.dumps(
+        stamp_doc(doc), sort_keys=True, indent=1
+    ).encode("utf-8")

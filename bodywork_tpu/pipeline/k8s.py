@@ -747,6 +747,51 @@ def generate_manifests(
                 },
             },
         }
+        # the integrity SCRUB (docs/RESILIENCE.md §11): proactive fsck
+        # over every store prefix 45 min after the day loop, repairing
+        # the safe subset (quarantine + digest-verified restore +
+        # derived rebuild). Exit 7 — actionable findings the repair
+        # could not clear — fails the Job, the k8s-native alarm an
+        # operator or alerting stack watches, exactly like the drift
+        # gate's exit 4. Pure host-side hashing and JSON work: a plain
+        # CPU ResourceSpec and the pipeline-wide image.
+        fsck_stage = dataclasses.replace(
+            first_stage, name="store-scrub", image=None, requirements=[],
+            resources=ResourceSpec(cpu_request=0.25, memory_mb=1024),
+        )
+        docs["99-store-scrub-cronjob.yaml"] = {
+            "apiVersion": "batch/v1",
+            "kind": "CronJob",
+            "metadata": {
+                "name": f"{spec.name}--store-scrub",
+                "namespace": namespace,
+                "labels": labels_base,
+            },
+            "spec": {
+                "schedule": _offset_schedule(daily_schedule, minutes=45),
+                # Forbid: two concurrent scrubs would race each other's
+                # quarantine CAS writes for no benefit
+                "concurrencyPolicy": "Forbid",
+                "jobTemplate": {
+                    "spec": {
+                        "template": {
+                            "spec": _pod_spec(
+                                spec,
+                                fsck_stage,
+                                store,
+                                image,
+                                ["python", "-m", "bodywork_tpu.cli",
+                                 "fsck", "--store", store_path,
+                                 "--repair", "--json"],
+                                "Never",
+                                gate_on_deps=False,  # an empty store
+                                # scans zero keys and exits 0
+                            )
+                        }
+                    }
+                },
+            },
+        }
         # the drift GATE the verdict rule exists to feed (calibrated bias
         # rule, monitor.detect_drift): runs after each day loop, exits 4
         # on current-state drift — the failed Job is the k8s-native alarm

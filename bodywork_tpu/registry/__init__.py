@@ -16,6 +16,7 @@ from bodywork_tpu.registry.manager import (
     ModelRegistry,
     PromotionConflict,
     RegistryError,
+    RollbackBlocked,
 )
 from bodywork_tpu.registry.records import (
     RegistryCorrupt,
@@ -36,6 +37,7 @@ __all__ = [
     "PromotionConflict",
     "RegistryCorrupt",
     "RegistryError",
+    "RollbackBlocked",
     "evaluate_candidate",
     "read_aliases",
     "register_candidate",

@@ -40,6 +40,15 @@ class PromotionConflict(RegistryError):
     (the CAS lost cleanly); re-read and retry if still relevant."""
 
 
+class RollbackBlocked(RegistryError):
+    """The restore target failed pre-verification: the ``previous``
+    checkpoint is missing from the store, or its bytes no longer match
+    the record's lineage digest. The alias is untouched — flipping it
+    would point serving at garbage exactly when an operator is trying
+    to recover, so the refusal is loud (its own `cli registry rollback`
+    exit code) and leaves a ``rollback_refused`` lineage event."""
+
+
 def _count_promotion(outcome: str) -> None:
     from bodywork_tpu.obs import get_registry
 
@@ -56,6 +65,16 @@ def _count_rollback() -> None:
         "bodywork_tpu_registry_rollbacks_total",
         "Registry rollbacks (production alias flipped back to previous)",
     ).inc()
+
+
+def _count_rollback_refused(reason: str) -> None:
+    from bodywork_tpu.obs import get_registry
+
+    get_registry().counter(
+        "bodywork_tpu_registry_rollback_refusals_total",
+        "Rollbacks refused because the restore target failed "
+        "pre-verification, by reason",
+    ).inc(reason=reason)
 
 
 def _count_canary_event(event: str) -> None:
@@ -210,11 +229,57 @@ class ModelRegistry:
         )
         return new_doc
 
+    def _verify_restorable(self, model_key: str, day: date | None) -> None:
+        """Pre-verify a rollback's restore target BEFORE the alias CAS:
+        the checkpoint must exist and its bytes must still match the
+        record's lineage digest. A dangling or bit-rotted ``previous``
+        rolled back blind puts a degraded (or unloadable) model live at
+        the exact moment resilience machinery is being exercised — the
+        refusal raises :class:`RollbackBlocked`, counts the reason, and
+        leaves a ``rollback_refused`` event on the target's record so
+        the ledger explains why production did not move."""
+        reason = None
+        if not self.store.exists(model_key):
+            reason = "checkpoint_missing"
+            detail = f"previous checkpoint {model_key!r} is missing"
+        else:
+            record = rec.load_record(self.store, model_key)
+            expected = record.get("model_digest") if record else None
+            if record is None:
+                reason = "record_unreadable"
+                detail = (
+                    f"record for {model_key!r} is absent or corrupt; "
+                    "cannot verify the checkpoint's lineage digest"
+                )
+            elif expected and rec.model_digest(
+                self.store.get_bytes(model_key)
+            ) != expected:
+                reason = "digest_mismatch"
+                detail = (
+                    f"checkpoint {model_key!r} no longer matches its "
+                    f"record digest {expected[:15]}… (at-rest corruption?)"
+                )
+        if reason is None:
+            return
+        _count_rollback_refused(reason)
+        # best-effort lineage event: with the record itself unreadable
+        # there is nowhere durable to write the refusal
+        rec.append_event(
+            self.store, model_key,
+            {"event": "rollback_refused", "day": str(day) if day else None,
+             "reason": reason},
+        )
+        log.error(f"rollback REFUSED ({reason}): {detail}")
+        raise RollbackBlocked(detail)
+
     def rollback(self, day: date | None = None, reason: str = "rollback") -> dict:
         """ONE operation back to the previous production: a single CAS
         flipping the alias document's ``production`` <-> ``previous``.
         No artefacts move; the checkpoint watcher's next poll swaps the
-        restored model back in."""
+        restored model back in. The restore target is pre-verified
+        (exists + record digest matches) before the CAS —
+        :meth:`_verify_restorable` — so a rollback can never land on a
+        dangling or corrupt ``previous``."""
         doc, token = rec.read_aliases(self.store, with_token=True)
         if doc is None:
             raise RegistryError("no registry alias document; nothing to roll back")
@@ -223,6 +288,7 @@ class ModelRegistry:
             raise RegistryError(
                 "no previous production recorded; nothing to roll back to"
             )
+        self._verify_restorable(previous, day)
         new_doc = {
             "schema": rec.ALIAS_SCHEMA,
             "production": previous,

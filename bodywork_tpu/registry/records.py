@@ -49,7 +49,6 @@ stage's pinned dependency closure.
 """
 from __future__ import annotations
 
-import hashlib
 import json
 from datetime import date
 
@@ -61,6 +60,7 @@ from bodywork_tpu.store.schema import (
     model_metrics_key,
     registry_record_key,
 )
+from bodywork_tpu.utils.integrity import stamp_doc, verify_doc
 from bodywork_tpu.utils.logging import get_logger
 
 log = get_logger("registry.records")
@@ -117,7 +117,16 @@ def _validated_read(
             return None
         try:
             doc = json.loads(raw.decode("utf-8"))
-            if isinstance(doc, dict) and doc.get("schema") == schema:
+            if (
+                isinstance(doc, dict)
+                and doc.get("schema") == schema
+                # embedded content digest (utils.integrity): a bit flip
+                # that keeps the JSON parseable — one digit of a model
+                # digest, a flipped status letter inside a quoted string
+                # — must still read as corrupt; legacy digest-less
+                # documents (None) stay acceptable
+                and verify_doc(doc) is not False
+            ):
                 return doc
         except (UnicodeDecodeError, ValueError):
             pass
@@ -155,7 +164,9 @@ def put_record(store: ArtefactStore, record: dict, expected_token) -> str:
     silently drop each other's events. :func:`update_record` is the
     retrying caller."""
     key = registry_record_key(record["model_key"])
-    data = json.dumps(record, sort_keys=True, indent=1).encode("utf-8")
+    data = json.dumps(
+        stamp_doc(record), sort_keys=True, indent=1
+    ).encode("utf-8")
     store.put_bytes_if_match(key, data, expected_token)
     return key
 
@@ -199,8 +210,12 @@ def model_digest(data: bytes) -> str:
     """Content digest used as the record's lineage version token —
     backend-independent (a filesystem inode token or GCS generation
     would tie the record's bytes to one backend instance and break the
-    chaos twin comparison) and tamper-evident."""
-    return "sha256:" + hashlib.sha256(data).hexdigest()
+    chaos twin comparison) and tamper-evident. Delegates to the shared
+    format (``utils.integrity.sha256_digest``) so the integrity scrub
+    can cross-check it against journal and sidecar evidence."""
+    from bodywork_tpu.utils.integrity import sha256_digest
+
+    return sha256_digest(data)
 
 
 def register_candidate(
@@ -356,7 +371,9 @@ def write_aliases(store: ArtefactStore, doc: dict, expected_token):
     assert doc.get("schema") == ALIAS_SCHEMA, doc
     return store.put_bytes_if_match(
         REGISTRY_ALIAS_KEY,
-        json.dumps(doc, sort_keys=True, indent=1).encode("utf-8"),
+        json.dumps(
+            stamp_doc(doc), sort_keys=True, indent=1
+        ).encode("utf-8"),
         expected_token,
     )
 

@@ -54,11 +54,19 @@ def open_store(url: str) -> ArtefactStore:
 
     - ``/path/to/dir`` or ``file:///path`` -> :class:`FilesystemStore`
     - ``gs://bucket/prefix``               -> :class:`~bodywork_tpu.store.gcs.GCSStore`
+
+    The backend comes wrapped in the audit subsystem's
+    :class:`~bodywork_tpu.audit.manifest.AuditedStore`, so every write
+    through a CLI entrypoint or k8s pod records its write-time digest
+    sidecar under ``audit/`` — the evidence the integrity scrubber
+    (``cli fsck``) verifies cold artefacts against.
     """
+    from bodywork_tpu.audit.manifest import AuditedStore
+
     if url.startswith("gs://"):
         from bodywork_tpu.store.gcs import GCSStore
 
-        return GCSStore.from_url(url)
+        return AuditedStore(GCSStore.from_url(url))
     if url.startswith("file://"):
         url = url[len("file://"):]
-    return FilesystemStore(url)
+    return AuditedStore(FilesystemStore(url))

@@ -15,6 +15,29 @@ from pathlib import Path
 from bodywork_tpu.store.base import ArtefactNotFound, ArtefactStore, CasConflict
 
 
+def _fsync_dir(path: Path) -> None:
+    """fsync a DIRECTORY. A file fsync + ``os.replace`` alone does not
+    make the rename durable across power loss — the new directory entry
+    lives in directory metadata, which the kernel flushes on its own
+    schedule — so every atomic write ends by syncing the parent
+    directory (the classic write-file / fsync-file / rename /
+    fsync-dir sequence). Module-level so the chaos torn-write test can
+    spy on it. Platforms whose directories refuse ``os.open`` for
+    syncing (some network filesystems) degrade silently: the rename is
+    still atomic, only its power-loss durability is weakened, which is
+    strictly the pre-existing behaviour."""
+    try:
+        fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 class FilesystemStore(ArtefactStore):
     backend_label = "filesystem"
 
@@ -43,6 +66,13 @@ class FilesystemStore(ArtefactStore):
                 # the chaos soak asserts never exists
                 os.fsync(f.fileno())
             os.replace(tmp, path)
+            # ...and fsync the PARENT DIRECTORY after the rename: the
+            # file fsync makes the bytes durable, the dir fsync makes
+            # the NAME durable — without it a power loss can forget the
+            # rename entirely (old content, or no file, at the key a
+            # completed put reported written). Covers the CAS path too:
+            # put_bytes_if_match writes through this same helper.
+            _fsync_dir(path.parent)
         except BaseException:
             if os.path.exists(tmp):
                 os.unlink(tmp)

@@ -52,6 +52,25 @@ discards the audit trail, so treat the prefix as durable, not derived.
 The alias doc is mutated exclusively through the compare-and-swap
 primitive ``ArtefactStore.put_bytes_if_match`` (never a raw
 ``put_bytes``), so concurrent promoters cannot tear it.
+
+``audit/`` holds the store's write-time digest manifest
+(``bodywork_tpu/audit/manifest.py``): one sidecar document per covered
+artefact under ``audit/digests/<key>.json`` recording the artefact's
+content digest (and, for small non-rebuildable classes, a compressed
+replica — the redundancy the fsck repair planner restores from).
+Delete safety: sidecars are DERIVED from the primary artefacts — the
+scrubber backfills a deleted digest record from the primary bytes on
+its next pass — but deleting a replica forfeits the self-healing
+redundancy for that artefact, so treat the prefix as cheap insurance,
+not scratch space.
+
+``quarantine/`` holds corrupt bytes the fsck repair planner moved aside
+(``bodywork_tpu/audit/repair.py``): per incident, the corrupt payload
+at ``quarantine/<original key>`` plus a metadata document
+``quarantine/<original key>.quarantine.json`` recording what was found.
+Quarantine entries are EVIDENCE, written only through the CAS primitive
+and never deleted by the framework — retention is an operator decision
+(docs/RESILIENCE.md §11 runbook).
 """
 from __future__ import annotations
 
@@ -71,7 +90,16 @@ REGISTRY_RECORDS_PREFIX = "registry/records/"
 #: mapping of ``production``/``previous`` to model keys; written ONLY
 #: via ``put_bytes_if_match`` — see the module docstring's delete note.
 REGISTRY_ALIAS_KEY = "registry/aliases.json"
+AUDIT_PREFIX = "audit/"
+AUDIT_DIGESTS_PREFIX = "audit/digests/"
+QUARANTINE_PREFIX = "quarantine/"
 
+#: every prefix the store schema defines — and therefore every prefix
+#: the integrity scrubber must audit: the fsck checker registry
+#: (``bodywork_tpu/audit/fsck.py``) is guard-pinned to cover EXACTLY
+#: this tuple, so a prefix added here without an auditor fails tier-1.
+#: Order matters to the scrubber: datasets/ is checked (and repaired)
+#: before the derived prefixes that rebuild from it.
 ALL_PREFIXES = (
     DATASETS_PREFIX,
     MODELS_PREFIX,
@@ -81,6 +109,8 @@ ALL_PREFIXES = (
     TRAINSTATE_PREFIX,
     RUNS_PREFIX,
     REGISTRY_PREFIX,
+    AUDIT_PREFIX,
+    QUARANTINE_PREFIX,
 )
 
 
@@ -132,3 +162,39 @@ def snapshot_key(d: date) -> str:
     (the embedded date is the most recent covered day, so the standard
     date-key protocol — ``history``/``latest`` — versions snapshots too)."""
     return f"{SNAPSHOTS_PREFIX}history-snapshot-{d}.npz"
+
+
+def audit_digest_key(key: str) -> str:
+    """The write-time digest sidecar for artefact ``key``
+    (``bodywork_tpu/audit/manifest.py``): the primary key path mirrored
+    under ``audit/digests/`` with a ``.json`` suffix, so the sidecar
+    namespace can never collide with a primary artefact and the inverse
+    mapping (:func:`audit_primary_key`) is exact."""
+    return f"{AUDIT_DIGESTS_PREFIX}{key}.json"
+
+
+def audit_primary_key(sidecar_key: str) -> str | None:
+    """Inverse of :func:`audit_digest_key`, or None for a key that is
+    not a well-formed sidecar key."""
+    if not sidecar_key.startswith(AUDIT_DIGESTS_PREFIX) or not (
+        sidecar_key.endswith(".json")
+    ):
+        return None
+    return sidecar_key[len(AUDIT_DIGESTS_PREFIX):-len(".json")]
+
+
+#: suffix distinguishing a quarantine METADATA document from the
+#: quarantined payload sitting next to it
+QUARANTINE_META_SUFFIX = ".quarantine.json"
+
+
+def quarantine_key(key: str) -> str:
+    """Where the fsck repair planner parks corrupt bytes found at
+    ``key`` — the original key path mirrored under ``quarantine/``."""
+    return f"{QUARANTINE_PREFIX}{key}"
+
+
+def quarantine_meta_key(key: str) -> str:
+    """The metadata document describing the quarantined bytes of
+    ``key`` (finding kind, digest of the corrupt payload)."""
+    return f"{QUARANTINE_PREFIX}{key}{QUARANTINE_META_SUFFIX}"

@@ -1,0 +1,77 @@
+"""Embedded content digests for CAS-mutated JSON documents.
+
+Every JSON artefact class whose document is mutated in place (run
+journals, registry records, the alias document — ``trainstate/`` already
+carries its own) embeds a ``doc_digest`` field: the sha256 of the
+document's CANONICAL serialization with the digest field removed. The
+digest makes silent at-rest corruption *detectable* even when a flipped
+byte leaves the JSON parseable and schema-valid — the gap the integrity
+scrubber (``bodywork_tpu/audit/fsck.py``) exists to close: schema checks
+catch structural damage, the digest catches semantic damage.
+
+Canonical form: ``json.dumps(doc, sort_keys=True,
+separators=(",", ":"))`` over the digest-less document — independent of
+the indent/whitespace the document was actually stored with, so readers
+that round-trip a document through ``json.loads`` can verify it without
+access to the original bytes. (The one corruption class this cannot see
+is a whitespace-to-whitespace byte flip, which by construction changes
+no content; full raw-byte coverage for non-JSON classes comes from the
+audit sidecar digests instead.)
+
+Verification is BACKWARD-COMPATIBLE: a document without the field (one
+written before this layer existed) verifies as ``None`` — "no digest
+recorded" — which readers accept and the scrubber reports as an
+advisory ``undigested`` finding whose repair is a rewrite.
+
+Stdlib-only: journal and registry readers sit on serving and stage hot
+paths and must not widen any pinned dependency closure.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+
+DOC_DIGEST_FIELD = "doc_digest"
+
+__all__ = [
+    "DOC_DIGEST_FIELD",
+    "doc_digest",
+    "sha256_digest",
+    "stamp_doc",
+    "verify_doc",
+]
+
+
+def sha256_digest(data: bytes) -> str:
+    """The ONE raw-byte content-digest format every evidence source
+    shares — run-journal artefact digests, registry lineage digests,
+    audit sidecar digests. They must produce byte-identical strings
+    (fsck cross-checks them against each other), so the format lives
+    here and the three subsystems delegate."""
+    return "sha256:" + hashlib.sha256(data).hexdigest()
+
+
+def doc_digest(doc: dict) -> str:
+    """sha256 over the canonical serialization of ``doc`` with the
+    digest field removed (the document must otherwise be JSON-able)."""
+    payload = {k: v for k, v in doc.items() if k != DOC_DIGEST_FIELD}
+    data = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    return "sha256:" + hashlib.sha256(data.encode("utf-8")).hexdigest()
+
+
+def stamp_doc(doc: dict) -> dict:
+    """Return ``doc`` with its ``doc_digest`` field set (in place —
+    writers stamp immediately before serializing)."""
+    doc[DOC_DIGEST_FIELD] = doc_digest(doc)
+    return doc
+
+
+def verify_doc(doc: dict) -> bool | None:
+    """True when the embedded digest matches the document's content,
+    False when it does not (corruption), None when no digest is
+    embedded (a legacy document — acceptable to readers, advisory to
+    the scrubber)."""
+    recorded = doc.get(DOC_DIGEST_FIELD)
+    if recorded is None:
+        return None
+    return recorded == doc_digest(doc)

@@ -1,0 +1,766 @@
+"""Store integrity scrubbing (ISSUE 10): fsck, repair, bit-rot chaos.
+
+The acceptance spine: the scrubber audits EVERY prefix in
+``schema.ALL_PREFIXES`` (guard-pinned against the checker registry and
+the documented integrity table), classifies at-rest corruption by the
+rebuildable / restorable / data-loss / advisory taxonomy, and the
+repair planner converges a bit-rotted store byte-identical to a healthy
+twin outside ``quarantine/`` — with a seeded corruption matrix pinning
+which consumer detects each artefact class's rot, on which op, with
+which counter, so detection coverage can never silently regress.
+"""
+import json
+import re
+import shutil
+from datetime import date
+from pathlib import Path
+
+import pytest
+
+from bodywork_tpu.audit import (
+    CHECKERS,
+    AuditedStore,
+    artefact_sha256,
+    read_sidecar,
+    run_fsck,
+)
+from bodywork_tpu.audit.repair import REPAIR_ORDER
+from bodywork_tpu.chaos import FaultPlan
+from bodywork_tpu.chaos.bitrot import _flip_bytes
+from bodywork_tpu.store import FilesystemStore, schema
+from bodywork_tpu.store.schema import (
+    ALL_PREFIXES,
+    DATASETS_PREFIX,
+    MODEL_METRICS_PREFIX,
+    MODELS_PREFIX,
+    REGISTRY_ALIAS_KEY,
+    RUNS_PREFIX,
+    SNAPSHOTS_PREFIX,
+    TEST_METRICS_PREFIX,
+    TRAINSTATE_PREFIX,
+    audit_digest_key,
+)
+
+pytestmark = pytest.mark.audit
+
+
+def _counter_total(name: str, **labels) -> float:
+    from bodywork_tpu.obs import get_registry
+
+    metric = get_registry().get(name)
+    if metric is None:
+        return 0.0
+    return sum(
+        s["value"] for s in metric.snapshot_samples()
+        if all(s["labels"].get(k) == v for k, v in labels.items())
+    )
+
+
+def _rot(root: Path, key: str, seed: int = 0) -> None:
+    """One seeded non-whitespace byte flip, timestamps preserved — the
+    matrix's at-rest corruption primitive (chaos.bitrot's)."""
+    assert _flip_bytes(
+        root, key, FaultPlan(seed=seed, bit_rot_max_flips=1)
+    ) is not None
+
+
+# -- guards (ISSUE 10 satellite: CI/tooling) -------------------------------
+
+
+def test_checker_registry_covers_exactly_all_prefixes():
+    """Adding a prefix to schema.ALL_PREFIXES without an auditor (or an
+    auditor for a prefix the schema does not define) fails tier-1."""
+    assert set(CHECKERS) == set(ALL_PREFIXES)
+
+
+def test_documented_integrity_table_covers_exactly_all_prefixes():
+    """The docs/RESILIENCE.md §11 integrity-guarantees table must carry
+    one row per schema prefix — the docs cannot drift from the code."""
+    text = Path(__file__).parent.parent.joinpath(
+        "docs", "RESILIENCE.md"
+    ).read_text()
+    rows = set(re.findall(r"^\| `([a-z-]+/)` \|", text, re.MULTILINE))
+    assert rows == set(ALL_PREFIXES)
+
+
+def test_every_planned_repair_action_is_executable():
+    """Every repair action a checker can plan must exist in the repair
+    planner's execution order (a planned-but-unimplemented action would
+    silently leave findings residual)."""
+    import inspect
+
+    from bodywork_tpu.audit import fsck as fsck_mod
+
+    source = inspect.getsource(fsck_mod)
+    planned = set(re.findall(r'repair="([a-z_]+)"', source))
+    planned |= set(re.findall(r'repair=\(\s*"([a-z_]+)"', source))
+    assert planned
+    assert planned <= set(REPAIR_ORDER) | {"quarantine"}
+
+
+def test_trainstate_digest_check_matches_training_stack():
+    """The scrubber re-implements trainstate's payload digest to stay
+    jax-free; the two implementations are pinned equal."""
+    from bodywork_tpu.audit.fsck import _trainstate_payload_digest
+    from bodywork_tpu.train import incremental
+
+    doc = {
+        "model_type": "linear", "feature_dim": 1,
+        "split": {"test_size": 0.2, "seed": 42},
+        "days": {"2026-01-01": {"n_rows": 4}},
+        "cum_g": [[1.0, 2.0], [2.0, 3.0]], "cum_c": [1.0, 2.0],
+    }
+    assert _trainstate_payload_digest(doc) == incremental._payload_digest(doc)
+
+
+def test_fsck_never_imports_jax(tmp_path):
+    """The scrub CronJob runs on plain CPU pods; importing the audit
+    subsystem (and scrubbing a store) must not pull the jax runtime."""
+    import subprocess
+    import sys
+
+    code = (
+        "import sys\n"
+        "from bodywork_tpu.audit import run_fsck\n"
+        "from bodywork_tpu.store import open_store\n"
+        f"store = open_store({str(tmp_path / 's')!r})\n"
+        "store.put_bytes('datasets/regression-dataset-2026-01-01.csv',"
+        " b'date,y,X\\n2026-01-01,1.0,2.0\\n')\n"
+        "report = run_fsck(store)\n"
+        "assert report['clean'], report\n"
+        "assert 'jax' not in sys.modules, 'fsck pulled in jax'\n"
+    )
+    subprocess.run(
+        [sys.executable, "-c", code], check=True,
+        cwd=Path(__file__).parent.parent,
+    )
+
+
+# -- the write-time digest manifest ----------------------------------------
+
+
+def test_audited_store_records_sidecars_on_covered_writes(tmp_path):
+    from bodywork_tpu.store import open_store
+
+    store = open_store(str(tmp_path / "s"))
+    assert isinstance(store, AuditedStore)  # open_store installs it
+    key = "datasets/regression-dataset-2026-01-01.csv"
+    store.put_bytes(key, b"date,y,X\n2026-01-01,1.0,2.0\n")
+    doc, status = read_sidecar(store, key)
+    assert status == "ok"
+    assert doc["sha256"] == artefact_sha256(store.get_bytes(key))
+    assert "replica" not in doc  # datasets restore from snapshots
+    model = "models/regressor-2026-01-01.npz"
+    store.put_bytes(model, b"fake-npz-bytes")
+    doc, status = read_sidecar(store, model)
+    assert status == "ok" and doc.get("replica")  # small classes replicate
+    # CAS-mutated registry documents are sidecar'd on the CAS path
+    store.put_bytes_if_match(REGISTRY_ALIAS_KEY, b'{"schema": "x"}', None)
+    doc, status = read_sidecar(store, REGISTRY_ALIAS_KEY)
+    assert status == "ok" and doc.get("replica")
+    # journals are NOT sidecar'd (wall-clock bytes would break twins)
+    store.put_bytes_if_match("runs/2026-01-01/journal.json", b"{}", None)
+    _doc, status = read_sidecar(store, "runs/2026-01-01/journal.json")
+    assert status == "absent"
+    # deleting a primary removes its sidecar
+    store.delete(model)
+    _doc, status = read_sidecar(store, model)
+    assert status == "absent"
+
+
+def test_fsck_clean_on_healthy_store(tmp_path):
+    from bodywork_tpu.store import open_store
+
+    store = open_store(str(tmp_path / "s"))
+    store.put_bytes(
+        "datasets/regression-dataset-2026-01-01.csv",
+        b"date,y,X\n2026-01-01,1.0,2.0\n",
+    )
+    report = run_fsck(store)
+    assert report["clean"] and report["ok"]
+    assert report["keys_scanned"] == 2  # the artefact + its sidecar
+
+
+def test_fsck_restores_replica_digest_verified(tmp_path):
+    from bodywork_tpu.store import open_store
+
+    store = open_store(str(tmp_path / "s"))
+    key = "model-metrics/regressor-2026-01-01.csv"
+    payload = b"MAPE,r_squared\n0.05,0.95\n"
+    store.put_bytes(key, payload)
+    _rot(tmp_path / "s", key)
+    report = run_fsck(store, repair=True)
+    [finding] = [
+        f for f in report["findings"] if f["problem"] == "digest_mismatch"
+    ]
+    assert finding["severity"] == "restorable"
+    assert store.get_bytes(key) == payload  # byte-identical restore
+    assert store.get_bytes(schema.quarantine_key(key)) != payload
+    meta = json.loads(
+        store.get_bytes(schema.quarantine_meta_key(key)).decode()
+    )
+    assert meta["problem"] == "digest_mismatch"
+    assert report["ok"] and not report["residual"]
+
+
+def test_fsck_flags_data_loss_and_never_fabricates(tmp_path):
+    """A corrupt dataset day with NO covering snapshot has no surviving
+    redundancy: data_loss — quarantined (copy), original left in place,
+    never 'repaired'."""
+    from bodywork_tpu.store import open_store
+
+    store = open_store(str(tmp_path / "s"))
+    key = "datasets/regression-dataset-2026-01-01.csv"
+    store.put_bytes(key, b"date,y,X\n2026-01-01,1.0,2.0\n")
+    corrupt_before = store.get_bytes(key)
+    _rot(tmp_path / "s", key)
+    corrupted = store.get_bytes(key)
+    assert corrupted != corrupt_before
+    report = run_fsck(store, repair=True)
+    [finding] = [
+        f for f in report["findings"] if f["problem"] == "digest_mismatch"
+    ]
+    assert finding["severity"] == "data_loss" and finding["repair"] is None
+    assert store.get_bytes(key) == corrupted  # untouched
+    assert store.get_bytes(schema.quarantine_key(key)) == corrupted
+    assert not report["ok"] and report["residual"]  # loudly not fixed
+
+
+def test_fsck_demotes_dangling_alias_slots(tmp_path):
+    """Cross-subsystem reference graph: a 'previous' slot pointing at a
+    vanished checkpoint is demoted in one CAS; a dangling 'production'
+    is reported as data_loss and NEVER auto-repaired."""
+    from bodywork_tpu.registry import records as rec
+    from bodywork_tpu.store import open_store
+
+    store = open_store(str(tmp_path / "s"))
+    for d in (1, 2):
+        store.put_bytes(f"models/regressor-2026-01-0{d}.npz", b"npz" * 10)
+    doc = {
+        "schema": rec.ALIAS_SCHEMA, "rev": 2,
+        "production": "models/regressor-2026-01-02.npz",
+        "previous": "models/regressor-2026-01-01.npz",
+        "updated_day": "2026-01-02", "last_op": "promote",
+    }
+    rec.write_aliases(store, doc, None)
+    store.delete("models/regressor-2026-01-01.npz")
+    report = run_fsck(store, repair=True)
+    demotions = [
+        r for r in report["repairs"] if r["action"] == "clear_previous"
+    ]
+    assert demotions and demotions[0]["outcome"] == "repaired"
+    assert rec.read_aliases(store)["previous"] is None
+    # now hollow out production: report-only, alias untouched
+    store.delete("models/regressor-2026-01-02.npz")
+    report = run_fsck(store, repair=True)
+    [finding] = [
+        f for f in report["findings"]
+        if f["problem"] == "dangling_alias" and f["severity"] == "data_loss"
+    ]
+    assert finding["repair"] is None
+    assert rec.read_aliases(store)["production"] == (
+        "models/regressor-2026-01-02.npz"
+    )
+
+
+def test_doc_digest_catches_semantic_flip_that_parses():
+    """The corruption class schema validation cannot see: a flipped
+    byte inside a quoted digest string leaves the JSON parseable and
+    schema-valid — the embedded doc_digest must still catch it."""
+    from bodywork_tpu.utils.integrity import stamp_doc, verify_doc
+
+    doc = stamp_doc({"schema": "x/1", "digest": "sha256:abcdef"})
+    assert verify_doc(doc) is True
+    doc["digest"] = "sha256:abcdee"  # one hex digit of rot
+    assert verify_doc(doc) is False
+    assert verify_doc({"schema": "x/1"}) is None  # legacy: no digest
+
+
+def test_fsck_detects_stale_registry_sidecar(tmp_path):
+    """Review-driven: a crash between a registry CAS write and its
+    sidecar write leaves a self-consistent replica one write behind.
+    Undetected, a later replica restore would silently roll the alias
+    back — the scrub must flag and refresh it from the healthy
+    primary."""
+    from bodywork_tpu.registry import records as rec
+    from bodywork_tpu.store import open_store
+
+    store = open_store(str(tmp_path / "s"))
+    store.put_bytes("models/regressor-2026-01-01.npz", b"npz" * 10)
+    store.put_bytes("models/regressor-2026-01-02.npz", b"npz" * 11)
+    doc = {
+        "schema": rec.ALIAS_SCHEMA, "rev": 1,
+        "production": "models/regressor-2026-01-01.npz",
+        "previous": None, "updated_day": "2026-01-01",
+        "last_op": "promote",
+    }
+    token = rec.write_aliases(store, doc, None)
+    # the crash window: the NEXT CAS lands on the inner store directly,
+    # so no sidecar refresh happens
+    doc2 = {**doc, "production": "models/regressor-2026-01-02.npz",
+            "previous": "models/regressor-2026-01-01.npz", "rev": 2}
+    rec.write_aliases(store.inner, doc2, token)
+    report = run_fsck(store, repair=True)
+    stale = [
+        f for f in report["findings"] if f["problem"] == "stale_sidecar"
+    ]
+    assert stale and stale[0]["key"] == audit_digest_key(REGISTRY_ALIAS_KEY)
+    doc, status = read_sidecar(store, REGISTRY_ALIAS_KEY)
+    assert status == "ok"
+    assert doc["sha256"] == artefact_sha256(
+        store.get_bytes(REGISTRY_ALIAS_KEY)
+    )  # refreshed: a future restore can no longer roll the alias back
+
+
+def test_quarantine_is_append_only_across_repeat_incidents(tmp_path):
+    """Review-driven: a second incident on the same key must take a new
+    suffixed slot — quarantine evidence is never overwritten."""
+    from bodywork_tpu.audit.repair import quarantine
+    from bodywork_tpu.store import open_store
+
+    store = open_store(str(tmp_path / "s"))
+    key = "model-metrics/regressor-2026-01-01.csv"
+    store.put_bytes(key, b"first incident")
+    assert quarantine(store, key, "digest_mismatch")
+    store.put_bytes(key, b"second incident")
+    assert quarantine(store, key, "digest_mismatch")
+    assert store.get_bytes(schema.quarantine_key(key)) == b"first incident"
+    assert store.get_bytes(
+        schema.quarantine_key(key) + ".2"
+    ) == b"second incident"
+    # re-parking the SAME bytes is an idempotent no-op, not a new slot
+    assert quarantine(store, key, "digest_mismatch")
+    assert not store.exists(schema.quarantine_key(key) + ".3")
+    # both incidents' metadata survives and the scrub accepts the pair
+    report = run_fsck(store)
+    assert not [
+        f for f in report["findings"]
+        if f["prefix"] == schema.QUARANTINE_PREFIX
+    ]
+
+
+# -- the cold-artefact corruption regression matrix (satellite) ------------
+#
+# One row per artefact class: corrupt it AT REST (seeded flip, mtime
+# preserved) and pin (a) the fsck finding's problem + severity, and
+# (b) which CONSUMER detects it, on which op, with which counter —
+# including the classes where the honest answer is "no consumer does;
+# fsck is the only detector", which is the gap this subsystem closes.
+
+
+@pytest.fixture(scope="module")
+def matrix_store(tmp_path_factory):
+    """A 2-day incremental-mode sim through an audited store: populates
+    every artefact class (datasets, models, metrics, snapshot,
+    trainstate, journals, records, alias, sidecars)."""
+    from bodywork_tpu.chaos.sim import _apply_train_mode
+    from bodywork_tpu.data.drift_config import DriftConfig
+    from bodywork_tpu.data.snapshot import write_snapshot
+    from bodywork_tpu.pipeline import LocalRunner, default_pipeline
+
+    root = tmp_path_factory.mktemp("matrix") / "store"
+    store = AuditedStore(FilesystemStore(root))
+    LocalRunner(
+        _apply_train_mode(default_pipeline("linear", "batch"), "incremental"),
+        store,
+        drift=DriftConfig(n_samples=120),
+    ).run_simulation(date(2026, 3, 1), 2)
+    write_snapshot(store)  # latest snapshot covers both days
+    report = run_fsck(store)
+    assert report["ok"], report["findings"]
+    return root
+
+
+def _case_store(matrix_store, tmp_path) -> tuple[Path, AuditedStore]:
+    root = tmp_path / "case"
+    shutil.copytree(matrix_store, root)
+    return root, AuditedStore(FilesystemStore(root))
+
+
+def _first_key(store, prefix: str) -> str:
+    keys = store.list_keys(prefix)
+    assert keys, f"matrix store has no {prefix} artefacts"
+    return keys[0]
+
+
+def test_matrix_dataset_day(matrix_store, tmp_path):
+    """Dataset rot: NO consumer digest-checks the CSV at read time (a
+    token-preserving flip rides snapshot slices or parses as garbage
+    rows — never an integrity error). fsck is the only reliable
+    detector; repair restores byte-identically from the snapshot
+    slice."""
+    root, store = _case_store(matrix_store, tmp_path)
+    key = _first_key(store, DATASETS_PREFIX)
+    healthy = store.get_bytes(key)
+    _rot(root, key)
+    before = _counter_total(
+        "bodywork_tpu_audit_findings_total", prefix=DATASETS_PREFIX,
+    )
+    report = run_fsck(store, repair=True)
+    assert _counter_total(
+        "bodywork_tpu_audit_findings_total", prefix=DATASETS_PREFIX,
+    ) > before
+    [finding] = [f for f in report["findings"] if f["key"] == key]
+    assert (finding["problem"], finding["severity"]) == (
+        "digest_mismatch", "restorable",
+    )
+    assert store.get_bytes(key) == healthy
+
+
+def test_matrix_checkpoint(matrix_store, tmp_path):
+    """Checkpoint rot: load_model (serving boot, rollback target) dies
+    on the artefact — fsck finds it proactively and restores from the
+    sidecar replica."""
+    from bodywork_tpu.models.checkpoint import load_model
+
+    root, store = _case_store(matrix_store, tmp_path)
+    key = _first_key(store, MODELS_PREFIX)
+    healthy = store.get_bytes(key)
+    _rot(root, key)
+    with pytest.raises(Exception):
+        load_model(store, key)
+    report = run_fsck(store, repair=True)
+    [finding] = [f for f in report["findings"] if f["key"] == key]
+    assert (finding["problem"], finding["severity"]) == (
+        "digest_mismatch", "restorable",
+    )
+    assert store.get_bytes(key) == healthy
+    (model, _d) = load_model(store, key)  # serveable again
+    assert model is not None
+
+
+@pytest.mark.parametrize("prefix", [MODEL_METRICS_PREFIX, TEST_METRICS_PREFIX])
+def test_matrix_metrics(matrix_store, tmp_path, prefix):
+    """Metrics rot: no consumer validates CSV content (the drift report
+    would silently chart garbage) — fsck detects via the sidecar digest
+    and restores the replica."""
+    root, store = _case_store(matrix_store, tmp_path)
+    key = _first_key(store, prefix)
+    healthy = store.get_bytes(key)
+    _rot(root, key)
+    report = run_fsck(store, repair=True)
+    [finding] = [f for f in report["findings"] if f["key"] == key]
+    assert (finding["problem"], finding["severity"]) == (
+        "digest_mismatch", "restorable",
+    )
+    assert store.get_bytes(key) == healthy
+
+
+def test_matrix_snapshot(matrix_store, tmp_path):
+    """Snapshot rot, both faces: STRUCTURAL damage (truncation) is the
+    one the loader already detects — zip validation fails, it falls
+    back, counting snapshot_loads_total{outcome=corrupt}. A byte FLIP
+    can land in zip slack the loader never checks, so the scrubber's
+    sidecar digest is the only guaranteed detector; either way fsck
+    grades it rebuildable and re-compacts from the datasets."""
+    from bodywork_tpu.data.snapshot import load_latest_snapshot
+
+    root, store = _case_store(matrix_store, tmp_path)
+    keys = store.list_keys(SNAPSHOTS_PREFIX)
+    for key in keys:  # truncate every kept snapshot: the fallback is spent
+        path = root / key
+        data = path.read_bytes()
+        path.write_bytes(data[: len(data) // 2])
+    before = _counter_total(
+        "bodywork_tpu_snapshot_loads_total", outcome="corrupt"
+    )
+    assert load_latest_snapshot(store) is None  # consumer detects + degrades
+    assert _counter_total(
+        "bodywork_tpu_snapshot_loads_total", outcome="corrupt"
+    ) > before
+    report = run_fsck(store, repair=True)
+    flagged = {f["key"] for f in report["findings"]}
+    assert set(keys) <= flagged
+    assert all(
+        f["severity"] == "rebuildable"
+        for f in report["findings"] if f["key"] in set(keys)
+    )
+    assert report["ok"]
+    assert load_latest_snapshot(store) is not None  # re-compacted
+
+
+def test_matrix_snapshot_zip_slack_flip_detected_by_digest(
+    matrix_store, tmp_path
+):
+    """The flip variant: whatever zip region a seeded flip lands in,
+    the sidecar digest re-hash flags the snapshot — detection can never
+    depend on where in the file the rot happened to fall."""
+    root, store = _case_store(matrix_store, tmp_path)
+    key = store.list_keys(SNAPSHOTS_PREFIX)[-1]
+    _rot(root, key)
+    report = run_fsck(store, repair=True)
+    [finding] = [f for f in report["findings"] if f["key"] == key]
+    assert finding["severity"] == "rebuildable"
+    assert finding["problem"] in ("digest_mismatch", "unreadable")
+    assert report["ok"]
+
+
+def test_matrix_trainstate(matrix_store, tmp_path):
+    """Trainstate rot: read_trainstate detects via the embedded payload
+    digest (train_trainstate_corrupt_total) and the trainer degrades to
+    a full refit; fsck grades it rebuildable and drops it so the next
+    train re-seeds O(1) behaviour."""
+    from bodywork_tpu.train.incremental import read_trainstate
+
+    root, store = _case_store(matrix_store, tmp_path)
+    key = _first_key(store, TRAINSTATE_PREFIX)
+    _rot(root, key)
+    before = _counter_total("bodywork_tpu_train_trainstate_corrupt_total")
+    doc, _token, reason = read_trainstate(store, "linear")
+    assert doc is None and reason == "trainstate_corrupt"
+    assert _counter_total(
+        "bodywork_tpu_train_trainstate_corrupt_total"
+    ) > before
+    report = run_fsck(store, repair=True)
+    [finding] = [f for f in report["findings"] if f["key"] == key]
+    assert (finding["problem"], finding["severity"]) == (
+        "digest_mismatch", "rebuildable",
+    )
+    assert not store.exists(key)  # dropped; quarantine holds the bytes
+    assert store.exists(schema.quarantine_key(key))
+
+
+def test_matrix_journal(matrix_store, tmp_path):
+    """Journal rot: RunJournal.acquire detects (doc digest), counts
+    runner_journal_corrupt_total, and CAS-repairs to a full re-run;
+    fsck grades it rebuildable and drops it."""
+    from bodywork_tpu.pipeline.journal import RunJournal
+
+    root, store = _case_store(matrix_store, tmp_path)
+    key = _first_key(store, RUNS_PREFIX)
+    _rot(root, key)
+    before = _counter_total("bodywork_tpu_runner_journal_corrupt_total")
+    journal = RunJournal(store, date(2026, 3, 1), lease_ttl_s=60)
+    journal.acquire()
+    assert journal.was_corrupt
+    assert _counter_total(
+        "bodywork_tpu_runner_journal_corrupt_total"
+    ) > before
+    # fresh copy for the fsck half (acquire just repaired the journal)
+    root2, store2 = _case_store(matrix_store, tmp_path / "b")
+    _rot(root2, key)
+    report = run_fsck(store2, repair=True)
+    [finding] = [f for f in report["findings"] if f["key"] == key]
+    assert (finding["problem"], finding["severity"]) == (
+        "unreadable", "rebuildable",
+    )
+    assert not store2.exists(key)
+
+
+def test_matrix_registry_record(matrix_store, tmp_path):
+    """Record rot: load_record degrades to absent-with-counter
+    (registry_corrupt_records_total{kind=record}); fsck restores the
+    sidecar replica byte-identically."""
+    from bodywork_tpu.registry.records import load_record
+
+    root, store = _case_store(matrix_store, tmp_path)
+    key = _first_key(store, schema.REGISTRY_RECORDS_PREFIX)
+    healthy = store.get_bytes(key)
+    model_key = json.loads(healthy.decode())["model_key"]
+    _rot(root, key)
+    before = _counter_total(
+        "bodywork_tpu_registry_corrupt_records_total", kind="record"
+    )
+    assert load_record(store, model_key) is None
+    assert _counter_total(
+        "bodywork_tpu_registry_corrupt_records_total", kind="record"
+    ) > before
+    report = run_fsck(store, repair=True)
+    [finding] = [f for f in report["findings"] if f["key"] == key]
+    assert (finding["problem"], finding["severity"]) == (
+        "unreadable", "restorable",
+    )
+    assert store.get_bytes(key) == healthy
+
+
+def test_matrix_alias(matrix_store, tmp_path):
+    """Alias rot: readers raise RegistryCorrupt (never the ungated
+    fallback), counting kind=alias; fsck restores the replica."""
+    from bodywork_tpu.registry.records import RegistryCorrupt, read_aliases
+
+    root, store = _case_store(matrix_store, tmp_path)
+    healthy = store.get_bytes(REGISTRY_ALIAS_KEY)
+    _rot(root, REGISTRY_ALIAS_KEY)
+    before = _counter_total(
+        "bodywork_tpu_registry_corrupt_records_total", kind="alias"
+    )
+    with pytest.raises(RegistryCorrupt):
+        read_aliases(store)
+    assert _counter_total(
+        "bodywork_tpu_registry_corrupt_records_total", kind="alias"
+    ) > before
+    report = run_fsck(store, repair=True)
+    [finding] = [
+        f for f in report["findings"] if f["key"] == REGISTRY_ALIAS_KEY
+    ]
+    assert (finding["problem"], finding["severity"]) == (
+        "unreadable", "restorable",
+    )
+    assert store.get_bytes(REGISTRY_ALIAS_KEY) == healthy
+    assert read_aliases(store)["production"]
+
+
+def test_matrix_sidecar(matrix_store, tmp_path):
+    """Sidecar rot: read_sidecar reports corrupt (evidence never lies
+    silently — the doc digest covers the recorded sha256); fsck rebuilds
+    it from the journal-verified primary."""
+    root, store = _case_store(matrix_store, tmp_path)
+    primary = _first_key(store, MODELS_PREFIX)
+    key = audit_digest_key(primary)
+    healthy = store.get_bytes(key)
+    _rot(root, key)
+    _doc, status = read_sidecar(store, primary)
+    assert status == "corrupt"
+    report = run_fsck(store, repair=True)
+    [finding] = [f for f in report["findings"] if f["key"] == key]
+    assert (finding["problem"], finding["severity"]) == (
+        "unreadable", "restorable",
+    )
+    assert store.get_bytes(key) == healthy  # deterministic re-record
+
+
+def test_matrix_quarantine(matrix_store, tmp_path):
+    """Quarantine rot: the evidence itself can rot; the scrubber says
+    so (advisory — nothing depends on quarantined bytes)."""
+    from bodywork_tpu.audit.repair import quarantine
+
+    root, store = _case_store(matrix_store, tmp_path)
+    victim = _first_key(store, MODEL_METRICS_PREFIX)
+    quarantine(store, victim, "digest_mismatch")
+    qkey = schema.quarantine_key(victim)
+    _rot(root, qkey)
+    report = run_fsck(store)
+    [finding] = [f for f in report["findings"] if f["key"] == qkey]
+    assert (finding["problem"], finding["severity"]) == (
+        "digest_mismatch", "advisory",
+    )
+
+
+# -- CLI contract (ISSUE 10 satellite: CI/tooling) -------------------------
+
+
+def test_cli_fsck_stdout_is_exactly_one_json_doc(tmp_path, capsys):
+    from bodywork_tpu.cli import FSCK_FINDINGS_EXIT, main
+    from bodywork_tpu.store import open_store
+
+    store_dir = tmp_path / "s"
+    store = open_store(str(store_dir))
+    key = "model-metrics/regressor-2026-01-01.csv"
+    store.put_bytes(key, b"MAPE\n0.05\n")
+    assert main(["fsck", "--store", str(store_dir), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)  # exactly ONE doc
+    assert report["schema"] == "bodywork_tpu.fsck_report/1"
+    assert report["clean"]
+    _rot(store_dir, key)
+    assert main(
+        ["fsck", "--store", str(store_dir), "--json"]
+    ) == FSCK_FINDINGS_EXIT
+    report = json.loads(capsys.readouterr().out)
+    assert not report["ok"] and report["findings"]
+    # --repair clears it; exit drops back to 0
+    assert main(
+        ["fsck", "--store", str(store_dir), "--json", "--repair"]
+    ) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["ok"] and report["repairs"]
+
+
+def test_day_report_carries_fsck_findings_block():
+    from types import SimpleNamespace
+
+    from bodywork_tpu.obs.spans import day_report
+
+    result = SimpleNamespace(
+        day=date(2026, 1, 1), wall_clock_s=1.0,
+        stage_seconds={"train": 1.0}, spans=[],
+    )
+    fsck = {
+        "clean": False, "ok": False, "keys_scanned": 9,
+        "by_severity": {"restorable": 1},
+        "findings": [{"key": "datasets/x.csv"}],
+        "repairs": [], "residual": [],
+    }
+    report = day_report(result, fsck=fsck)
+    assert report["fsck"]["by_severity"] == {"restorable": 1}
+    assert "repairs" not in report["fsck"]  # summary block, not the log
+    assert "fsck" not in day_report(result)  # absent unless scrubbed
+
+
+# -- the bit-rot chaos acceptance ------------------------------------------
+
+
+def _assert_bit_rot_summary(summary):
+    assert summary["injected"] > 0
+    assert summary["undetected"] == [], summary["undetected"]
+    assert summary["post_repair_residual"] == []
+    assert summary["comparison"]["ok"], summary["comparison"]
+    assert summary["ok"]
+    # the sweep reached every prefix the sim populated (trainstate/ and
+    # quarantine/ are empty in a full-train run)
+    populated = {
+        "datasets/", "models/", "model-metrics/", "test-metrics/",
+        "snapshots/", "runs/", "registry/", "audit/",
+    }
+    assert populated <= set(summary["injected_by_prefix"]), summary[
+        "injected_by_prefix"
+    ]
+
+
+@pytest.mark.chaos
+def test_bit_rot_smoke_three_days(tmp_path):
+    """ISSUE 10 acceptance (tier-1 smoke, seconds-scale): seeded at-rest
+    corruption across every populated prefix of a 3-day sim — 100%
+    detected + classified, repair converges byte-identical to the
+    healthy twin outside quarantine/, zero corruptions pass silently."""
+    from bodywork_tpu.chaos import run_bit_rot_sim
+    from bodywork_tpu.data.drift_config import DriftConfig
+
+    summary = run_bit_rot_sim(
+        tmp_path / "rot", date(2026, 1, 1), 3,
+        FaultPlan(seed=3, bit_rot_p=0.25),
+        drift=DriftConfig(n_samples=60),
+    )
+    _assert_bit_rot_summary(summary)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_bit_rot_full_scale(tmp_path):
+    """The full-scale acceptance: reference-parity day sizes over a
+    4-day horizon, same bars as the smoke."""
+    from bodywork_tpu.chaos import run_bit_rot_sim
+
+    summary = run_bit_rot_sim(
+        tmp_path / "rot", date(2026, 1, 1), 4,
+        FaultPlan(seed=5, bit_rot_p=0.25),
+    )
+    _assert_bit_rot_summary(summary)
+
+
+def test_bit_rot_same_seed_same_damage(tmp_path):
+    """The injector is addressed by pure (seed, key) streams: two
+    identical stores rotted under one seed take byte-identical damage."""
+    from bodywork_tpu.chaos.bitrot import inject_bit_rot
+    from bodywork_tpu.store import open_store
+
+    roots = []
+    for name in ("a", "b"):
+        root = tmp_path / name
+        store = open_store(str(root))
+        store.put_bytes(
+            "datasets/regression-dataset-2026-01-01.csv",
+            b"date,y,X\n2026-01-01,1.0,2.0\n2026-01-01,2.0,3.0\n",
+        )
+        store.put_bytes("models/regressor-2026-01-01.npz", b"npz" * 40)
+        roots.append(root)
+    plans = [FaultPlan(seed=7, bit_rot_p=1.0) for _ in roots]
+    injected = [
+        inject_bit_rot(FilesystemStore(r), p)
+        for r, p in zip(roots, plans)
+    ]
+    assert injected[0] == injected[1]
+    a = sorted((p.name, p.read_bytes()) for p in roots[0].rglob("*")
+               if p.is_file())
+    b = sorted((p.name, p.read_bytes()) for p in roots[1].rglob("*")
+               if p.is_file())
+    assert a == b

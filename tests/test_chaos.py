@@ -220,6 +220,31 @@ def test_torn_write_is_repaired_by_retry():
     assert mem.get_bytes("models/m.npz") == payload
 
 
+def test_filesystem_write_fsyncs_parent_directory(tmp_path, monkeypatch):
+    """ISSUE 10 satellite: a file fsync + rename alone does not make the
+    rename durable across power loss — the directory entry lives in
+    directory metadata. Every atomic write (plain put AND the CAS path)
+    must end by fsyncing the parent directory, through the spy-able
+    module-level helper."""
+    from bodywork_tpu.store import filesystem as fs_mod
+    from bodywork_tpu.store.filesystem import FilesystemStore
+
+    synced: list = []
+    real = fs_mod._fsync_dir
+    monkeypatch.setattr(
+        fs_mod, "_fsync_dir", lambda p: (synced.append(p), real(p))[1]
+    )
+    store = FilesystemStore(tmp_path / "s")
+    store.put_bytes("datasets/a.csv", b"x,y\n1,2\n")
+    assert synced and synced[-1] == (tmp_path / "s" / "datasets")
+    synced.clear()
+    token = store.put_bytes_if_match("registry/aliases.json", b"{}", None)
+    assert synced and synced[-1] == (tmp_path / "s" / "registry")
+    synced.clear()
+    store.put_bytes_if_match("registry/aliases.json", b"{1}", token)
+    assert synced, "the CAS overwrite path must sync the directory too"
+
+
 def test_every_public_op_routes_through_shared_retry_policy():
     """Satellite guard: put/get/get_many/list/delete/exists — and the
     registry's CAS primitive put_bytes_if_match — each absorb one

@@ -553,11 +553,17 @@ def test_crash_sweep_every_boundary_subprocess(tmp_path):
 
 
 def test_exit_codes_are_distinct_and_documented():
-    from bodywork_tpu.cli import DRIFT_EXIT
+    from bodywork_tpu.cli import (
+        DRIFT_EXIT,
+        FSCK_FINDINGS_EXIT,
+        ROLLBACK_REFUSED_EXIT,
+    )
     from bodywork_tpu.utils.shutdown import SIGTERM_EXIT
 
     codes = {0, 1, 2, DRIFT_EXIT, LEASE_LOST_EXIT, RESUMED_NOOP_EXIT,
+             FSCK_FINDINGS_EXIT, ROLLBACK_REFUSED_EXIT,
              kill.EXIT_KILLED, SIGTERM_EXIT}
-    assert len(codes) == 8  # no collisions
-    assert (LEASE_LOST_EXIT, RESUMED_NOOP_EXIT, kill.EXIT_KILLED,
-            SIGTERM_EXIT) == (5, 6, 86, 143)
+    assert len(codes) == 10  # no collisions
+    assert (LEASE_LOST_EXIT, RESUMED_NOOP_EXIT, FSCK_FINDINGS_EXIT,
+            ROLLBACK_REFUSED_EXIT, kill.EXIT_KILLED,
+            SIGTERM_EXIT) == (5, 6, 7, 8, 86, 143)

@@ -195,7 +195,7 @@ def test_manifests_structure(tmp_path):
         kinds[doc["kind"]] += 1
     assert kinds == {
         "Namespace": 1, "ConfigMap": 1, "PersistentVolumeClaim": 1,
-        "Job": 3, "Deployment": 1, "Service": 1, "CronJob": 3,
+        "Job": 3, "Deployment": 1, "Service": 1, "CronJob": 4,
     }
     # the second CronJob is the drift GATE: audits each day loop 30 min
     # after it, exits 4 (failed Job = the k8s-native alarm) on
@@ -215,6 +215,21 @@ def test_manifests_structure(tmp_path):
         "containers"][0]["command"]
     assert cmd[3:] == ["compact", "--store", "/mnt/store"]
     assert compact["spec"]["schedule"] == "15 6 * * *"  # day loop + 15 min
+    # the fourth is the integrity SCRUB (ISSUE 10): proactive fsck over
+    # every store prefix 45 min after the day loop, repairing the safe
+    # subset; exit 7 (actionable findings remain) fails the Job — the
+    # same k8s-native alarm shape as the drift gate's exit 4
+    scrub = docs["99-store-scrub-cronjob.yaml"]
+    cmd = scrub["spec"]["jobTemplate"]["spec"]["template"]["spec"][
+        "containers"][0]["command"]
+    assert cmd[3:] == ["fsck", "--store", "/mnt/store", "--repair",
+                       "--json"]
+    assert scrub["spec"]["schedule"] == "45 6 * * *"  # day loop + 45 min
+    assert scrub["spec"]["concurrencyPolicy"] == "Forbid"
+    # hashing/JSON work only: never a TPU request or nodeSelector
+    pod = scrub["spec"]["jobTemplate"]["spec"]["template"]["spec"]
+    assert "nodeSelector" not in pod
+    assert "limits" not in pod["containers"][0]["resources"]
     # default store medium is a ReadWriteMany PVC (multi-node safe): every
     # pod mounts the claim, nothing references the node's own filesystem
     pvc = docs["00-store-pvc.yaml"]

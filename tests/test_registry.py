@@ -120,6 +120,58 @@ def test_rollback_without_previous_is_clean_error(store):
         ModelRegistry(store).rollback()
 
 
+def _promote_two(store):
+    a = _add_candidate(store, 1)
+    b = _add_candidate(store, 2)
+    registry = ModelRegistry(store)
+    registry.promote(a, day=date(2026, 7, 1))
+    registry.promote(b, day=date(2026, 7, 2))
+    return registry, a, b
+
+
+def test_rollback_refused_when_previous_checkpoint_missing(store):
+    """ISSUE 10 satellite: a dangling ``previous`` must refuse the flip
+    (today it would roll back into a degraded boot), leave the alias
+    untouched, and record a rollback_refused lineage event."""
+    from bodywork_tpu.registry import RollbackBlocked
+
+    registry, a, b = _promote_two(store)
+    store.delete(a)  # the restore target rots away at rest
+    with pytest.raises(RollbackBlocked, match="missing"):
+        registry.rollback(day=date(2026, 7, 3))
+    doc = rec.read_aliases(store)
+    assert doc["production"] == b and doc["previous"] == a  # untouched
+    record = rec.load_record(store, a)
+    assert record["history"][-1]["event"] == "rollback_refused"
+    assert record["history"][-1]["reason"] == "checkpoint_missing"
+
+
+def test_rollback_refused_when_previous_digest_mismatches(store):
+    """Bit-rotted ``previous`` bytes: the record's lineage digest no
+    longer matches, so the pre-verification refuses BEFORE the CAS."""
+    from bodywork_tpu.registry import RollbackBlocked
+
+    registry, a, b = _promote_two(store)
+    data = bytearray(store.get_bytes(a))
+    data[len(data) // 2] ^= 0xFF
+    store.put_bytes(a, bytes(data))
+    with pytest.raises(RollbackBlocked, match="no longer matches"):
+        registry.rollback(day=date(2026, 7, 3))
+    doc = rec.read_aliases(store)
+    assert doc["production"] == b and doc["previous"] == a
+    assert rec.load_record(store, a)["history"][-1]["reason"] == (
+        "digest_mismatch"
+    )
+
+
+def test_rollback_verifies_then_flips_when_healthy(store):
+    """The pre-verification must not break the healthy path: intact
+    previous checkpoint + matching digest -> the one-CAS flip lands."""
+    registry, a, b = _promote_two(store)
+    doc = registry.rollback(day=date(2026, 7, 3))
+    assert doc["production"] == a and doc["previous"] == b
+
+
 def test_reregister_of_production_keeps_its_status(store):
     """A same-key retrain with CHANGED bytes must not flip the currently
     aliased production record back to 'candidate' (the ledger would
