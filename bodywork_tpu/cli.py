@@ -206,6 +206,7 @@ def cmd_serve(args) -> int:
             server_engine=args.server_engine,
             max_pending=args.max_pending,
             retry_after_max_s=args.retry_after_max_s,
+            dtype=args.dtype,
         ).start()
         if svc.metrics_url:
             log.info(f"aggregated metrics at {svc.metrics_url}")
@@ -249,6 +250,7 @@ def cmd_serve(args) -> int:
                 server_engine=args.server_engine,
                 max_pending=args.max_pending,
                 retry_after_max_s=args.retry_after_max_s,
+                dtype=args.dtype,
             )
         except ShutdownRequested:
             log.warning("SIGTERM during service startup; exiting")
@@ -1334,6 +1336,23 @@ def build_parser() -> argparse.ArgumentParser:
              "the fused Pallas MLP kernel (f32 or bf16 weights), or auto "
              "(kernel only where it wins: wide MLPs on a real TPU; "
              "never bf16)",
+    )
+    p.add_argument(
+        # choices hardcoded to keep parser construction import-light;
+        # pinned == serve.predictor.SERVE_DTYPES by tests/test_compiled.py
+        "--dtype", default=_env_choice(
+            "BODYWORK_TPU_SERVE_DTYPE",
+            ("float32", "bfloat16", "int8"), "float32",
+        ),
+        choices=["float32", "bfloat16", "int8"],
+        help="serving precision (env BODYWORK_TPU_SERVE_DTYPE "
+             "overrides): float32 (default — byte-identical to the "
+             "frozen contract), or a quantized variant (bfloat16 "
+             "matmuls / int8 weights, MLP only). A quantized dtype only "
+             "serves after the shadow quality gate admits it against "
+             "the f32 predictions of the same checkpoint; a regression "
+             "past the policy ceiling keeps f32 serving (visible on "
+             "/healthz serving_dtype)",
     )
     p.add_argument(
         "--reload-interval", type=float, default=30.0,

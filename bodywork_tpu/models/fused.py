@@ -55,3 +55,81 @@ def metrics_dict(tail: np.ndarray) -> dict[str, float]:
         "r_squared": float(tail[1]),
         "max_residual": float(tail[2]),
     }
+
+
+# -- quantized inference (serve --dtype {bfloat16,int8}) ---------------------
+#
+# Serving the small-to-mid MLP regime is weight-HBM-bound: every forward
+# re-reads the whole dense stack. bf16 halves those bytes (models.mlp
+# compute_dtype); int8 quarters them, at a per-matmul relative error of
+# order 1/127 on the weight operand. Quantization here is symmetric
+# per-OUTPUT-CHANNEL (one f32 scale per weight column): within a column
+# the quantization grid adapts to that column's own dynamic range, which
+# for He-initialised dense stacks keeps the realised prediction error one
+# to two orders below a per-tensor scale. Biases and the folded scaler
+# stay f32 (they are O(width) bytes — nothing to win), and accumulation
+# is always f32. Whether the realised quality delta is acceptable is NOT
+# decided here: serve.server routes it through the shadow gate
+# (registry.gates quantization check) before a quantized predictor may
+# take traffic.
+
+
+def quantize_int8(w) -> tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-output-channel int8 quantization of a 2-D weight
+    matrix: returns ``(q, scale)`` with ``w ≈ q * scale[None, :]``.
+    An all-zero column gets scale 1.0 (q is zero anyway)."""
+    w = np.asarray(w, dtype=np.float32)
+    absmax = np.max(np.abs(w), axis=0)
+    scale = np.where(absmax > 0.0, absmax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(w / scale[None, :]), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def quantize_mlp_params_int8(params: dict) -> dict:
+    """Quantize an MLP params pytree's dense weights to int8 + per-column
+    f32 scales; the scaler and biases ride through untouched. The result
+    is the pytree :func:`int8_mlp_apply` serves from."""
+    layers = []
+    for layer in params["net"]["layers"]:
+        q, scale = quantize_int8(layer["w"])
+        layers.append({
+            "wq": q,
+            "w_scale": np.asarray(scale, dtype=np.float32),
+            "b": np.asarray(layer["b"], dtype=np.float32),
+        })
+    scaler = {
+        k: np.asarray(v, dtype=np.float32)
+        for k, v in params["scaler"].items()
+    }
+    return {"net": {"layers": layers}, "scaler": scaler}
+
+
+def dequantize_mlp_params(qparams: dict) -> dict:
+    """The f32 params pytree an int8 pytree represents (tests compare
+    this against the original to bound the quantization error)."""
+    layers = []
+    for layer in qparams["net"]["layers"]:
+        w = (
+            np.asarray(layer["wq"], dtype=np.float32)
+            * np.asarray(layer["w_scale"], dtype=np.float32)[None, :]
+        )
+        layers.append({"w": w, "b": np.asarray(layer["b"], dtype=np.float32)})
+    return {"net": {"layers": layers}, "scaler": dict(qparams["scaler"])}
+
+
+def int8_mlp_apply(qparams: dict, x: jax.Array) -> jax.Array:
+    """Full MLP apply from int8 weights: raw X -> raw prediction, the
+    pure ``(params, X) -> y`` shape the AOT executable cache lowers
+    (serve.predictor.Int8MLPPredictor). Weights dequantize inside the
+    program — XLA fuses the ``int8 -> f32 scale`` into the matmul's
+    operand read, so HBM traffic is the int8 bytes."""
+    s = qparams["scaler"]
+    h = (x - s["x_mean"]) / s["x_std"]
+    layers = qparams["net"]["layers"]
+    for i, layer in enumerate(layers):
+        w = layer["wq"].astype(jnp.float32) * layer["w_scale"][None, :]
+        h = jnp.dot(h, w, preferred_element_type=jnp.float32) + layer["b"]
+        if i < len(layers) - 1:
+            h = jax.nn.relu(h)
+    out = h[:, 0]
+    return out * s["y_std"] + s["y_mean"]

@@ -83,13 +83,37 @@ def _mlp_kernel(n_layers: int, operand_dtype, *refs):
     out_ref[:] = h
 
 
+def _mlp_kernel_int8(n_layers: int, *refs):
+    """Int8-weight fused dense stack: x_ref, (wq, scale, b) per layer,
+    out_ref. Weights sit in VMEM as int8 (a quarter of the f32 bytes —
+    ~2x the servable width before spilling vs bf16) and dequantize
+    per-tile right before the dot; scales/biases/accumulation stay f32."""
+    x_ref, out_ref = refs[0], refs[-1]
+    h = x_ref[:]
+    for i in range(n_layers):
+        wq = refs[1 + 3 * i][:]
+        scale = refs[2 + 3 * i][:]
+        b = refs[3 + 3 * i][:]
+        w = wq.astype(jnp.float32) * scale[None, :]
+        h = jnp.dot(h, w, preferred_element_type=jnp.float32) + b[None, :]
+        if i < n_layers - 1:
+            h = jnp.maximum(h, 0.0)
+    out_ref[:] = h
+
+
 def make_pallas_mlp_apply(params: dict, interpret: bool = False,
-                          compute_dtype: str | None = None):
+                          compute_dtype: str | None = None,
+                          row_tile: int | None = None):
     """Build ``apply(X) -> y`` running the folded MLP as one Pallas kernel.
 
     Weights are padded/folded once at build time and stay on device;
-    ``apply`` pads the batch to a ROW_TILE multiple and returns the first
-    column (the regression head) unpadded.
+    ``apply`` pads the batch to a ``row_tile`` multiple (default
+    :data:`ROW_TILE`) and returns the first column (the regression head)
+    unpadded. A smaller ``row_tile`` (still a multiple of 8, the f32
+    sublane) is the coalesced-batch serving shape: a 16-row coalescer
+    flush grids over two 8-row tiles instead of padding to 256 rows —
+    the whole fused-kernel path becomes usable for cross-request
+    micro-batching, not just bulk scoring.
 
     ``compute_dtype="bfloat16"`` stores the padded weights in bf16 (half
     the VMEM bytes per weight; since square-layer weight bytes grow as
@@ -99,9 +123,22 @@ def make_pallas_mlp_apply(params: dict, interpret: bool = False,
     ``xla-bf16`` engine, whose activations and biases are bf16 end-to-end
     — so the two bf16 engines agree only to bf16 precision, not bitwise.
     Same ~3-significant-digit prediction trade, opt-in the same way.
+
+    ``compute_dtype="int8"`` stores the padded weights as symmetric
+    per-output-channel int8 (``models.fused.quantize_int8`` applied to
+    the FOLDED weights, so the scaler fold costs no extra error source)
+    with f32 scales, dequantized per-tile inside the kernel — a quarter
+    of f32's weight VMEM/HBM bytes. Same quality-gate contract as the
+    XLA int8 engine (serve.server).
     """
     from jax.experimental import pallas as pl
 
+    tile = int(row_tile or ROW_TILE)
+    if tile < 8 or tile % 8 != 0:
+        raise ValueError(
+            f"row_tile must be a positive multiple of 8, got {tile}"
+        )
+    int8_weights = compute_dtype == "int8"
     operand_dtype = (
         jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
     )
@@ -112,13 +149,25 @@ def make_pallas_mlp_apply(params: dict, interpret: bool = False,
 
     weights = []
     for (w, b), rows, cols in zip(folded, padded[:-1], padded[1:]):
-        # only the matmul LHS/RHS drop to bf16; biases stay f32 and are
-        # added to the f32 accumulator
-        weights.append(_pad_to(w, rows, cols).astype(operand_dtype))
+        if int8_weights:
+            from bodywork_tpu.models.fused import quantize_int8
+
+            q, scale = quantize_int8(w)
+            weights.append(_pad_to(jnp.asarray(q), rows, cols))
+            # zero-pad scales: padded columns hold q=0, any scale works
+            weights.append(_pad_to(jnp.asarray(scale), cols=cols))
+        else:
+            # only the matmul LHS/RHS drop to bf16; biases stay f32 and
+            # are added to the f32 accumulator
+            weights.append(_pad_to(w, rows, cols).astype(operand_dtype))
         weights.append(_pad_to(b, cols=cols))
 
     n_layers = len(folded)
-    kernel = partial(_mlp_kernel, n_layers, operand_dtype)
+    kernel = (
+        partial(_mlp_kernel_int8, n_layers)
+        if int8_weights
+        else partial(_mlp_kernel, n_layers, operand_dtype)
+    )
     in_width, out_width = padded[0], padded[-1]
 
     @jax.jit
@@ -133,17 +182,17 @@ def make_pallas_mlp_apply(params: dict, interpret: bool = False,
                 f"expected {d_in} feature(s), got {X.shape[1]}"
             )
         n = X.shape[0]
-        n_pad = -(-n // ROW_TILE) * ROW_TILE
+        n_pad = -(-n // tile) * tile
         Xp = jnp.zeros((n_pad, in_width), jnp.float32)
         Xp = Xp.at[:n, : X.shape[1]].set(X)
 
-        grid = (n_pad // ROW_TILE,)
+        grid = (n_pad // tile,)
         out = pl.pallas_call(
             kernel,
             out_shape=jax.ShapeDtypeStruct((n_pad, out_width), jnp.float32),
             grid=grid,
             in_specs=[
-                pl.BlockSpec((ROW_TILE, in_width), lambda i: (i, 0)),
+                pl.BlockSpec((tile, in_width), lambda i: (i, 0)),
             ]
             + [
                 # constant index map: weights/biases identical every step,
@@ -151,7 +200,7 @@ def make_pallas_mlp_apply(params: dict, interpret: bool = False,
                 pl.BlockSpec(w.shape, lambda i, nd=w.ndim: (0,) * nd)
                 for w in weights
             ],
-            out_specs=pl.BlockSpec((ROW_TILE, out_width), lambda i: (i, 0)),
+            out_specs=pl.BlockSpec((tile, out_width), lambda i: (i, 0)),
             interpret=interpret,
         )(Xp, *weights)
         return out[:n, 0]

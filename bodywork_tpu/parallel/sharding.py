@@ -120,6 +120,11 @@ class DataParallelPredictor(PaddedPredictor):
         self.mesh = mesh
         self._sharded_dispatch, _ = make_data_parallel_apply(model, mesh)
 
+    def _aot_fn(self):
+        # mesh-sharded dispatch owns its own jit cache; the single-device
+        # AOT executable cache does not apply
+        return None
+
     def _dispatch_padded(self, Xp: np.ndarray):
         # the *sharded* program, un-materialised: warmup compiles and
         # enqueues without paying a device->host transfer; the base

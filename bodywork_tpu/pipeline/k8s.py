@@ -233,6 +233,10 @@ def _container(
             ("BODYWORK_TPU_SERVER_ENGINE", "thread"),
             ("BODYWORK_TPU_MAX_PENDING", ""),
             ("BODYWORK_TPU_RETRY_AFTER_MAX_S", ""),
+            # serving precision (serve --dtype): flip to bfloat16/int8
+            # with `kubectl set env` — the shadow quality gate still
+            # decides per checkpoint whether the quantized variant serves
+            ("BODYWORK_TPU_SERVE_DTYPE", "float32"),
             # SLO-watchdog breach thresholds (ops/slo.py policy_from_env;
             # empty = the coded defaults): retune the canary abort
             # budget with `kubectl set env`, no rebuild/redeploy

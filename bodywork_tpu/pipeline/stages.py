@@ -231,16 +231,18 @@ def train_stage(
     )
 
 
-def _serve_env_knobs() -> tuple[str, int | None, float | None]:
+def _serve_env_knobs() -> tuple[str, int | None, float | None, str]:
     """The deployed serving knobs (``(server_engine, max_pending,
-    retry_after_max_s)``) from the pod environment — the k8s serve
-    Deployment materialises them as env vars (``pipeline/k8s.py``) so an
-    operator flips the HTTP front-end or the admission budget with a
-    ``kubectl set env``, no image rebuild. Malformed values are ignored
-    with a warning (same contract as ``cli serve``'s env defaults): a
-    typo must degrade to the default, never crash the serving pod."""
+    retry_after_max_s, dtype)``) from the pod environment — the k8s
+    serve Deployment materialises them as env vars (``pipeline/k8s.py``)
+    so an operator flips the HTTP front-end, the admission budget, or
+    the serving precision with a ``kubectl set env``, no image rebuild.
+    Malformed values are ignored with a warning (same contract as
+    ``cli serve``'s env defaults): a typo must degrade to the default,
+    never crash the serving pod."""
     import os
 
+    from bodywork_tpu.serve.predictor import SERVE_DTYPES
     from bodywork_tpu.serve.server import SERVER_ENGINES
 
     engine = os.environ.get("BODYWORK_TPU_SERVER_ENGINE", "").strip()
@@ -250,6 +252,13 @@ def _serve_env_knobs() -> tuple[str, int | None, float | None]:
             f"(expected one of {SERVER_ENGINES})"
         )
         engine = ""
+    dtype = os.environ.get("BODYWORK_TPU_SERVE_DTYPE", "").strip()
+    if dtype and dtype not in SERVE_DTYPES:
+        log.warning(
+            f"ignoring BODYWORK_TPU_SERVE_DTYPE={dtype!r} "
+            f"(expected one of {SERVE_DTYPES})"
+        )
+        dtype = ""
     max_pending: int | None = None
     raw = os.environ.get("BODYWORK_TPU_MAX_PENDING", "").strip()
     if raw:
@@ -276,7 +285,8 @@ def _serve_env_knobs() -> tuple[str, int | None, float | None]:
                 "(need a number >= 1)"
             )
             retry_after_max_s = None
-    return engine or "thread", max_pending, retry_after_max_s
+    return engine or "thread", max_pending, retry_after_max_s, \
+        dtype or "float32"
 
 
 def serve_stage(
@@ -360,10 +370,10 @@ def serve_stage(
     from bodywork_tpu.serve.server import (
         SERVER_ENGINES,
         build_admission,
-        build_predictor,
+        build_serving_predictor,
     )
 
-    env_engine, env_max_pending, env_retry_max = _serve_env_knobs()
+    env_engine, env_max_pending, env_retry_max, env_dtype = _serve_env_knobs()
     if server_engine is None:
         server_engine = env_engine
     if server_engine not in SERVER_ENGINES:
@@ -376,9 +386,15 @@ def serve_stage(
     if retry_after_max_s is None:
         retry_after_max_s = env_retry_max
     admission = build_admission(server_engine, max_pending, retry_after_max_s)
-    predictor = build_predictor(  # mesh_data=None: single-device serving
-        model, None, engine,
+    # dtype from the pod env (BODYWORK_TPU_SERVE_DTYPE): a quantized
+    # choice runs the shadow quality gate before it may serve, exactly
+    # as `cli serve --dtype` does — f32 (the default) is byte-identical
+    # to the pre-dtype behaviour
+    predictor, _served_dtype = build_serving_predictor(
+        # mesh_data=None: single-device serving
+        ctx.store, model, None, engine,
         buckets=tuple(buckets) if buckets else None,
+        dtype=env_dtype,
     )
     # warmup itself skips shapes already dispatched this process, and only
     # syncs when something new was dispatched — so the persistent day-loop

@@ -92,6 +92,15 @@ class GatePolicy:
     #: block when the candidate-vs-production mean |prediction delta|
     #: over the shadow window exceeds this (None = record, never block)
     shadow_max_mean_abs_delta: float | None = None
+    #: shadow window (dataset days) for the QUANTIZED-serving quality
+    #: gate (``serve --dtype {bfloat16,int8}``): the quantized predictor
+    #: scores the last K days next to the f32 predictor of the SAME
+    #: checkpoint, and may only take traffic when the delta passes the
+    #: same ceilings the candidate shadow check uses
+    #: (``shadow_max_mape_ratio`` + ``mape_slack``,
+    #: ``shadow_max_mean_abs_delta``) — one quality-gate rulebook, one
+    #: new knob (:func:`evaluate_quantization`)
+    quantized_shadow_days: int = 3
 
 
 @dataclasses.dataclass
@@ -157,6 +166,52 @@ def _production_drifted(store: ArtefactStore, window: int) -> bool:
     except Exception as exc:  # a broken report must not wedge the gate
         log.warning(f"drift check failed (treating as not-drifted): {exc!r}")
         return False
+
+
+def evaluate_quantization(
+    report: dict, policy: GatePolicy | None = None
+) -> tuple[bool, str]:
+    """The quantized-serving quality verdict over a shadow-comparison
+    report (``registry.shadow.shadow_compare``: quantized = candidate,
+    f32 = production — the SAME checkpoint, two dtypes). Applies exactly
+    the candidate shadow check's ceilings (``shadow_max_mape_ratio`` +
+    ``mape_slack``, ``shadow_max_mean_abs_delta``): the question "may
+    this lower-precision variant answer for that model" IS the shadow
+    question, so it gets the shadow rulebook, not a new one. Returns
+    ``(ok, detail)``; the serving boot path keeps f32 on a False."""
+    policy = policy or GatePolicy()
+    ok = True
+    detail = (
+        f"mean|Δ|={report['mean_abs_delta']:.6f} over "
+        f"{report['days']} day(s)/{report['rows']} rows"
+    )
+    if (
+        policy.shadow_max_mean_abs_delta is not None
+        and report["mean_abs_delta"] > policy.shadow_max_mean_abs_delta
+    ):
+        ok = False
+        detail += f" exceeds {policy.shadow_max_mean_abs_delta}"
+    q_mape = report.get("candidate_mape")
+    f32_mape = report.get("production_mape")
+    if (
+        q_mape is not None
+        and f32_mape is not None
+        and math.isfinite(q_mape)
+        and math.isfinite(f32_mape)
+    ):
+        ceiling = f32_mape * policy.shadow_max_mape_ratio + policy.mape_slack
+        if q_mape > ceiling:
+            ok = False
+            detail += (
+                f"; quantized shadow MAPE {q_mape:.6f} exceeds ceiling "
+                f"{ceiling:.6f} (f32 {f32_mape:.6f})"
+            )
+    else:
+        # a non-finite quantized MAPE is a broken variant, full stop
+        if q_mape is None or not math.isfinite(q_mape):
+            ok = False
+            detail += f"; quantized shadow MAPE unusable ({q_mape})"
+    return ok, detail
 
 
 def evaluate_candidate(

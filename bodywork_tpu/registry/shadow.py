@@ -31,6 +31,52 @@ def _window_mape(preds, labels) -> float:
     return float(np.mean(np.abs(preds - labels) / denom))
 
 
+def shadow_compare(
+    store: ArtefactStore,
+    predict_candidate,
+    predict_production,
+    days: int = 7,
+    max_rows_per_day: int | None = None,
+) -> dict:
+    """Score two ``predict(X) -> y`` callables over the last ``days``
+    persisted dataset days and compare — the engine behind
+    :func:`shadow_evaluate` (two checkpoints) and the quantized-serving
+    quality gate (one checkpoint, two dtypes — ``serve.server``).
+    Report shape as documented on :func:`shadow_evaluate`."""
+    import numpy as np
+
+    from bodywork_tpu.data.io import load_dataset
+
+    hist = store.history(DATASETS_PREFIX)
+    if not hist:
+        raise ValueError("no dataset history to shadow-evaluate over")
+    window = hist[-days:]
+    deltas, cand_all, prod_all, labels_all = [], [], [], []
+    for key, _d in window:
+        ds = load_dataset(store, key)
+        X, y = ds.X, ds.y
+        if max_rows_per_day is not None:
+            X, y = X[:max_rows_per_day], y[:max_rows_per_day]
+        cand_pred = np.asarray(predict_candidate(X), dtype=np.float64)
+        prod_pred = np.asarray(predict_production(X), dtype=np.float64)
+        deltas.append(cand_pred - prod_pred)
+        cand_all.append(cand_pred)
+        prod_all.append(prod_pred)
+        labels_all.append(np.asarray(y, dtype=np.float64))
+    delta = np.concatenate(deltas)
+    cand_pred = np.concatenate(cand_all)
+    prod_pred = np.concatenate(prod_all)
+    labels = np.concatenate(labels_all)
+    return {
+        "days": len(window),
+        "rows": int(delta.size),
+        "mean_abs_delta": float(np.mean(np.abs(delta))),
+        "max_abs_delta": float(np.max(np.abs(delta))),
+        "candidate_mape": _window_mape(cand_pred, labels),
+        "production_mape": _window_mape(prod_pred, labels),
+    }
+
+
 def shadow_evaluate(
     store: ArtefactStore,
     candidate_key: str,
@@ -49,41 +95,14 @@ def shadow_evaluate(
     Raises when either checkpoint or the window cannot be loaded — the
     gate surfaces that as a failed check rather than guessing.
     """
-    import numpy as np
-
-    from bodywork_tpu.data.io import load_dataset
     from bodywork_tpu.models.checkpoint import load_model_bytes
 
-    hist = store.history(DATASETS_PREFIX)
-    if not hist:
-        raise ValueError("no dataset history to shadow-evaluate over")
-    window = hist[-days:]
     candidate = load_model_bytes(store.get_bytes(candidate_key))
     production = load_model_bytes(store.get_bytes(production_key))
-    deltas, cand_all, prod_all, labels_all = [], [], [], []
-    for key, _d in window:
-        ds = load_dataset(store, key)
-        X, y = ds.X, ds.y
-        if max_rows_per_day is not None:
-            X, y = X[:max_rows_per_day], y[:max_rows_per_day]
-        cand_pred = np.asarray(candidate.predict(X), dtype=np.float64)
-        prod_pred = np.asarray(production.predict(X), dtype=np.float64)
-        deltas.append(cand_pred - prod_pred)
-        cand_all.append(cand_pred)
-        prod_all.append(prod_pred)
-        labels_all.append(np.asarray(y, dtype=np.float64))
-    delta = np.concatenate(deltas)
-    cand_pred = np.concatenate(cand_all)
-    prod_pred = np.concatenate(prod_all)
-    labels = np.concatenate(labels_all)
-    report = {
-        "days": len(window),
-        "rows": int(delta.size),
-        "mean_abs_delta": float(np.mean(np.abs(delta))),
-        "max_abs_delta": float(np.max(np.abs(delta))),
-        "candidate_mape": _window_mape(cand_pred, labels),
-        "production_mape": _window_mape(prod_pred, labels),
-    }
+    report = shadow_compare(
+        store, candidate.predict, production.predict,
+        days=days, max_rows_per_day=max_rows_per_day,
+    )
     log.info(
         f"shadow eval {candidate_key} vs {production_key}: "
         f"mean|Δ|={report['mean_abs_delta']:.4f} over "

@@ -1,5 +1,11 @@
-from bodywork_tpu.serve.predictor import BF16MLPPredictor, PaddedPredictor
-from bodywork_tpu.serve.admission import AdmissionController
+from bodywork_tpu.serve.predictor import (
+    EXECUTABLE_CACHE,
+    SERVE_DTYPES,
+    BF16MLPPredictor,
+    Int8MLPPredictor,
+    PaddedPredictor,
+)
+from bodywork_tpu.serve.admission import AdmissionController, SharedBudgetSlot
 from bodywork_tpu.serve.aio import AioServiceHandle
 from bodywork_tpu.serve.app import create_app
 from bodywork_tpu.serve.batcher import CoalescerSaturated, RequestCoalescer
@@ -11,6 +17,7 @@ from bodywork_tpu.serve.server import (
     ServiceHandle,
     build_admission,
     build_predictor,
+    build_serving_predictor,
     resolve_engine,
     serve_latest_model,
 )
@@ -21,13 +28,18 @@ __all__ = [
     "BF16MLPPredictor",
     "CheckpointWatcher",
     "CoalescerSaturated",
+    "EXECUTABLE_CACHE",
+    "Int8MLPPredictor",
     "RequestCoalescer",
     "MultiProcessService",
     "PaddedPredictor",
     "RoundRobinApp",
     "SERVER_ENGINES",
+    "SERVE_DTYPES",
+    "SharedBudgetSlot",
     "build_admission",
     "build_predictor",
+    "build_serving_predictor",
     "create_app",
     "resolve_engine",
     "ServiceHandle",

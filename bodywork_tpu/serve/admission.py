@@ -70,6 +70,7 @@ __all__ = [
     "SHED_TOTAL_METRIC",
     "QUEUE_DEPTH_METRIC",
     "AdmissionController",
+    "SharedBudgetSlot",
     "count_shed",
 ]
 
@@ -100,6 +101,48 @@ def count_shed(reason: str) -> None:
     ).inc(reason=reason)
 
 
+class SharedBudgetSlot:
+    """One worker's slot in a cross-process admission budget.
+
+    The budget is a ``multiprocessing.Array('i', n_workers)``: worker
+    ``i`` only ever mutates ``array[i]`` (its own admitted count), and an
+    admission decision compares ``sum(array)`` — the service-wide held
+    work — against ``max_pending`` under the array's one lock. Per-slot
+    accounting is what makes the budget SELF-HEALING: when a replica
+    dies mid-request, the supervisor zeroes its slot before respawning,
+    so a crash can never leak budget and slowly choke the fleet (a
+    single shared counter would leak exactly the dead worker's unknown
+    in-flight count, forever)."""
+
+    def __init__(self, array, index: int):
+        self.array = array
+        self.index = int(index)
+
+    def admit(self, max_pending: int) -> tuple[bool, int]:
+        """Try to take one unit; returns ``(admitted, service_total)``."""
+        with self.array.get_lock():
+            total = sum(self.array)
+            if total >= max_pending:
+                return False, total
+            self.array[self.index] += 1
+            return True, total + 1
+
+    def release(self) -> None:
+        with self.array.get_lock():
+            if self.array[self.index] > 0:
+                self.array[self.index] -= 1
+
+    def total(self) -> int:
+        with self.array.get_lock():
+            return sum(self.array)
+
+    @staticmethod
+    def clear(array, index: int) -> None:
+        """Zero a (dead) worker's slot — the supervisor's reclaim hook."""
+        with array.get_lock():
+            array[index] = 0
+
+
 class AdmissionController:
     """Bounded-pending admission with an EWMA queue-delay estimator.
 
@@ -125,6 +168,7 @@ class AdmissionController:
         ewma_alpha: float = 0.2,
         retry_after_min_s: float = 1.0,
         retry_after_max_s: float = 30.0,
+        shared_slot: SharedBudgetSlot | None = None,
     ):
         if max_pending < 1:
             raise ValueError(f"max_pending must be >= 1, got {max_pending}")
@@ -141,6 +185,17 @@ class AdmissionController:
         self.retry_after_max_s = retry_after_max_s
         self._lock = threading.Lock()
         self._pending = 0
+        #: optional cross-process budget slot (:class:`SharedBudgetSlot`):
+        #: when set, admission decisions compare the SERVICE-WIDE
+        #: admitted count against ``max_pending`` — N SO_REUSEPORT
+        #: replica processes behind one port become one benchmarkable
+        #: unit with ONE budget (serve.multiproc wires it) instead of N
+        #: independent budgets summing to N x max_pending. The local
+        #: ``_pending`` keeps tracking this process's own contribution:
+        #: the queue-depth gauge reports the LOCAL count, so the
+        #: multiproc sum-aggregate /metrics merge still shows the true
+        #: service total exactly once.
+        self._shared = shared_slot
         self._draining = False
         self._depth_probe = None
         #: high-water mark of the pending depth — the budget-invariant
@@ -202,6 +257,33 @@ class AdmissionController:
             count_shed("drain")
             return False
         external = self._external_depth()
+        shared = self._shared
+        if shared is not None:
+            # service-wide budget first: the shared count is the sum of
+            # every replica's admitted-and-unfinished work. One shared
+            # lock acquisition + an O(n_workers) sum — the same cost
+            # class as the local path (the kernel balances connections,
+            # so contention is spread N ways).
+            if external > self.max_pending:
+                # shed on upstream backlog alone: don't touch the
+                # cross-process lock on the path that exists to be cheap
+                admitted, shared_total = False, 0
+            else:
+                admitted, shared_total = shared.admit(self.max_pending)
+            with self._lock:
+                if admitted:
+                    self._pending += 1
+                    self._admitted_count += 1
+                    if shared_total > self.max_observed_pending:
+                        self.max_observed_pending = shared_total
+                else:
+                    self._shed_count += 1
+                depth = self._pending
+            self._g_depth.set(float(depth))
+            if not admitted:
+                count_shed("admission")
+                return False
+            return True
         with self._lock:
             if (
                 self._pending >= self.max_pending
@@ -228,11 +310,19 @@ class AdmissionController:
         response ready) feeds the EWMA estimator. Under load that delay
         includes the queueing the NEXT client would experience, which is
         exactly what its Retry-After should reflect."""
-        external = self._external_depth()
+        shared = self._shared
+        # the probe only matters for the local-budget depth fold — don't
+        # pay it per release on the shared path (hot, by design cheap)
+        external = self._external_depth() if shared is None else 0
         with self._lock:
             if self._pending > 0:
                 self._pending -= 1
-            depth = max(self._pending, external)
+                if shared is not None:
+                    shared.release()
+            depth = (
+                self._pending if shared is not None
+                else max(self._pending, external)
+            )
             if observed_delay_s is not None and observed_delay_s >= 0.0:
                 if self._ewma_delay_s is None:
                     self._ewma_delay_s = float(observed_delay_s)
@@ -248,8 +338,12 @@ class AdmissionController:
     @property
     def queue_depth(self) -> int:
         """Requests currently held anywhere: admitted-and-unfinished or
-        queued upstream of admission (the depth probe)."""
+        queued upstream of admission (the depth probe). With a shared
+        budget this is the SERVICE-WIDE admitted count."""
         external = self._external_depth()
+        shared = self._shared
+        if shared is not None:
+            return max(shared.total(), external)
         with self._lock:
             return max(self._pending, external)
 
@@ -284,15 +378,21 @@ class AdmissionController:
         still-queued-before-admission (the aio engine's connection
         backlog — zero on the threaded engine)."""
         external = self._external_depth()
+        shared = self._shared
+        shared_total = shared.total() if shared is not None else None
         with self._lock:
             pending = self._pending
             ewma = self._ewma_delay_s
             shed = self._shed_count
             admitted = self._admitted_count
-        depth = max(pending, external)
+        budget_used = shared_total if shared_total is not None else pending
+        depth = max(budget_used, external)
         return {
             "queue_depth": depth,
             "pending": pending,
+            # service-wide admitted count when replicas share ONE budget
+            # (serve --workers N); None on a per-process controller
+            "shared_pending": shared_total,
             "upstream_depth": external,
             "max_pending": self.max_pending,
             # the exact try_admit predicate (`>` on the external probe:
@@ -300,7 +400,8 @@ class AdmissionController:
             # count) — /healthz must never claim "shedding" while
             # requests are still being admitted
             "shedding": (
-                pending >= self.max_pending or external > self.max_pending
+                budget_used >= self.max_pending
+                or external > self.max_pending
             ),
             "retry_after_s": self.retry_after_s(),
             "ewma_queue_delay_s": round(ewma, 6) if ewma is not None else None,

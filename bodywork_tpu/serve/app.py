@@ -461,6 +461,16 @@ class ScoringApp:
                 if old is not None
                 else PaddedPredictor(model)
             )
+            # a predictor built HERE was warmed by nobody: compile (and
+            # run) every bucket BEFORE the swap pointer publishes, so a
+            # caller skipping the watcher path (tests, ad-hoc swaps)
+            # still never lands a compile — or a device fault — on the
+            # first scoring request. With the process-wide executable
+            # cache a same-architecture swap makes this free (pure
+            # cache hits); sync=False because surfacing execution
+            # faults synchronously is the WATCHER's pre-swap contract,
+            # not this fallback's.
+            predictor.warmup(sync=False)
         self._served = _Served(
             predictor, model.info, str(model_date) if model_date else None,
             model_key=model_key, source=model_source,
@@ -521,6 +531,10 @@ class ScoringApp:
                 if base is not None
                 else PaddedPredictor(model)
             )
+            # same warm-before-publish contract as swap_model: a canary
+            # start must not land its first-bucket compile (or a device
+            # fault) on the first scoring request that routes to it
+            predictor.warmup(sync=False)
         old = self._canary
         self._canary_fraction = float(fraction)
         self._canary_seed = int(seed)
@@ -940,6 +954,7 @@ class ScoringApp:
                     "model_date": None,
                     "model_key": None,
                     "model_source": None,
+                    "serving_dtype": None,
                     # a degraded boot can still hold a live canary (the
                     # watcher loads it independently of production) —
                     # probes must see the release loop's real state
@@ -971,6 +986,10 @@ class ScoringApp:
             # carries the degraded flag + reason below.
             "model_key": served.model_key,
             "model_source": served.source,
+            # the serving precision actually live ("float32" after a
+            # quantization-gate rejection — the operator-visible proof
+            # that --dtype never silently costs quality)
+            "serving_dtype": getattr(served.predictor, "dtype", "float32"),
             # the live-release channel: WHICH canary takes a fraction of
             # traffic (None = no canary) and the SLO watchdog's latest
             # verdict — so probes and the traffic harness attribute

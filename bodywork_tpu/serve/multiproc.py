@@ -107,7 +107,10 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
                  metrics_dir: str | None = None,
                  server_engine: str = "thread",
                  max_pending: int | None = None,
-                 retry_after_max_s: float | None = None):
+                 retry_after_max_s: float | None = None,
+                 shared_budget=None,
+                 slot_index: int = 0,
+                 dtype: str = "float32"):
     """One serving replica: load latest checkpoint -> predictor -> listen
     on the shared port. Runs in a SPAWNED process (a fork would inherit
     the parent's initialized XLA runtime threads — undefined behavior)."""
@@ -118,7 +121,7 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
     from bodywork_tpu.serve.server import (
         _registry_bounds,
         build_admission,
-        build_predictor,
+        build_serving_predictor,
     )
     from bodywork_tpu.store import open_store
 
@@ -127,13 +130,28 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
     # else the newest date-keyed checkpoint (models/checkpoint.py)
     served_key, served_source = resolve_serving_key(store)
     model, model_date = load_model(store, served_key)
-    predictor = build_predictor(model, None, engine, buckets=buckets)
-    # one admission budget PER WORKER PROCESS (as one coalescer per
-    # worker): each replica sheds against its own kernel-balanced
-    # connection share, and the aggregated queue-depth gauge (sum) plus
-    # the shed counter still give the service-wide saturation picture
+    # dtype composes here exactly as in single-process serving: a
+    # quantized dtype runs the shadow quality gate per worker (same
+    # store, same window — same verdict on every replica)
+    predictor, _served_dtype = build_serving_predictor(
+        store, model, None, engine, buckets=buckets, dtype=dtype,
+    )
+    # ONE admission budget for the whole fleet when the supervisor hands
+    # every worker a slot in the shared cross-process budget array
+    # (max_pending is then service-wide; the supervisor zeroes a dead
+    # worker's slot so crashes can't leak budget); without it each
+    # replica sheds against its own kernel-balanced connection share.
+    # Either way the aggregated queue-depth gauge (sum of per-worker
+    # contributions) plus the shed counter give the service-wide
+    # saturation picture.
+    shared_slot = None
+    if shared_budget is not None:
+        from bodywork_tpu.serve.admission import SharedBudgetSlot
+
+        shared_slot = SharedBudgetSlot(shared_budget, slot_index)
     admission = build_admission(server_engine, max_pending,
-                                retry_after_max_s)
+                                retry_after_max_s,
+                                shared_slot=shared_slot)
     # one coalescer PER WORKER PROCESS: replicas never share a dispatcher
     # (they never share a predictor either), so each worker amortises its
     # own connection share across its own padded device calls
@@ -191,6 +209,7 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
             engine=engine, served_key=served_key, buckets=buckets,
             slo_watchdog=SloWatchdog(store, [app],
                                      policy=policy_from_env()),
+            dtype=dtype,
         ).start()
     try:
         if aio_handle is not None:
@@ -244,14 +263,21 @@ class MultiProcessService:
         server_engine: str = "thread",
         max_pending: int | None = None,
         retry_after_max_s: float | None = None,
+        dtype: str = "float32",
     ):
         assert workers >= 1, "need at least one replica"
+        from bodywork_tpu.serve.predictor import SERVE_DTYPES
         from bodywork_tpu.serve.server import SERVER_ENGINES
 
         if server_engine not in SERVER_ENGINES:
             raise ValueError(
                 f"unknown server engine {server_engine!r}; "
                 f"expected one of {SERVER_ENGINES}"
+            )
+        if dtype not in SERVE_DTYPES:
+            raise ValueError(
+                f"unknown serving dtype {dtype!r}; "
+                f"expected one of {SERVE_DTYPES}"
             )
         self.store_path = str(store_path)
         self.host = host
@@ -268,6 +294,9 @@ class MultiProcessService:
         self.server_engine = server_engine
         self.max_pending = max_pending
         self.retry_after_max_s = retry_after_max_s
+        #: quantized serving dtype, per worker (each runs the shadow
+        #: quality gate itself at boot/swap — same store, same verdict)
+        self.dtype = dtype
         # opt-in aggregated /metrics: a shared snapshot dir every worker
         # flushes into, so any replica can answer for the whole service.
         # Created lazily in start() so a failed startup never leaks it.
@@ -276,6 +305,20 @@ class MultiProcessService:
         self.restart = restart
         self.startup_timeout_s = startup_timeout_s
         self._ctx = multiprocessing.get_context("spawn")
+        # ONE service-wide admission budget across the fleet: every
+        # worker's controller admits against the sum of this per-slot
+        # array, so max_pending bounds the SERVICE's held work (the "N
+        # replicas as one benchmarkable unit" contract bench config 11
+        # measures). Per-worker slots so the supervisor can zero a dead
+        # replica's contribution (a crash must not leak budget). Created
+        # whenever admission would be armed in the workers (explicit
+        # budget, or the aio engine's default).
+        self._shared_budget = (
+            self._ctx.Array("i", workers)
+            if workers > 1
+            and (max_pending is not None or server_engine == "aio")
+            else None
+        )
         self._reserved = _reuseport_socket(host, port)
         self.port = self._reserved.getsockname()[1]
         self._procs: list = []
@@ -299,7 +342,7 @@ class MultiProcessService:
     def worker_pids(self) -> list[int]:
         return [p.pid for p in self._procs if p.is_alive()]
 
-    def _spawn_one(self):
+    def _spawn_one(self, slot_index: int = 0):
         ready = self._ctx.Queue()
         proc = self._ctx.Process(
             target=_worker_main,
@@ -307,7 +350,8 @@ class MultiProcessService:
                   self.watch_interval_s, self.buckets, ready,
                   self.batch_window_ms, self.batch_max_rows,
                   self.metrics_dir, self.server_engine,
-                  self.max_pending, self.retry_after_max_s),
+                  self.max_pending, self.retry_after_max_s,
+                  self._shared_budget, slot_index, self.dtype),
             daemon=True,
         )
         proc.start()
@@ -337,8 +381,8 @@ class MultiProcessService:
             self.metrics_dir = tempfile.mkdtemp(prefix="bodywork-tpu-obs-")
         spawned: list = []
         try:
-            for _ in range(self.workers):
-                spawned.append(self._spawn_one())
+            for i in range(self.workers):
+                spawned.append(self._spawn_one(i))
             for proc, ready in spawned:
                 self._wait_ready(ready, proc)
         except BaseException:
@@ -383,6 +427,18 @@ class MultiProcessService:
                 if slot["policy"].exhausted:
                     continue  # parked: budget burned, already reported
                 if slot["respawn_at"] is None:
+                    # FIRST observation of this death: reclaim whatever
+                    # admission budget the worker still held, whether or
+                    # not it will ever respawn (a parked or
+                    # restart=False slot must not shrink the service
+                    # budget forever) — its in-flight requests died with
+                    # it either way
+                    if self._shared_budget is not None:
+                        from bodywork_tpu.serve.admission import (
+                            SharedBudgetSlot,
+                        )
+
+                        SharedBudgetSlot.clear(self._shared_budget, i)
                     alive_s = now - slot["spawned_at"]
                     delay = slot["policy"].on_death(alive_s)
                     if delay is None:
@@ -412,7 +468,7 @@ class MultiProcessService:
                 if now < slot["respawn_at"]:
                     continue  # still backing off
                 slot["respawn_at"] = None
-                new_proc, ready = self._spawn_one()
+                new_proc, ready = self._spawn_one(i)
                 _count_worker_restart()
                 try:
                     self._wait_ready(ready, new_proc)

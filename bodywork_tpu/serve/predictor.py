@@ -1,4 +1,5 @@
-"""Shape-bucketed prediction wrapper for serving.
+"""Shape-bucketed prediction wrapper for serving, over an AOT-compiled
+executable cache.
 
 SURVEY.md "hard part (1)": keep host<->device transfers and *recompilation*
 out of the per-request path. Under jit, every distinct input shape is a new
@@ -8,12 +9,28 @@ a fixed bucket (powers of two), so the set of compiled executables is small,
 pre-warmable at startup, and shared across requests. Oversized requests are
 chunked through the largest bucket.
 
+Compilation itself goes through a PROCESS-WIDE executable cache
+(:data:`EXECUTABLE_CACHE`): every bucket's program is lowered and compiled
+ahead of time (``jax.jit(...).lower(...).compile()``) and keyed by
+``(engine tag, param-shape digest, bucket shape, device placement)`` —
+*not* by the parameter values. A hot swap to a same-architecture
+checkpoint therefore re-binds the new params to the already-compiled
+executable: the swap pays zero compiles, on or off the request path, which
+is what keeps the canary watchdog's p99-ratio verdict from eating a
+compile stall every time a canary starts or production rolls. Input
+buffers are donated on the dispatch path where the backend supports it
+(TPU/GPU; the padded batch is a fresh scratch buffer, so the executable
+may reuse its memory for the output).
+
 The reference has no analogue (sklearn predict is shape-agnostic); this is
 pure TPU-serving design.
 """
 from __future__ import annotations
 
 import itertools
+import os
+import threading
+import time
 
 import numpy as np
 
@@ -24,11 +41,160 @@ log = get_logger("serve.predictor")
 
 DEFAULT_BUCKETS = (1, 8, 64, 512, 4096)
 
+#: the serving dtypes `cli serve --dtype` exposes — ONE source of truth,
+#: pinned == the cli choices == bench config 11's sweep by a guard test
+#: (tests/test_compiled.py). "float32" is the default engine exactly as
+#: before; "bfloat16"/"int8" are the quantized variants, which only ever
+#: serve after the shadow quality gate admits them (serve.server).
+SERVE_DTYPES = ("float32", "bfloat16", "int8")
+
+#: set to "0" to disable CROSS-INSTANCE executable reuse (each predictor
+#: then compiles its own buckets — the pre-AOT behaviour whose swap
+#: stall bench config 11 measures as the baseline). Per-instance caching
+#: and the hit/miss accounting stay on either way.
+AOT_CACHE_ENV = "BODYWORK_TPU_AOT_CACHE"
+
 #: (predictor class, model class, n_features, bucket, extra) shapes already
-#: dispatched this process — the jit cache holds their executables, so
-#: re-warming them (e.g. the day-loop re-serving daily) would only pay a
-#: pointless host->device transfer per bucket
+#: dispatched this process — their executables are compiled and their
+#: first execution has run, so re-warming them (e.g. the day-loop
+#: re-serving daily) would only pay a pointless host->device transfer
+#: per bucket
 _WARMED_SHAPES: set[tuple] = set()
+
+
+def params_shape_digest(params) -> tuple:
+    """A hashable fingerprint of a params pytree's ARCHITECTURE — every
+    leaf's shape, dtype, and device placement/sharding, in tree order —
+    deliberately blind to the values: two same-architecture checkpoints
+    digest identically, which is exactly what lets a hot swap re-bind
+    new params to an already-compiled executable. Sharding is part of
+    the program identity (mesh-sharded params lower a different
+    computation than single-device ones), so it is part of the key."""
+    import jax
+
+    return tuple(
+        (
+            tuple(np.shape(leaf)),
+            str(np.result_type(leaf)),
+            str(getattr(leaf, "sharding", None)),
+        )
+        for leaf in jax.tree_util.tree_leaves(params)
+    )
+
+
+def _leaf_struct(leaf):
+    """The ShapeDtypeStruct an AOT lowering sees for one params leaf —
+    sharding-preserving: a compiled executable must accept the ACTUAL
+    arrays it will be called with (a mesh-sharded checkpoint's leaves
+    carry NamedShardings; lowering them as single-device would make
+    every call a sharding-mismatch error)."""
+    import jax
+
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is not None:
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=sharding)
+    return jax.ShapeDtypeStruct(np.shape(leaf), np.result_type(leaf))
+
+
+class ExecutableCache:
+    """Process-wide cache of AOT-compiled serving executables.
+
+    Keyed by ``(engine tag, params digest, batch shape, devices)`` — the
+    full identity of an XLA program minus the parameter VALUES. Entries
+    survive hot swaps (the whole point) and are never evicted: the key
+    space is bounded by (architectures seen) x (buckets), both small by
+    design. Hit/miss counters and the compile-seconds histogram are the
+    observability contract bench config 11 and the swap regression test
+    read (``bodywork_tpu_serve_executable_cache_{hits,misses}_total``,
+    ``bodywork_tpu_serve_compile_seconds``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache: dict[tuple, object] = {}
+        # plain-int mirrors of the obs counters, for cheap assertions
+        # (the counting-jit seam the swap regression test reads)
+        self.hits = 0
+        self.misses = 0
+        self._metrics = None
+
+    def _obs(self):
+        if self._metrics is None:
+            from bodywork_tpu.obs import get_registry
+
+            reg = get_registry()
+            self._metrics = (
+                reg.counter(
+                    "bodywork_tpu_serve_executable_cache_hits_total",
+                    "Serving-bucket executable requests answered from the "
+                    "process-wide AOT cache (no compile)",
+                ),
+                reg.counter(
+                    "bodywork_tpu_serve_executable_cache_misses_total",
+                    "Serving-bucket executables compiled (cache miss); a "
+                    "nonzero rate on the request path is a warmup bug",
+                ),
+                reg.histogram(
+                    "bodywork_tpu_serve_compile_seconds",
+                    "Wall time of one serving-bucket AOT lower+compile "
+                    "(executable-cache miss)",
+                ),
+            )
+        return self._metrics
+
+    @staticmethod
+    def enabled() -> bool:
+        return os.environ.get(AOT_CACHE_ENV, "1") != "0"
+
+    def get(self, key: tuple, build):
+        """The compiled executable for ``key``, compiling via ``build()``
+        on a miss. With the cache disabled (:data:`AOT_CACHE_ENV`) every
+        call compiles — the measured-stall baseline — but still counts."""
+        hits, misses, compile_s = self._obs()
+        if self.enabled():
+            with self._lock:
+                compiled = self._cache.get(key)
+            if compiled is not None:
+                with self._lock:
+                    self.hits += 1
+                hits.inc()
+                return compiled
+        t0 = time.perf_counter()
+        compiled = build()
+        compile_seconds = time.perf_counter() - t0
+        with self._lock:
+            self.misses += 1
+            if self.enabled():
+                self._cache[key] = compiled
+        misses.inc()
+        compile_s.observe(compile_seconds)
+        return compiled
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._cache),
+                "hits": self.hits,
+                "misses": self.misses,
+            }
+
+
+#: THE process-wide executable cache (one per serving process, exactly as
+#: one k8s pod holds one XLA compile cache)
+EXECUTABLE_CACHE = ExecutableCache()
+
+
+def _donate_inputs() -> bool:
+    """Donate the padded batch buffer to the executable where the
+    backend implements donation (TPU/GPU). Safe by construction: inputs
+    arrive as HOST numpy arrays, so what the executable consumes (and
+    may reuse for its output) is the device-side transfer buffer — the
+    caller's array is never aliased, and the uncoalesced
+    sanity-firewall fallback re-predict (serve.app) that re-submits the
+    SAME host array is unaffected (pinned by test). On CPU XLA ignores
+    donation (and warns at compile), so skip it there."""
+    import jax
+
+    return jax.devices()[0].platform in ("tpu", "gpu")
 
 
 class PaddedPredictor:
@@ -39,36 +205,138 @@ class PaddedPredictor:
     logic here.
     """
 
+    #: the serving dtype tag this predictor class answers for (one of
+    #: :data:`SERVE_DTYPES`) — part of the executable-cache key and the
+    #: /healthz identity the quantization gate reports
+    dtype = "float32"
+
     def __init__(self, model: Regressor, buckets: tuple[int, ...] = DEFAULT_BUCKETS):
         assert model.params is not None, "cannot serve an unfitted model"
         self.model = model
         self.buckets = tuple(sorted(buckets))
+        #: per-instance executable handles: (bucket, n_features) ->
+        #: compiled. A plain dict read on the hot path; the process-wide
+        #: EXECUTABLE_CACHE behind it is what survives this instance
+        self._compiled: dict[tuple, object] = {}
+        self._aot_eligible: bool | None = None
+
+    # -- AOT executable plumbing -------------------------------------------
+    def _aot_fn(self):
+        """The pure ``(params, X) -> y`` apply this predictor's
+        executables are lowered from, or None when the engine cannot be
+        AOT-cached across instances (params baked into a kernel closure,
+        mesh-placed dispatch) — those subclasses fall back to their own
+        jit path in :meth:`_dispatch_padded`."""
+        return type(self.model).apply
+
+    def _exec_params(self):
+        """The params pytree the compiled executable is CALLED with
+        (quantized predictors substitute their quantized tree)."""
+        return self.model.params
+
+    def _aot_ok(self) -> bool:
+        """Whether this predictor's params can be AOT-lowered: a pytree
+        mixing multi-device-sharded leaves (a mesh-trained checkpoint)
+        with uncommitted host leaves has no single lowering the compiled
+        call signature can pin — jit reconciles such mixes at trace
+        time, so those params keep the per-class jit path (mesh serving
+        proper goes through DataParallelPredictor)."""
+        if self._aot_eligible is None:
+            import jax
+
+            eligible = self._aot_fn() is not None
+            if eligible:
+                for leaf in jax.tree_util.tree_leaves(self._exec_params()):
+                    sharding = getattr(leaf, "sharding", None)
+                    if sharding is not None and len(leaf.devices()) > 1:
+                        eligible = False
+                        break
+            self._aot_eligible = eligible
+        return self._aot_eligible
+
+    def _compiled_for(self, bucket: int, n_features: int):
+        """The AOT executable for one padded batch shape — resolved from
+        the process-wide cache, compiling on first sight of this
+        (architecture, shape) anywhere in the process. Request-side
+        calls normally hit the per-instance dict; a lazy compile here is
+        an executable-cache miss, which the swap regression test pins
+        at zero across a warmed hot swap."""
+        import jax
+
+        handle = self._compiled.get((bucket, n_features))
+        if handle is not None:
+            return handle
+        fn = self._aot_fn()
+        params = self._exec_params()
+        key = (
+            # BOTH classes: the predictor picks the program variant, the
+            # MODEL class owns the apply being lowered — two model
+            # classes with identical params architectures must never
+            # share an executable (the warmup dedup key makes the same
+            # distinction)
+            type(self).__name__, type(self.model).__qualname__, self.dtype,
+            params_shape_digest(params), (bucket, n_features),
+            self._warm_key_extra(),
+        )
+
+        def build():
+            structs = jax.tree_util.tree_map(_leaf_struct, params)
+            x_struct = jax.ShapeDtypeStruct((bucket, n_features), np.float32)
+            donate = (1,) if _donate_inputs() else ()
+            return (
+                jax.jit(fn, donate_argnums=donate)
+                .lower(structs, x_struct)
+                .compile()
+            )
+
+        handle = EXECUTABLE_CACHE.get(key, build)
+        self._compiled[(bucket, n_features)] = handle
+        return handle
 
     def _predict_padded(self, Xp: np.ndarray) -> np.ndarray:
         """Run the model on an exactly-bucket-sized batch."""
         return np.asarray(self._dispatch_padded(Xp))
 
-    def _dispatch_padded(self, Xp: np.ndarray):
-        """Dispatch the padded batch without materialising on the host
-        (compile + enqueue only — no device->host transfer)."""
+    def _fallback_dispatch(self, Xp: np.ndarray):
+        """The non-AOT dispatch (``_aot_ok`` False — e.g. mesh-sharded
+        params): MUST serve the same engine/dtype as the AOT path, so
+        quantized subclasses override it with their own jitted quantized
+        apply — falling back to the f32 per-class apply there would
+        silently serve a different precision than /healthz reports."""
         return self.model.predict_device(Xp)
 
+    def _dispatch_padded(self, Xp: np.ndarray):
+        """Dispatch the padded batch without materialising on the host
+        (enqueue only — no device->host transfer). Routes through the
+        bucket's AOT executable, so the request path never compiles
+        (a shape nobody warmed still works — it compiles here, counted
+        as a cache miss). Engines/params that cannot AOT-cache
+        (``_aot_ok`` False) fall back to the per-class jit path."""
+        if not self._aot_ok():
+            return self._fallback_dispatch(Xp)
+        return self._compiled_for(Xp.shape[0], Xp.shape[1])(
+            self._exec_params(), Xp
+        )
+
     def warmup(self, n_features: int | None = None, sync: bool = True) -> None:
-        """Compile every bucket shape before taking traffic (startup cost,
-        analogous to the reference's load-model-at-boot — ``stage_2:113``).
+        """Compile every bucket's executable AND run each once before
+        taking traffic (startup cost, analogous to the reference's
+        load-model-at-boot — ``stage_2:113``).
 
         The feature dimension defaults to the fitted model's own, so the
-        shapes compiled here are exactly the request-path shapes. All
-        buckets are dispatched first (XLA compiles synchronously at
-        dispatch; execution drains asynchronously), then with ``sync`` a
-        ``fence`` (``utils.sync``) surfaces any device-side execution error
-        (e.g. HBM OOM on the largest bucket) HERE — before the health gate
-        reports ready — at the cost of one tiny fetch per bucket
-        (``block_until_ready`` would be transfer-free but does not actually
-        wait over the axon relay). ``sync=False`` is for callers that
-        already executed these exact shapes in this process (the local
-        day-loop re-serving each day).
-        """
+        shapes compiled here are exactly the request-path shapes.
+        Compilation is the AOT lower+compile through the process-wide
+        executable cache — a same-architecture swap finds every bucket
+        already compiled and pays nothing. Each bucket is then executed
+        once (XLA compiles nothing at dispatch; execution drains
+        asynchronously), and with ``sync`` a ``fence`` (``utils.sync``)
+        surfaces any device-side execution error (e.g. HBM OOM on the
+        largest bucket) HERE — before the health gate reports ready — at
+        the cost of one tiny fetch per bucket (``block_until_ready``
+        would be transfer-free but does not actually wait over the axon
+        relay). ``sync=False`` is for callers that already executed
+        these exact shapes in this process (the local day-loop
+        re-serving each day)."""
         import jax
 
         if n_features is None:
@@ -86,6 +354,13 @@ class PaddedPredictor:
             for b in self.buckets:
                 key = (type(self), type(self.model), shapes, n_features, b, extra)
                 if key in _WARMED_SHAPES:
+                    # executables compiled + executed earlier in this
+                    # process; re-warming would only pay a transfer. The
+                    # per-instance handle dict still needs filling so
+                    # the first request doesn't pay a (cheap, cache-hit)
+                    # process-cache lookup under its latency budget.
+                    if self._aot_ok():
+                        self._compiled_for(b, n_features)
                     continue
                 results.append(
                     self._dispatch_padded(
@@ -107,6 +382,7 @@ class PaddedPredictor:
             f"warmed up predict buckets {self.buckets} (n_features={n_features},"
             f" {len(results)} new)"
         )
+
 
     def _warm_key_extra(self) -> tuple:
         """Extra warm-cache key material beyond (model class, shape): the
@@ -151,39 +427,41 @@ class PaddedPredictor:
         return self._predict_padded(Xp)[:n]
 
 
-#: process-wide jitted bf16 apply, shared by every BF16MLPPredictor
-#: instance (mirroring the per-class ``_APPLY_FNS`` cache in models/base):
-#: a hot-reload swap builds a fresh predictor for the new checkpoint, and
-#: only a SHARED jit wrapper lets the ``_WARMED_SHAPES`` dedup skip its
-#: warmup correctly — a per-instance wrapper would have an empty compile
-#: cache and push the compile onto the first scoring request
+#: process-wide jitted bf16 apply — kept for the benchmark's device-side
+#: (HTTP-free) timing path, so the measured program is the same one the
+#: BF16MLPPredictor's AOT executables are lowered from
 _BF16_APPLY = None
 
 
 def bf16_mlp_apply():
-    """The shared jitted ``mlp_apply(..., compute_dtype='bfloat16')`` —
-    also what the benchmark times, so the measured engine IS the served
-    one."""
+    """The shared jitted ``mlp_apply(..., compute_dtype='bfloat16')``."""
     global _BF16_APPLY
     if _BF16_APPLY is None:
-        from functools import partial
-
         import jax
 
-        from bodywork_tpu.models.mlp import mlp_apply
-
-        _BF16_APPLY = jax.jit(partial(mlp_apply, compute_dtype="bfloat16"))
+        _BF16_APPLY = jax.jit(_bf16_apply_fn())
     return _BF16_APPLY
+
+
+def _bf16_apply_fn():
+    from functools import partial
+
+    from bodywork_tpu.models.mlp import mlp_apply
+
+    return partial(mlp_apply, compute_dtype="bfloat16")
 
 
 class BF16MLPPredictor(PaddedPredictor):
     """Serves an MLP with the dense stack's matmuls in bfloat16 (the
-    opt-in ``xla-bf16`` engine): single-pass MXU at wide widths, ~half the
-    HBM traffic of f32 weights. Predictions carry bf16's ~3 significant
-    digits — callers choose this engine explicitly for throughput; the
-    default engine stays f32 so the frozen contract's recorded exchanges
-    reproduce bit-for-bit.
+    opt-in ``xla-bf16`` engine, also ``--dtype bfloat16``): single-pass
+    MXU at wide widths, ~half the HBM traffic of f32 weights. Predictions
+    carry bf16's ~3 significant digits — callers choose this engine
+    explicitly for throughput (and ``--dtype`` routes it through the
+    shadow quality gate first); the default engine stays f32 so the
+    frozen contract's recorded exchanges reproduce bit-for-bit.
     """
+
+    dtype = "bfloat16"
 
     def __init__(self, model, buckets: tuple[int, ...] | None = None):
         from bodywork_tpu.models.mlp import MLPRegressor
@@ -193,15 +471,82 @@ class BF16MLPPredictor(PaddedPredictor):
                 f"engine='xla-bf16' serves MLP models; got {model.info}"
             )
         super().__init__(model, buckets if buckets else DEFAULT_BUCKETS)
-        self._apply = bf16_mlp_apply()
 
-    def _dispatch_padded(self, Xp: np.ndarray):
-        return self._apply(self.model.params, Xp)
+    def _aot_fn(self):
+        return _bf16_apply_fn()
+
+    def _fallback_dispatch(self, Xp: np.ndarray):
+        # same bf16 program, jit-cached — never the f32 apply
+        return bf16_mlp_apply()(self.model.params, Xp)
 
     def _warm_key_extra(self) -> tuple:
         # a distinct executable per engine: never share warm state with
         # the f32 predictor for the same model/shape
         return ("xla-bf16", *super()._warm_key_extra())
+
+
+class Int8MLPPredictor(PaddedPredictor):
+    """Serves an MLP from int8 weights (``--dtype int8``): every dense
+    weight matrix is quantized once at construction to symmetric
+    per-output-channel int8 (``models.fused.quantize_mlp_params_int8``)
+    and dequantized inside the compiled program — a quarter of f32's
+    weight HBM traffic per forward, the dominant serving cost for
+    memory-bound widths. Biases, the scaler, and accumulation stay f32.
+    Quantization error is a per-matmul relative error of order 1/127 on
+    the weight operand; ``--dtype`` routes the realised quality delta
+    through the shadow gate before this predictor may serve."""
+
+    dtype = "int8"
+
+    def __init__(self, model, buckets: tuple[int, ...] | None = None):
+        import jax
+
+        from bodywork_tpu.models.fused import quantize_mlp_params_int8
+        from bodywork_tpu.models.mlp import MLPRegressor
+
+        if not isinstance(model, MLPRegressor):
+            raise ValueError(
+                f"dtype='int8' serves MLP models; got {model.info}"
+            )
+        super().__init__(model, buckets if buckets else DEFAULT_BUCKETS)
+        # quantize once, then pin the quantized tree in device memory:
+        # a host-resident pytree would re-upload the whole weight stack
+        # on EVERY dispatch — exactly the per-request transfer this
+        # module exists to eliminate
+        self._qparams = jax.device_put(
+            quantize_mlp_params_int8(model.host_params())
+        )
+
+    def _aot_fn(self):
+        from bodywork_tpu.models.fused import int8_mlp_apply
+
+        return int8_mlp_apply
+
+    def _exec_params(self):
+        return self._qparams
+
+    def _fallback_dispatch(self, Xp: np.ndarray):
+        # same int8 program, jit-cached — never the f32 apply
+        return _int8_jit_apply()(self._qparams, Xp)
+
+    def _warm_key_extra(self) -> tuple:
+        return ("xla-int8", *super()._warm_key_extra())
+
+
+#: process-wide jitted int8 apply — the Int8 predictor's non-AOT
+#: fallback path (mesh-mixed params), same program as its executables
+_INT8_APPLY = None
+
+
+def _int8_jit_apply():
+    global _INT8_APPLY
+    if _INT8_APPLY is None:
+        import jax
+
+        from bodywork_tpu.models.fused import int8_mlp_apply
+
+        _INT8_APPLY = jax.jit(int8_mlp_apply)
+    return _INT8_APPLY
 
 
 class PallasMLPPredictor(PaddedPredictor):
@@ -219,18 +564,32 @@ class PallasMLPPredictor(PaddedPredictor):
 
     def __init__(self, model, buckets: tuple[int, ...] | None = None,
                  interpret: bool = False,
-                 compute_dtype: str | None = None):
+                 compute_dtype: str | None = None,
+                 row_tile: int | None = None):
         from bodywork_tpu.ops import ROW_TILE, make_pallas_mlp_apply
 
+        if compute_dtype in ("bfloat16", "int8"):
+            self.dtype = compute_dtype
+        tile = row_tile or ROW_TILE
         if buckets is None:
-            # the kernel pads every batch to a ROW_TILE multiple anyway;
-            # sub-tile buckets would just compile duplicate programs
-            buckets = (ROW_TILE, 2 * ROW_TILE, 16 * ROW_TILE)
+            # the kernel pads every batch to a row-tile multiple anyway;
+            # sub-tile buckets would just compile duplicate programs.
+            # A caller serving the coalescer's small flushes passes a
+            # smaller row_tile (the kernel grids over it) so a handful
+            # of coalesced rows stops padding to the full 256-row tile.
+            buckets = (tile, 2 * tile, 16 * tile)
         super().__init__(model, buckets)
         self._apply = make_pallas_mlp_apply(
-            model.params, interpret=interpret, compute_dtype=compute_dtype
+            model.params, interpret=interpret, compute_dtype=compute_dtype,
+            row_tile=tile,
         )
         self._instance_id = next(self._instance_counter)
+
+    def _aot_fn(self):
+        # params live inside the kernel closure: nothing to re-bind
+        # across a swap, so the process-wide executable cache does not
+        # apply — the per-instance jit apply below is the compile cache
+        return None
 
     def _dispatch_padded(self, Xp: np.ndarray):
         return self._apply(Xp)

@@ -55,6 +55,7 @@ class CheckpointWatcher:
         served_key: str | None = None,
         buckets: tuple[int, ...] | None = None,
         slo_watchdog=None,
+        dtype: str = "float32",
     ):
         # one watcher drives every replica app: replicas share read-only
         # model state by design, so one load+warm serves them all
@@ -63,6 +64,11 @@ class CheckpointWatcher:
         self.poll_interval_s = poll_interval_s
         self.mesh_data = mesh_data
         self.engine = engine
+        #: the serving dtype (serve.predictor.SERVE_DTYPES): a swapped-in
+        #: checkpoint re-runs the quantization shadow gate for it, so a
+        #: retrain whose quantized variant regresses falls back to f32
+        #: on THAT swap without touching the dtype choice for later ones
+        self.dtype = dtype
         # the caller's EXPLICIT bucket narrowing (pipeline spec), if any.
         # Distinct from the booted predictor's buckets, which may just be
         # an engine's default policy that should not survive an
@@ -186,8 +192,17 @@ class CheckpointWatcher:
     def _build_swap_predictor(self, model):
         """Build + warm a predictor for a model being swapped in (the
         production reload and the canary load share this, so a canary
-        serves through exactly the engine selection production does)."""
-        from bodywork_tpu.serve.server import build_predictor, resolve_engine
+        serves through exactly the engine selection — and, for a
+        quantized dtype, the shadow quality gate — production does).
+        Every bucket is compiled AND executed here, on the watcher
+        thread, BEFORE the swap pointer publishes: with the process-wide
+        executable cache a same-architecture swap finds its executables
+        already compiled (zero compile work), and a new architecture
+        pays its compiles here, never on a scoring request."""
+        from bodywork_tpu.serve.server import (
+            build_serving_predictor,
+            resolve_engine,
+        )
 
         # Bucket policy for the swapped-in predictor, in priority order:
         # 1. the caller's explicit list (a reload must not widen the
@@ -214,8 +229,13 @@ class CheckpointWatcher:
             swap_buckets = current.buckets
         else:
             swap_buckets = None
-        predictor = build_predictor(
-            model, self.mesh_data, new_resolved, buckets=swap_buckets,
+        # ONE composition point for every dtype (build_serving_predictor
+        # collapses to plain build_predictor for float32): a swapped-in
+        # checkpoint goes through exactly the selection — and, for a
+        # quantized dtype, the shadow quality gate — boot did
+        predictor, _served_dtype = build_serving_predictor(
+            self.store, model, self.mesh_data, new_resolved,
+            buckets=swap_buckets, dtype=self.dtype,
         )
         if predictor is None:
             # plain xla engine with no bucket narrowing: the app-level

@@ -161,6 +161,43 @@ def resolve_engine(
     return "xla"
 
 
+def quantized_engine_for(engine: str, dtype: str) -> str:
+    """Map a (resolved base engine, serving dtype) pair onto the engine
+    variant that implements it: ``xla``+bf16 -> ``xla-bf16``, ``xla``+
+    int8 -> ``xla-int8``, ``pallas``+bf16/int8 -> the kernel variants.
+    Explicit quantized engine choices (``--engine xla-bf16``) may not be
+    combined with a CONTRADICTING ``--dtype``."""
+    from bodywork_tpu.serve.predictor import SERVE_DTYPES
+
+    if dtype not in SERVE_DTYPES:
+        raise ValueError(
+            f"unknown serving dtype {dtype!r}; expected one of {SERVE_DTYPES}"
+        )
+    if dtype == "float32":
+        return engine
+    variants = {
+        ("xla", "bfloat16"): "xla-bf16",
+        ("xla", "int8"): "xla-int8",
+        ("pallas", "bfloat16"): "pallas-bf16",
+        ("pallas", "int8"): "pallas-int8",
+    }
+    if engine in variants.values():
+        # an explicit quantized engine: --dtype must agree with it
+        implied = "bfloat16" if engine.endswith("bf16") else "int8"
+        if implied != dtype:
+            raise ValueError(
+                f"--engine {engine} contradicts --dtype {dtype}"
+            )
+        return engine
+    variant = variants.get((engine, dtype))
+    if variant is None:
+        raise ValueError(
+            f"engine {engine!r} has no {dtype} variant; use engine "
+            "'xla' or 'pallas' with --dtype"
+        )
+    return variant
+
+
 def build_predictor(model, mesh_data: int | None = None, engine: str = "xla",
                     buckets: tuple[int, ...] | None = None):
     """The predictor for a (resolved) engine choice, or ``None`` for the
@@ -175,7 +212,7 @@ def build_predictor(model, mesh_data: int | None = None, engine: str = "xla",
     bucket policy when unset)."""
     engine = resolve_engine(engine, model, mesh_data)
     predictor = None
-    if engine in ("pallas", "pallas-bf16"):
+    if engine in ("pallas", "pallas-bf16", "pallas-int8"):
         import jax
 
         from bodywork_tpu.models.mlp import MLPRegressor
@@ -196,20 +233,28 @@ def build_predictor(model, mesh_data: int | None = None, engine: str = "xla",
                 "in the (slow) Pallas interpreter — use engine='xla' "
                 "unless you are testing the kernel itself"
             )
+        kernel_dtype = {
+            "pallas-bf16": "bfloat16", "pallas-int8": "int8",
+        }.get(engine)
         predictor = PallasMLPPredictor(
             model, buckets=buckets, interpret=interpret,
-            compute_dtype="bfloat16" if engine == "pallas-bf16" else None,
+            compute_dtype=kernel_dtype,
         )
-    elif engine == "xla-bf16":
-        from bodywork_tpu.serve.predictor import BF16MLPPredictor
+    elif engine in ("xla-bf16", "xla-int8"):
+        from bodywork_tpu.serve.predictor import (
+            BF16MLPPredictor,
+            Int8MLPPredictor,
+        )
 
         if mesh_data and mesh_data > 1:
             raise ValueError(
-                "engine='xla-bf16' is single-device; drop --mesh-data"
+                f"engine={engine!r} is single-device; drop --mesh-data"
             )
-        # never chosen by "auto": trading prediction precision (bf16's ~3
-        # significant digits) for throughput is an explicit caller decision
-        predictor = BF16MLPPredictor(model, buckets=buckets)
+        # never chosen by "auto": trading prediction precision for
+        # throughput is an explicit caller decision (and --dtype routes
+        # it through the shadow quality gate first)
+        cls = BF16MLPPredictor if engine == "xla-bf16" else Int8MLPPredictor
+        predictor = cls(model, buckets=buckets)
     elif engine == "xla":
         if buckets and not (mesh_data and mesh_data > 1):
             # an explicit bucket list must never be silently ignored, so
@@ -236,10 +281,122 @@ def build_predictor(model, mesh_data: int | None = None, engine: str = "xla",
     return predictor
 
 
+def _count_quantization_gate(dtype: str, outcome: str) -> None:
+    from bodywork_tpu.obs import get_registry
+
+    reg = get_registry()
+    reg.counter(
+        "bodywork_tpu_serve_quantization_gate_total",
+        "Quantized-serving shadow-gate verdicts at boot/swap, by dtype "
+        "and outcome "
+        "(served|rejected_quality|no_shadow_data|unsupported_model)",
+    ).inc(dtype=dtype, outcome=outcome)
+    reg.gauge(
+        "bodywork_tpu_serve_quantized_state",
+        "Quantized serving: 0=f32 default, 1=quantized dtype serving, "
+        "2=quantized requested but f32 kept (gate/unsupported)",
+        aggregate="max",
+    ).set(1.0 if outcome == "served" else 2.0)
+
+
+def build_serving_predictor(
+    store: ArtefactStore,
+    model,
+    mesh_data: int | None = None,
+    engine: str = "xla",
+    buckets: tuple[int, ...] | None = None,
+    dtype: str = "float32",
+    policy=None,
+):
+    """The predictor serving should run for a (engine, dtype) choice —
+    the ONE composition point boot (``serve_latest_model``), the
+    hot-reload watcher, and the multiproc workers share, so a swapped-in
+    checkpoint goes through exactly the selection (and the quality gate)
+    the booted one did.
+
+    ``dtype="float32"`` is :func:`build_predictor` unchanged. A
+    quantized dtype builds BOTH variants and runs the f32-vs-quantized
+    shadow comparison over the last ``policy.quantized_shadow_days``
+    dataset days (``registry.shadow.shadow_compare`` + the gate's
+    ceilings, ``registry.gates.evaluate_quantization``): a quality
+    regression past the policy ceiling KEEPS F32 SERVING — quantization
+    is a performance upgrade that must never cost quality silently. A
+    store with no dataset history to shadow over also keeps f32 (there
+    is no evidence either way; refusing is the safe default).
+
+    Returns ``(predictor_or_None, served_dtype)`` — ``served_dtype`` is
+    what actually serves ("float32" after a rejection), surfaced on
+    /healthz and the ``bodywork_tpu_serve_quantized_state`` gauge."""
+    if dtype in (None, "float32"):
+        return build_predictor(model, mesh_data, engine, buckets=buckets), \
+            "float32"
+    from bodywork_tpu.registry.gates import GatePolicy, evaluate_quantization
+    from bodywork_tpu.registry.shadow import shadow_compare
+
+    if mesh_data and mesh_data > 1:
+        raise ValueError("--dtype quantized serving is single-device")
+    policy = policy or GatePolicy()
+    base_engine = resolve_engine(engine, model, mesh_data)
+    quant_engine = quantized_engine_for(base_engine, dtype)
+    # the f32 baseline predictor: the gate's reference, and the fallback
+    # that serves when the quantized variant fails it
+    f32_engine = "pallas" if base_engine.startswith("pallas") else "xla"
+    f32_predictor = build_predictor(
+        model, mesh_data, f32_engine, buckets=buckets
+    )
+    f32_predict = (
+        f32_predictor.predict if f32_predictor is not None
+        else model.predict_padded
+    )
+    try:
+        quant_predictor = build_predictor(
+            model, mesh_data, quant_engine, buckets=buckets
+        )
+    except ValueError as exc:
+        # e.g. a linear checkpoint under --dtype int8 (the quantized
+        # engines are MLP-only): the dtype knob is a fleet-wide env
+        # setting while the serving model changes per swap — crashing
+        # the pod would turn a valid-but-inapplicable knob into an
+        # outage, so keep f32 serving and say so (same contract as a
+        # quality rejection)
+        log.warning(
+            f"dtype={dtype} unavailable for this checkpoint ({exc}); "
+            "keeping f32 serving"
+        )
+        _count_quantization_gate(dtype, "unsupported_model")
+        return f32_predictor, "float32"
+    try:
+        report = shadow_compare(
+            store, quant_predictor.predict, f32_predict,
+            days=policy.quantized_shadow_days,
+        )
+    except ValueError as exc:
+        if "no dataset history" not in str(exc):
+            raise
+        log.warning(
+            f"dtype={dtype}: no dataset history to shadow the quantized "
+            "variant over; keeping f32 serving"
+        )
+        _count_quantization_gate(dtype, "no_shadow_data")
+        return f32_predictor, "float32"
+    ok, detail = evaluate_quantization(report, policy)
+    if not ok:
+        log.warning(
+            f"dtype={dtype} REJECTED by the shadow quality gate "
+            f"({detail}); keeping f32 serving"
+        )
+        _count_quantization_gate(dtype, "rejected_quality")
+        return f32_predictor, "float32"
+    log.info(f"dtype={dtype} admitted by the shadow quality gate ({detail})")
+    _count_quantization_gate(dtype, "served")
+    return quant_predictor, dtype
+
+
 def build_admission(
     server_engine: str,
     max_pending: int | None,
     retry_after_max_s: float | None = None,
+    shared_slot=None,
 ):
     """The admission controller for a serving process, or ``None``.
 
@@ -250,7 +407,14 @@ def build_admission(
     the work it holds. The threaded engine keeps its historical
     admit-everything default — its thread pool is its own (cruder)
     bound, and the closed-loop parity benches must see an unchanged
-    service."""
+    service.
+
+    ``shared_slot`` (:class:`~bodywork_tpu.serve.admission.
+    SharedBudgetSlot`) makes ``max_pending`` a SERVICE-WIDE budget
+    shared by every replica process behind one SO_REUSEPORT port
+    (``serve --workers N`` wires it): the fleet sheds as one unit, which
+    is what makes an N-replica capacity record a number about ONE
+    service rather than N accidental ones."""
     from bodywork_tpu.serve.admission import AdmissionController
 
     if max_pending is None and server_engine != "aio":
@@ -260,6 +424,8 @@ def build_admission(
         kwargs["max_pending"] = max_pending
     if retry_after_max_s is not None:
         kwargs["retry_after_max_s"] = retry_after_max_s
+    if shared_slot is not None:
+        kwargs["shared_slot"] = shared_slot
     return AdmissionController(**kwargs)
 
 
@@ -293,8 +459,16 @@ def serve_latest_model(
     server_engine: str = "thread",
     max_pending: int | None = None,
     retry_after_max_s: float | None = None,
+    dtype: str = "float32",
 ):
     """Load latest model -> HBM, warm up, serve (reference ``stage_2`` main).
+
+    ``dtype`` picks the serving precision (``serve.predictor.
+    SERVE_DTYPES``): ``bfloat16``/``int8`` serve the quantized variant
+    of the checkpoint — but ONLY after the shadow quality gate admits it
+    (:func:`build_serving_predictor`); a regression past the policy
+    ceiling keeps f32 serving and says so on /healthz and the
+    ``bodywork_tpu_serve_quantized_state`` gauge.
 
     ``mesh_data > 1`` serves through a data-parallel predictor sharding each
     batch over a ``(mesh_data, 1)`` device mesh (BASELINE.json config 4).
@@ -357,7 +531,9 @@ def serve_latest_model(
         # with buckets set, build_predictor always returns a predictor
         # (every engine honours the list), so create_app never needs the
         # knob here
-        predictor = build_predictor(model, mesh_data, engine, buckets=buckets)
+        predictor, _served_dtype = build_serving_predictor(
+            store, model, mesh_data, engine, buckets=buckets, dtype=dtype,
+        )
         model_bounds = _registry_bounds(store, served_key)
     admission = build_admission(server_engine, max_pending, retry_after_max_s)
     app = create_app(
@@ -392,6 +568,7 @@ def serve_latest_model(
             served_key=served_key if served_key is not None else NOTHING_SERVED,
             buckets=buckets,
             slo_watchdog=watchdog,
+            dtype=dtype,
         )
         watcher.start()
         handle.add_cleanup(watcher.stop)

@@ -516,15 +516,18 @@ def test_serve_env_knobs_parsing(monkeypatch):
     monkeypatch.setenv("BODYWORK_TPU_SERVER_ENGINE", "aio")
     monkeypatch.setenv("BODYWORK_TPU_MAX_PENDING", "64")
     monkeypatch.setenv("BODYWORK_TPU_RETRY_AFTER_MAX_S", "12")
-    assert _serve_env_knobs() == ("aio", 64, 12.0)
+    monkeypatch.setenv("BODYWORK_TPU_SERVE_DTYPE", "int8")
+    assert _serve_env_knobs() == ("aio", 64, 12.0, "int8")
     monkeypatch.setenv("BODYWORK_TPU_SERVER_ENGINE", "gevent")
     monkeypatch.setenv("BODYWORK_TPU_MAX_PENDING", "zero")
     monkeypatch.setenv("BODYWORK_TPU_RETRY_AFTER_MAX_S", "-3")
-    assert _serve_env_knobs() == ("thread", None, None)
+    monkeypatch.setenv("BODYWORK_TPU_SERVE_DTYPE", "fp7")
+    assert _serve_env_knobs() == ("thread", None, None, "float32")
     for name in ("BODYWORK_TPU_SERVER_ENGINE", "BODYWORK_TPU_MAX_PENDING",
-                 "BODYWORK_TPU_RETRY_AFTER_MAX_S"):
+                 "BODYWORK_TPU_RETRY_AFTER_MAX_S",
+                 "BODYWORK_TPU_SERVE_DTYPE"):
         monkeypatch.delenv(name)
-    assert _serve_env_knobs() == ("thread", None, None)
+    assert _serve_env_knobs() == ("thread", None, None, "float32")
 
 
 def test_serve_stage_aio_engine_full_day(store):
@@ -563,18 +566,20 @@ def test_cli_and_stage_env_knob_parsers_agree(monkeypatch):
     from bodywork_tpu.cli import build_parser
     from bodywork_tpu.pipeline.stages import _serve_env_knobs
 
-    for engine, pending, retry in (
-        ("aio", "64", "12"),           # well-formed
-        ("gevent", "zero", "-3"),      # malformed -> defaults, no crash
-        ("", "", ""),                  # unset-equivalent
+    for engine, pending, retry, dtype in (
+        ("aio", "64", "12", "bfloat16"),        # well-formed
+        ("gevent", "zero", "-3", "fp7"),        # malformed -> defaults
+        ("", "", "", ""),                       # unset-equivalent
     ):
         monkeypatch.setenv("BODYWORK_TPU_SERVER_ENGINE", engine)
         monkeypatch.setenv("BODYWORK_TPU_MAX_PENDING", pending)
         monkeypatch.setenv("BODYWORK_TPU_RETRY_AFTER_MAX_S", retry)
+        monkeypatch.setenv("BODYWORK_TPU_SERVE_DTYPE", dtype)
         knobs = _serve_env_knobs()
         args = build_parser().parse_args(["serve", "--store", "s"])
         assert (
             args.server_engine,
             args.max_pending,
             args.retry_after_max_s,
-        ) == knobs, (engine, pending, retry)
+            args.dtype,
+        ) == knobs, (engine, pending, retry, dtype)

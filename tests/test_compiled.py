@@ -1,0 +1,539 @@
+"""Compiled serving core (ISSUE 12): the AOT executable cache, the
+warm-before-publish swap contract, input-buffer donation safety,
+quantized (bf16/int8) serving behind the shadow quality gate, and the
+shared cross-process admission budget.
+
+Guard tests pin the three-way single source of truth — the padding
+bucket set (``serve.predictor.DEFAULT_BUCKETS``) == the AOT-warmed
+executable set (what ``warmup`` compiles) == bench config 11's sweep
+shapes — and the serving-dtype table (``SERVE_DTYPES`` == the
+``cli serve --dtype`` choices == bench's ``COMPILED_DTYPES``).
+"""
+import multiprocessing
+from datetime import date
+
+import numpy as np
+import pytest
+
+from bodywork_tpu.models.mlp import MLPConfig, MLPRegressor
+from bodywork_tpu.serve.predictor import (
+    DEFAULT_BUCKETS,
+    EXECUTABLE_CACHE,
+    SERVE_DTYPES,
+    BF16MLPPredictor,
+    Int8MLPPredictor,
+    PaddedPredictor,
+    params_shape_digest,
+)
+
+
+@pytest.fixture(scope="module")
+def mlp_pair():
+    """Two independently-fitted SAME-architecture MLPs (the hot-swap
+    shape: new params, same program)."""
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(512, 2)).astype(np.float32)
+    y = (X @ np.array([1.5, -2.0]) + 3.0).astype(np.float32)
+    cfg = MLPConfig(hidden=(8, 8), n_steps=40)
+    a = MLPRegressor(cfg).fit(X, y)
+    b = MLPRegressor(MLPConfig(hidden=(8, 8), n_steps=40, seed=9)).fit(X, y)
+    return a, b
+
+
+@pytest.fixture()
+def seeded_store(store):
+    """A store holding one dataset day + one small MLP checkpoint —
+    the minimum the quantization shadow gate needs."""
+    from bodywork_tpu.data import Dataset, generate_day, persist_dataset
+    from bodywork_tpu.train import train_on_history
+
+    d = date(2026, 3, 1)
+    X, y = generate_day(d)
+    persist_dataset(store, Dataset(X, y, d))
+    result = train_on_history(
+        store, "mlp", model_kwargs={"hidden": [8, 8], "n_steps": 60}
+    )
+    return store, result
+
+
+# -- AOT executable cache ----------------------------------------------------
+
+def test_same_architecture_swap_is_compile_free(mlp_pair):
+    """The tentpole claim: a second predictor over a same-architecture
+    checkpoint resolves every bucket from the process-wide cache — zero
+    compiles — and still serves the NEW params' predictions."""
+    a, b = mlp_pair
+    assert params_shape_digest(a.params) == params_shape_digest(b.params)
+    pa = PaddedPredictor(a, buckets=(1, 8))
+    pa.warmup(sync=False)
+    misses_before = EXECUTABLE_CACHE.stats()["misses"]
+    pb = PaddedPredictor(b, buckets=(1, 8))
+    pb.warmup(sync=False)
+    X = np.array([[1.0, 2.0], [3.0, 4.0]], dtype=np.float32)
+    out = pb.predict(X)
+    assert EXECUTABLE_CACHE.stats()["misses"] == misses_before
+    # the executable was re-BOUND, not re-used with stale params
+    np.testing.assert_array_equal(out, np.asarray(b.predict_device(X)))
+    assert not np.array_equal(out, np.asarray(a.predict_device(X)))
+
+
+def test_aot_dispatch_byte_identical_to_jit_apply(mlp_pair):
+    """f32 default path: the AOT executable's output is byte-identical
+    to the per-class jit apply (the pre-AOT behaviour) — the chaos
+    byte-identity soak's per-request guarantee, pinned directly."""
+    a, _ = mlp_pair
+    p = PaddedPredictor(a, buckets=(1, 8, 64))
+    p.warmup(sync=False)
+    rng = np.random.default_rng(3)
+    for n in (1, 5, 8, 33):
+        X = rng.normal(size=(n, 2)).astype(np.float32)
+        np.testing.assert_array_equal(
+            p.predict(X), np.asarray(a.predict_device(X))[:n]
+        )
+
+
+def test_swap_lands_zero_request_side_compiles(mlp_pair):
+    """Satellite regression: across an app-level hot swap (the
+    predictor=None path nobody warms), scoring requests observe ZERO
+    executable-cache misses — the counting-jit seam. The swap itself is
+    also compile-free (same architecture)."""
+    from bodywork_tpu.serve.app import create_app
+
+    a, b = mlp_pair
+    app = create_app(a, date(2026, 3, 1), buckets=(1, 8), warmup=True,
+                     warmup_sync=False)
+    client = app.test_client()
+    assert client.post("/score/v1", json={"X": [1.0, 2.0]}).status_code == 200
+    misses_before = EXECUTABLE_CACHE.stats()["misses"]
+    app.swap_model(b, date(2026, 3, 2))  # predictor=None: app builds+warms
+    # the freshly-built predictor is fully warmed BEFORE the pointer
+    # published (satellite 1): every bucket handle resolved
+    served = app.served_bundle
+    assert all(
+        (bucket, 2) in served.predictor._compiled for bucket in (1, 8)
+    )
+    for _ in range(5):
+        assert client.post(
+            "/score/v1", json={"X": [1.0, 2.0]}
+        ).status_code == 200
+    assert EXECUTABLE_CACHE.stats()["misses"] == misses_before
+
+
+def test_set_canary_warms_before_publish(mlp_pair):
+    """Canary-start must not land its first-bucket compile on the first
+    scoring request that routes to it (satellite 1, canary leg)."""
+    from bodywork_tpu.serve.app import create_app
+
+    a, b = mlp_pair
+    app = create_app(a, date(2026, 3, 1), buckets=(1, 8), warmup=True,
+                     warmup_sync=False)
+    app.set_canary(b, date(2026, 3, 2), model_key="models/x.npz",
+                   fraction=1.0, seed=1)
+    canary = app._canary
+    assert all((bucket, 2) in canary.predictor._compiled for bucket in (1, 8))
+
+
+def test_unwarmed_shape_still_serves_and_counts_miss(mlp_pair):
+    """A bucket nobody warmed compiles lazily on dispatch (correctness
+    over purity) and the miss counter makes the warmup bug visible."""
+    a, _ = mlp_pair
+    p = PaddedPredictor(a, buckets=(32,))  # a bucket no other test compiles
+    # no warmup at all
+    misses_before = EXECUTABLE_CACHE.stats()["misses"]
+    out = p.predict(np.array([[1.0, 2.0]], dtype=np.float32))
+    assert out.shape == (1,)
+    assert EXECUTABLE_CACHE.stats()["misses"] >= misses_before + 1
+
+
+def test_aot_cache_env_disable(mlp_pair, monkeypatch):
+    """BODYWORK_TPU_AOT_CACHE=0 (bench config 11's stall baseline): no
+    cross-instance reuse — every fresh predictor recompiles — while
+    per-instance dispatch still works."""
+    from bodywork_tpu.serve import predictor as predictor_mod
+
+    a, b = mlp_pair
+    monkeypatch.setenv(predictor_mod.AOT_CACHE_ENV, "0")
+    p1 = PaddedPredictor(a, buckets=(4,))
+    p1.predict(np.ones((2, 2), np.float32))
+    misses_before = EXECUTABLE_CACHE.stats()["misses"]
+    p2 = PaddedPredictor(b, buckets=(4,))
+    p2.predict(np.ones((2, 2), np.float32))
+    assert EXECUTABLE_CACHE.stats()["misses"] > misses_before
+
+
+# -- donation safety (satellite 2) -------------------------------------------
+
+def test_dispatch_never_mutates_caller_array(mlp_pair):
+    """The donate-input audit: predict() must not mutate (or alias) the
+    caller's host array, including the EXACT-bucket-size case where no
+    padding copy happens — the uncoalesced sanity-firewall fallback
+    re-predicts through the SAME array after the routed predictor
+    already consumed it."""
+    a, b = mlp_pair
+    p = PaddedPredictor(a, buckets=(1, 4))
+    X = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0], [7.0, 8.0]],
+                 dtype=np.float32)  # n == bucket 4: the no-copy path
+    before = X.tobytes()
+    first = p.predict(X)
+    assert X.tobytes() == before
+    # the firewall shape: a SECOND predictor re-predicts the same array
+    fallback = PaddedPredictor(b, buckets=(1, 4)).predict(X)
+    assert X.tobytes() == before
+    # and re-running the first is byte-stable (no hidden state/aliasing)
+    np.testing.assert_array_equal(first, p.predict(X))
+    assert fallback.shape == first.shape
+
+
+def test_firewall_fallback_bytes_equal_production_route(seeded_store):
+    """With the AOT cache + donation active, a canary sanity violation
+    answered from production is byte-identical to a production-routed
+    request — the firewall re-predict rides the same executables."""
+    import jax
+
+    from bodywork_tpu.serve.app import create_app
+
+    store, result = seeded_store
+    model = result.model
+    app = create_app(model, date(2026, 3, 1), buckets=(1, 8), warmup=True,
+                     warmup_sync=False, model_key="models/prod.npz",
+                     model_bounds={"lo": -1e6, "hi": 1e6})
+    client = app.test_client()
+    body = {"X": [55.0]}
+    clean = client.post("/score/v1", json=body)
+    assert clean.status_code == 200
+    # NaN-sabotaged same-architecture canary at fraction 1.0
+    bad_params = jax.tree_util.tree_map(
+        lambda leaf: np.full(np.shape(leaf), np.nan, dtype=np.float32),
+        model.host_params(),
+    )
+    bad = MLPRegressor(model.config, bad_params)
+    app.set_canary(bad, date(2026, 3, 2), model_key="models/bad.npz",
+                   fraction=1.0, seed=5)
+    answered = client.post("/score/v1", json=body)
+    assert answered.status_code == 200
+    assert answered.data == clean.data
+    assert answered.headers["X-Bodywork-Model-Key"] == "models/prod.npz"
+
+
+# -- quantized serving (tentpole b) ------------------------------------------
+
+def test_quantized_predictors_within_pinned_tolerance(mlp_pair):
+    """bf16/int8 predictions track the f32 engine within the pinned
+    numeric envelope (relative to the prediction scale)."""
+    a, _ = mlp_pair
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(64, 2)).astype(np.float32)
+    f32 = PaddedPredictor(a, buckets=(64,)).predict(X)
+    scale = max(1.0, float(np.max(np.abs(f32))))
+    b16 = BF16MLPPredictor(a, buckets=(64,)).predict(X)
+    q8 = Int8MLPPredictor(a, buckets=(64,)).predict(X)
+    assert np.max(np.abs(b16 - f32)) / scale < 2e-2  # bf16: ~3 sig digits
+    assert np.max(np.abs(q8 - f32)) / scale < 2e-2   # int8 per-channel
+
+
+def test_int8_quantization_roundtrip():
+    from bodywork_tpu.models.fused import dequantize_mlp_params, quantize_int8
+
+    rng = np.random.default_rng(2)
+    w = rng.normal(size=(16, 8)).astype(np.float32)
+    q, scale = quantize_int8(w)
+    assert q.dtype == np.int8 and scale.shape == (8,)
+    err = np.abs(q.astype(np.float32) * scale[None, :] - w)
+    # symmetric per-channel: error bounded by half a quantization step
+    assert np.all(err <= scale[None, :] * 0.5 + 1e-7)
+    # zero columns round-trip exactly
+    w[:, 3] = 0.0
+    q, scale = quantize_int8(w)
+    assert np.all(q[:, 3] == 0) and scale[3] == 1.0
+    params = {"net": {"layers": [{"w": w, "b": np.zeros(8, np.float32)}]},
+              "scaler": {"x_mean": np.zeros(16, np.float32),
+                         "x_std": np.ones(16, np.float32),
+                         "y_mean": np.float32(0), "y_std": np.float32(1)}}
+    from bodywork_tpu.models.fused import quantize_mlp_params_int8
+
+    deq = dequantize_mlp_params(quantize_mlp_params_int8(params))
+    assert np.max(np.abs(deq["net"]["layers"][0]["w"] - w)) <= \
+        np.max(scale) * 0.5 + 1e-7
+
+
+def test_quantized_cross_engine_http_byte_identity(seeded_store):
+    """Cross-dtype/cross-engine parity over REAL HTTP (satellite 3):
+    int8 responses are identical BETWEEN the thread and aio engines
+    (coalesced path included) and within tolerance of the f32 engine's
+    responses."""
+    import json
+
+    import requests as rq
+
+    from bodywork_tpu.serve import serve_latest_model
+
+    store, _result = seeded_store
+    bodies = [{"X": [40.0]}, {"X": [71.5]}, {"X": [[1.0], [2.0], [3.0]]}]
+
+    def responses(server_engine, dtype, window_ms):
+        handle = serve_latest_model(
+            store, host="127.0.0.1", port=0, block=False, buckets=(1, 8),
+            server_engine=server_engine, batch_window_ms=window_ms,
+            dtype=dtype,
+        )
+        try:
+            out = []
+            for body in bodies:
+                route = "/score/v1/batch" if isinstance(
+                    body["X"][0], list
+                ) else "/score/v1"
+                url = handle.url.replace("/score/v1", route)
+                resp = rq.post(url, json=body, timeout=30)
+                assert resp.status_code == 200
+                out.append(resp.content)
+            health = rq.get(
+                handle.url.replace("/score/v1", "/healthz"), timeout=10
+            ).json()
+            return out, health
+        finally:
+            handle.stop()
+
+    thread_q, health_t = responses("thread", "int8", 0.0)
+    aio_q, health_a = responses("aio", "int8", 2.0)  # coalesced path
+    f32, health_f = responses("aio", "float32", 2.0)
+    assert health_t["serving_dtype"] == "int8"
+    assert health_a["serving_dtype"] == "int8"
+    assert health_f["serving_dtype"] == "float32"
+    assert thread_q == aio_q  # byte-identical BETWEEN engines
+    for quant, full in zip(aio_q, f32):
+        qv = json.loads(quant)
+        fv = json.loads(full)
+        q_preds = qv.get("predictions") or [qv["prediction"]]
+        f_preds = fv.get("predictions") or [fv["prediction"]]
+        for qp, fp in zip(q_preds, f_preds):
+            # pinned envelope on the LABEL scale (the reference
+            # generator's labels span ~0..100; int8's std-space error is
+            # re-amplified by the folded y_std, so a per-prediction
+            # relative bound would explode exactly where predictions
+            # cross zero — the same pathology that keeps MAPE rules
+            # opt-in everywhere in this codebase)
+            assert abs(qp - fp) < 1.0
+
+
+def test_quantization_gate_sabotage_keeps_f32(seeded_store, monkeypatch):
+    """Acceptance: a quantized variant whose quality regresses past the
+    policy ceiling NEVER serves — the gate keeps f32 and says so."""
+    from bodywork_tpu.models import fused
+    from bodywork_tpu.obs import get_registry
+    from bodywork_tpu.serve.server import build_serving_predictor
+
+    store, result = seeded_store
+    _real = fused.quantize_mlp_params_int8
+
+    def garbage(params):
+        q = _real(params)
+        for layer in q["net"]["layers"]:
+            layer["w_scale"] = layer["w_scale"] * 40.0  # wreck the weights
+        return q
+
+    monkeypatch.setattr(fused, "quantize_mlp_params_int8", garbage)
+    predictor, served_dtype = build_serving_predictor(
+        store, result.model, None, "xla", buckets=(1, 8), dtype="int8",
+    )
+    assert served_dtype == "float32"
+    assert not isinstance(predictor, Int8MLPPredictor)
+    rejected = get_registry().counter(
+        "bodywork_tpu_serve_quantization_gate_total"
+    ).value(dtype="int8", outcome="rejected_quality")
+    assert rejected >= 1, "gate rejection must be counted"
+
+
+def test_quantized_dtype_on_non_mlp_keeps_f32(seeded_store):
+    """Review regression: the dtype knob is a fleet-wide env setting
+    while the served model changes per swap — a linear checkpoint under
+    --dtype int8 must keep f32 serving (counted), never crash-loop the
+    pod."""
+    from bodywork_tpu.models import LinearRegressor
+    from bodywork_tpu.obs import get_registry
+    from bodywork_tpu.serve.server import build_serving_predictor
+
+    store, _result = seeded_store
+    rng = np.random.default_rng(4)
+    X = rng.uniform(0, 100, 200).astype(np.float32)
+    y = (1.0 + 0.5 * X).astype(np.float32)
+    linear = LinearRegressor().fit(X, y)
+    predictor, served_dtype = build_serving_predictor(
+        store, linear, None, "xla", buckets=(1, 8), dtype="int8",
+    )
+    assert served_dtype == "float32"
+    assert get_registry().counter(
+        "bodywork_tpu_serve_quantization_gate_total"
+    ).value(dtype="int8", outcome="unsupported_model") >= 1
+
+
+def test_quantization_gate_no_data_keeps_f32(store, mlp_pair):
+    """A store with no dataset history gives the gate no evidence:
+    quantized serving is refused, f32 serves."""
+    from bodywork_tpu.serve.server import build_serving_predictor
+
+    a, _ = mlp_pair
+    predictor, served_dtype = build_serving_predictor(
+        store, a, None, "xla", buckets=(1, 8), dtype="bfloat16",
+    )
+    assert served_dtype == "float32"
+
+
+def test_pallas_row_tile_and_int8_match_xla():
+    """The kernel extensions (coalesced-batch row tile, int8 weights)
+    agree with the XLA reference in interpreter mode."""
+    from bodywork_tpu.ops import make_pallas_mlp_apply
+
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(300, 3)).astype(np.float32)
+    y = (X @ np.array([1.0, -1.0, 0.5]) + 2.0).astype(np.float32)
+    m = MLPRegressor(MLPConfig(hidden=(8,), n_steps=30)).fit(X, y)
+    ref = m.predict(X[:20])
+    small_tile = make_pallas_mlp_apply(m.params, interpret=True, row_tile=8)
+    np.testing.assert_allclose(
+        np.asarray(small_tile(X[:20])), ref, atol=1e-4, rtol=1e-4
+    )
+    q8 = make_pallas_mlp_apply(m.params, interpret=True,
+                               compute_dtype="int8", row_tile=8)
+    scale = max(1.0, float(np.max(np.abs(ref))))
+    assert np.max(np.abs(np.asarray(q8(X[:20])) - ref)) / scale < 2e-2
+    with pytest.raises(ValueError):
+        make_pallas_mlp_apply(m.params, interpret=True, row_tile=7)
+
+
+def test_non_aot_fallback_keeps_quantized_dtype(mlp_pair):
+    """Review regression: when the AOT path is ineligible (mesh-mixed
+    params), a quantized predictor must still dispatch its QUANTIZED
+    program — silently serving f32 while /healthz reports int8/bf16
+    would falsify the operator-visible dtype proof."""
+    a, _ = mlp_pair
+    X = np.array([[1.0, 2.0], [3.0, 4.0]], dtype=np.float32)
+    q8 = Int8MLPPredictor(a, buckets=(8,))
+    aot_out = q8.predict(X)
+    q8._aot_eligible = False  # force the fallback path
+    np.testing.assert_array_equal(q8.predict(X), aot_out)
+    f32 = PaddedPredictor(a, buckets=(8,)).predict(X)
+    assert not np.array_equal(aot_out, f32)
+    b16 = BF16MLPPredictor(a, buckets=(8,))
+    b16_aot = b16.predict(X)
+    b16._aot_eligible = False
+    np.testing.assert_array_equal(b16.predict(X), b16_aot)
+    # int8 params live on device (no per-dispatch host upload)
+    import jax
+
+    assert all(
+        isinstance(leaf, jax.Array)
+        for leaf in jax.tree_util.tree_leaves(q8._qparams)
+    )
+
+
+# -- shared admission budget (tentpole c) ------------------------------------
+
+def test_shared_budget_is_service_wide_and_self_healing():
+    """Two controllers over one slot array: the budget bounds the SUM
+    of their admitted work; zeroing a (dead) worker's slot reclaims
+    exactly its contribution."""
+    from bodywork_tpu.serve.admission import (
+        AdmissionController,
+        SharedBudgetSlot,
+    )
+
+    array = multiprocessing.get_context("spawn").Array("i", 2)
+    c0 = AdmissionController(max_pending=3,
+                             shared_slot=SharedBudgetSlot(array, 0))
+    c1 = AdmissionController(max_pending=3,
+                             shared_slot=SharedBudgetSlot(array, 1))
+    assert c0.try_admit() and c0.try_admit()
+    assert c1.try_admit()
+    # service-wide budget of 3 is full — BOTH controllers shed now
+    assert not c1.try_admit()
+    assert not c0.try_admit()
+    assert c0.queue_depth == 3 and c1.queue_depth == 3
+    state = c1.state()
+    assert state["shared_pending"] == 3 and state["shedding"]
+    # worker 0 "dies": the supervisor zeroes its slot — its 2 units come
+    # back without touching worker 1's single admitted request
+    SharedBudgetSlot.clear(array, 0)
+    assert c1.try_admit() and c1.try_admit()
+    assert not c1.try_admit()
+    c1.release()
+    assert c1.try_admit()
+
+
+def test_local_budget_unchanged_without_shared_slot():
+    from bodywork_tpu.serve.admission import AdmissionController
+
+    c = AdmissionController(max_pending=2)
+    assert c.try_admit() and c.try_admit() and not c.try_admit()
+    assert c.state()["shared_pending"] is None
+    c.release()
+    assert c.try_admit()
+
+
+# -- guards (satellite 4) ----------------------------------------------------
+
+def test_bucket_set_single_source_of_truth(mlp_pair):
+    """Padding-bucket set == AOT-warmed executable set == bench config
+    11 sweep shapes. One source of truth in serve/predictor.py."""
+    import bench
+
+    assert bench.COMPILED_SWEEP_BUCKETS == tuple(DEFAULT_BUCKETS)
+    a, _ = mlp_pair
+    p = PaddedPredictor(a)  # default buckets
+    assert p.buckets == tuple(sorted(DEFAULT_BUCKETS))
+    p.warmup(sync=False)
+    n_features = a.n_features
+    warmed = {bucket for (bucket, nf) in p._compiled if nf == n_features}
+    assert warmed == set(DEFAULT_BUCKETS)
+
+
+def test_dtype_table_single_source_of_truth():
+    """SERVE_DTYPES == cli serve --dtype choices == bench COMPILED_DTYPES
+    (a dtype missing from any table would be unreachable or unmeasured)."""
+    import bench
+
+    from bodywork_tpu.cli import build_parser
+
+    serve_parser = (
+        build_parser()._subparsers._group_actions[0].choices["serve"]
+    )
+    action = next(
+        a for a in serve_parser._actions if a.dest == "dtype"
+    )
+    assert tuple(action.choices) == SERVE_DTYPES
+    assert bench.COMPILED_DTYPES == SERVE_DTYPES
+
+
+def test_new_metric_names_pass_obs_lint():
+    from bodywork_tpu.obs.registry import validate_metric_name
+
+    validate_metric_name(
+        "bodywork_tpu_serve_executable_cache_hits_total", "counter"
+    )
+    validate_metric_name(
+        "bodywork_tpu_serve_executable_cache_misses_total", "counter"
+    )
+    validate_metric_name("bodywork_tpu_serve_compile_seconds", "histogram")
+    validate_metric_name(
+        "bodywork_tpu_serve_quantization_gate_total", "counter"
+    )
+    validate_metric_name("bodywork_tpu_serve_quantized_state", "gauge")
+
+
+def test_bench_config11_smoke():
+    """Config 11 at smoke scale (tier-1, seconds): swap drive with zero
+    cache misses, the dtype records, and the record shape — the full
+    capture is the committed BENCH record."""
+    import bench
+
+    rec = bench.bench_compiled_serving(
+        duration_s=1.2, drive_rate_rps=40.0, isolate=False,
+        capacity_window_s=0.6, replica_point=False,
+        dtypes=("float32", "int8"),
+        mlp_kwargs={"hidden": [8, 8], "n_steps": 40},
+    )
+    assert rec["swap"]["executable_cache_misses_during_drive"] == 0
+    assert rec["swap"]["same_architecture"] is True
+    assert rec["swap"]["baseline_stall"]["total_compile_s"] > 0
+    assert rec["sweep_buckets"] == list(bench.COMPILED_SWEEP_BUCKETS)
+    assert rec["dtypes"]["int8"]["served_dtype"] == "int8"
+    assert rec["dtypes"]["float32"]["capacity_rps"] > 0
