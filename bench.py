@@ -703,7 +703,17 @@ def bench_single_row_scoring(
         "batcher_on": {
             "batch_window_ms": window_ms, "batch_max_rows": max_rows,
         },
+        # the ISSUE 13 overhead row: the batcher-off shape with request
+        # tracing at FULL head sampling (every request minted, sampled,
+        # span-recorded, flight-recorder appended — the worst case;
+        # production runs a fraction of this). Compared against
+        # batcher_off, which runs tracing-off, in tracing_overhead below.
+        "tracing_on": {},
     }
+    from bodywork_tpu.obs.tracing import configure_tracing, get_tracer
+
+    tracer = get_tracer()
+    restore = (tracer.sample_fraction, tracer.seed)
     for name, kwargs in variants.items():
         # fresh registry per variant, so the server-side histograms below
         # cover exactly THIS variant's requests (the registry is
@@ -711,6 +721,7 @@ def bench_single_row_scoring(
         from bodywork_tpu.obs import get_registry
 
         get_registry().reset()
+        configure_tracing(1.0 if name == "tracing_on" else 0.0, seed=0)
         handle = serve_latest_model(
             store, host="127.0.0.1", port=0, block=False,
             buckets=buckets, **kwargs,
@@ -753,8 +764,26 @@ def bench_single_row_scoring(
             record[name] = sub
         finally:
             handle.stop()
+    # restore whatever tracing config the process had (the bench child
+    # may host further configs)
+    configure_tracing(*restore)
 
     off, on = record["batcher_off"], record["batcher_on"]
+    tracing = record["tracing_on"]
+    # the overhead row the acceptance pins: tracing at full head
+    # sampling vs tracing-off, identical serving shape — deltas should
+    # sit within run-to-run noise (tracing costs two hashes + span
+    # bookkeeping against an HTTP round trip)
+    record["tracing_overhead"] = {
+        "p50_delta_s": round(tracing["p50_s"] - off["p50_s"], 6),
+        "p99_delta_s": round(tracing["p99_s"] - off["p99_s"], 6),
+        "p50_ratio": round(tracing["p50_s"] / off["p50_s"], 3),
+        "protocol": (
+            "tracing_on = batcher-off shape with head sampling 1.0 "
+            "(every request traced into the flight recorder); "
+            "batcher_off runs tracing-off"
+        ),
+    }
     record["value"] = off["p50_s"]
     # reference scores one row per 8.22 ms; >1 means our single-row HTTP
     # p50 beats the reference's recorded per-score cost

@@ -66,6 +66,7 @@ from bodywork_tpu.store.schema import (
     ALL_PREFIXES,
     AUDIT_PREFIX,
     DATASETS_PREFIX,
+    FLIGHTREC_PREFIX,
     MODEL_METRICS_PREFIX,
     MODELS_PREFIX,
     QUARANTINE_META_SUFFIX,
@@ -670,6 +671,59 @@ def _check_audit(ctx: FsckContext) -> list[Finding]:
     return out
 
 
+def _check_flightrec(ctx: FsckContext) -> list[Finding]:
+    """Flight-recorder dumps (``obs/tracing.py``): schema-tagged JSON
+    with an embedded ``doc_digest`` plus (when written through an
+    audited store) a raw-byte sidecar carrying a compressed replica —
+    evidence with no producer to rebuild it, so the sidecar replica is
+    the ONLY restore path and a dump rotting without one is data loss
+    of forensics (reported, quarantined, never fabricated)."""
+    from bodywork_tpu.obs.tracing import validate_flight_record
+
+    out = []
+    for key in ctx.keys[FLIGHTREC_PREFIX]:
+        data = _get(ctx.store, key)
+        if data is None:
+            continue
+        sidecar_doc, status = ctx.sidecar(key)
+        valid = validate_flight_record(_json_doc(data))
+        digest_ok = (
+            status != "ok" or sidecar_doc["sha256"] == artefact_sha256(data)
+        )
+        if valid:
+            if status == "absent":
+                out.append(Finding(
+                    key, FLIGHTREC_PREFIX, "undigested", "advisory",
+                    detail="no write-time digest recorded (dump written "
+                           "outside an audited store); whitespace rot "
+                           "here would be invisible",
+                    repair="backfill_digest",
+                ))
+            elif not digest_ok:
+                # primary verifies its own embedded digest: the SIDECAR
+                # is the stale half — restoring its replica would roll
+                # the dump back, so re-record instead (registry rule)
+                out.append(Finding(
+                    audit_digest_key(key), AUDIT_PREFIX, "stale_sidecar",
+                    "restorable",
+                    detail=f"sidecar digest disagrees with a healthy "
+                           f"{key!r} (doc digest verifies)",
+                    repair="rebuild_sidecar",
+                ))
+            continue
+        restorable = status == "ok" and sidecar_doc.get("replica")
+        out.append(Finding(
+            key, FLIGHTREC_PREFIX, "unreadable",
+            "restorable" if restorable else "data_loss",
+            detail="flight record fails schema/doc-digest validation"
+                   + ("" if restorable else
+                      " and no sidecar replica survives — the verdict's "
+                      "forensic evidence is lost"),
+            repair="restore_replica" if restorable else None,
+        ))
+    return out
+
+
 def _check_quarantine(ctx: FsckContext) -> list[Finding]:
     out = []
     keys = set(ctx.keys[QUARANTINE_PREFIX])
@@ -720,6 +774,7 @@ CHECKERS = {
     REGISTRY_PREFIX: _check_registry,
     AUDIT_PREFIX: _check_audit,
     QUARANTINE_PREFIX: _check_quarantine,
+    FLIGHTREC_PREFIX: _check_flightrec,
 }
 
 

@@ -48,6 +48,7 @@ from bodywork_tpu.store.base import ArtefactNotFound, ArtefactStore, DelegatingS
 from bodywork_tpu.store.schema import (
     AUDIT_PREFIX,
     DATASETS_PREFIX,
+    FLIGHTREC_PREFIX,
     MODEL_METRICS_PREFIX,
     MODELS_PREFIX,
     REGISTRY_PREFIX,
@@ -72,6 +73,10 @@ PUT_SIDECAR_PREFIXES = (
     MODEL_METRICS_PREFIX,
     TEST_METRICS_PREFIX,
     SNAPSHOTS_PREFIX,
+    # flight-recorder dumps are one-shot evidence with no producer to
+    # rebuild them — the sidecar (with replica, below) is their only
+    # redundancy against at-rest rot
+    FLIGHTREC_PREFIX,
 )
 
 #: CAS-mutated classes that also get a sidecar, written after each
@@ -88,6 +93,9 @@ REPLICA_PREFIXES = (
     MODEL_METRICS_PREFIX,
     TEST_METRICS_PREFIX,
     REGISTRY_PREFIX,
+    # dumps are ring-buffer bounded (a few hundred KB at most), so the
+    # compressed replica is cheap insurance for unrebuildable evidence
+    FLIGHTREC_PREFIX,
 )
 
 #: fixed zlib level: replica bytes must be deterministic across

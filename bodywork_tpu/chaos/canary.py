@@ -163,12 +163,23 @@ def run_canary_chaos(
     samples_per_day: int = 96,
     poll_every: int = 20,
     policy=None,
+    trace_fraction: float = 0.5,
 ) -> dict:
     """One seeded canary release-safety scenario against a FRESH store.
     Returns the acceptance summary (``summary["ok"]`` is the verdict);
-    see the module docstring for what each scenario proves."""
+    see the module docstring for what each scenario proves.
+
+    The drive runs with request tracing configured at
+    ``trace_fraction`` head sampling under the scenario's own seed
+    (``obs/tracing.py``), so the watchdog's verdict ships a
+    flight-recorder dump — the summary carries the dump key(s), the
+    sampled trace ids (a pure function of (seed, request bytes):
+    replays reproduce them), and how many sampled canary traces show
+    the firewall-fallback child span. ``trace_fraction=0`` runs the
+    scenario tracing-off, byte-identical by the header-only rule."""
     from bodywork_tpu.chaos.plan import FaultPlan, activate
     from bodywork_tpu.models.checkpoint import load_model
+    from bodywork_tpu.obs.tracing import configured_tracing
     from bodywork_tpu.ops.slo import SloPolicy, SloWatchdog
     from bodywork_tpu.registry import ModelRegistry, read_aliases
     from bodywork_tpu.registry.records import load_record
@@ -268,12 +279,15 @@ def run_canary_chaos(
     ]
     bounds = as_bounds(bounds_doc) or (-np.inf, np.inf)
     plan = FaultPlan(seed=seed, canary_latency_p=1.0, canary_latency_s=0.05)
-    if scenario == "latency":
-        with activate(plan):
+    # the drive (and the final reconcile poll — the watchdog's dump
+    # must see the tracer's recorder) runs under scoped tracing config
+    with configured_tracing(trace_fraction, seed=seed):
+        if scenario == "latency":
+            with activate(plan):
+                trace = _drive(app, twin_app, watcher, xs, poll_every, bounds)
+        else:
             trace = _drive(app, twin_app, watcher, xs, poll_every, bounds)
-    else:
-        trace = _drive(app, twin_app, watcher, xs, poll_every, bounds)
-    watcher.check_once()  # final reconcile (covers n % poll_every != 0)
+        watcher.check_once()  # final reconcile (covers n % poll_every != 0)
     state = (app.slo_state or {}).get("state")
     if state == "breached" and trace["abort_at"] is None:
         trace["abort_at"] = n_requests
@@ -298,6 +312,24 @@ def run_canary_chaos(
             for k, s in zip(trace["keys"], trace["statuses"])
         )
     ).hexdigest()
+    # flight-recorder evidence (ISSUE 13 e2e): the verdict's dump(s)
+    # under obs/flightrec/, the sampled trace ids (deterministic from
+    # (seed, request bytes) — a replay reproduces this exact set), and
+    # how many sampled canary-routed traces carry the firewall-fallback
+    # child span (the NaN scenario's per-request proof that production
+    # answered for the sabotaged canary)
+    from bodywork_tpu.obs.tracing import iter_flight_records
+
+    flight_records = list(iter_flight_records(store))
+    sampled_trace_ids: set[str] = set()
+    fallback_span_traces = 0
+    for _key, flight_doc in flight_records:
+        for t in flight_doc["traces"]:
+            sampled_trace_ids.add(t["trace_id"])
+            if (t.get("meta") or {}).get("stream") == "canary" and any(
+                s["name"] == "firewall-fallback" for s in t["spans"]
+            ):
+                fallback_span_traces += 1
     summary = {
         "scenario": scenario,
         "seed": seed,
@@ -320,6 +352,10 @@ def run_canary_chaos(
         ),
         "canary_record_status": record.get("status"),
         "routing_digest": routing_digest,
+        "trace_fraction": trace_fraction,
+        "flight_record_keys": [k for k, _d in flight_records],
+        "sampled_trace_ids": sorted(sampled_trace_ids),
+        "fallback_span_traces": fallback_span_traces,
     }
     # budget: the breach must land within one window of CANARY-ROUTED
     # requests past the point the canary went live (plus one poll of

@@ -43,6 +43,7 @@ from bodywork_tpu.store.filesystem import FilesystemStore
 from bodywork_tpu.store.resilient import ResilientStore
 from bodywork_tpu.store.schema import (
     AUDIT_DIGESTS_PREFIX,
+    FLIGHTREC_PREFIX,
     QUARANTINE_PREFIX,
     RUNS_PREFIX,
     SNAPSHOTS_PREFIX,
@@ -169,6 +170,13 @@ _COMPARE_EXCLUDED = (
     QUARANTINE_PREFIX,
     AUDIT_DIGESTS_PREFIX + TEST_METRICS_PREFIX,
     AUDIT_DIGESTS_PREFIX + SNAPSHOTS_PREFIX,
+    # flight-recorder dumps are verdict evidence only one twin can hold
+    # (the faulted twin runs with tracing enabled; the baseline runs
+    # tracing-off) — excluded WITH their sidecars, exactly like
+    # quarantine/. Everything else must stay byte-identical with
+    # tracing on: trace ids ride only a response header.
+    FLIGHTREC_PREFIX,
+    AUDIT_DIGESTS_PREFIX + FLIGHTREC_PREFIX,
 )
 
 
@@ -279,6 +287,7 @@ def run_chaos_sim(
     comparison's scope — corrupt reads of it (it is in the default
     ``corrupt_prefixes``) must degrade to a rebuild that converges to
     the same bytes as the fault-free twin's."""
+    from bodywork_tpu.obs.tracing import configured_tracing
     from bodywork_tpu.pipeline import LocalRunner, default_pipeline
 
     root = Path(root)
@@ -297,20 +306,25 @@ def run_chaos_sim(
 
     log.info(f"chaos sim: baseline run ({days} day(s)) -> {baseline_dir}")
     baseline_store = FilesystemStore(baseline_dir)
-    LocalRunner(
-        _apply_train_mode(
-            default_pipeline(model_type, scoring_mode), train_mode
-        ),
-        baseline_store,
-        drift=drift,
-    ).run_simulation(start, days)
+    with configured_tracing(0.0):  # the tracing-OFF twin
+        LocalRunner(
+            _apply_train_mode(
+                default_pipeline(model_type, scoring_mode), train_mode
+            ),
+            baseline_store,
+            drift=drift,
+        ).run_simulation(start, days)
 
     log.info(
         f"chaos sim: faulted run (seed={plan.seed}) -> {chaos_dir}"
     )
     real_store = FilesystemStore(chaos_dir)
     wrapped = ResilientStore(FaultInjectingStore(real_store, plan))
-    with activate(plan):
+    # the faulted twin runs with request tracing ON at full head
+    # sampling (obs.tracing): the byte-identity comparison below is
+    # therefore ALSO the proof that tracing never leaks into response
+    # bodies or store artefacts outside obs/flightrec/
+    with activate(plan), configured_tracing(1.0, seed=plan.seed):
         LocalRunner(
             chaos_pipeline_spec(model_type, scoring_mode, train_mode),
             wrapped,
@@ -329,6 +343,10 @@ def run_chaos_sim(
             for name in _RETRY_COUNTERS
         },
         "breaker_state": wrapped.breaker.state,
+        # the faulted twin ran with tracing at full head sampling while
+        # the baseline ran tracing-off — the comparison above is the
+        # tracing byte-identity proof (ISSUE 13 acceptance)
+        "tracing": {"faulted_sample_fraction": 1.0, "baseline": "off"},
         "ok": comparison["ok"],
     }
     return summary

@@ -21,6 +21,7 @@ its own driver:
     python -m bodywork_tpu.cli registry list|show|promote|rollback|gate --store DIR ...
     python -m bodywork_tpu.cli registry canary start|stop|promote|status --store DIR ...
     python -m bodywork_tpu.cli traffic run --url URL [--rate R] [--duration S] ...
+    python -m bodywork_tpu.cli trace show|tail|export --store DIR ...
 
 Every command exits 0 on success and 1 with a logged error otherwise — the
 exit-code contract the reference implements per-script
@@ -30,7 +31,8 @@ exit-code contract the reference implements per-script
 complete, journal-verified), 143 = graceful SIGTERM unwind; ``report
 --fail-on-drift`` exits 4, ``fsck`` exits 7 when actionable integrity
 findings remain, ``registry rollback`` exits 8 when the restore target
-fails pre-verification, and a chaos kill switch exits 86.
+fails pre-verification, ``trace`` exits 9 when the requested trace or
+dump is not recorded, and a chaos kill switch exits 86.
 """
 from __future__ import annotations
 
@@ -300,7 +302,10 @@ def cmd_traffic_run(args) -> int:
                           "--log-out (generate only)")
                 return 1
             return 0
-        report = run_open_loop(args.url, requests, timeout_s=args.timeout)
+        report = run_open_loop(
+            args.url, requests, timeout_s=args.timeout,
+            results_log=args.results_out,
+        )
         print(format_report(report))
         return 0
     except (OSError, ValueError) as exc:
@@ -1010,6 +1015,76 @@ def cmd_chaos_canary(args) -> int:
         return 0
     log.error(f"canary chaos scenario {args.scenario!r} FAILED")
     return 1
+
+
+def cmd_trace(args) -> int:
+    """Inspect stored flight-recorder dumps (``obs/flightrec/``,
+    ``obs/tracing.py``): ``tail`` lists recent dumps and their traces,
+    ``show`` prints one trace as JSON by (a prefix of) its id, and
+    ``export --chrome`` renders traces through the existing Chrome
+    trace-event emitter for Perfetto. Exits 9 when the requested trace
+    (or any dump, for tail/export) is absent — distinct from 1 (error)
+    so scripts can tell 'not recorded' from 'broken'."""
+    from bodywork_tpu.obs.tracing import (
+        find_trace,
+        flight_trace_spans,
+        iter_flight_records,
+    )
+
+    configure_logger(stream=sys.stderr)
+    store = _store(args)
+    command = args.trace_command
+    if command == "show":
+        dump_key, trace_doc = find_trace(store, args.trace_id)
+        if trace_doc is None:
+            log.error(f"trace {args.trace_id!r} not found in any dump")
+            return 9
+        import json as _json
+
+        print(_json.dumps(
+            {"dump": dump_key, "trace": trace_doc}, indent=2, sort_keys=True
+        ))
+        return 0
+    records = list(iter_flight_records(store))
+    if not records:
+        log.error("no flight-recorder dumps stored (obs/flightrec/ empty "
+                  "— dumps are written at SLO-watchdog verdicts with "
+                  "tracing enabled)")
+        return 9
+    if command == "tail":
+        for key, doc in records[-args.n:]:
+            print(
+                f"{key}  verdict={doc['verdict']} reason={doc['reason']!r} "
+                f"canary={doc.get('canary_key')} traces={doc['n_traces']}"
+            )
+            for t in doc["traces"][-args.traces:]:
+                meta = t.get("meta") or {}
+                print(
+                    f"  {t['trace_id']}  {t.get('route')} "
+                    f"status={t.get('status')} "
+                    f"duration={t.get('duration_s')}s "
+                    f"stream={meta.get('stream', '-')} "
+                    f"spans={len(t['spans'])}"
+                )
+        return 0
+    # export: one trace by id, or every trace of the newest dump
+    from bodywork_tpu.obs.spans import write_chrome_trace
+
+    if args.trace_id:
+        dump_key, trace_doc = find_trace(store, args.trace_id)
+        if trace_doc is None:
+            log.error(f"trace {args.trace_id!r} not found in any dump")
+            return 9
+        spans = flight_trace_spans(trace_doc)
+        source = dump_key
+    else:
+        source, doc = records[-1]
+        spans = [
+            span for t in doc["traces"] for span in flight_trace_spans(t)
+        ]
+    path = write_chrome_trace(args.chrome, spans, process_name=source)
+    print(path)
+    return 0
 
 
 #: alias names `registry show` resolves (anything else must look like a
@@ -1871,6 +1946,52 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-in", default=None, metavar="FILE",
                    help="replay THIS request log instead of generating "
                         "one (ignores the shape flags)")
+    p.add_argument("--results-out", default=None, metavar="FILE",
+                   help="write one JSONL record per request (status, "
+                        "client latency, answering model key, and the "
+                        "server's X-Bodywork-Trace-Id) — the join table "
+                        "between client-observed latency and the "
+                        "server-side spans `trace show` renders "
+                        "(docs/OBSERVABILITY.md tracing section)")
+
+    p = sub.add_parser(
+        "trace",
+        help="inspect request traces from stored flight-recorder dumps "
+             "(obs/flightrec/ — written at SLO-watchdog verdicts; "
+             "docs/OBSERVABILITY.md tracing section)",
+    )
+    trace_sub = p.add_subparsers(dest="trace_command", required=True)
+    p = trace_sub.add_parser(
+        "show", help="print one stored trace (JSON) by trace id or prefix"
+    )
+    p.set_defaults(fn=cmd_trace)
+    p.add_argument("--store", **common_store)
+    p.add_argument("trace_id",
+                   help="full 32-hex trace id, or any unambiguous prefix "
+                        "(first match wins) — e.g. from a /metrics "
+                        "EXEMPLAR line, /healthz latency_exemplars, or "
+                        "the traffic harness's --results-out log")
+    p = trace_sub.add_parser(
+        "tail", help="list recent dumps and the traces they carry"
+    )
+    p.set_defaults(fn=cmd_trace)
+    p.add_argument("--store", **common_store)
+    p.add_argument("-n", type=_positive_int, default=5, metavar="N",
+                   help="dumps to show, newest last (default 5)")
+    p.add_argument("--traces", type=_positive_int, default=10, metavar="N",
+                   help="traces to list per dump (default 10)")
+    p = trace_sub.add_parser(
+        "export",
+        help="render stored traces as a Chrome trace-event file "
+             "(open in Perfetto / chrome://tracing) — one track per trace",
+    )
+    p.set_defaults(fn=cmd_trace)
+    p.add_argument("--store", **common_store)
+    p.add_argument("--chrome", required=True, metavar="OUT.json",
+                   help="output path for the Chrome trace-event JSON")
+    p.add_argument("--trace-id", default=None,
+                   help="export only this trace (id or prefix); default: "
+                        "every trace of the newest dump")
 
     p = add("deploy", cmd_deploy, help="write GKE TPU manifests")
     p.add_argument("--spec", default=None, help="pipeline spec YAML (overrides --model/--mode)")

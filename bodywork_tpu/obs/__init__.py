@@ -13,6 +13,11 @@ telemetry surface (ISSUE 2):
 - :mod:`~bodywork_tpu.obs.spans` — stage spans for the pipeline runner:
   per-day structured run reports (JSON) and Chrome trace-event files
   loadable in Perfetto.
+- :mod:`~bodywork_tpu.obs.tracing` — request-scoped tracing through the
+  serving hot path: W3C-compatible trace ids with deterministic head
+  sampling, a flight-recorder ring buffer the SLO watchdog dumps at
+  every verdict, and histogram exemplars tying fat latency buckets to
+  replayable traces.
 
 Everything here is stdlib-only on purpose: the hot serving path and the
 per-stage pods must be able to import it without pulling the accelerator
@@ -39,8 +44,24 @@ from bodywork_tpu.obs.spans import (
     write_chrome_trace,
     write_day_report,
 )
+from bodywork_tpu.obs.tracing import (
+    TRACE_ID_HEADER,
+    FlightRecorder,
+    RequestTrace,
+    Tracer,
+    configure_tracing,
+    configured_tracing,
+    get_tracer,
+)
 
 __all__ = [
+    "TRACE_ID_HEADER",
+    "FlightRecorder",
+    "RequestTrace",
+    "Tracer",
+    "configure_tracing",
+    "configured_tracing",
+    "get_tracer",
     "DEFAULT_LATENCY_BUCKETS",
     "METRIC_NAME_RE",
     "UNIT_SUFFIXES",

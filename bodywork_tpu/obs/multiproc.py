@@ -16,9 +16,18 @@ multiprocess mode makes, minus the mmap machinery):
   counting);
 - sibling numbers lag by at most one flush interval, and a scrape loop
   converges as flushes land (counters only grow);
-- a worker that died keeps contributing its last flushed snapshot — its
-  already-served requests must not vanish from service totals, exactly
-  as a restarted pod's Prometheus counters persist in recording rules.
+- a worker that died keeps contributing its last flushed snapshot's
+  MONOTONIC totals (counters, histograms) — its already-served requests
+  must not vanish from service totals, exactly as a restarted pod's
+  Prometheus counters persist in recording rules — but its GAUGES are
+  aged out: a point-in-time reading of a process that no longer exists
+  is a lie (a crashed replica's last queue-depth would otherwise read
+  high forever after the supervisor respawns it). Liveness is a
+  zero-signal ``kill(pid, 0)`` probe against the snapshot's recorded
+  pid — same-host by construction, since the snapshot dir is the
+  serving process's own — cross-checked against the recorded
+  ``/proc/<pid>/stat`` start time so a RECYCLED pid can never
+  resurrect a dead worker's gauges.
 """
 from __future__ import annotations
 
@@ -54,6 +63,23 @@ def _snapshot_path(directory: str | Path, pid: int) -> Path:
     return Path(directory) / f"{SNAPSHOT_PREFIX}{pid}.json"
 
 
+def _pid_start(pid: int) -> int | None:
+    """The kernel's process start time (jiffies since boot, field 22 of
+    ``/proc/<pid>/stat``) — what makes the liveness probe PID-REUSE
+    proof: a recycled pid carries a different start time, so a dead
+    worker's gauges can never be resurrected by an unrelated process
+    inheriting its pid. None off-procfs (the probe then degrades to the
+    existence check alone)."""
+    try:
+        stat = Path(f"/proc/{pid}/stat").read_bytes()
+        # split after the comm field (parenthesised, may embed spaces):
+        # the remaining fields start at field 3, so starttime (22) is
+        # the 20th of them
+        return int(stat.rsplit(b")", 1)[1].split()[19])
+    except (OSError, IndexError, ValueError):
+        return None
+
+
 def write_snapshot(registry: Registry, directory: str | Path,
                    pid: int | None = None) -> Path:
     """Atomically persist one process's snapshot (tmp file + rename, so a
@@ -64,7 +90,11 @@ def write_snapshot(registry: Registry, directory: str | Path,
     # may delete it at teardown — a worker's final flush racing that
     # deletion must fail (caught by the flusher) rather than resurrect
     # the directory and leak it
-    payload = json.dumps({"pid": pid, "snapshot": registry.snapshot()})
+    payload = json.dumps({
+        "pid": pid,
+        "pid_start": _pid_start(pid),
+        "snapshot": registry.snapshot(),
+    })
     fd, tmp = tempfile.mkstemp(dir=directory, prefix=".obs-tmp-")
     try:
         with os.fdopen(fd, "w") as f:
@@ -80,12 +110,54 @@ def write_snapshot(registry: Registry, directory: str | Path,
         raise
 
 
+def _pid_alive(pid: int) -> bool:
+    """Zero-signal liveness probe. PermissionError means the pid exists
+    under another uid — alive; only ProcessLookupError means gone."""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except (PermissionError, OSError):
+        return True
+    return True
+
+
+def _age_out_dead(payload: dict) -> dict:
+    """A DEAD worker's snapshot contributes its monotonic totals only:
+    counters and histograms persist (served requests must not vanish
+    from service totals), gauges are dropped (a dead process's
+    point-in-time readings — queue depth, watchdog state — would
+    otherwise poison the merged view forever; the module docstring's
+    stale-worker rule). Dead = the pid is gone OR its recorded start
+    time no longer matches (pid recycled to an unrelated process)."""
+    pid = payload.get("pid")
+    snap = payload["snapshot"]
+    if isinstance(pid, int):
+        alive = _pid_alive(pid)
+        if alive:
+            recorded = payload.get("pid_start")
+            current = _pid_start(pid)
+            if (
+                recorded is not None
+                and current is not None
+                and recorded != current
+            ):
+                alive = False  # pid reused by a different process
+        if not alive:
+            return {
+                name: entry for name, entry in snap.items()
+                if entry.get("type") != "gauge"
+            }
+    return snap
+
+
 def read_sibling_snapshots(
     directory: str | Path, exclude_pid: int | None = None
 ) -> list[dict]:
     """Every flushed snapshot in ``directory`` except ``exclude_pid``'s
-    own file. Unreadable/torn files are skipped (a worker mid-first-flush
-    must not fail the whole scrape)."""
+    own file, with dead workers' gauges aged out (:func:`_age_out_dead`).
+    Unreadable/torn files are skipped (a worker mid-first-flush must not
+    fail the whole scrape)."""
     directory = Path(directory)
     if not directory.is_dir():
         return []
@@ -97,8 +169,8 @@ def read_sibling_snapshots(
             continue
         try:
             payload = json.loads(path.read_text())
-            snaps.append(payload["snapshot"])
-        except (OSError, ValueError, KeyError):
+            snaps.append(_age_out_dead(payload))
+        except (OSError, ValueError, KeyError, TypeError):
             continue
     return snaps
 

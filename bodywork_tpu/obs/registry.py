@@ -209,23 +209,34 @@ class Histogram(_Metric):
         # per-bucket NON-cumulative counts + sum + count; rendered
         # cumulatively (the snapshot keeps them additive for merging —
         # cumulative counts also merge additively, but non-cumulative is
-        # harder to mis-merge)
+        # harder to mis-merge). "exemplars" holds the LAST exemplar
+        # (trace id + observed value) per bucket, None where never set —
+        # the tie from a fat latency bucket to a replayable trace
+        # (docs/OBSERVABILITY.md tracing section).
         return {
             "buckets": [0] * (len(self.buckets) + 1),  # +1: the +Inf bucket
             "sum": 0.0,
             "count": 0,
+            "exemplars": [None] * (len(self.buckets) + 1),
         }
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: str | None = None, **labels) -> None:
+        """Record one observation; ``exemplar`` (a trace id) is kept as
+        the bucket's last exemplar. Exemplar-less observes leave the
+        slot untouched — unsampled requests cost nothing extra here."""
         value = float(value)
         with self._lock:
             sample = self._sample(labels)
             for i, bound in enumerate(self.buckets):
                 if value <= bound:
-                    sample["buckets"][i] += 1
                     break
             else:
-                sample["buckets"][-1] += 1
+                i = len(self.buckets)
+            sample["buckets"][i] += 1
+            if exemplar is not None:
+                sample["exemplars"][i] = {
+                    "trace_id": exemplar, "value": value,
+                }
             sample["sum"] += value
             sample["count"] += 1
 
@@ -241,15 +252,35 @@ class Histogram(_Metric):
 
     def snapshot_samples(self) -> list[dict]:
         with self._lock:
-            return [
-                {
+            out = []
+            for k, v in self._samples.items():
+                entry = {
                     "labels": dict(k),
                     "buckets": list(v["buckets"]),
                     "sum": v["sum"],
                     "count": v["count"],
                 }
-                for k, v in self._samples.items()
-            ]
+                if any(e is not None for e in v["exemplars"]):
+                    entry["exemplars"] = [
+                        dict(e) if e is not None else None
+                        for e in v["exemplars"]
+                    ]
+                out.append(entry)
+            return out
+
+    def exemplars(self, **labels) -> dict[str, str]:
+        """``{bucket upper bound: trace id}`` for every bucket holding an
+        exemplar — the /healthz view tying fat buckets to traces."""
+        with self._lock:
+            sample = self._peek(labels)
+            if sample is None:
+                return {}
+            bounds = [_fmt_value(b) for b in self.buckets] + ["+Inf"]
+            return {
+                bounds[i]: e["trace_id"]
+                for i, e in enumerate(sample["exemplars"])
+                if e is not None
+            }
 
 
 class Registry:
@@ -413,6 +444,22 @@ def render_snapshot(snapshot: dict) -> str:
                     f"{name}_count{_fmt_labels(sample['labels'])}"
                     f" {sample['count']}"
                 )
+                # exemplar annotations (comment lines: the 0.0.4 text
+                # format has no native exemplar syntax, so they ride as
+                # parser-invisible comments — docs/OBSERVABILITY.md) —
+                # the last trace id observed into each bucket
+                exemplars = sample.get("exemplars")
+                if exemplars:
+                    le_bounds = [_fmt_value(b) for b in bounds] + ["+Inf"]
+                    for i, exemplar in enumerate(exemplars):
+                        if exemplar is None:
+                            continue
+                        lines.append(
+                            f"# EXEMPLAR {name}_bucket"
+                            f"{_fmt_labels(sample['labels'], {'le': le_bounds[i]})}"
+                            f" trace_id={exemplar['trace_id']}"
+                            f" value={_fmt_value(exemplar['value'])}"
+                        )
         else:
             for sample in entry["samples"]:
                 lines.append(
@@ -467,6 +514,23 @@ def merge_snapshots(snapshots: list[dict]) -> dict:
                     ]
                     existing["sum"] += sample["sum"]
                     existing["count"] += sample["count"]
+                    # exemplars: any contributor's exemplar beats none;
+                    # between two, the later-merged snapshot wins (the
+                    # semantics are "the LAST trace seen per bucket" and
+                    # merge inputs carry no ordering evidence)
+                    incoming = sample.get("exemplars")
+                    if incoming:
+                        current = existing.get("exemplars")
+                        if current is None:
+                            existing["exemplars"] = [
+                                dict(e) if e is not None else None
+                                for e in incoming
+                            ]
+                        else:
+                            existing["exemplars"] = [
+                                (dict(b) if b is not None else a)
+                                for a, b in zip(current, incoming)
+                            ]
                 elif entry["type"] == "counter":
                     existing["value"] += sample["value"]
                 else:  # gauge

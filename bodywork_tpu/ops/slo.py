@@ -44,11 +44,24 @@ Determinism: verdicts are pure functions of the window's metric deltas
 same abort at the same poll — the property the canary chaos acceptance
 (``chaos/canary.py``) pins.
 
+Every verdict additionally ships its evidence: the tracer's in-process
+flight recorder (``obs/tracing.py`` — the ring buffer of completed
+sampled request traces) is dumped to the store under ``obs/flightrec/``
+at each abort AND promote, so an auto-rollback arrives with the very
+requests that convicted the canary (firewall-fallback child spans
+included) and an auto-promote with the healthy window that acquitted
+it. The dump key lands in the published watchdog state (``/healthz``
+``watchdog.flight_record``) and the SLO runbook starts from it
+(docs/RESILIENCE.md §9). A dump failure is logged and swallowed — the
+CAS verdict must never block on evidence I/O.
+
 Metrics: ``bodywork_tpu_slo_watchdog_state`` (0 idle / 1 watching / 2
 breached), ``bodywork_tpu_slo_burn_rate_ratio``,
 ``bodywork_tpu_slo_p99_latency_ratio``,
 ``bodywork_tpu_slo_breaches_total{reason}``,
-``bodywork_tpu_slo_canary_promotions_total`` (docs/OBSERVABILITY.md).
+``bodywork_tpu_slo_canary_promotions_total``,
+``bodywork_tpu_flight_record_dumps_total{verdict}``
+(docs/OBSERVABILITY.md).
 """
 from __future__ import annotations
 
@@ -326,6 +339,11 @@ class SloWatchdog:
             "bodywork_tpu_slo_canary_promotions_total",
             "Canaries auto-promoted after surviving their window healthy",
         )
+        self._m_dumps = reg.counter(
+            "bodywork_tpu_flight_record_dumps_total",
+            "Flight-recorder dumps written to obs/flightrec/ at watchdog "
+            "verdicts, by verdict (abort|promote)",
+        )
         self._g_state.set(STATE_IDLE)
 
     # -- state -------------------------------------------------------------
@@ -515,6 +533,45 @@ class SloWatchdog:
         self._publish(state)
         return None
 
+    def _dump_flight_record(self, verdict: str, reason: str,
+                            canary_key: str, window: dict | None) -> str | None:
+        """Persist the tracer's flight recorder at a verdict — each
+        auto-rollback (and promote) ships the sampled request traces
+        that decided it. Best-effort by design: evidence I/O must never
+        block or fail the one-CAS verdict itself."""
+        from bodywork_tpu.obs.tracing import (
+            flight_record_doc,
+            get_tracer,
+            write_flight_record,
+        )
+
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return None
+        try:
+            doc = flight_record_doc(
+                tracer.recorder.snapshot(),
+                verdict=verdict,
+                reason=reason,
+                canary_key=canary_key,
+                production_key=self.apps[0].model_key,
+                window=window,
+                sampling={
+                    "seed": tracer.seed,
+                    "fraction": tracer.sample_fraction,
+                },
+            )
+            key = write_flight_record(self.store, doc)
+        except Exception as exc:  # noqa: BLE001 — evidence, not verdict
+            log.error(f"flight-record dump failed: {exc!r}")
+            return None
+        self._m_dumps.inc(verdict=verdict)
+        log.info(
+            f"flight record: {doc['n_traces']} trace(s) -> {key} "
+            f"({verdict}: {reason})"
+        )
+        return key
+
     def _abort(self, canary_key: str, reason: str, state: dict,
                window: dict) -> str:
         """The breach action: ONE CAS retiring the canary + immediate
@@ -534,13 +591,16 @@ class SloWatchdog:
             log.warning("canary abort lost the alias race (already applied)")
         for app in self.apps:
             app.clear_canary()
+        dump_key = self._dump_flight_record(
+            "abort", reason, canary_key, state.get("window")
+        )
         self._m_breaches.inc(reason=reason)
         self._g_state.set(STATE_BREACHED)
         self._canary_key = None
         self._snapshots = []
         self._publish({
             **state, "state": "breached", "verdict": reason,
-            "detail": detail,
+            "detail": detail, "flight_record": dump_key,
         })
         return "abort"
 
@@ -567,11 +627,16 @@ class SloWatchdog:
             return None
         for app in self.apps:
             app.promote_canary_bundle()
+        dump_key = self._dump_flight_record(
+            "promote", "healthy window survived", canary_key,
+            state.get("window"),
+        )
         self._m_promotions.inc()
         self._g_state.set(STATE_IDLE)
         self._canary_key = None
         self._snapshots = []
         self._publish({
             **state, "state": "promoted", "verdict": "healthy",
+            "flight_record": dump_key,
         })
         return "promote"

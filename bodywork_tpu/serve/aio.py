@@ -59,6 +59,12 @@ import numpy as np
 from werkzeug.exceptions import MethodNotAllowed, NotFound
 
 from bodywork_tpu.obs import get_registry
+from bodywork_tpu.obs.tracing import (
+    TRACE_ID_HEADER,
+    TRACEPARENT_HEADER,
+    get_tracer,
+    parse_traceparent,
+)
 from bodywork_tpu.serve.admission import count_shed
 from bodywork_tpu.serve.app import (
     MODEL_KEY_HEADER,
@@ -256,7 +262,7 @@ class AioScoringServer:
                     busy = True
                 method, path, headers, body = request
                 status, payload, content_type, extra = await self._dispatch(
-                    method, path, body
+                    method, path, headers, body
                 )
                 keep_alive = headers.get("connection", "").lower() != "close"
                 writer.write(self._encode_response(
@@ -279,7 +285,8 @@ class AioScoringServer:
                 pass
 
     # -- dispatch ----------------------------------------------------------
-    async def _dispatch(self, method: str, path: str, body: bytes):
+    async def _dispatch(self, method: str, path: str, headers: dict,
+                        body: bytes):
         """Route one request. Returns ``(status, body_bytes,
         content_type, extra_headers)``. Mirrors ``ScoringApp.__call__``'s
         routing/metrics semantics so dashboards see one request stream
@@ -306,6 +313,23 @@ class AioScoringServer:
                 )
         t0 = time.perf_counter()
         scoring = path in ("/score/v1", "/score/v1/batch")
+        # request-scoped tracing: same mint/sampling as the WSGI engine
+        # (obs.tracing — the id is a pure function of (seed, body), so
+        # one request traces identically on either front-end). Before
+        # admission only an ingress traceparent creates a context (one
+        # header lookup); minting from the body happens in
+        # _score_common AFTER admission, so a shed never pays the hash
+        # — the holder lets the handler publish the minted trace back
+        # to this frame for the finish/header step.
+        tracer = get_tracer()
+        traced = scoring and method == "POST" and tracer.enabled
+        trace_box: list = [None]
+        if traced:
+            traceparent = headers.get(TRACEPARENT_HEADER)
+            if traceparent is not None and (
+                parse_traceparent(traceparent) is not None
+            ):
+                trace_box[0] = tracer.begin(traceparent, b"")
         routes = {
             ("POST", "/score/v1"): self._score_single,
             ("POST", "/score/v1/batch"): self._score_batch,
@@ -327,7 +351,9 @@ class AioScoringServer:
                     (),
                 )
             else:
-                status, payload, content_type, extra = await handler(app, body)
+                status, payload, content_type, extra = await handler(
+                    app, body, trace_box if traced else None
+                )
         except Exception as exc:  # don't leak tracebacks to clients
             log.error(f"unhandled error serving {path}: {exc!r}")
             status, payload, content_type, extra = (
@@ -339,8 +365,18 @@ class AioScoringServer:
         app._m_requests.inc(
             route=path if known_path else "unknown", status=str(status)
         )
+        trace = trace_box[0]
         if scoring and status == 200:
-            app._m_latency.observe(time.perf_counter() - t0)
+            app._m_latency.observe(
+                time.perf_counter() - t0,
+                exemplar=(
+                    trace.trace_id
+                    if trace is not None and trace.sampled else None
+                ),
+            )
+        if trace is not None:
+            tracer.finish(trace, path if known_path else "unknown", status)
+            extra = tuple(extra) + ((TRACE_ID_HEADER, trace.trace_id),)
         return status, payload, content_type, extra
 
     def _chaos_decision(self, path: str):
@@ -357,17 +393,27 @@ class AioScoringServer:
             count_shed("chaos")
         return status, delay, plan.http_retry_after_s
 
-    async def _score_common(self, app, body, score):
+    async def _score_common(self, app, body, score, trace_box=None):
         """The shared scoring-request shell: admission, parse, canary
         routing, no-model 503, per-stream accounting — then the
         per-route ``score`` coroutine. (Chaos HTTP injection happens
         upstream in ``_dispatch``, middleware-style; the canary-stream
         latency injection happens HERE, awaited so the loop never
-        stalls.)"""
+        stalls.) ``trace_box`` is ``_dispatch``'s one-slot trace holder:
+        pre-admission it carries only an ingress-traceparent context;
+        an ADMITTED request without one mints its deterministic id here
+        — after admission, so sheds never pay the body hash."""
+        trace = trace_box[0] if trace_box is not None else None
         admission = self.admission
         if admission is not None and not admission.try_admit():
             # shed BEFORE parsing: a refused request costs one counter
             # increment and ~200 bytes of response
+            if trace is not None and trace.sampled:
+                now = time.perf_counter()
+                trace.add(
+                    "admission-shed", now, now,
+                    queue_depth=admission.queue_depth,
+                )
             return (
                 429,
                 json.dumps(
@@ -376,6 +422,9 @@ class AioScoringServer:
                 "application/json",
                 (("Retry-After", str(admission.retry_after_s())),),
             )
+        if trace_box is not None and trace is None:
+            trace = trace_box[0] = get_tracer().begin(None, body)
+        sampled = trace is not None and trace.sampled
         t_admit = time.perf_counter()
         try:
             t0 = time.perf_counter()
@@ -384,7 +433,10 @@ class AioScoringServer:
             except ValueError:
                 payload = None
             X, message = parse_features(payload)
-            app._m_parse.observe(time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            app._m_parse.observe(t1 - t0)
+            if sampled:
+                trace.add("parse", t0, t1)
             if message is not None:
                 return (
                     400,
@@ -405,6 +457,10 @@ class AioScoringServer:
                     (("Retry-After", str(app.retry_after_s())),),
                 )
             streamed = app.stream_metrics_active()
+            if sampled:
+                trace.annotate(
+                    stream=stream, routed_model_key=served.model_key
+                )
             t_stream = time.perf_counter()
             if streamed:
                 app.count_stream_request(served, stream)
@@ -412,22 +468,25 @@ class AioScoringServer:
             if delay is not None:
                 await asyncio.sleep(delay)
             try:
-                result = await score(app, served, stream, X)
+                result = await score(app, served, stream, X, trace)
             except Exception:
                 if streamed:
                     app.count_stream_error(served, stream)
                 raise
             if streamed:
                 app.observe_stream_latency(
-                    served, stream, time.perf_counter() - t_stream
+                    served, stream, time.perf_counter() - t_stream,
+                    exemplar=trace.trace_id if sampled else None,
                 )
             return result
         finally:
             if admission is not None:
                 admission.release(time.perf_counter() - t_admit)
 
-    async def _score_single(self, app: ScoringApp, body: bytes):
-        async def score(app, served, stream, X):
+    async def _score_single(self, app: ScoringApp, body: bytes,
+                            trace_box=None):
+        async def score(app, served, stream, X, trace):
+            sampled = trace is not None and trace.sampled
             X = np.array(X, ndmin=2)  # scalar -> (1, 1), as the reference
             loop = asyncio.get_running_loop()
             prediction0 = None
@@ -451,7 +510,10 @@ class AioScoringServer:
                         pass
 
                 try:
-                    app.batcher.submit_nowait(served, X[0], on_done=_resolve)
+                    app.batcher.submit_nowait(
+                        served, X[0], on_done=_resolve,
+                        trace=trace if sampled else None,
+                    )
                 except CoalescerSaturated:
                     app._m_fallbacks.inc()
                 else:
@@ -474,7 +536,10 @@ class AioScoringServer:
                     self._executor, served.predictor.predict, X
                 )
                 prediction0 = float(predictions[0])
-                app._m_dispatch.observe(time.perf_counter() - t0)
+                t1 = time.perf_counter()
+                app._m_dispatch.observe(t1 - t0)
+                if sampled:
+                    trace.add("device-dispatch", t0, t1, coalesced=False)
             # prediction-sanity firewall: the cheap precheck runs inline
             # (pure numpy on one float); the fallback dispatch — a device
             # call — rides the executor so the loop never blocks on it
@@ -483,23 +548,29 @@ class AioScoringServer:
                 served, fallback = await loop.run_in_executor(
                     self._executor,
                     app.firewall, served, stream, X, prediction0, reason,
+                    trace,
                 )
                 prediction0 = float(np.asarray(fallback).ravel()[0])
             t0 = time.perf_counter()
             payload = json.dumps(
                 single_score_payload(served, prediction0)
             ).encode()
-            app._m_serialize.observe(time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            app._m_serialize.observe(t1 - t0)
+            if sampled:
+                trace.add("serialize", t0, t1)
             extra = (
                 ((MODEL_KEY_HEADER, served.model_key),)
                 if served.model_key else ()
             )
             return 200, payload, "application/json", extra
 
-        return await self._score_common(app, body, score)
+        return await self._score_common(app, body, score, trace_box)
 
-    async def _score_batch(self, app: ScoringApp, body: bytes):
-        async def score(app, served, stream, X):
+    async def _score_batch(self, app: ScoringApp, body: bytes,
+                           trace_box=None):
+        async def score(app, served, stream, X, trace):
+            sampled = trace is not None and trace.sampled
             if X.ndim == 0:
                 X = X[None]
             loop = asyncio.get_running_loop()
@@ -507,27 +578,34 @@ class AioScoringServer:
             predictions = await loop.run_in_executor(
                 self._executor, served.predictor.predict, X
             )
-            app._m_dispatch.observe(time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            app._m_dispatch.observe(t1 - t0)
+            if sampled:
+                trace.add("device-dispatch", t0, t1, coalesced=False)
             reason = app.sanity_reason(served, predictions)
             if reason is not None:
                 served, predictions = await loop.run_in_executor(
                     self._executor,
                     app.firewall, served, stream, X, predictions, reason,
+                    trace,
                 )
             t0 = time.perf_counter()
             payload = json.dumps(
                 batch_score_payload(served, predictions)
             ).encode()
-            app._m_serialize.observe(time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            app._m_serialize.observe(t1 - t0)
+            if sampled:
+                trace.add("serialize", t0, t1)
             extra = (
                 ((MODEL_KEY_HEADER, served.model_key),)
                 if served.model_key else ()
             )
             return 200, payload, "application/json", extra
 
-        return await self._score_common(app, body, score)
+        return await self._score_common(app, body, score, trace_box)
 
-    async def _healthz(self, app: ScoringApp, body: bytes):
+    async def _healthz(self, app: ScoringApp, body: bytes, trace_box=None):
         payload, status, retry_after = app.healthz_payload()
         extra = (
             (("Retry-After", str(retry_after)),) if retry_after is not None
@@ -535,7 +613,7 @@ class AioScoringServer:
         )
         return status, json.dumps(payload).encode(), "application/json", extra
 
-    async def _metrics(self, app: ScoringApp, body: bytes):
+    async def _metrics(self, app: ScoringApp, body: bytes, trace_box=None):
         from bodywork_tpu.obs.multiproc import aggregated_render
 
         loop = asyncio.get_running_loop()

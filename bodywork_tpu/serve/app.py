@@ -53,6 +53,14 @@ from werkzeug.wrappers import Request, Response
 
 from bodywork_tpu.models.base import Regressor
 from bodywork_tpu.obs import get_registry
+from bodywork_tpu.obs.tracing import (
+    TRACE_ID_HEADER,
+    TRACEPARENT_HEADER,
+    get_tracer,
+    parse_traceparent,
+    reset_active_span,
+    set_active_span,
+)
 from bodywork_tpu.serve.batcher import CoalescerSaturated
 from bodywork_tpu.serve.predictor import PaddedPredictor
 from bodywork_tpu.utils.logging import get_logger
@@ -293,6 +301,12 @@ class ScoringApp:
         #: shared snapshot dir for multi-worker /metrics aggregation
         #: (serve.multiproc); None = this process's registry alone
         self.metrics_dir = metrics_dir
+        #: the process-wide request tracer (obs.tracing): scoring
+        #: requests get a W3C-compatible trace id (ingress traceparent
+        #: or deterministically minted), head-sampled spans, and the
+        #: X-Bodywork-Trace-Id response header. Fraction 0 = off,
+        #: zero per-request work.
+        self.tracer = get_tracer()
         # hot-path phase instrumentation (obs.registry; the registry is
         # process-global, so replica apps in one process share metrics —
         # exactly as one k8s pod exposes one scrape target)
@@ -628,9 +642,11 @@ class ScoringApp:
             model_key=served.model_key or "unknown", stream=stream
         )
 
-    def observe_stream_latency(self, served, stream: str, seconds: float) -> None:
+    def observe_stream_latency(self, served, stream: str, seconds: float,
+                               exemplar: str | None = None) -> None:
         self._m_stream_latency.observe(
-            seconds, model_key=served.model_key or "unknown", stream=stream
+            seconds, exemplar=exemplar,
+            model_key=served.model_key or "unknown", stream=stream,
         )
 
     def sanity_reason(self, served, predictions) -> str | None:
@@ -646,7 +662,8 @@ class ScoringApp:
             reason=reason,
         )
 
-    def firewall(self, served, stream: str, X, predictions, reason: str):
+    def firewall(self, served, stream: str, X, predictions, reason: str,
+                 trace=None):
         """Apply the prediction-sanity firewall AFTER a violation was
         detected: a canary violation is answered from the PRODUCTION
         model (counted — the violation is the watchdog's abort signal —
@@ -656,7 +673,10 @@ class ScoringApp:
         healthier model to answer from); a production out-of-range is
         counted and served (the band is statistical; refusing real
         production traffic on it would turn a drifted day into an
-        outage). Returns ``(answering_bundle, predictions)``."""
+        outage). Returns ``(answering_bundle, predictions)``. A sampled
+        ``trace`` records the fallback re-predict as a child span — the
+        flight-recorder evidence that a canary request was answered by
+        production."""
         self.count_sanity_violation(served, stream, reason)
         if stream == "canary":
             production = self._served
@@ -670,7 +690,15 @@ class ScoringApp:
             # predictor's own shape normalisation applies, so fallback
             # predictions are byte-identical to a production-routed call
             fallback = production.predictor.predict(X)
-            self._m_dispatch.observe(time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            self._m_dispatch.observe(t1 - t0)
+            if trace is not None and trace.sampled:
+                trace.add(
+                    "firewall-fallback", t0, t1,
+                    reason=reason,
+                    violating_model_key=served.model_key,
+                    answered_by=production.model_key,
+                )
             if sanity_violation(fallback, None) is not None:
                 # production's answer is itself non-finite: nothing sane
                 # to serialize — the zero-garbage guarantee holds by 500
@@ -725,6 +753,25 @@ class ScoringApp:
     def __call__(self, environ, start_response):
         request = Request(environ)
         t0 = time.perf_counter()
+        scoring_post = (
+            request.method == "POST" and request.path in _SCORING_ROUTES
+        )
+        # request-scoped tracing (obs.tracing). BEFORE admission only a
+        # request that ARRIVED with a valid traceparent gets a context
+        # (one header lookup — its id needs no body); minting for the
+        # rest happens AFTER admission, so a shed request never reads or
+        # hashes its body and the zero-footprint shed invariant below
+        # holds. Traceparent-carrying sheds still answer with their id
+        # and record the shed span. Fraction 0 skips all of it.
+        trace = None
+        tracer = self.tracer
+        traced = scoring_post and tracer.enabled
+        if traced:
+            traceparent = request.headers.get(TRACEPARENT_HEADER)
+            if traceparent is not None and (
+                parse_traceparent(traceparent) is not None
+            ):
+                trace = tracer.begin(traceparent, b"")
         # admission runs FIRST — before parsing, before the no-model
         # check, before anything that costs per-request work. A shed
         # request must leave zero footprint beyond its counter: that is
@@ -732,25 +779,37 @@ class ScoringApp:
         # admitted queue instead of drowning with it.
         admission = self.admission
         admitted = False
-        if (
-            admission is not None
-            and request.method == "POST"
-            and request.path in _SCORING_ROUTES
-        ):
+        if admission is not None and scoring_post:
             if not admission.try_admit():
                 response = self.shed_response()
+                if trace is not None:
+                    if trace.sampled:
+                        now = time.perf_counter()
+                        trace.add(
+                            "admission-shed", now, now,
+                            queue_depth=admission.queue_depth,
+                        )
+                    tracer.finish(trace, request.path, response.status_code)
+                    response.headers[TRACE_ID_HEADER] = trace.trace_id
                 self._m_requests.inc(
                     route=request.path, status=str(response.status_code)
                 )
                 return response(environ, start_response)
             admitted = True
+        if traced and trace is None:
+            # admitted without ingress context: mint deterministically
+            # from the body bytes (the same buffered bytes get_json
+            # reads later — werkzeug caches, so no second socket read)
+            trace = tracer.begin(
+                None, request.get_data(cache=True, parse_form_data=False)
+            )
         try:
             handler = self._routes.get((request.method, request.path))
             if handler is None:
                 if any(path == request.path for _m, path in self._routes):
                     raise MethodNotAllowed()
                 raise NotFound()
-            response = handler(request)
+            response = handler(request, trace)
         except HTTPException as exc:
             response = _json_response({"error": exc.description}, exc.code)
         except Exception as exc:  # don't leak tracebacks to clients
@@ -769,8 +828,20 @@ class ScoringApp:
         self._m_requests.inc(route=route, status=str(response.status_code))
         if request.path in _SCORING_ROUTES and response.status_code == 200:
             # count == requests successfully scored (the invariant the
-            # bench cross-checks against client-side latencies)
-            self._m_latency.observe(time.perf_counter() - t0)
+            # bench cross-checks against client-side latencies); sampled
+            # requests leave their trace id as the bucket's exemplar
+            self._m_latency.observe(
+                time.perf_counter() - t0,
+                exemplar=(
+                    trace.trace_id
+                    if trace is not None and trace.sampled else None
+                ),
+            )
+        if trace is not None:
+            tracer.finish(trace, route, response.status_code)
+            # the id rides ONLY this header, never a body — the chaos
+            # comparator ignores it exactly like the model-key header
+            response.headers[TRACE_ID_HEADER] = trace.trace_id
         return response(environ, start_response)
 
     def test_client(self):
@@ -779,12 +850,15 @@ class ScoringApp:
         return Client(self)
 
     # -- shared parsing ----------------------------------------------------
-    def _features_from(self, request: Request):
+    def _features_from(self, request: Request, trace=None):
         t0 = time.perf_counter()
         try:
             return self._parse_features(request)
         finally:
-            self._m_parse.observe(time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            self._m_parse.observe(t1 - t0)
+            if trace is not None and trace.sampled:
+                trace.add("parse", t0, t1)
 
     def _parse_features(self, request: Request):
         X, message = parse_features(request.get_json(silent=True))
@@ -817,10 +891,10 @@ class ScoringApp:
         return response
 
     # -- routes ------------------------------------------------------------
-    def score_data_instance(self, request: Request) -> Response:
+    def score_data_instance(self, request: Request, trace=None) -> Response:
         """Single-instance scoring; reference-parity contract
         (``stage_2:73-80``)."""
-        X, err = self._features_from(request)
+        X, err = self._features_from(request, trace)
         if err is not None:
             # validation precedes the no-model check: a malformed request
             # can never succeed, so it must get its non-retryable 400
@@ -834,6 +908,9 @@ class ScoringApp:
             return self._no_model_response()
         routed = served  # metrics stay attributed to the ROUTED bundle
         streamed = self.stream_metrics_active()
+        sampled = trace is not None and trace.sampled
+        if sampled:
+            trace.annotate(stream=stream, routed_model_key=served.model_key)
         t_stream = time.perf_counter()
         if streamed:
             self.count_stream_request(routed, stream)
@@ -848,23 +925,26 @@ class ScoringApp:
                     # (canary rows batch with canary rows), and the
                     # response pairs that generation's prediction with
                     # that generation's identity fields below. Queue-wait
-                    # and device-dispatch phases are recorded by the
-                    # coalescer.
-                    prediction0 = self.batcher.submit(served, X[0])
+                    # and device-dispatch phases (and their spans, for a
+                    # sampled request) are recorded by the coalescer.
+                    prediction0 = self.batcher.submit(
+                        served, X[0], trace=trace if sampled else None
+                    )
                 except CoalescerSaturated:
                     # overload/shutdown: degrade to a direct dispatch
                     self._m_fallbacks.inc()
             if prediction0 is None:
-                t0 = time.perf_counter()
-                prediction0 = float(served.predictor.predict(X)[0])
-                self._m_dispatch.observe(time.perf_counter() - t0)
+                prediction0, _ = self._traced_dispatch(
+                    served, X, trace if sampled else None
+                )
+                prediction0 = float(np.asarray(prediction0).ravel()[0])
             # the prediction-sanity firewall: BEFORE serialization, on
             # every path (coalesced included) — a violating value never
             # reaches a client
             reason = self.sanity_reason(served, prediction0)
             if reason is not None:
                 served, fallback = self.firewall(
-                    served, stream, X, prediction0, reason
+                    served, stream, X, prediction0, reason, trace=trace
                 )
                 prediction0 = float(np.asarray(fallback).ravel()[0])
         except Exception:
@@ -873,7 +953,10 @@ class ScoringApp:
             raise
         t0 = time.perf_counter()
         response = _json_response(single_score_payload(served, prediction0))
-        self._m_serialize.observe(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        self._m_serialize.observe(t1 - t0)
+        if sampled:
+            trace.add("serialize", t0, t1)
         if served.model_key:
             # the ANSWERING model (post-fallback) — what the traffic
             # harness attributes the response to
@@ -882,13 +965,34 @@ class ScoringApp:
             # latency stays on the routed stream: a fallen-back canary
             # request still COST its caller the canary's time
             self.observe_stream_latency(
-                routed, stream, time.perf_counter() - t_stream
+                routed, stream, time.perf_counter() - t_stream,
+                exemplar=trace.trace_id if sampled else None,
             )
         return response
 
-    def score_batch(self, request: Request) -> Response:
+    def _traced_dispatch(self, served, X, trace):
+        """One direct (uncoalesced) padded device dispatch, with the
+        phase histogram observation both paths already made — plus, for
+        a sampled request, a device-dispatch span installed as the
+        ACTIVE span so the predictor's AOT-cache seam can annotate it
+        (obs.tracing.annotate_active)."""
+        span = token = None
+        if trace is not None:
+            span = trace.start_span("device-dispatch", coalesced=False)
+            token = set_active_span(span)
+        t0 = time.perf_counter()
+        try:
+            predictions = served.predictor.predict(X)
+        finally:
+            self._m_dispatch.observe(time.perf_counter() - t0)
+            if span is not None:
+                reset_active_span(token)
+                trace.end_span(span)
+        return predictions, span
+
+    def score_batch(self, request: Request, trace=None) -> Response:
         """Batched scoring: one padded device call for up to bucket-size rows."""
-        X, err = self._features_from(request)
+        X, err = self._features_from(request, trace)
         if err is not None:
             return err  # 400 before 503: see score_data_instance
         served, stream = self.route_stream(X)  # whole batch, one stream
@@ -896,6 +1000,12 @@ class ScoringApp:
             return self._no_model_response()
         routed = served
         streamed = self.stream_metrics_active()
+        sampled = trace is not None and trace.sampled
+        if sampled:
+            trace.annotate(
+                stream=stream, routed_model_key=served.model_key,
+                rows=int(np.atleast_1d(X).shape[0]),
+            )
         t_stream = time.perf_counter()
         if streamed:
             self.count_stream_request(routed, stream)
@@ -903,13 +1013,13 @@ class ScoringApp:
             X = X[None]
         try:
             self.apply_canary_chaos(stream)
-            t0 = time.perf_counter()
-            predictions = served.predictor.predict(X)
-            self._m_dispatch.observe(time.perf_counter() - t0)
+            predictions, _ = self._traced_dispatch(
+                served, X, trace if sampled else None
+            )
             reason = self.sanity_reason(served, predictions)
             if reason is not None:
                 served, predictions = self.firewall(
-                    served, stream, X, predictions, reason
+                    served, stream, X, predictions, reason, trace=trace
                 )
         except Exception:
             if streamed:
@@ -917,12 +1027,16 @@ class ScoringApp:
             raise
         t0 = time.perf_counter()
         response = _json_response(batch_score_payload(served, predictions))
-        self._m_serialize.observe(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        self._m_serialize.observe(t1 - t0)
+        if sampled:
+            trace.add("serialize", t0, t1)
         if served.model_key:
             response.headers[MODEL_KEY_HEADER] = served.model_key
         if streamed:
             self.observe_stream_latency(
-                routed, stream, time.perf_counter() - t_stream
+                routed, stream, time.perf_counter() - t_stream,
+                exemplar=trace.trace_id if sampled else None,
             )
         return response
 
@@ -967,6 +1081,7 @@ class ScoringApp:
                     "watchdog": self.slo_state,
                     "queue_depth": queue_depth,
                     "admission": admission_state,
+                    "latency_exemplars": self._m_latency.exemplars() or None,
                 },
                 503,
                 self.retry_after_s(),
@@ -1008,19 +1123,24 @@ class ScoringApp:
             # onto the siblings (readiness semantics, pipeline/k8s.py).
             "queue_depth": queue_depth,
             "admission": admission_state,
+            # tracing exemplars: the last sampled trace id per scoring-
+            # latency bucket — a probe reading a fat p99 bucket gets the
+            # trace id to replay through `cli trace show` (None when
+            # tracing is off or nothing sampled yet)
+            "latency_exemplars": self._m_latency.exemplars() or None,
         }
         if reason is not None:
             payload["reason"] = reason
         return payload, 200, None
 
-    def healthz(self, request: Request) -> Response:
+    def healthz(self, request: Request, trace=None) -> Response:
         payload, status, retry_after = self.healthz_payload()
         response = _json_response(payload, status)
         if retry_after is not None:
             response.headers["Retry-After"] = str(retry_after)
         return response
 
-    def metrics_endpoint(self, request: Request) -> Response:
+    def metrics_endpoint(self, request: Request, trace=None) -> Response:
         """Prometheus text exposition of this process's registry, merged
         with sibling workers' flushed snapshots when ``metrics_dir`` is
         set (``serve --workers N --metrics`` exposes ONE coherent view

@@ -74,13 +74,18 @@ class _Submission:
     the OPTIONAL push-style completion channel (fired on the dispatcher
     thread right after ``event`` is set): the asyncio front-end sets it
     to hand the result back to its event loop without parking a thread
-    on ``event.wait`` — the threaded engine keeps the blocking wait."""
+    on ``event.wait`` — the threaded engine keeps the blocking wait.
+    ``trace`` is the submitting request's SAMPLED span context (None for
+    unsampled/untraced requests — the common case pays one attribute):
+    the dispatcher records the queue-wait and the shared device-dispatch
+    span into each sampled member's trace, linked across the batch."""
 
     __slots__ = (
         "row", "served", "event", "result", "error", "enqueued_at", "on_done",
+        "trace", "enqueued_perf",
     )
 
-    def __init__(self, row: np.ndarray, served, on_done=None):
+    def __init__(self, row: np.ndarray, served, on_done=None, trace=None):
         self.row = row
         self.served = served
         self.event = threading.Event()
@@ -88,6 +93,10 @@ class _Submission:
         self.error: BaseException | None = None
         self.enqueued_at = time.monotonic()
         self.on_done = on_done
+        self.trace = trace
+        # perf_counter twin of enqueued_at: trace spans live on the
+        # perf_counter timeline (obs.tracing); only taken when traced
+        self.enqueued_perf = time.perf_counter() if trace is not None else 0.0
 
 
 class RequestCoalescer:
@@ -175,14 +184,17 @@ class RequestCoalescer:
             self._thread.join(timeout=10)
 
     # -- request path ------------------------------------------------------
-    def submit_nowait(self, served, row: np.ndarray, on_done=None) -> _Submission:
+    def submit_nowait(self, served, row: np.ndarray, on_done=None,
+                      trace=None) -> _Submission:
         """Enqueue one row WITHOUT waiting: returns the submission whose
         ``event`` (pull) or ``on_done`` callback (push — must be set
         HERE, before the enqueue, or the dispatcher can complete the
         batch first and the callback never fires) signals completion.
         The asyncio front-end's bridge into the coalescer; raises
-        :class:`CoalescerSaturated` exactly as :meth:`submit` does."""
-        sub = _Submission(np.asarray(row, dtype=np.float32), served, on_done)
+        :class:`CoalescerSaturated` exactly as :meth:`submit` does.
+        ``trace``: the request's sampled span context, or None."""
+        sub = _Submission(np.asarray(row, dtype=np.float32), served, on_done,
+                          trace)
         with self._cond:
             if self._stopped or not self._started:
                 self._m_saturated.inc()
@@ -204,13 +216,14 @@ class RequestCoalescer:
         with self._cond:
             return len(self._pending) + len(self._inflight)
 
-    def submit(self, served, row: np.ndarray, timeout_s: float = 60.0) -> float:
+    def submit(self, served, row: np.ndarray, timeout_s: float = 60.0,
+               trace=None) -> float:
         """Enqueue one ``(1, n_features)``-shaped row against ``served``
         (the app's immutable served-model bundle) and block until its
         prediction returns. Raises :class:`CoalescerSaturated` when the
         queue is full/stopped, or the batch's own error if the device
         call failed."""
-        sub = self.submit_nowait(served, row)
+        sub = self.submit_nowait(served, row, trace=trace)
         if not sub.event.wait(timeout_s):
             raise TimeoutError(
                 f"coalesced prediction not ready within {timeout_s:.0f}s"
@@ -293,14 +306,30 @@ class RequestCoalescer:
     def _execute(self, batch: list[_Submission]) -> None:
         served = batch[0].served
         now = time.monotonic()
+        t_exec = time.perf_counter()
         for sub in batch:
             self._m_queue_wait.observe(now - sub.enqueued_at)
         self._m_batch_rows.observe(len(batch))
+        # trace fan-in: each SAMPLED member gets its queue-wait span and
+        # the batch's shared device-dispatch span, the latter carrying
+        # every member's request span id as links — one coalesced
+        # dispatch explains N request traces (obs.tracing)
+        traced = [sub for sub in batch if sub.trace is not None]
+        links = [sub.trace.root_span_id for sub in traced]
         try:
             X = np.vstack([sub.row for sub in batch])
             t0 = time.perf_counter()
             predictions = served.predictor.predict(X)
-            self._m_dispatch.observe(time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            self._m_dispatch.observe(t1 - t0)
+            for sub in traced:
+                sub.trace.add(
+                    "queue-wait", sub.enqueued_perf, t_exec,
+                )
+                sub.trace.add(
+                    "device-dispatch", t0, t1,
+                    coalesced=True, batch_rows=len(batch), links=links,
+                )
             for i, sub in enumerate(batch):
                 sub.result = float(predictions[i])
         except BaseException as exc:  # scatter, don't kill the dispatcher

@@ -35,6 +35,7 @@ import time
 import numpy as np
 
 from bodywork_tpu.models.base import Regressor
+from bodywork_tpu.obs.tracing import annotate_active
 from bodywork_tpu.utils.logging import get_logger
 
 log = get_logger("serve.predictor")
@@ -265,7 +266,17 @@ class PaddedPredictor:
 
         handle = self._compiled.get((bucket, n_features))
         if handle is not None:
+            # the normal warmed case: this instance's own handle. The
+            # annotation is the tracing seam (obs.tracing) — a no-op
+            # contextvar read unless a sampled request's dispatch span
+            # is active.
+            annotate_active(aot_cache="warm", bucket=bucket)
             return handle
+        # first sight of this shape on THIS instance: the annotation
+        # below records whether the process-wide cache answered ("hit")
+        # or a lazy compile landed on the request path ("miss" — the
+        # warmup-bug signal the cache-miss counter also carries)
+        misses_before = EXECUTABLE_CACHE.misses
         fn = self._aot_fn()
         params = self._exec_params()
         key = (
@@ -291,6 +302,12 @@ class PaddedPredictor:
 
         handle = EXECUTABLE_CACHE.get(key, build)
         self._compiled[(bucket, n_features)] = handle
+        annotate_active(
+            aot_cache=(
+                "miss" if EXECUTABLE_CACHE.misses > misses_before else "hit"
+            ),
+            bucket=bucket,
+        )
         return handle
 
     def _predict_padded(self, Xp: np.ndarray) -> np.ndarray:
@@ -311,7 +328,14 @@ class PaddedPredictor:
         bucket's AOT executable, so the request path never compiles
         (a shape nobody warmed still works — it compiles here, counted
         as a cache miss). Engines/params that cannot AOT-cache
-        (``_aot_ok`` False) fall back to the per-class jit path."""
+        (``_aot_ok`` False) fall back to the per-class jit path.
+
+        A sampled request's active device-dispatch span (obs.tracing)
+        is annotated by ``_compiled_for`` with how the executable
+        resolved: ``warm`` (this instance's own handle — the normal
+        warmed case), ``hit`` (process-wide cache, first sight on this
+        instance), ``miss`` (lazily compiled ON the request path — the
+        warmup-bug signal the cache-miss counter also carries)."""
         if not self._aot_ok():
             return self._fallback_dispatch(Xp)
         return self._compiled_for(Xp.shape[0], Xp.shape[1])(

@@ -71,6 +71,19 @@ at ``quarantine/<original key>`` plus a metadata document
 Quarantine entries are EVIDENCE, written only through the CAS primitive
 and never deleted by the framework — retention is an operator decision
 (docs/RESILIENCE.md §11 runbook).
+
+``obs/flightrec/`` holds flight-recorder dumps (``obs/tracing.py``):
+one content-addressed JSON document per SLO-watchdog abort/promote
+verdict (schema ``bodywork_tpu.flight_record/1``) carrying the sampled
+request traces that were in flight when the verdict fired — the
+per-request evidence behind each auto-rollback. Delete safety: dumps
+are DIAGNOSTIC EVIDENCE, never consumed by serving, training, or any
+repair path — deleting the prefix only forfeits the forensic record of
+past verdicts (``cli trace`` goes dark for them); nothing rebuilds
+them, so treat the prefix like ``quarantine/``: cheap history whose
+retention is an operator decision. Dumps get a digest sidecar + replica
+via the audit layer (``PUT_SIDECAR_PREFIXES``) so at-rest rot is
+detectable and restorable.
 """
 from __future__ import annotations
 
@@ -93,6 +106,9 @@ REGISTRY_ALIAS_KEY = "registry/aliases.json"
 AUDIT_PREFIX = "audit/"
 AUDIT_DIGESTS_PREFIX = "audit/digests/"
 QUARANTINE_PREFIX = "quarantine/"
+#: flight-recorder dumps (obs/tracing.py) — diagnostic evidence; see
+#: the module docstring's delete-safety note
+FLIGHTREC_PREFIX = "obs/flightrec/"
 
 #: every prefix the store schema defines — and therefore every prefix
 #: the integrity scrubber must audit: the fsck checker registry
@@ -111,6 +127,7 @@ ALL_PREFIXES = (
     REGISTRY_PREFIX,
     AUDIT_PREFIX,
     QUARANTINE_PREFIX,
+    FLIGHTREC_PREFIX,
 )
 
 
@@ -198,3 +215,14 @@ def quarantine_meta_key(key: str) -> str:
     """The metadata document describing the quarantined bytes of
     ``key`` (finding kind, digest of the corrupt payload)."""
     return f"{QUARANTINE_PREFIX}{key}{QUARANTINE_META_SUFFIX}"
+
+
+def flight_record_key(seq: int, verdict: str, doc_digest: str) -> str:
+    """Where one flight-recorder dump lands. ``seq`` (the count of
+    dumps already stored — no wall clock, the chaos twins' determinism
+    discipline) leads the name so a lexicographic listing IS write
+    order; the content digest fragment keeps concurrent writers'
+    distinct documents collision-free, and the verdict reads at a
+    glance in an operator's listing."""
+    fragment = doc_digest.removeprefix("sha256:")[:16]
+    return f"{FLIGHTREC_PREFIX}flight-{seq:06d}-{verdict}-{fragment}.json"

@@ -66,6 +66,11 @@ class _Result:
     #: the X-Bodywork-Model-Key response header (which model ANSWERED —
     #: production, canary, or a firewall fallback); None when absent
     model_key: str | None = None
+    #: the X-Bodywork-Trace-Id response header (obs/tracing.py): the
+    #: server-side trace this request became — the join key between
+    #: client-observed latency and server-side spans; None when the
+    #: service runs tracing-off
+    trace_id: str | None = None
 
 
 def _percentile(sorted_vals: list, q: float) -> float | None:
@@ -105,6 +110,10 @@ class LoadReport:
     retry_after: dict      # {responses, mean_s, max_s} where the header appeared
     send_lag_p99_s: float | None
     max_in_flight: int
+    #: responses carrying an X-Bodywork-Trace-Id header — nonzero means
+    #: the service ran tracing-on and the results log (when written)
+    #: joins to server-side spans
+    traced_responses: int = 0
     #: latency/goodput broken down by the RESPONDING model key (the
     #: X-Bodywork-Model-Key header; "unknown" bucket when absent) — how
     #: a canary sweep attributes per-version behaviour with this harness
@@ -193,6 +202,7 @@ async def _http_transport(pool: _ConnectionPool, request: Request):
             status = int(parts[1])
             retry_after = None
             model_key = None
+            trace_id = None
             content_length = None
             keep_alive = True
             while True:
@@ -210,6 +220,10 @@ async def _http_transport(pool: _ConnectionPool, request: Request):
                     # which model version ANSWERED — the per-model-key
                     # report breakdown reads this (canary sweeps)
                     model_key = value.strip() or None
+                elif name == "x-bodywork-trace-id":
+                    # the server-side trace id (obs/tracing.py) — logged
+                    # per request so spans join to client latencies
+                    trace_id = value.strip() or None
                 elif name == "content-length":
                     try:
                         content_length = int(value.strip())
@@ -222,7 +236,7 @@ async def _http_transport(pool: _ConnectionPool, request: Request):
             # a response with no Content-Length would need a close/EOF
             # to delimit — never reusable
             reusable = keep_alive and content_length is not None
-            return status, retry_after, model_key
+            return status, retry_after, model_key, trace_id
         finally:
             pool.release(reader, writer, reusable)
     raise ConnectionResetError("unreachable")  # pragma: no cover
@@ -234,10 +248,18 @@ def run_open_loop(
     timeout_s: float = 30.0,
     transport=None,
     duration_s: float | None = None,
+    results_log: str | None = None,
 ) -> LoadReport:
     """Fire ``requests_log`` at its scheduled arrival times against
     ``url`` (scheme://host:port — any path component is ignored; each
     log entry carries its own route) and summarise the outcome.
+
+    ``results_log`` writes one JSONL record per request (scheduled
+    arrival, status, client-observed latency, send lag, answering model
+    key, and the server's returned trace id) — the join table between
+    this harness's client-side latencies and the server-side spans a
+    flight-recorder dump or ``cli trace show`` holds for the same trace
+    id (obs/tracing.py).
 
     Runs its own event loop, so it is callable from plain synchronous
     code (the CLI, bench children, tests); do not call it from inside a
@@ -278,12 +300,15 @@ def run_open_loop(
             in_flight += 1
             max_in_flight = max(max_in_flight, in_flight)
             model_key = None
+            trace_id = None
             try:
                 outcome = await asyncio.wait_for(transport(req), timeout_s)
                 # the HTTP transport reports (status, retry_after,
-                # model_key); 2-tuples from older/pluggable transports
-                # land in the "unknown" attribution bucket
-                if len(outcome) == 3:
+                # model_key, trace_id); shorter tuples from older or
+                # pluggable transports land in the "unknown" buckets
+                if len(outcome) >= 4:
+                    status, retry_after, model_key, trace_id = outcome[:4]
+                elif len(outcome) == 3:
                     status, retry_after, model_key = outcome
                 else:
                     status, retry_after = outcome
@@ -297,7 +322,7 @@ def run_open_loop(
             results.append(_Result(
                 t_s=req.t_s, status=status, retry_after_s=retry_after,
                 latency_s=loop.time() - target, send_lag_s=send_lag,
-                model_key=model_key,
+                model_key=model_key, trace_id=trace_id,
             ))
 
         try:
@@ -307,6 +332,25 @@ def run_open_loop(
                 pool.close()
 
     asyncio.run(_drive())
+
+    if results_log:
+        # per-request JSONL, in scheduled-arrival order (the log the
+        # harness joins against server-side spans by trace id)
+        from pathlib import Path as _Path
+
+        path = _Path(results_log)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "w") as f:
+            for r in sorted(results, key=lambda r: r.t_s):
+                f.write(json.dumps({
+                    "t_s": _round6(r.t_s),
+                    "status": r.status,
+                    "latency_s": _round6(r.latency_s),
+                    "send_lag_s": _round6(r.send_lag_s),
+                    "retry_after_s": r.retry_after_s,
+                    "model_key": r.model_key,
+                    "trace_id": r.trace_id,
+                }) + "\n")
 
     ok = [r for r in results if r.status == 200]
     ok_in_window = sum(1 for r in ok if r.t_s + r.latency_s <= span)
@@ -371,6 +415,7 @@ def run_open_loop(
         },
         send_lag_p99_s=_round6(_percentile(lags, 99)),
         max_in_flight=max_in_flight,
+        traced_responses=sum(1 for r in results if r.trace_id is not None),
         per_model_key=per_model_key,
     )
     log.info(

@@ -156,6 +156,44 @@ def test_aio_routing_edges(engine_pair):
     assert QUEUE_DEPTH_METRIC in metrics.text  # the saturation gauge rides /metrics
 
 
+def test_metrics_content_type_pinned_both_engines(engine_pair):
+    """ISSUE 13 satellite: /metrics on BOTH engines answers with the
+    exact Prometheus exposition content type — scrapers key parsing off
+    it, so it is pinned verbatim, not prefix-matched."""
+    for engine, base in engine_pair.items():
+        response = rq.get(base + "/metrics", timeout=10)
+        assert response.status_code == 200, engine
+        assert response.headers["Content-Type"] == (
+            "text/plain; version=0.0.4; charset=utf-8"
+        ), engine
+
+
+def test_trace_ids_identical_across_engines(engine_pair):
+    """Tracing (ISSUE 13): the minted trace id is a pure function of
+    (seed, request body), so both engines answer the same request with
+    the SAME X-Bodywork-Trace-Id — and an ingress traceparent id is
+    kept verbatim on either."""
+    from bodywork_tpu.obs.tracing import configured_tracing
+
+    with configured_tracing(1.0, seed=0):
+        minted = {
+            engine: rq.post(
+                base + "/score/v1", json={"X": 50}, timeout=10
+            ).headers["X-Bodywork-Trace-Id"]
+            for engine, base in engine_pair.items()
+        }
+        assert len(set(minted.values())) == 1, minted
+        ingress = "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"
+        for engine, base in engine_pair.items():
+            response = rq.post(
+                base + "/score/v1", json={"X": 50}, timeout=10,
+                headers={"traceparent": ingress},
+            )
+            assert response.headers["X-Bodywork-Trace-Id"] == (
+                "0af7651916cd43dd8448eb211c80319c"
+            ), engine
+
+
 def test_healthz_surfaces_queue_depth_both_engines(engine_pair):
     for engine, base in engine_pair.items():
         body = rq.get(base + "/healthz", timeout=10).json()

@@ -79,7 +79,7 @@ def test_documented_integrity_table_covers_exactly_all_prefixes():
     text = Path(__file__).parent.parent.joinpath(
         "docs", "RESILIENCE.md"
     ).read_text()
-    rows = set(re.findall(r"^\| `([a-z-]+/)` \|", text, re.MULTILINE))
+    rows = set(re.findall(r"^\| `([a-z/-]+/)` \|", text, re.MULTILINE))
     assert rows == set(ALL_PREFIXES)
 
 

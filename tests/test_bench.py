@@ -137,7 +137,8 @@ def test_bench_single_row_scoring_record_shape():
     assert record["unit"] == "s/request"
     assert record["baseline_request_s"] == bench.BASELINE_REQUEST_S
     off, on = record["batcher_off"], record["batcher_on"]
-    for sub in (off, on):
+    tracing = record["tracing_on"]
+    for sub in (off, on, tracing):
         assert 0 < sub["p50_s"] <= sub["p99_s"]
         assert sub["requests"] == 30
         conc = sub["concurrent"]
@@ -145,6 +146,14 @@ def test_bench_single_row_scoring_record_shape():
         assert conc["requests"] == 16 * 5
         assert conc["requests_per_s"] > 0
         assert 0 < conc["latency_p50_s"] <= conc["latency_p99_s"]
+    # the ISSUE 13 overhead row: tracing at full head sampling vs
+    # tracing-off, same serving shape — the deltas are recorded (noise
+    # bounds are the bench runner's business, not a unit assertion)
+    overhead = record["tracing_overhead"]
+    assert overhead["p50_delta_s"] == pytest.approx(
+        tracing["p50_s"] - off["p50_s"], abs=1e-9
+    )
+    assert overhead["p50_ratio"] > 0
     # headline = the honest like-for-like: batcher-OFF sequential p50
     assert record["value"] == off["p50_s"]
     assert record["vs_baseline"] == pytest.approx(

@@ -177,6 +177,98 @@ def test_prometheus_exposition_golden():
     )
 
 
+# --- exemplars (ISSUE 13) --------------------------------------------------
+
+
+def test_histogram_exemplars_record_render_and_merge():
+    """The last trace id per bucket rides the snapshot, renders as a
+    parser-invisible `# EXEMPLAR` comment, and survives the
+    multiprocess merge; exemplar-less histograms render exactly as
+    before (the golden test above pins that)."""
+    reg = Registry()
+    h = reg.histogram("bodywork_tpu_lat_seconds", "lat", buckets=(0.1, 1.0))
+    h.observe(0.05)                       # no exemplar: slot untouched
+    h.observe(0.05, exemplar="a" * 32)
+    h.observe(0.06, exemplar="b" * 32)    # last-wins per bucket
+    h.observe(5.0, exemplar="c" * 32)     # +Inf bucket
+    assert h.exemplars() == {"0.1": "b" * 32, "+Inf": "c" * 32}
+    text = reg.render()
+    assert (
+        '# EXEMPLAR bodywork_tpu_lat_seconds_bucket{le="0.1"} '
+        f"trace_id={'b' * 32} value=0.06" in text
+    )
+    # exemplar comments are invisible to a 0.0.4 parser: sample lines
+    # are unchanged
+    assert 'bodywork_tpu_lat_seconds_bucket{le="0.1"} 3' in text
+    # merge: a contributor's exemplar beats none; later beats earlier
+    other = Registry()
+    h2 = other.histogram("bodywork_tpu_lat_seconds", "lat", buckets=(0.1, 1.0))
+    h2.observe(0.5, exemplar="d" * 32)
+    merged = merge_snapshots([reg.snapshot(), other.snapshot()])
+    sample = merged["bodywork_tpu_lat_seconds"]["samples"][0]
+    assert sample["count"] == 5
+    assert sample["exemplars"][0]["trace_id"] == "b" * 32
+    assert sample["exemplars"][1]["trace_id"] == "d" * 32
+    assert "trace_id=" + "d" * 32 in render_snapshot(merged)
+
+
+# --- the doc-drift guard (ISSUE 13 satellite) -------------------------------
+
+
+def _registered_metric_names() -> set:
+    """Every metric-name string literal in the package sources that
+    passes the registration lint — the closest static proxy for 'the
+    registered names' (every registration site uses a literal name)."""
+    import re
+    from pathlib import Path
+
+    import bodywork_tpu
+    from bodywork_tpu.obs.registry import UNIT_SUFFIXES
+
+    names = set()
+    for path in Path(bodywork_tpu.__file__).parent.rglob("*.py"):
+        for name in re.findall(
+            r'"(bodywork_tpu_[a-z0-9_]+)"', path.read_text()
+        ):
+            if name.endswith(UNIT_SUFFIXES):
+                names.add(name)
+    return names
+
+
+def test_metric_catalogue_and_code_cannot_diverge():
+    """Every metric family documented in docs/OBSERVABILITY.md must
+    exist in the code's registered names and vice versa — the
+    hand-maintained catalogue (12 PRs of accretion) can no longer drift
+    silently. Docs may additionally show exposition forms
+    (``*_bucket``/``*_sum``/``*_count`` of a documented histogram)."""
+    import re
+    from pathlib import Path
+
+    code = _registered_metric_names()
+    assert code, "name scan found nothing — the guard itself broke"
+    text = Path(__file__).parent.parent.joinpath(
+        "docs", "OBSERVABILITY.md"
+    ).read_text()
+    documented = set()
+    for name in set(re.findall(r"bodywork_tpu_[a-z0-9_]+", text)):
+        if name not in code:
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix) and name[: -len(suffix)] in code:
+                    name = name[: -len(suffix)]
+                    break
+        documented.add(name)
+    undocumented = sorted(code - documented)
+    phantom = sorted(documented - code)
+    assert not undocumented, (
+        f"metric families registered in code but missing from "
+        f"docs/OBSERVABILITY.md: {undocumented}"
+    )
+    assert not phantom, (
+        f"metric families documented in docs/OBSERVABILITY.md but not "
+        f"registered anywhere in the package: {phantom}"
+    )
+
+
 # --- multiprocess aggregation ---------------------------------------------
 
 
@@ -211,6 +303,101 @@ def test_merge_snapshots_across_workers():
     # the merged snapshot renders through the same exposition path
     text = render_snapshot(merged)
     assert "bodywork_tpu_scoring_latency_seconds_count 8" in text
+
+
+def test_merge_with_disjoint_bucket_sets_keeps_first_definition():
+    """Two code versions flushing DIFFERENT bucket ladders for one
+    histogram name cannot merge element-wise; the merge keeps the
+    first-seen definition and skips the irreconcilable contribution
+    rather than corrupting counts (ISSUE 13 satellite edge)."""
+    a, b = Registry(), Registry()
+    a.histogram("bodywork_tpu_x_seconds", buckets=(0.1, 1.0)).observe(0.05)
+    b.histogram("bodywork_tpu_x_seconds", buckets=(0.5,)).observe(0.05)
+    merged = merge_snapshots([a.snapshot(), b.snapshot()])
+    entry = merged["bodywork_tpu_x_seconds"]
+    assert entry["buckets"] == [0.1, 1.0]       # first definition wins
+    assert entry["samples"][0]["count"] == 1    # conflicting one skipped
+    # and the merged view still renders
+    assert "bodywork_tpu_x_seconds_count 1" in render_snapshot(merged)
+
+
+def test_histogram_quantile_empty_and_single_bucket_windows():
+    """The watchdog's quantile estimator on degenerate windows: an
+    empty window answers None (never a fake 0), a single-bucket window
+    answers that bucket's bound, and an all-overflow window answers
+    +Inf (ISSUE 13 satellite edges)."""
+    import math
+
+    from bodywork_tpu.ops.slo import histogram_quantile
+
+    assert histogram_quantile((0.1, 1.0), [0, 0, 0], 0.99) is None
+    assert histogram_quantile((), [], 0.99) is None
+    # one bucket holding everything: p50 and p99 both answer its bound
+    assert histogram_quantile((0.1,), [5, 0], 0.5) == 0.1
+    assert histogram_quantile((0.1,), [5, 0], 0.99) == 0.1
+    # everything in the +Inf overflow slot
+    assert histogram_quantile((0.1,), [0, 3], 0.99) == math.inf
+
+
+def test_counter_merge_after_worker_restart_preserves_totals(tmp_path):
+    """A worker that crashed and respawned starts its counters at zero
+    under a NEW pid file; the dead pid's last flushed snapshot keeps
+    contributing its monotonic totals, so the merged service total
+    never goes backwards (ISSUE 13 satellite edge)."""
+    import subprocess
+    import sys
+
+    from bodywork_tpu.obs.multiproc import aggregated_render, write_snapshot
+
+    # a real, dead pid (a subprocess that already exited)
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    dead_pid = proc.pid
+    crashed = _worker_registry(7, 0.005)   # 7 requests, then died
+    write_snapshot(crashed, tmp_path, pid=dead_pid)
+    respawned = _worker_registry(2, 0.005)  # restart: counters reset to 0+2
+    write_snapshot(respawned, tmp_path, pid=999_999_999)
+    live = _worker_registry(1, 0.005)
+    text = aggregated_render(live, tmp_path)
+    # totals: 7 (dead, retained) + 2 (respawn) + 1 (live) — no dip
+    assert "bodywork_tpu_http_requests_total 10" in text
+
+
+def test_dead_worker_gauges_age_out_of_the_merge(tmp_path):
+    """The stale-worker fix (ISSUE 13 satellite): a crashed replica's
+    last snapshot keeps its counters/histograms in the merged view but
+    its GAUGES are aged out — queue depth must not read high forever
+    after a respawn. Liveness is probed on the snapshot's recorded pid."""
+    import subprocess
+    import sys
+
+    from bodywork_tpu.obs.multiproc import (
+        aggregated_snapshot,
+        read_sibling_snapshots,
+        write_snapshot,
+    )
+
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    dead_pid = proc.pid
+    crashed = _worker_registry(3, 0.005)
+    crashed.gauge("bodywork_tpu_stuck_queue_depth", aggregate="sum").set(500)
+    write_snapshot(crashed, tmp_path, pid=dead_pid)
+    # an ALIVE sibling's gauges still merge (pid 1 always exists; a
+    # PermissionError probe counts as alive too)
+    alive = _worker_registry(2, 0.05)
+    write_snapshot(alive, tmp_path, pid=1)
+    snaps = read_sibling_snapshots(tmp_path, exclude_pid=None)
+    dead_snaps = [s for s in snaps if "bodywork_tpu_stuck_queue_depth" in s]
+    assert not dead_snaps, "dead worker's gauge survived the merge"
+    live = _worker_registry(1, 0.005)
+    merged = aggregated_snapshot(live, tmp_path)
+    # monotonic totals from the dead worker persist...
+    assert merged["bodywork_tpu_http_requests_total"]["samples"][0][
+        "value"] == 6
+    # ...its inflight gauge contributes nothing, the live ones still sum
+    assert merged["bodywork_tpu_inflight_rows"]["samples"][0]["value"] == 4
+    assert "bodywork_tpu_stuck_queue_depth" not in merged
 
 
 def test_snapshot_files_roundtrip(tmp_path):
