@@ -103,7 +103,7 @@ from datetime import date
 BASELINE_DAY_S = 1317 * 0.00822  # reference stage-4 scoring loop, see above
 BASELINE_REQUEST_S = 0.00822  # reference per-request scoring latency
 
-ALL_CONFIGS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11)
+ALL_CONFIGS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12)
 HEADLINE_CONFIG = 2  # the north-star day loop
 
 #: config 11's padded-bucket sweep — pinned == serve.predictor.
@@ -1673,7 +1673,8 @@ class _ServeTarget:
 
     def __init__(self, store_path: str, engine: str, window_ms: float,
                  max_rows: int, buckets, isolate: bool,
-                 dtype: str = "float32"):
+                 dtype: str = "float32", mesh_data: int | None = None,
+                 env: dict | None = None):
         self.engine = engine
         self._proc = None
         self._handle = None
@@ -1689,10 +1690,13 @@ class _ServeTarget:
                    "--buckets", ",".join(str(b) for b in buckets)]
             if dtype != "float32":
                 cmd += ["--dtype", dtype]
+            if mesh_data and mesh_data > 1:
+                cmd += ["--mesh-data", str(mesh_data)]
             self._proc = subprocess.Popen(
                 cmd,
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
                 cwd=os.path.dirname(os.path.abspath(__file__)),
+                env=env,
             )
             _wait_healthy(self.base_url, self._proc)
         else:
@@ -1703,7 +1707,7 @@ class _ServeTarget:
                 FilesystemStore(store_path), host="127.0.0.1", port=0,
                 block=False, buckets=buckets, batch_window_ms=window_ms,
                 batch_max_rows=max_rows, server_engine=engine,
-                dtype=dtype,
+                dtype=dtype, mesh_data=mesh_data,
             )
             self.base_url = self._handle.url.replace("/score/v1", "")
 
@@ -2715,6 +2719,292 @@ def bench_incremental_train(
     }
 
 
+# -- config 12: sharded serving scaling --------------------------------------
+
+#: the mesh sizes config 12 sweeps: single-device baseline, then the
+#: data axis doubling up to a v5e-8's worth of devices. On CPU these are
+#: VIRTUAL devices (xla_force_host_platform_device_count) sharing the
+#: host's cores — see the in-record caveat.
+SHARDED_MESH_SIZES = (1, 2, 4, 8)
+
+
+def _sharded_backend_is_cpu() -> bool:
+    """Whether the config-12 sweep runs on (virtual) CPU devices. On a
+    real accelerator the sweep subprocesses must inherit the accelerator
+    backend — forcing CPU there would silently benchmark virtual
+    devices while the record claimed a hardware capture."""
+    import jax
+
+    return jax.devices()[0].platform == "cpu"
+
+
+def _mesh_env(n_devices: int) -> dict:
+    """Subprocess env for one sweep point. CPU backend: force exactly
+    ``n_devices`` virtual devices (the standard JAX stand-in for an
+    n-chip slice; tests/conftest.py uses the same flag — any inherited
+    device-count flag is replaced, not doubled up). Real accelerator:
+    inherit the environment untouched — the server's ``--mesh-data N``
+    then takes the first N REAL devices, which is the capture the
+    scaling-slope claim needs."""
+    env = dict(os.environ)
+    if not _sharded_backend_is_cpu():
+        return env
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags).strip()
+    return env
+
+
+def _sharded_dispatch_probe(store_path: str, mesh_data: int,
+                            bucket: int = 4096, reps: int = 20) -> dict:
+    """Device-dispatch rows/s through the serving predictor at one mesh
+    size (HTTP-free — the mechanism view of what the mesh buys, like
+    config 11's per-dtype dispatch rate). Must run inside a process
+    whose device count matches ``mesh_data`` — the sweep calls it in
+    the per-mesh subprocess; the in-process smoke calls it directly on
+    the test mesh."""
+    import numpy as np
+
+    from bodywork_tpu.models.checkpoint import load_model, resolve_serving_key
+    from bodywork_tpu.serve.server import build_serving_predictor
+    from bodywork_tpu.store import FilesystemStore
+
+    store = FilesystemStore(store_path)
+    key, _source = resolve_serving_key(store)
+    model, _d = load_model(store, key)
+    predictor, _dtype = build_serving_predictor(
+        store, model, mesh_data if mesh_data > 1 else None, "xla",
+        buckets=(bucket,), dtype="float32",
+    )
+    if predictor is None:
+        from bodywork_tpu.serve.predictor import PaddedPredictor
+
+        predictor = PaddedPredictor(model, (bucket,))
+    predictor.warmup(sync=False)
+    X = np.zeros((bucket, model.n_features or 1), dtype=np.float32)
+    predictor.predict(X)  # compiled + first-run costs paid
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        predictor.predict(X)
+    rate = bucket * reps / (time.perf_counter() - t0)
+    return {
+        "bucket": bucket,
+        "device_dispatch_rows_per_s": round(rate, 1),
+    }
+
+
+def _dispatch_probe_isolated(store_path: str, mesh_data: int,
+                             bucket: int, reps: int) -> dict:
+    """Run :func:`_sharded_dispatch_probe` in a subprocess with exactly
+    ``mesh_data`` virtual devices (the driver's own device count is
+    fixed at import; the probe's mesh must match the sweep point's)."""
+    code = (
+        "import json, sys; from bench import _sharded_dispatch_probe; "
+        f"print(json.dumps(_sharded_dispatch_probe({store_path!r}, "
+        f"{mesh_data}, {bucket}, {reps})))"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=_mesh_env(mesh_data), capture_output=True, timeout=300,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"dispatch probe (mesh {mesh_data}) failed: "
+            f"{proc.stderr.decode(errors='replace')[-800:]}"
+        )
+    return json.loads(proc.stdout.decode().strip().splitlines()[-1])
+
+
+def bench_sharded_scaling(
+    mesh_sizes: tuple = SHARDED_MESH_SIZES,
+    window_ms: float = 2.0,
+    max_rows: int = 64,
+    rate_cap_rps: float = OPEN_LOOP_RATE_CAP_RPS,
+    isolate: bool = True,
+    capacity_window_s: float = 3.0,
+    dispatch_bucket: int = 4096,
+    dispatch_reps: int = 20,
+    mlp_kwargs: dict | None = None,
+) -> dict:
+    """Config 12: sharded serving scaling efficiency vs device count.
+
+    The first capacity record whose serving hot path dispatches through
+    a device mesh (every earlier config is single-device): per mesh
+    size in ``mesh_sizes``, a subprocess-isolated server with exactly
+    that many (virtual) devices serves ``--mesh-data N`` through the
+    AOT-cached :class:`~bodywork_tpu.parallel.ShardedMLPPredictor`, and
+    the record reports
+
+    - **device_dispatch_rows_per_s**: the padded device call at
+      ``dispatch_bucket`` rows, HTTP-free (the mechanism view — rows
+      split over the ``data`` axis, params resident per device);
+    - **capacity_rps**: config 9's open-loop ramp against the live
+      server (the deployment view, front-end costs included);
+    - **scaling efficiency** per mesh size, computed in-record:
+      ``rate(N) / (N * rate(1))`` for both views — the number a TPU
+      capture of this config turns into the scale-out claim.
+
+    The /healthz ``mesh`` block of every sweep point is captured in the
+    record: each point PROVES it really served sharded (or really
+    single-device, for the baseline) rather than silently falling back.
+
+    CPU CAVEAT (in-record): virtual devices multiplex the same host
+    cores, so CPU efficiency NEVER approaches 1 and may fall below
+    1/N — the sweep on CPU proves the end-to-end sharded-dispatch
+    protocol (mesh placement, per-mesh executables, byte-identical
+    responses, capacity harness against a sharded replica); the
+    efficiency-vs-device-count slope is a TPU claim.
+    """
+    import numpy as np  # noqa: F401  (parity with sibling benches)
+
+    from bodywork_tpu.data import Dataset, generate_day, persist_dataset
+    from bodywork_tpu.store import FilesystemStore
+    from bodywork_tpu.train import train_on_history
+
+    mlp_kwargs = mlp_kwargs or {"hidden": [64, 64], "n_steps": 300}
+    store_path = tempfile.mkdtemp(prefix="bench-sharded-")
+    store = FilesystemStore(store_path)
+    d = date(2026, 1, 1)
+    X, y = generate_day(d)
+    persist_dataset(store, Dataset(X, y, d))
+    train_on_history(store, "mlp", model_kwargs=mlp_kwargs)
+    buckets = tuple(sorted({1, 16, max_rows}))
+
+    import requests as rq
+
+    points: dict = {}
+    for n in mesh_sizes:
+        mesh_data = n if n > 1 else None
+        target = _ServeTarget(
+            store_path, "aio", window_ms, max_rows, buckets, isolate,
+            mesh_data=mesh_data, env=_mesh_env(n) if isolate else None,
+        )
+        try:
+            health = rq.get(target.base_url + "/healthz", timeout=10).json()
+            capacity, ramp = _open_loop_capacity(
+                target.url, rate_cap_rps, window_s=capacity_window_s
+            )
+        finally:
+            target.stop()
+        if isolate:
+            probe = _dispatch_probe_isolated(
+                store_path, n, dispatch_bucket, dispatch_reps
+            )
+        else:
+            probe = _sharded_dispatch_probe(
+                store_path, n, dispatch_bucket, dispatch_reps
+            )
+        # did the ramp actually find a peak, or run out of offered rate?
+        # (a fast front end can outrun the driver's rate cap — capacity
+        # is then a LOWER BOUND, which the efficiency math must not
+        # silently treat as the peak)
+        last = ramp[-1] if ramp else None
+        truncated = bool(
+            last
+            and last["goodput_in_window_rps"] >= 0.9 * last["offered_rps"]
+            and last["shed_fraction"] == 0.0
+            and 2.0 * last["offered_rps"] > rate_cap_rps
+        )
+        points[str(n)] = {
+            "mesh_data": n,
+            # the server's own testimony that this point served sharded
+            # (None = single-device baseline)
+            "healthz_mesh": health.get("mesh"),
+            "capacity_rps": capacity,
+            "capacity_is_lower_bound": truncated,
+            "capacity_ramp": ramp,
+            **probe,
+        }
+        print(
+            f"  mesh {n}: healthz mesh={health.get('mesh')}, capacity "
+            f"{capacity:.0f} rps, device "
+            f"{probe['device_dispatch_rows_per_s']:,.0f} rows/s",
+            file=sys.stderr,
+        )
+
+    base_n = mesh_sizes[0]
+    base = points.get(str(base_n), {})
+    base_cap = base.get("capacity_rps") or None
+    base_disp = base.get("device_dispatch_rows_per_s") or None
+    for n in mesh_sizes:
+        p = points[str(n)]
+        # normalised per DEVICE relative to the sweep's own baseline
+        # point (rate(N) / ((N/base_n) * rate(base_n))): the baseline
+        # reads exactly 1.0 even when a sweep starts above mesh size 1
+        p["capacity_scaling_efficiency"] = (
+            round(p["capacity_rps"] / ((n / base_n) * base_cap), 4)
+            if base_cap else None
+        )
+        p["dispatch_scaling_efficiency"] = (
+            round(
+                p["device_dispatch_rows_per_s"] / ((n / base_n) * base_disp),
+                4,
+            )
+            if base_disp else None
+        )
+
+    top = points[str(mesh_sizes[-1])]
+    capacity_note = None
+    if all(p["capacity_is_lower_bound"] for p in points.values()):
+        capacity_note = (
+            "every mesh size's ramp ran out of offered rate before "
+            "saturating (zero sheds at the harness rate cap): the aio "
+            "front end, not the device plane, is the bottleneck on this "
+            "box (config 11 measured the same per-replica ceiling), so "
+            "capacity_rps is a LOWER BOUND at every size and the "
+            "capacity-view efficiency degenerates to 1/N — the "
+            "device-dispatch view is the discriminating signal here"
+        )
+    return {
+        "metric": "sharded_scaling_efficiency",
+        "cpu_count": os.cpu_count(),
+        # True = xla_force_host_platform_device_count stand-ins (the
+        # cpu_caveat applies); False = the sweep ran on real accelerator
+        # devices and the efficiency slope is a hardware claim
+        "virtual_devices": _sharded_backend_is_cpu(),
+        "capacity_note": capacity_note,
+        "unit": f"capacity_N/(N*capacity_1) at N={mesh_sizes[-1]}",
+        "value": top["capacity_scaling_efficiency"],
+        "vs_baseline": None,
+        "baseline_note": (
+            "config 9/11 capacity records are single-device serving; "
+            "the per-mesh baseline here is this run's own mesh-1 point "
+            "(same box, same harness) — cross-box rps comparisons are "
+            "not meaningful"
+        ),
+        "mesh_sizes": list(mesh_sizes),
+        "points": points,
+        "cpu_caveat": (
+            "virtual CPU devices (xla_force_host_platform_device_count) "
+            "share the host's physical cores: an N-device mesh adds "
+            "sharding overhead without adding compute, so CPU "
+            "efficiency is expected well below 1 and can fall below "
+            "1/N. This record proves the sharded serving protocol end "
+            "to end (mesh placement, per-mesh AOT executables, config-9 "
+            "capacity harness against a sharded replica); the "
+            "efficiency slope itself is a TPU claim"
+        ),
+        "protocol": (
+            "one day's dataset, one MLP checkpoint "
+            f"({mlp_kwargs}); per mesh size N in {list(mesh_sizes)}: a "
+            "subprocess-isolated aio server with exactly N virtual "
+            "devices serving --mesh-data N (ShardedMLPPredictor: params "
+            "NamedSharding-placed, rows split on the data axis, "
+            "programs AOT-cached per mesh), /healthz mesh block "
+            "captured as proof of sharded dispatch; config-9 open-loop "
+            "ramp capacity + HTTP-free device dispatch rows/s at "
+            f"bucket {dispatch_bucket} (subprocess with matching device "
+            "count); scaling efficiency rate(N)/(N*rate(1)) computed "
+            "in-record for both views"
+        ),
+    }
+
+
 #: CONFIG_TIMEOUT_S budget and appear in ALL_CONFIGS — pinned by
 #: tests/test_bench.py::test_config_registry_sync so a new config can
 #: never silently miss one of the three tables (config 7 was once wired
@@ -2733,6 +3023,7 @@ CONFIG_BENCHES = {
     9: lambda: bench_open_loop_serving(),
     10: lambda: bench_incremental_train(),
     11: lambda: bench_compiled_serving(),
+    12: lambda: bench_sharded_scaling(),
 }
 
 
@@ -2798,9 +3089,13 @@ RESUME_MAX_AGE_S = 6 * 3600
 #: calls: 2 in-process trains, the swap drive, 3 per-dtype subprocess
 #: servers (each a cold JAX init), and two multiproc fleet points
 #: (another cold init per worker) — generously sized for a loaded box
+#: config 12 is four subprocess-isolated servers (a cold JAX init each)
+#: plus four dispatch-probe subprocesses (another cold init each) around
+#: capacity ramps of a few seconds per window — generously sized for a
+#: loaded box
 CONFIG_TIMEOUT_S = {
     1: 300, 2: 300, 3: 600, 4: 600, 5: 450, 6: 1200, 7: 600, 8: 300,
-    9: 600, 10: 1800, 11: 1200,
+    9: 600, 10: 1800, 11: 1200, 12: 1200,
 }
 
 

@@ -193,8 +193,11 @@ def cmd_serve(args) -> int:
         # real OS-process replicas on one SO_REUSEPORT port (the local
         # materialisation of the reference's `replicas: 2` Deployment);
         # single-device engines only — each worker owns its own params
-        if args.mesh_data and args.mesh_data > 1:
-            log.error("--workers is per-process serving; drop --mesh-data")
+        if (args.mesh_data and args.mesh_data > 1) or args.mesh_model > 1:
+            log.error(
+                "--workers is per-process serving; drop --mesh-data/"
+                "--mesh-model"
+            )
             return 1
         from bodywork_tpu.serve import MultiProcessService
 
@@ -244,6 +247,7 @@ def cmd_serve(args) -> int:
                 port=args.port,
                 block=True,
                 mesh_data=args.mesh_data,
+                mesh_model=args.mesh_model,
                 engine=args.engine,
                 watch_interval_s=watch,
                 buckets=args.buckets,
@@ -1400,8 +1404,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=5000)
     p.add_argument(
-        "--mesh-data", type=int, default=None,
-        help="shard batches over this many devices (data-parallel serving)",
+        "--mesh-data", type=int,
+        default=_env_number("BODYWORK_TPU_MESH_DATA", int, 1),
+        help="shard each padded request batch over this many devices "
+             "(the mesh's data axis; env BODYWORK_TPU_MESH_DATA "
+             "overrides — the knob the k8s serve Deployment "
+             "materialises). Default: single-device serving",
+    )
+    p.add_argument(
+        "--mesh-model", type=int,
+        default=_env_number("BODYWORK_TPU_MESH_MODEL", int, 1) or 1,
+        help="tensor-parallel mesh axis for sharded serving (MLP "
+             "checkpoints only — weights Megatron-split across this "
+             "many devices; env BODYWORK_TPU_MESH_MODEL overrides). "
+             "Combines with --mesh-data into a data x model mesh",
     )
     p.add_argument(
         "--engine", default="auto",

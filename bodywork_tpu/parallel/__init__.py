@@ -1,10 +1,12 @@
 from bodywork_tpu.parallel.mesh import (
     make_mesh,
     multihost_init,
+    multihost_shutdown,
     split_devices,
 )
 from bodywork_tpu.parallel.sharding import (
     DataParallelPredictor,
+    ShardedMLPPredictor,
     make_data_parallel_predict,
     mlp_param_sharding,
 )
@@ -13,8 +15,10 @@ from bodywork_tpu.parallel.train_step import train_mlp_sharded
 __all__ = [
     "make_mesh",
     "multihost_init",
+    "multihost_shutdown",
     "split_devices",
     "DataParallelPredictor",
+    "ShardedMLPPredictor",
     "make_data_parallel_predict",
     "mlp_param_sharding",
     "train_mlp_sharded",

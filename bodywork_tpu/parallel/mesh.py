@@ -45,7 +45,22 @@ def make_mesh(
     dev_array = np.asarray(devices).reshape(data, model)
     mesh = Mesh(dev_array, axis_names=("data", "model"))
     log.info(f"mesh data={data} model={model} over {n} {devices[0].platform} device(s)")
+    _record_mesh_info(data, model)
     return mesh
+
+
+def _record_mesh_info(data: int, model: int) -> None:
+    """Publish the mesh topology on the metrics registry: one ``_info``
+    gauge sample per (data, model) shape built this process, so a scrape
+    of any training or serving pod answers "what mesh is this process
+    actually running?" without log archaeology."""
+    from bodywork_tpu.obs import get_registry
+
+    get_registry().gauge(
+        "bodywork_tpu_parallel_mesh_info",
+        "Device-mesh topology in use: one sample per (data, model) mesh "
+        "shape built by this process (value is always 1)",
+    ).set(1.0, data=str(data), model=str(model))
 
 
 def split_devices(n_groups: int, devices=None) -> list[list]:
@@ -60,6 +75,55 @@ def split_devices(n_groups: int, devices=None) -> list[list]:
         raise ValueError(f"cannot split {n} devices into {n_groups} equal groups")
     per = n // n_groups
     return [devices[i * per : (i + 1) * per] for i in range(n_groups)]
+
+
+def _distributed_initialized() -> bool:
+    """Whether this process already joined a ``jax.distributed`` cluster —
+    version-portable. Newer JAX exposes ``jax.distributed.is_initialized``;
+    older releases (e.g. 0.4.37, the pinned toolchain) only carry the
+    global client state object, so probe that with ``getattr`` fallbacks
+    rather than crash every worker on an ``AttributeError`` before the
+    cluster can even form."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    state = getattr(jax.distributed, "global_state", None)
+    if state is None:
+        try:
+            from jax._src import distributed as _dist
+        except ImportError:  # pragma: no cover - future-proofing only
+            return False
+        state = getattr(_dist, "global_state", None)
+    return getattr(state, "client", None) is not None
+
+
+def _arm_cpu_collectives() -> None:
+    """Give a multi-process CPU cluster a cross-process collectives
+    backend BEFORE the CPU client is created. XLA:CPU implements
+    cross-process computations only through a pluggable collectives
+    layer (Gloo, in the standard jaxlib wheels); without it every
+    collective dies with "Multiprocess computations aren't implemented
+    on the CPU backend". TPU/GPU backends carry their own collectives
+    (ICI/DCN, NCCL) — the flag only governs the CPU client, so arming
+    it unconditionally is safe there too. Best-effort: a JAX without
+    the flag (or with backends already live) keeps whatever it has."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception as exc:  # unknown flag / already-initialized backend
+        log.warning(f"could not arm CPU gloo collectives: {exc!r}")
+
+
+def multihost_shutdown() -> bool:
+    """Leave the ``jax.distributed`` cluster if this process joined one
+    (the paired exit for :func:`multihost_init`, so a finishing
+    Indexed-Job worker releases its coordinator connection instead of
+    holding it until process teardown). Idempotent: a no-op (False)
+    when the process never initialized or already shut down."""
+    if not _distributed_initialized():
+        return False
+    jax.distributed.shutdown()
+    log.info("left the distributed cluster")
+    return True
 
 
 def multihost_init() -> bool:
@@ -82,8 +146,9 @@ def multihost_init() -> bool:
         return False
     # idempotent: the daily retrain loop calls this every day, but
     # jax.distributed.initialize raises RuntimeError on a second call
-    if jax.distributed.is_initialized():
+    if _distributed_initialized():
         return True
+    _arm_cpu_collectives()
     n_proc = os.environ.get("NUM_PROCESSES") or os.environ.get(
         "JAX_NUM_PROCESSES"
     )

@@ -102,6 +102,117 @@ def make_data_parallel_predict(model: Regressor, mesh: Mesh):
     return predict
 
 
+def _round_buckets_to_axis(buckets, n_data: int) -> tuple[int, ...]:
+    """Round each padding bucket up to a multiple of the mesh's data-axis
+    size so every padded batch splits evenly across the mesh (stable XLA
+    shapes; a non-divisible batch dimension does not even lower). Shared
+    by every mesh predictor so their padded-shape policies cannot
+    diverge."""
+    return tuple(sorted({b + (-b) % n_data for b in buckets}))
+
+
+def param_partition_specs(model: Regressor, mesh: Mesh):
+    """PartitionSpecs for serving a model's params over ``mesh``: the
+    Megatron-style dense sharding for MLPs (:func:`mlp_param_sharding`),
+    full replication for everything else (the linear model's params are
+    two scalars — there is nothing to split; ``model > 1`` without an
+    MLP is refused by the predictor, not silently replicated)."""
+    from bodywork_tpu.models.mlp import MLPRegressor
+
+    if isinstance(model, MLPRegressor):
+        return mlp_param_sharding(mesh, model.params)
+    return jax.tree.map(lambda _: P(), model.params)
+
+
+class ShardedMLPPredictor(PaddedPredictor):
+    """Mesh-sharded serving through the process-wide AOT executable cache.
+
+    The serving counterpart of :func:`~bodywork_tpu.parallel.train_step.
+    train_mlp_sharded`: params are placed ONCE with ``NamedSharding``
+    over the ``data x model`` mesh (MLP weights Megatron-sharded on the
+    ``model`` axis, everything else replicated), each padded request
+    batch is sharded on the ``data`` axis, and XLA compiles whatever
+    collectives the shardings imply. Unlike
+    :class:`DataParallelPredictor` (per-instance jit), the programs here
+    ride the same AOT :class:`~bodywork_tpu.serve.predictor.
+    ExecutableCache` single-device serving uses — the lowering pins the
+    leaf shardings (``_leaf_struct``) and the cache key carries the mesh
+    shape + device set (:meth:`_warm_key_extra`), so a same-architecture
+    same-mesh hot swap re-binds params to already-compiled executables
+    (zero compiles, the config-12 acceptance bar) while two mesh shapes
+    can never collide on one executable.
+
+    Per-row results are the single-device program's rows exactly — the
+    HTTP byte-identity contract tests/test_sharded_serve.py pins over
+    both engines.
+    """
+
+    def __init__(self, model: Regressor, mesh: Mesh,
+                 buckets: tuple[int, ...] | None = None):
+        from bodywork_tpu.models.mlp import MLPRegressor
+        from bodywork_tpu.serve.predictor import DEFAULT_BUCKETS
+
+        if mesh.shape["model"] > 1 and not isinstance(model, MLPRegressor):
+            raise ValueError(
+                f"tensor-parallel serving (mesh model axis "
+                f"{mesh.shape['model']}) requires an MLP; got {model.info}"
+            )
+        if buckets is None:
+            buckets = DEFAULT_BUCKETS
+        n_data = mesh.shape["data"]
+        super().__init__(model, _round_buckets_to_axis(buckets, n_data))
+        self.mesh = mesh
+        specs = param_partition_specs(model, mesh)
+        self._sharded_params = jax.device_put(model.params, _named(mesh, specs))
+        self._x_sharding = NamedSharding(mesh, P("data", None))
+        self._mesh_label = f"{n_data}x{mesh.shape['model']}"
+        self._dispatch_counter = None
+
+    # -- AOT plumbing: same cache, mesh-aware programs ----------------------
+    def _exec_params(self):
+        return self._sharded_params
+
+    def _aot_ok(self) -> bool:
+        # the whole params tree is mesh-placed by construction and the
+        # lowering pins every leaf's NamedSharding — always AOT-safe
+        # (the base-class bypass exists for MIXED host/mesh pytrees)
+        return True
+
+    def _x_struct(self, bucket: int, n_features: int):
+        return jax.ShapeDtypeStruct(
+            (bucket, n_features), np.float32, sharding=self._x_sharding
+        )
+
+    def _out_shardings(self):
+        # keep the output row-sharded: the host fetch in _predict_padded
+        # gathers shards without forcing an in-program all-gather
+        return NamedSharding(self.mesh, P("data"))
+
+    def _warm_key_extra(self) -> tuple:
+        # the mesh shape AND its device set: same-shape meshes over
+        # different device subsets are different programs, and two mesh
+        # shapes must never share an executable
+        return (
+            "sharded",
+            tuple(self.mesh.shape.items()),
+            tuple(d.id for d in self.mesh.devices.flat),
+        )
+
+    def _dispatch_padded(self, Xp: np.ndarray):
+        if self._dispatch_counter is None:
+            from bodywork_tpu.obs import get_registry
+
+            self._dispatch_counter = get_registry().counter(
+                "bodywork_tpu_serve_sharded_dispatch_total",
+                "Padded device dispatches executed through a mesh-sharded "
+                "serving predictor, by mesh shape (data x model)",
+            )
+        self._dispatch_counter.inc(mesh=self._mesh_label)
+        # the compiled executable's input spec carries the row sharding;
+        # a host numpy batch is transferred shard-wise by the call itself
+        return super()._dispatch_padded(Xp)
+
+
 class DataParallelPredictor(PaddedPredictor):
     """A :class:`PaddedPredictor` whose bucket execution shards rows across
     the mesh ``data`` axis — the serving path for BASELINE.json config 4.
@@ -113,10 +224,7 @@ class DataParallelPredictor(PaddedPredictor):
         if buckets is None:
             buckets = (64, 512, 4096)
         n_data = mesh.shape["data"]
-        # round each bucket up to a multiple of the data-axis size so every
-        # padded batch splits evenly across the mesh (stable XLA shapes)
-        buckets = tuple(sorted({b + (-b) % n_data for b in buckets}))
-        super().__init__(model, buckets)
+        super().__init__(model, _round_buckets_to_axis(buckets, n_data))
         self.mesh = mesh
         self._sharded_dispatch, _ = make_data_parallel_apply(model, mesh)
 

@@ -237,6 +237,12 @@ def _container(
             # with `kubectl set env` — the shadow quality gate still
             # decides per checkpoint whether the quantized variant serves
             ("BODYWORK_TPU_SERVE_DTYPE", "float32"),
+            # serving mesh (serve --mesh-data/--mesh-model, read by
+            # stages._serve_env_knobs): shard the forward pass over a
+            # data x model device mesh with `kubectl set env` — empty =
+            # single-device, the pre-mesh behaviour exactly
+            ("BODYWORK_TPU_MESH_DATA", ""),
+            ("BODYWORK_TPU_MESH_MODEL", ""),
             # SLO-watchdog breach thresholds (ops/slo.py policy_from_env;
             # empty = the coded defaults): retune the canary abort
             # budget with `kubectl set env`, no rebuild/redeploy

@@ -231,15 +231,17 @@ def train_stage(
     )
 
 
-def _serve_env_knobs() -> tuple[str, int | None, float | None, str]:
+def _serve_env_knobs() -> tuple[
+    str, int | None, float | None, str, int | None, int
+]:
     """The deployed serving knobs (``(server_engine, max_pending,
-    retry_after_max_s, dtype)``) from the pod environment — the k8s
-    serve Deployment materialises them as env vars (``pipeline/k8s.py``)
-    so an operator flips the HTTP front-end, the admission budget, or
-    the serving precision with a ``kubectl set env``, no image rebuild.
-    Malformed values are ignored with a warning (same contract as
-    ``cli serve``'s env defaults): a typo must degrade to the default,
-    never crash the serving pod."""
+    retry_after_max_s, dtype, mesh_data, mesh_model)``) from the pod
+    environment — the k8s serve Deployment materialises them as env vars
+    (``pipeline/k8s.py``) so an operator flips the HTTP front-end, the
+    admission budget, the serving precision, or the device mesh with a
+    ``kubectl set env``, no image rebuild. Malformed values are ignored
+    with a warning (same contract as ``cli serve``'s env defaults): a
+    typo must degrade to the default, never crash the serving pod."""
     import os
 
     from bodywork_tpu.serve.predictor import SERVE_DTYPES
@@ -285,8 +287,35 @@ def _serve_env_knobs() -> tuple[str, int | None, float | None, str]:
                 "(need a number >= 1)"
             )
             retry_after_max_s = None
+    # the serving mesh (serve.server.build_predictor): data-parallel row
+    # sharding x Megatron tensor parallelism. None/1 = single-device,
+    # byte-identical to the pre-mesh behaviour
+    mesh_data: int | None = None
+    raw = os.environ.get("BODYWORK_TPU_MESH_DATA", "").strip()
+    if raw:
+        try:
+            mesh_data = int(raw)
+            if mesh_data < 1:
+                raise ValueError(raw)
+        except ValueError:
+            log.warning(
+                f"ignoring BODYWORK_TPU_MESH_DATA={raw!r} (need an int >= 1)"
+            )
+            mesh_data = None
+    mesh_model = 1
+    raw = os.environ.get("BODYWORK_TPU_MESH_MODEL", "").strip()
+    if raw:
+        try:
+            mesh_model = int(raw)
+            if mesh_model < 1:
+                raise ValueError(raw)
+        except ValueError:
+            log.warning(
+                f"ignoring BODYWORK_TPU_MESH_MODEL={raw!r} (need an int >= 1)"
+            )
+            mesh_model = 1
     return engine or "thread", max_pending, retry_after_max_s, \
-        dtype or "float32"
+        dtype or "float32", mesh_data, mesh_model
 
 
 def serve_stage(
@@ -300,6 +329,8 @@ def serve_stage(
     server_engine: str | None = None,
     max_pending: int | None = None,
     retry_after_max_s: float | None = None,
+    mesh_data: int | None = None,
+    mesh_model: int | None = None,
 ) -> "ServiceHandle":  # noqa: F821
     """Load the latest model into device HBM and start the scoring service
     on a background thread (reference stage 2). Returns the handle; the
@@ -331,7 +362,14 @@ def serve_stage(
     materialises) so a deployed service switches engines without a
     spec change. One admission controller is shared across the replica
     apps: they share the listen port, so they share the backpressure
-    boundary."""
+    boundary.
+
+    ``mesh_data``/``mesh_model`` shard the serving forward pass over a
+    ``data x model`` device mesh (``serve.server.build_predictor`` —
+    MLP weights Megatron-split, request rows data-split, programs
+    AOT-cached per mesh), again defaulting from the pod environment so
+    a deployed service scales onto more chips with one
+    ``kubectl set env``."""
     from bodywork_tpu.models.checkpoint import load_model
     from bodywork_tpu.serve import ServiceHandle, create_app
 
@@ -373,7 +411,8 @@ def serve_stage(
         build_serving_predictor,
     )
 
-    env_engine, env_max_pending, env_retry_max, env_dtype = _serve_env_knobs()
+    (env_engine, env_max_pending, env_retry_max, env_dtype,
+     env_mesh_data, env_mesh_model) = _serve_env_knobs()
     if server_engine is None:
         server_engine = env_engine
     if server_engine not in SERVER_ENGINES:
@@ -385,16 +424,21 @@ def serve_stage(
         max_pending = env_max_pending
     if retry_after_max_s is None:
         retry_after_max_s = env_retry_max
+    if mesh_data is None:
+        mesh_data = env_mesh_data
+    if mesh_model is None:
+        mesh_model = env_mesh_model
     admission = build_admission(server_engine, max_pending, retry_after_max_s)
-    # dtype from the pod env (BODYWORK_TPU_SERVE_DTYPE): a quantized
-    # choice runs the shadow quality gate before it may serve, exactly
-    # as `cli serve --dtype` does — f32 (the default) is byte-identical
-    # to the pre-dtype behaviour
+    # dtype + mesh from the pod env (BODYWORK_TPU_SERVE_DTYPE /
+    # BODYWORK_TPU_MESH_DATA / BODYWORK_TPU_MESH_MODEL): a quantized
+    # choice runs the shadow quality gate before it may serve, a mesh
+    # choice shards the forward pass, exactly as `cli serve` does — the
+    # defaults are byte-identical to the pre-knob behaviour
     predictor, _served_dtype = build_serving_predictor(
-        # mesh_data=None: single-device serving
-        ctx.store, model, None, engine,
+        ctx.store, model, mesh_data, engine,
         buckets=tuple(buckets) if buckets else None,
         dtype=env_dtype,
+        mesh_model=mesh_model or 1,
     )
     # warmup itself skips shapes already dispatched this process, and only
     # syncs when something new was dispatched — so the persistent day-loop
@@ -441,6 +485,7 @@ def serve_stage(
         watcher = CheckpointWatcher(
             apps, ctx.store, poll_interval_s=watch_interval_s,
             served_key=served_key, engine=engine,
+            mesh_data=mesh_data, mesh_model=mesh_model or 1,
             # the spec's explicit narrowing must survive engine-changing
             # swaps (the watcher only re-applies engine default buckets
             # when the caller never narrowed them)

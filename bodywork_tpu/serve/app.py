@@ -199,6 +199,18 @@ def batch_score_payload(served, predictions) -> dict:
     }
 
 
+def _predictor_mesh(predictor) -> dict | None:
+    """The device-mesh shape a predictor dispatches over, or None for
+    single-device predictors — the /healthz ``mesh`` block."""
+    mesh = getattr(predictor, "mesh", None)
+    if mesh is None:
+        return None
+    return {
+        "data": int(mesh.shape["data"]),
+        "model": int(mesh.shape["model"]),
+    }
+
+
 class _Served:
     """One served model: predictor + identity, swapped as a unit so a
     request can never pair one model's prediction with another's info.
@@ -1069,6 +1081,7 @@ class ScoringApp:
                     "model_key": None,
                     "model_source": None,
                     "serving_dtype": None,
+                    "mesh": None,
                     # a degraded boot can still hold a live canary (the
                     # watcher loads it independently of production) —
                     # probes must see the release loop's real state
@@ -1105,6 +1118,12 @@ class ScoringApp:
             # quantization-gate rejection — the operator-visible proof
             # that --dtype never silently costs quality)
             "serving_dtype": getattr(served.predictor, "dtype", "float32"),
+            # the serving mesh actually live ({"data": D, "model": M}
+            # for a sharded predictor, None single-device) — the
+            # operator-visible proof that the --mesh-data/--mesh-model
+            # knobs took effect, and what bench config 12 reads to
+            # confirm each sweep point really dispatched sharded
+            "mesh": _predictor_mesh(served.predictor),
             # the live-release channel: WHICH canary takes a fraction of
             # traffic (None = no canary) and the SLO watchdog's latest
             # verdict — so probes and the traffic harness attribute

@@ -235,6 +235,20 @@ class PaddedPredictor:
         (quantized predictors substitute their quantized tree)."""
         return self.model.params
 
+    def _x_struct(self, bucket: int, n_features: int):
+        """The ShapeDtypeStruct the padded input batch is lowered as.
+        Mesh-sharded predictors attach a NamedSharding here so the
+        compiled program shards rows over the mesh's ``data`` axis."""
+        import jax
+
+        return jax.ShapeDtypeStruct((bucket, n_features), np.float32)
+
+    def _out_shardings(self):
+        """Output sharding for the AOT lowering (None = let jit decide —
+        the single-device default). Mesh predictors pin the row-sharded
+        output so nothing forces a gather inside the program."""
+        return None
+
     def _aot_ok(self) -> bool:
         """Whether this predictor's params can be AOT-lowered: a pytree
         mixing multi-device-sharded leaves (a mesh-trained checkpoint)
@@ -292,10 +306,14 @@ class PaddedPredictor:
 
         def build():
             structs = jax.tree_util.tree_map(_leaf_struct, params)
-            x_struct = jax.ShapeDtypeStruct((bucket, n_features), np.float32)
+            x_struct = self._x_struct(bucket, n_features)
             donate = (1,) if _donate_inputs() else ()
+            jit_kwargs: dict = {"donate_argnums": donate}
+            out_shardings = self._out_shardings()
+            if out_shardings is not None:
+                jit_kwargs["out_shardings"] = out_shardings
             return (
-                jax.jit(fn, donate_argnums=donate)
+                jax.jit(fn, **jit_kwargs)
                 .lower(structs, x_struct)
                 .compile()
             )

@@ -56,6 +56,7 @@ class CheckpointWatcher:
         buckets: tuple[int, ...] | None = None,
         slo_watchdog=None,
         dtype: str = "float32",
+        mesh_model: int = 1,
     ):
         # one watcher drives every replica app: replicas share read-only
         # model state by design, so one load+warm serves them all
@@ -63,6 +64,11 @@ class CheckpointWatcher:
         self.store = store
         self.poll_interval_s = poll_interval_s
         self.mesh_data = mesh_data
+        #: tensor-parallel mesh axis for swapped-in predictors: a swap
+        #: re-places the new checkpoint's params over the SAME mesh shape
+        #: the boot predictor used, so the AOT executable cache re-binds
+        #: instead of recompiling (same-mesh swaps are compile-free)
+        self.mesh_model = mesh_model
         self.engine = engine
         #: the serving dtype (serve.predictor.SERVE_DTYPES): a swapped-in
         #: checkpoint re-runs the quantization shadow gate for it, so a
@@ -218,11 +224,13 @@ class CheckpointWatcher:
         #    compiles per warmup for nothing.
         current = self.apps[0].predictor  # None on a degraded boot
         old_resolved = (
-            resolve_engine(self.engine, current.model, self.mesh_data)
+            resolve_engine(self.engine, current.model, self.mesh_data,
+                           mesh_model=self.mesh_model)
             if current is not None
             else None  # nothing served yet: nothing to inherit
         )
-        new_resolved = resolve_engine(self.engine, model, self.mesh_data)
+        new_resolved = resolve_engine(self.engine, model, self.mesh_data,
+                                      mesh_model=self.mesh_model)
         if self.buckets is not None:
             swap_buckets = self.buckets
         elif current is not None and new_resolved == old_resolved:
@@ -236,6 +244,7 @@ class CheckpointWatcher:
         predictor, _served_dtype = build_serving_predictor(
             self.store, model, self.mesh_data, new_resolved,
             buckets=swap_buckets, dtype=self.dtype,
+            mesh_model=self.mesh_model,
         )
         if predictor is None:
             # plain xla engine with no bucket narrowing: the app-level

@@ -134,6 +134,7 @@ def resolve_engine(
     model,
     mesh_data: int | None = None,
     platform: str | None = None,
+    mesh_model: int = 1,
 ) -> str:
     """Resolve ``engine="auto"`` to the faster engine for the regime:
     the fused Pallas kernel only ever wins for wide MLPs on a real TPU
@@ -143,8 +144,8 @@ def resolve_engine(
         return engine
     from bodywork_tpu.models.mlp import MLPRegressor
 
-    if mesh_data and mesh_data > 1:
-        return "xla"  # the kernel is single-device
+    if (mesh_data and mesh_data > 1) or mesh_model > 1:
+        return "xla"  # the kernel is single-device; the mesh path is XLA
     if not isinstance(model, MLPRegressor):
         return "xla"
     if platform is None:
@@ -199,7 +200,8 @@ def quantized_engine_for(engine: str, dtype: str) -> str:
 
 
 def build_predictor(model, mesh_data: int | None = None, engine: str = "xla",
-                    buckets: tuple[int, ...] | None = None):
+                    buckets: tuple[int, ...] | None = None,
+                    mesh_model: int = 1):
     """The predictor for a (resolved) engine choice, or ``None`` for the
     app's single-device bucketed default. Shared by boot-time serving and
     the hot-reload watcher so a swapped-in model goes through exactly the
@@ -209,8 +211,18 @@ def build_predictor(model, mesh_data: int | None = None, engine: str = "xla",
     the same knob the app's default predictor honours, threaded here so a
     pipeline spec's explicit bucket list is never silently ignored when a
     non-default engine is selected (each engine keeps its own default
-    bucket policy when unset)."""
-    engine = resolve_engine(engine, model, mesh_data)
+    bucket policy when unset).
+
+    ``mesh_data``/``mesh_model`` > 1 serve through a ``data x model``
+    device mesh: MLP checkpoints get the AOT-cached
+    :class:`~bodywork_tpu.parallel.ShardedMLPPredictor` (Megatron
+    weight sharding on ``model``, rows split on ``data``); other model
+    classes serve data-parallel (their params are too small to split —
+    a requested ``mesh_model`` > 1 degrades to the data axis with a
+    warning rather than crash-looping a pod whose fleet-wide env knob
+    outlives any one checkpoint)."""
+    engine = resolve_engine(engine, model, mesh_data, mesh_model=mesh_model)
+    use_mesh = bool(mesh_data and mesh_data > 1) or mesh_model > 1
     predictor = None
     if engine in ("pallas", "pallas-bf16", "pallas-int8"):
         import jax
@@ -218,9 +230,10 @@ def build_predictor(model, mesh_data: int | None = None, engine: str = "xla",
         from bodywork_tpu.models.mlp import MLPRegressor
         from bodywork_tpu.serve.predictor import PallasMLPPredictor
 
-        if mesh_data and mesh_data > 1:
+        if use_mesh:
             raise ValueError(
-                f"engine={engine!r} is single-device; drop --mesh-data"
+                f"engine={engine!r} is single-device; drop --mesh-data/"
+                "--mesh-model"
             )
         if not isinstance(model, MLPRegressor):
             raise ValueError(
@@ -246,9 +259,10 @@ def build_predictor(model, mesh_data: int | None = None, engine: str = "xla",
             Int8MLPPredictor,
         )
 
-        if mesh_data and mesh_data > 1:
+        if use_mesh:
             raise ValueError(
-                f"engine={engine!r} is single-device; drop --mesh-data"
+                f"engine={engine!r} is single-device; drop --mesh-data/"
+                "--mesh-model"
             )
         # never chosen by "auto": trading prediction precision for
         # throughput is an explicit caller decision (and --dtype routes
@@ -256,7 +270,7 @@ def build_predictor(model, mesh_data: int | None = None, engine: str = "xla",
         cls = BF16MLPPredictor if engine == "xla-bf16" else Int8MLPPredictor
         predictor = cls(model, buckets=buckets)
     elif engine == "xla":
-        if buckets and not (mesh_data and mesh_data > 1):
+        if buckets and not use_mesh:
             # an explicit bucket list must never be silently ignored, so
             # the plain engine materialises the bucketed default here
             # rather than returning None and hoping the caller re-applies
@@ -265,19 +279,54 @@ def build_predictor(model, mesh_data: int | None = None, engine: str = "xla",
             predictor = PaddedPredictor(model, buckets)
     else:
         raise ValueError(f"unknown serving engine {engine!r}")
-    if mesh_data and mesh_data > 1:
+    if use_mesh:
         import jax
 
-        from bodywork_tpu.parallel import DataParallelPredictor, make_mesh
+        from bodywork_tpu.models.mlp import MLPRegressor
+        from bodywork_tpu.parallel import (
+            DataParallelPredictor,
+            ShardedMLPPredictor,
+            make_mesh,
+        )
 
-        devices = jax.devices()
-        if mesh_data > len(devices):
-            raise ValueError(
-                f"--mesh-data {mesh_data} exceeds the {len(devices)} "
-                f"available device(s)"
+        data = mesh_data if mesh_data and mesh_data > 1 else 1
+        model_axis = mesh_model
+        if model_axis > 1 and not isinstance(model, MLPRegressor):
+            # the mesh knobs are fleet-wide env settings while the served
+            # model changes per swap: a linear checkpoint under
+            # --mesh-model 2 keeps serving (data-parallel) instead of
+            # crash-looping the pod (same contract as --dtype int8 over
+            # a linear checkpoint)
+            log.warning(
+                f"mesh_model={model_axis} requires an MLP checkpoint; "
+                f"serving {model.info} data-parallel over "
+                f"{data} device(s) instead"
             )
-        mesh = make_mesh(data=mesh_data, devices=devices[:mesh_data])
-        predictor = DataParallelPredictor(model, mesh, buckets=buckets)
+            model_axis = 1
+        devices = jax.devices()
+        if data * model_axis > len(devices):
+            # the mesh knobs are fleet-wide env settings while device
+            # counts vary per pod (and per box): an oversized request
+            # serves the largest mesh that FITS, with a warning —
+            # crash-looping the pod would turn a sizing typo into an
+            # outage (same contract as the model-class degrade above)
+            requested = f"{data}x{model_axis}"
+            if model_axis > len(devices):
+                model_axis = 1
+            data = max(len(devices) // model_axis, 1)
+            log.warning(
+                f"mesh {requested} needs more than the {len(devices)} "
+                f"available device(s); serving {data}x{model_axis} instead"
+            )
+        mesh = make_mesh(
+            data=data, model=model_axis, devices=devices[:data * model_axis]
+        )
+        if isinstance(model, MLPRegressor):
+            predictor = ShardedMLPPredictor(model, mesh, buckets=buckets)
+        else:
+            # non-MLP params are two scalars — nothing to tensor-shard;
+            # the data-parallel predictor is the right program
+            predictor = DataParallelPredictor(model, mesh, buckets=buckets)
     return predictor
 
 
@@ -288,8 +337,8 @@ def _count_quantization_gate(dtype: str, outcome: str) -> None:
     reg.counter(
         "bodywork_tpu_serve_quantization_gate_total",
         "Quantized-serving shadow-gate verdicts at boot/swap, by dtype "
-        "and outcome "
-        "(served|rejected_quality|no_shadow_data|unsupported_model)",
+        "and outcome (served|rejected_quality|no_shadow_data|"
+        "unsupported_model|unsupported_mesh)",
     ).inc(dtype=dtype, outcome=outcome)
     reg.gauge(
         "bodywork_tpu_serve_quantized_state",
@@ -307,6 +356,7 @@ def build_serving_predictor(
     buckets: tuple[int, ...] | None = None,
     dtype: str = "float32",
     policy=None,
+    mesh_model: int = 1,
 ):
     """The predictor serving should run for a (engine, dtype) choice —
     the ONE composition point boot (``serve_latest_model``), the
@@ -327,14 +377,25 @@ def build_serving_predictor(
     Returns ``(predictor_or_None, served_dtype)`` — ``served_dtype`` is
     what actually serves ("float32" after a rejection), surfaced on
     /healthz and the ``bodywork_tpu_serve_quantized_state`` gauge."""
+    use_mesh = bool(mesh_data and mesh_data > 1) or mesh_model > 1
     if dtype in (None, "float32"):
-        return build_predictor(model, mesh_data, engine, buckets=buckets), \
-            "float32"
+        return build_predictor(model, mesh_data, engine, buckets=buckets,
+                               mesh_model=mesh_model), "float32"
+    if use_mesh:
+        # both knobs are fleet-wide env settings; the quantized engines
+        # are single-device. Crash-looping the pod on the combination
+        # would turn a config contradiction into an outage — keep f32
+        # MESH serving (the mesh is the capacity knob; precision is the
+        # optional one) and say so, same contract as an unsupported model
+        log.warning(
+            f"dtype={dtype} is single-device; keeping f32 serving over "
+            f"the {mesh_data or 1}x{mesh_model} mesh"
+        )
+        _count_quantization_gate(dtype, "unsupported_mesh")
+        return build_predictor(model, mesh_data, engine, buckets=buckets,
+                               mesh_model=mesh_model), "float32"
     from bodywork_tpu.registry.gates import GatePolicy, evaluate_quantization
     from bodywork_tpu.registry.shadow import shadow_compare
-
-    if mesh_data and mesh_data > 1:
-        raise ValueError("--dtype quantized serving is single-device")
     policy = policy or GatePolicy()
     base_engine = resolve_engine(engine, model, mesh_data)
     quant_engine = quantized_engine_for(base_engine, dtype)
@@ -460,6 +521,7 @@ def serve_latest_model(
     max_pending: int | None = None,
     retry_after_max_s: float | None = None,
     dtype: str = "float32",
+    mesh_model: int = 1,
 ):
     """Load latest model -> HBM, warm up, serve (reference ``stage_2`` main).
 
@@ -470,8 +532,11 @@ def serve_latest_model(
     ceiling keeps f32 serving and says so on /healthz and the
     ``bodywork_tpu_serve_quantized_state`` gauge.
 
-    ``mesh_data > 1`` serves through a data-parallel predictor sharding each
-    batch over a ``(mesh_data, 1)`` device mesh (BASELINE.json config 4).
+    ``mesh_data``/``mesh_model`` > 1 serve through a sharded predictor
+    over a ``(mesh_data, mesh_model)`` device mesh — params placed with
+    NamedSharding (MLP weights Megatron-split on the ``model`` axis),
+    request rows split on ``data``, programs AOT-cached per mesh
+    (:func:`build_predictor`; BASELINE.json config 4, bench config 12).
     ``engine="pallas"`` serves an MLP through the fused Pallas kernel
     (``ops.mlp_kernel``; single-device, TPU only); ``engine="auto"`` picks
     the engine by regime (:func:`resolve_engine`). ``watch_interval_s``
@@ -533,6 +598,7 @@ def serve_latest_model(
         # knob here
         predictor, _served_dtype = build_serving_predictor(
             store, model, mesh_data, engine, buckets=buckets, dtype=dtype,
+            mesh_model=mesh_model,
         )
         model_bounds = _registry_bounds(store, served_key)
     admission = build_admission(server_engine, max_pending, retry_after_max_s)
@@ -561,7 +627,7 @@ def serve_latest_model(
         watchdog = SloWatchdog(store, [app], policy=policy_from_env())
         watcher = CheckpointWatcher(
             app, store, poll_interval_s=watch_interval_s,
-            mesh_data=mesh_data, engine=engine,
+            mesh_data=mesh_data, mesh_model=mesh_model, engine=engine,
             # degraded boot serves nothing: the sentinel (NOT None, which
             # would re-snapshot latest() as already-served and skip a
             # checkpoint published in the lookup->construction window)

@@ -246,6 +246,15 @@ def persist_metrics(
     return key
 
 
+def _multihost_nonzero_process() -> bool:
+    """True in a worker that joined a multi-process ``jax.distributed``
+    cluster and is NOT process 0 — the processes that must not persist
+    (one cluster, one writer)."""
+    import jax
+
+    return jax.process_count() > 1 and jax.process_index() != 0
+
+
 def _fit_sharded(model, model_type, split, mesh_data, mesh_model, fit_seed):
     """Fit over a dp x tp mesh and evaluate on the held-out split."""
     if model_type != "mlp":
@@ -363,6 +372,13 @@ def train_on_history(
     # train must not mutate the store before its stage's DAG position —
     # an aborted day would otherwise leave a future-dated model behind)
     bounds = _prediction_bounds(ds.y)
+    if persist and use_mesh and _multihost_nonzero_process():
+        # a multi-process cluster runs ONE global program whose result is
+        # replicated into every worker; only process 0 writes the (byte-
+        # identical) artefacts — N workers racing the same keys against a
+        # shared store would be pure write amplification
+        log.info("non-zero process in a multihost cluster: skipping persist")
+        persist = False
     if persist:
         from bodywork_tpu.models.checkpoint import save_model_bytes
 

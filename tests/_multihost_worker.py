@@ -21,9 +21,18 @@ def main() -> int:
 
     import numpy as np
 
-    from bodywork_tpu.parallel import make_mesh, multihost_init, train_mlp_sharded
+    from bodywork_tpu.parallel import (
+        make_mesh,
+        multihost_init,
+        multihost_shutdown,
+        train_mlp_sharded,
+    )
 
     assert multihost_init(), "coordinator env not detected"
+    # idempotency, against the REAL cluster state: the daily retrain
+    # loop calls multihost_init every day in one long-lived process —
+    # the second call must see the live client and no-op, not crash
+    assert multihost_init(), "second multihost_init must be a no-op"
 
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -58,6 +67,9 @@ def main() -> int:
     facts["predictions"] = [float(p) for p in preds]
     with open(out_file, "w") as f:
         json.dump(facts, f)
+    # clean worker exit: release the coordinator connection instead of
+    # holding it until process teardown (paired with multihost_init)
+    assert multihost_shutdown(), "shutdown should report it left the cluster"
     return 0
 
 

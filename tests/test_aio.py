@@ -555,17 +555,22 @@ def test_serve_env_knobs_parsing(monkeypatch):
     monkeypatch.setenv("BODYWORK_TPU_MAX_PENDING", "64")
     monkeypatch.setenv("BODYWORK_TPU_RETRY_AFTER_MAX_S", "12")
     monkeypatch.setenv("BODYWORK_TPU_SERVE_DTYPE", "int8")
-    assert _serve_env_knobs() == ("aio", 64, 12.0, "int8")
+    monkeypatch.setenv("BODYWORK_TPU_MESH_DATA", "4")
+    monkeypatch.setenv("BODYWORK_TPU_MESH_MODEL", "2")
+    assert _serve_env_knobs() == ("aio", 64, 12.0, "int8", 4, 2)
     monkeypatch.setenv("BODYWORK_TPU_SERVER_ENGINE", "gevent")
     monkeypatch.setenv("BODYWORK_TPU_MAX_PENDING", "zero")
     monkeypatch.setenv("BODYWORK_TPU_RETRY_AFTER_MAX_S", "-3")
     monkeypatch.setenv("BODYWORK_TPU_SERVE_DTYPE", "fp7")
-    assert _serve_env_knobs() == ("thread", None, None, "float32")
+    monkeypatch.setenv("BODYWORK_TPU_MESH_DATA", "none")
+    monkeypatch.setenv("BODYWORK_TPU_MESH_MODEL", "0")
+    assert _serve_env_knobs() == ("thread", None, None, "float32", None, 1)
     for name in ("BODYWORK_TPU_SERVER_ENGINE", "BODYWORK_TPU_MAX_PENDING",
                  "BODYWORK_TPU_RETRY_AFTER_MAX_S",
-                 "BODYWORK_TPU_SERVE_DTYPE"):
+                 "BODYWORK_TPU_SERVE_DTYPE", "BODYWORK_TPU_MESH_DATA",
+                 "BODYWORK_TPU_MESH_MODEL"):
         monkeypatch.delenv(name)
-    assert _serve_env_knobs() == ("thread", None, None, "float32")
+    assert _serve_env_knobs() == ("thread", None, None, "float32", None, 1)
 
 
 def test_serve_stage_aio_engine_full_day(store):
@@ -604,15 +609,17 @@ def test_cli_and_stage_env_knob_parsers_agree(monkeypatch):
     from bodywork_tpu.cli import build_parser
     from bodywork_tpu.pipeline.stages import _serve_env_knobs
 
-    for engine, pending, retry, dtype in (
-        ("aio", "64", "12", "bfloat16"),        # well-formed
-        ("gevent", "zero", "-3", "fp7"),        # malformed -> defaults
-        ("", "", "", ""),                       # unset-equivalent
+    for engine, pending, retry, dtype, mesh_d, mesh_m in (
+        ("aio", "64", "12", "bfloat16", "4", "2"),      # well-formed
+        ("gevent", "zero", "-3", "fp7", "-1", "x"),     # malformed -> defaults
+        ("", "", "", "", "", ""),                       # unset-equivalent
     ):
         monkeypatch.setenv("BODYWORK_TPU_SERVER_ENGINE", engine)
         monkeypatch.setenv("BODYWORK_TPU_MAX_PENDING", pending)
         monkeypatch.setenv("BODYWORK_TPU_RETRY_AFTER_MAX_S", retry)
         monkeypatch.setenv("BODYWORK_TPU_SERVE_DTYPE", dtype)
+        monkeypatch.setenv("BODYWORK_TPU_MESH_DATA", mesh_d)
+        monkeypatch.setenv("BODYWORK_TPU_MESH_MODEL", mesh_m)
         knobs = _serve_env_knobs()
         args = build_parser().parse_args(["serve", "--store", "s"])
         assert (
@@ -620,4 +627,6 @@ def test_cli_and_stage_env_knob_parsers_agree(monkeypatch):
             args.max_pending,
             args.retry_after_max_s,
             args.dtype,
-        ) == knobs, (engine, pending, retry, dtype)
+            args.mesh_data,
+            args.mesh_model,
+        ) == knobs, (engine, pending, retry, dtype, mesh_d, mesh_m)

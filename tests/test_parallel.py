@@ -254,11 +254,15 @@ def test_day_loop_with_sharded_training(tmp_path):
 def test_multihost_init_joins_only_with_coordinator(monkeypatch):
     import jax
 
+    from bodywork_tpu.parallel import mesh as mesh_mod
     from bodywork_tpu.parallel.mesh import multihost_init
 
     # no coordinator env: a single-host process must not try to join
     monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
     monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("PROCESS_ID", raising=False)
+    monkeypatch.delenv("JOB_COMPLETION_INDEX", raising=False)
     assert multihost_init() is False
 
     # with the GKE-style coordinator env, the process joins the cluster
@@ -271,10 +275,66 @@ def test_multihost_init_joins_only_with_coordinator(monkeypatch):
     assert calls == [1]
 
     # idempotent: the daily retrain path calls it every day, and
-    # jax.distributed.initialize raises if called twice
-    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+    # jax.distributed.initialize raises if called twice. The probe is
+    # the version-portable _distributed_initialized (the installed JAX
+    # has no jax.distributed.is_initialized — the seed's AttributeError)
+    monkeypatch.setattr(mesh_mod, "_distributed_initialized", lambda: True)
     assert multihost_init() is True
     assert calls == [1]
+
+
+def test_multihost_init_second_call_is_noop_and_shutdown_idempotent(
+    monkeypatch,
+):
+    """The regression pinned by ISSUE 14: a second ``multihost_init()``
+    in one process must be a no-op (the daily retrain loop calls it
+    every day), never a crash — and ``multihost_shutdown`` without a
+    cluster is a clean False, not an error."""
+    import jax
+
+    from bodywork_tpu.parallel.mesh import (
+        _distributed_initialized,
+        multihost_init,
+        multihost_shutdown,
+    )
+
+    # the portable probe itself must answer on THIS JAX version without
+    # AttributeError (the seed bug), whatever the answer is
+    assert _distributed_initialized() in (False, True)
+
+    calls = []
+    monkeypatch.setenv("COORDINATOR_ADDRESS", "coordinator:8476")
+    monkeypatch.delenv("NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("PROCESS_ID", raising=False)
+    monkeypatch.delenv("JOB_COMPLETION_INDEX", raising=False)
+    monkeypatch.setattr(jax.distributed, "initialize", lambda: calls.append(1))
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+    # simulate the client state flipping live once initialize ran — the
+    # real jax.distributed contract the portable probe reads
+    state = {"up": False}
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda: (calls.append(1), state.update(up=True)),
+    )
+    from bodywork_tpu.parallel import mesh as mesh_mod
+
+    monkeypatch.setattr(
+        mesh_mod, "_distributed_initialized", lambda: state["up"]
+    )
+    assert multihost_init() is True
+    assert multihost_init() is True  # second call: no-op, NOT a re-init
+    assert calls == [1]
+
+    shut = []
+    monkeypatch.setattr(
+        jax.distributed, "shutdown",
+        lambda: (shut.append(1), state.update(up=False)),
+    )
+    assert multihost_shutdown() is True
+    assert multihost_shutdown() is False  # idempotent
+    assert shut == [1]
 
 
 def test_sharded_training_at_wide_shapes_actually_distributes():

@@ -343,7 +343,7 @@ def test_checkpoint_watcher_engine_change_uses_new_default_buckets(
     calls = iter(["pallas", "xla"])
     monkeypatch.setattr(
         server_mod, "resolve_engine",
-        lambda engine, m, mesh_data=None, platform=None:
+        lambda engine, m, mesh_data=None, platform=None, mesh_model=1:
         next(calls) if engine == "auto" else engine,
     )
     _save_model_for_day(store, 3, slope=1.5)
@@ -355,7 +355,7 @@ def test_checkpoint_watcher_engine_change_uses_new_default_buckets(
     calls2 = iter(["pallas", "xla"])
     monkeypatch.setattr(
         server_mod, "resolve_engine",
-        lambda engine, m, mesh_data=None, platform=None:
+        lambda engine, m, mesh_data=None, platform=None, mesh_model=1:
         next(calls2) if engine == "auto" else engine,
     )
     explicit = CheckpointWatcher(app, store, poll_interval_s=3600,

@@ -1,0 +1,538 @@
+"""Sharded serving (ISSUE 14): the mesh-dispatched forward pass through
+the process-wide AOT executable cache.
+
+The contract under test: a ``data x model`` mesh predictor
+(``parallel.ShardedMLPPredictor``) serves BYTE-IDENTICAL responses to
+the single-device predictor over real HTTP on both engines (coalesced
+path and firewall fallback included) for data-parallel meshes, per-mesh
+executables never collide in the cache, and a same-mesh hot swap through
+the real ``CheckpointWatcher`` path compiles NOTHING. Plus the
+three-table knob guard: ``cli serve --mesh-data/--mesh-model`` == the
+``stages._serve_env_knobs`` pod-env parsing == the env vars the k8s
+serve Deployment materialises (the PR 6/PR 12 parser-drift pattern).
+"""
+import sys
+import threading
+from datetime import date
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+import requests as rq
+
+from bodywork_tpu.models.linear import LinearRegressor
+from bodywork_tpu.models.mlp import MLPConfig, MLPRegressor
+from bodywork_tpu.parallel import DataParallelPredictor, ShardedMLPPredictor, make_mesh
+from bodywork_tpu.serve import AioServiceHandle, ServiceHandle, create_app
+from bodywork_tpu.serve.predictor import EXECUTABLE_CACHE, PaddedPredictor
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+@pytest.fixture(scope="module")
+def mlp_model():
+    rng = np.random.default_rng(7)
+    X = rng.uniform(0, 100, 800).astype(np.float32)
+    y = (1.0 + 0.5 * X + rng.normal(0, 1, 800)).astype(np.float32)
+    cfg = MLPConfig(hidden=(16, 16), n_steps=80, batch_size=64)
+    return MLPRegressor(cfg).fit(X, y)
+
+
+@pytest.fixture(scope="module")
+def linear_model():
+    rng = np.random.default_rng(3)
+    X = rng.uniform(0, 100, 400).astype(np.float32)
+    y = (1.0 + 0.5 * X + rng.normal(0, 1, 400)).astype(np.float32)
+    return LinearRegressor().fit(X, y)
+
+
+@pytest.fixture()
+def seeded_mlp_store(store):
+    """A store with one dataset day and one MLP checkpoint."""
+    from bodywork_tpu.data import Dataset, generate_day, persist_dataset
+    from bodywork_tpu.train import train_on_history
+
+    d = date(2026, 4, 1)
+    X, y = generate_day(d)
+    persist_dataset(store, Dataset(X, y, d))
+    result = train_on_history(
+        store, "mlp", model_kwargs={"hidden": [8, 8], "n_steps": 40}
+    )
+    return store, result
+
+
+# -- predictor semantics -----------------------------------------------------
+
+def test_sharded_predictor_byte_identical_data_parallel(mlp_model):
+    """At every padded shape both predictors compile (buckets divisible
+    by the data axis, a couple of rows or more per shard), the sharded
+    program yields the single-device program's rows EXACTLY — the
+    per-request guarantee behind the HTTP byte-identity contract.
+    Sub-shard paddings are where XLA:CPU's vector path can differ in
+    the last ulp, which is why the predictor rounds its buckets to the
+    data axis and the HTTP fixture serves a shared bucket set."""
+    single = PaddedPredictor(mlp_model, buckets=(8, 64, 512))
+    single.warmup(sync=False)
+    rng = np.random.default_rng(0)
+    X = rng.uniform(0, 100, (600, 1)).astype(np.float32)
+    for n_data in (2, 4):
+        mesh = make_mesh(data=n_data, devices=jax.devices()[:n_data])
+        pred = ShardedMLPPredictor(mlp_model, mesh, buckets=(8, 64, 512))
+        assert pred.buckets == (8, 64, 512)  # divisible: no rounding
+        pred.warmup(sync=False)
+        for n in (1, 3, 8, 100, 600):
+            np.testing.assert_array_equal(
+                pred.predict(X[:n]), single.predict(X[:n]),
+                err_msg=f"mesh {n_data}x1, n={n}",
+            )
+    # the full 8-device mesh at >= 8 rows per shard (request sizes that
+    # land in the 64/512 buckets on both predictors)
+    mesh8 = make_mesh(data=8)
+    pred8 = ShardedMLPPredictor(mlp_model, mesh8, buckets=(64, 512))
+    pred8.warmup(sync=False)
+    for n in (64, 100, 600):
+        np.testing.assert_array_equal(
+            pred8.predict(X[:n]), single.predict(X[:n]),
+            err_msg=f"mesh 8x1, n={n}",
+        )
+
+
+def test_sharded_predictor_tensor_parallel(mlp_model):
+    """``model > 1`` really splits the hidden weights across the mesh
+    (not silent replication) and tracks the single-device predictions
+    numerically (bitwise identity is NOT claimed for tensor parallelism:
+    the row-parallel psum reassociates the hidden-dim reduction)."""
+    mesh = make_mesh(data=2, model=2, devices=jax.devices()[:4])
+    pred = ShardedMLPPredictor(mlp_model, mesh, buckets=(8, 64))
+    pred.warmup(sync=False)
+    w0 = pred._sharded_params["net"]["layers"][0]["w"]
+    # column-parallel first layer: each shard holds half the 16 features
+    assert {s.data.shape for s in w0.addressable_shards} == {(1, 8)}
+    X = np.linspace(0.0, 100.0, 64, dtype=np.float32)[:, None]
+    np.testing.assert_allclose(
+        pred.predict(X), mlp_model.predict(X), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_sharded_predictor_refuses_tensor_parallel_non_mlp(linear_model):
+    mesh = make_mesh(data=2, model=2, devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="requires an MLP"):
+        ShardedMLPPredictor(linear_model, mesh)
+
+
+def test_executable_cache_distinguishes_mesh_shapes(mlp_model):
+    """Two mesh shapes over the same checkpoint compile two executable
+    sets (no cross-mesh reuse — a 2x1 program cannot serve a 4x1 mesh),
+    while a second same-mesh predictor reuses everything."""
+    buckets = (16, 128)
+    mesh2 = make_mesh(data=2, devices=jax.devices()[:2])
+    p2 = ShardedMLPPredictor(mlp_model, mesh2, buckets=buckets)
+    p2.warmup(sync=False)
+    before = EXECUTABLE_CACHE.stats()["misses"]
+    mesh4 = make_mesh(data=4, devices=jax.devices()[:4])
+    p4 = ShardedMLPPredictor(mlp_model, mesh4, buckets=buckets)
+    p4.warmup(sync=False)
+    after_mesh4 = EXECUTABLE_CACHE.stats()["misses"]
+    assert after_mesh4 > before  # distinct mesh -> distinct executables
+    # same mesh shape again: everything already compiled
+    p2b = ShardedMLPPredictor(
+        mlp_model, make_mesh(data=2, devices=jax.devices()[:2]),
+        buckets=buckets,
+    )
+    p2b.warmup(sync=False)
+    assert EXECUTABLE_CACHE.stats()["misses"] == after_mesh4
+    X = np.ones((5, 1), np.float32)
+    np.testing.assert_array_equal(p2.predict(X), p4.predict(X))
+
+
+def test_mesh_checkpoint_roundtrip_and_same_mesh_no_recompile(mlp_model):
+    """A mesh-TRAINED checkpoint round-trips through save/load bytes and
+    serves through the sharded predictor; re-placing the loaded (host)
+    params over the same mesh re-binds the already-compiled executables
+    — zero new compiles."""
+    from bodywork_tpu.models import load_model_bytes, save_model_bytes
+    from bodywork_tpu.parallel import train_mlp_sharded
+
+    rng = np.random.default_rng(5)
+    X = rng.uniform(0, 100, 512).astype(np.float32)
+    y = (1.0 + 0.5 * X).astype(np.float32)
+    cfg = MLPConfig(hidden=(16, 16), n_steps=30, batch_size=64)
+    mesh = make_mesh(data=2, model=2, devices=jax.devices()[:4])
+    trained = train_mlp_sharded(X, y, cfg, mesh)
+    clone = load_model_bytes(save_model_bytes(trained))
+    p1 = ShardedMLPPredictor(clone, mesh, buckets=(8, 64))
+    p1.warmup(sync=False)
+    misses = EXECUTABLE_CACHE.stats()["misses"]
+    # the hot-swap shape: ANOTHER load of the same bytes, same mesh
+    clone2 = load_model_bytes(save_model_bytes(trained))
+    p2 = ShardedMLPPredictor(clone2, mesh, buckets=(8, 64))
+    p2.warmup(sync=False)
+    assert EXECUTABLE_CACHE.stats()["misses"] == misses
+    np.testing.assert_array_equal(
+        p1.predict(X[:32]), p2.predict(X[:32])
+    )
+
+
+# -- engine selection (serve.server.build_predictor) -------------------------
+
+def test_build_predictor_mesh_routing(mlp_model, linear_model):
+    from bodywork_tpu.serve.server import build_predictor
+
+    p = build_predictor(mlp_model, mesh_data=2)
+    assert isinstance(p, ShardedMLPPredictor)
+    assert dict(p.mesh.shape) == {"data": 2, "model": 1}
+    p = build_predictor(mlp_model, mesh_data=2, mesh_model=2)
+    assert isinstance(p, ShardedMLPPredictor)
+    assert dict(p.mesh.shape) == {"data": 2, "model": 2}
+    # a model-only mesh is valid (pure tensor parallelism)
+    p = build_predictor(mlp_model, mesh_model=2)
+    assert dict(p.mesh.shape) == {"data": 1, "model": 2}
+    # non-MLP params have nothing to tensor-shard: data-parallel serving,
+    # and a requested model axis degrades (fleet-wide env knob vs
+    # per-swap model class — must not crash-loop the pod)
+    p = build_predictor(linear_model, mesh_data=2)
+    assert isinstance(p, DataParallelPredictor)
+    p = build_predictor(linear_model, mesh_data=2, mesh_model=2)
+    assert isinstance(p, DataParallelPredictor)
+    assert dict(p.mesh.shape) == {"data": 2, "model": 1}
+    # single-device engines refuse the mesh outright
+    with pytest.raises(ValueError, match="single-device"):
+        build_predictor(mlp_model, mesh_data=2, engine="pallas")
+    with pytest.raises(ValueError, match="single-device"):
+        build_predictor(mlp_model, mesh_model=2, engine="xla-bf16")
+    # an oversized mesh request DEGRADES to the largest mesh that fits
+    # (fleet-wide env knob vs per-pod device count — never a crash loop)
+    p = build_predictor(mlp_model, mesh_data=1024)
+    assert dict(p.mesh.shape) == {"data": len(jax.devices()), "model": 1}
+    p = build_predictor(mlp_model, mesh_data=2, mesh_model=1024)
+    assert dict(p.mesh.shape) == {"data": len(jax.devices()), "model": 1}
+
+
+def test_quantized_dtype_over_mesh_keeps_f32(seeded_mlp_store):
+    """--dtype int8 + --mesh-data N is a config contradiction (the
+    quantized engines are single-device): serving keeps f32 OVER THE
+    MESH — the capacity knob wins, the pod never crash-loops — and the
+    gate counter says so."""
+    from bodywork_tpu.obs import get_registry
+    from bodywork_tpu.serve.server import build_serving_predictor
+
+    store, result = seeded_mlp_store
+    counter = get_registry().counter(
+        "bodywork_tpu_serve_quantization_gate_total"
+    )
+    before = counter.value(dtype="int8", outcome="unsupported_mesh")
+    predictor, served_dtype = build_serving_predictor(
+        store, result.model, 2, "xla", dtype="int8"
+    )
+    assert served_dtype == "float32"
+    assert isinstance(predictor, ShardedMLPPredictor)
+    assert counter.value(dtype="int8", outcome="unsupported_mesh") == \
+        before + 1
+
+
+# -- HTTP byte identity: sharded vs single-device, both engines --------------
+
+@pytest.fixture(scope="module")
+def sharded_vs_single(mlp_model):
+    """Four live HTTP services over ONE checkpoint: {single-device,
+    2x1-mesh} x {thread, aio}, coalescer on — the byte-identity grid."""
+    handles = {}
+    # ONE shared bucket set, divisible by the data axis: every request
+    # pads to the same shape on every service (where the byte-identity
+    # claim is exact — see the direct predictor test)
+    buckets = (8, 64)
+    for engine in ("thread", "aio"):
+        for tag, predictor in (
+            ("single", PaddedPredictor(mlp_model, buckets=buckets)),
+            ("sharded", ShardedMLPPredictor(
+                mlp_model,
+                make_mesh(data=2, devices=jax.devices()[:2]),
+                buckets=buckets,
+            )),
+        ):
+            app = create_app(
+                mlp_model, date(2026, 4, 1), predictor=predictor,
+                warmup=True, warmup_sync=False, batch_window_ms=2.0,
+            )
+            cls = AioServiceHandle if engine == "aio" else ServiceHandle
+            handles[(engine, tag)] = cls(app, "127.0.0.1", 0).start()
+    yield {
+        key: h.url.replace("/score/v1", "") for key, h in handles.items()
+    }
+    for h in handles.values():
+        h.stop()
+        h.app.close()
+
+
+@pytest.mark.parametrize("route,body,expect_status", [
+    ("/score/v1", {"X": 50}, 200),
+    ("/score/v1", {"X": [[60.0]]}, 200),
+    ("/score/v1/batch", {"X": [1.0, 2.0, 3.0]}, 200),
+    ("/score/v1", {"Y": 1}, 400),
+])
+def test_sharded_http_byte_identity(sharded_vs_single, route, body,
+                                    expect_status):
+    """The acceptance bar: sharded serving answers byte-identical HTTP
+    responses to single-device serving, on both engines."""
+    contents = set()
+    for key, base in sharded_vs_single.items():
+        resp = rq.post(base + route, json=body, timeout=10)
+        assert resp.status_code == expect_status, key
+        contents.add(resp.content)
+    assert len(contents) == 1
+
+
+def test_sharded_coalesced_path_byte_identical(sharded_vs_single):
+    """Concurrent single-row scores ride the coalescer into one padded
+    SHARDED device call — still byte-identical to the single-device
+    service, on both engines."""
+    xs = [float(v) for v in np.linspace(5, 95, 16)]
+
+    def burst(base):
+        out = {}
+
+        def one(x):
+            out[x] = rq.post(base + "/score/v1", json={"X": x}, timeout=10)
+
+        threads = [threading.Thread(target=one, args=(x,)) for x in xs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return out
+
+    per_target = {k: burst(base) for k, base in sharded_vs_single.items()}
+    for x in xs:
+        contents = {per_target[k][x].content for k in per_target}
+        assert len(contents) == 1, f"X={x}"
+    for k, responses in per_target.items():
+        assert all(r.status_code == 200 for r in responses.values()), k
+
+
+def test_firewall_fallback_on_sharded_production(mlp_model):
+    """A NaN canary over a SHARDED production: the firewall's fallback
+    re-predict rides the sharded predictor and answers byte-identical
+    to the clean production route."""
+    mesh = make_mesh(data=2, devices=jax.devices()[:2])
+    predictor = ShardedMLPPredictor(mlp_model, mesh, buckets=(1, 8))
+    app = create_app(
+        mlp_model, date(2026, 4, 1), predictor=predictor, warmup=True,
+        warmup_sync=False, model_key="models/prod.npz",
+        model_bounds={"lo": -1e6, "hi": 1e6},
+    )
+    client = app.test_client()
+    body = {"X": [55.0]}
+    clean = client.post("/score/v1", json=body)
+    assert clean.status_code == 200
+    bad_params = jax.tree_util.tree_map(
+        lambda leaf: np.full(np.shape(leaf), np.nan, dtype=np.float32),
+        mlp_model.host_params(),
+    )
+    bad = MLPRegressor(mlp_model.config, bad_params)
+    bad_predictor = ShardedMLPPredictor(bad, mesh, buckets=(1, 8))
+    app.set_canary(bad, date(2026, 4, 2), bad_predictor,
+                   model_key="models/bad.npz", fraction=1.0, seed=5)
+    answered = client.post("/score/v1", json=body)
+    assert answered.status_code == 200
+    assert answered.data == clean.data
+    assert answered.headers["X-Bodywork-Model-Key"] == "models/prod.npz"
+
+
+# -- hot swap through the real watcher path ----------------------------------
+
+def test_same_mesh_hot_swap_compiles_nothing(seeded_mlp_store):
+    """The zero-miss acceptance criterion: a same-architecture swap
+    through the real ``CheckpointWatcher`` path over a live mesh-served
+    app resolves every bucket from the process-wide cache — zero
+    executable-cache misses — and the app serves the NEW checkpoint
+    sharded over the SAME mesh."""
+    from bodywork_tpu.data import Dataset, generate_day, persist_dataset
+    from bodywork_tpu.serve.reload import CheckpointWatcher
+    from bodywork_tpu.train import train_on_history
+
+    store, result_a = seeded_mlp_store
+    buckets = (1, 8, 64)
+    predictor = ShardedMLPPredictor(
+        result_a.model, make_mesh(data=2, devices=jax.devices()[:2]),
+        buckets=buckets,
+    )
+    app = create_app(result_a.model, date(2026, 4, 1), predictor=predictor,
+                     warmup=True, warmup_sync=False,
+                     model_key=result_a.model_artefact_key)
+    watcher = CheckpointWatcher(
+        app, store, poll_interval_s=3600, mesh_data=2,
+        served_key=result_a.model_artefact_key, buckets=buckets,
+    )
+    # a second day's dataset -> a new same-architecture checkpoint
+    d2 = date(2026, 4, 2)
+    X2, y2 = generate_day(d2)
+    persist_dataset(store, Dataset(X2, y2, d2))
+    result_b = train_on_history(
+        store, "mlp", model_kwargs={"hidden": [8, 8], "n_steps": 40}
+    )
+    misses_before = EXECUTABLE_CACHE.stats()["misses"]
+    assert watcher.check_once() is True
+    assert EXECUTABLE_CACHE.stats()["misses"] == misses_before
+    swapped = app.predictor
+    assert isinstance(swapped, ShardedMLPPredictor)
+    assert dict(swapped.mesh.shape) == {"data": 2, "model": 1}
+    assert app.model_key == result_b.model_artefact_key
+    X = np.array([[42.0]], dtype=np.float32)
+    np.testing.assert_array_equal(
+        swapped.predict(X), np.asarray(result_b.model.predict(X))
+    )
+
+
+# -- /healthz + metrics ------------------------------------------------------
+
+def test_healthz_reports_mesh(mlp_model):
+    mesh = make_mesh(data=4, devices=jax.devices()[:4])
+    app = create_app(
+        mlp_model, date(2026, 4, 1),
+        predictor=ShardedMLPPredictor(mlp_model, mesh, buckets=(8,)),
+        warmup=True, warmup_sync=False,
+    )
+    payload, status, _retry = app.healthz_payload()
+    assert status == 200
+    assert payload["mesh"] == {"data": 4, "model": 1}
+    single = create_app(mlp_model, date(2026, 4, 1), buckets=(8,),
+                        warmup=False)
+    payload, _s, _r = single.healthz_payload()
+    assert payload["mesh"] is None
+
+
+def test_sharded_metrics_registered_and_counted(mlp_model):
+    """The ISSUE 14 obs satellite: the mesh-info gauge and the
+    per-dispatch counter pass the name lint and actually move."""
+    from bodywork_tpu.obs import get_registry
+    from bodywork_tpu.obs.registry import validate_metric_name
+
+    validate_metric_name("bodywork_tpu_parallel_mesh_info", "gauge")
+    validate_metric_name(
+        "bodywork_tpu_serve_sharded_dispatch_total", "counter"
+    )
+    reg = get_registry()
+    mesh = make_mesh(data=2, devices=jax.devices()[:2])
+    gauge = reg.gauge("bodywork_tpu_parallel_mesh_info")
+    assert gauge.value(data="2", model="1") == 1.0
+    counter = reg.counter("bodywork_tpu_serve_sharded_dispatch_total")
+    before = counter.value(mesh="2x1")
+    pred = ShardedMLPPredictor(mlp_model, mesh, buckets=(8,))
+    pred.predict(np.ones((3, 1), np.float32))
+    assert counter.value(mesh="2x1") > before
+
+
+# -- the three-table mesh-knob guard -----------------------------------------
+
+def test_mesh_knobs_cli_stage_and_k8s_stay_in_sync(monkeypatch):
+    """cli serve --mesh-data/--mesh-model env defaults == the pod-boot
+    ``_serve_env_knobs`` parsing == the env vars materialised on the
+    k8s serve Deployment. A knob present in only some layers would be
+    either unreachable or silently dead in the pipeline path (the PR 6
+    bug, twice re-pinned)."""
+    from bodywork_tpu.cli import build_parser
+    from bodywork_tpu.pipeline import default_pipeline
+    from bodywork_tpu.pipeline.k8s import generate_manifests
+    from bodywork_tpu.pipeline.stages import _serve_env_knobs
+
+    for mesh_d, mesh_m, want_d, want_m in (
+        ("4", "2", 4, 2),        # well-formed
+        ("0", "-2", None, 1),    # out-of-range -> defaults
+        ("two", "x", None, 1),   # malformed -> defaults
+        ("", "", None, 1),       # unset-equivalent
+    ):
+        monkeypatch.setenv("BODYWORK_TPU_MESH_DATA", mesh_d)
+        monkeypatch.setenv("BODYWORK_TPU_MESH_MODEL", mesh_m)
+        knobs = _serve_env_knobs()
+        assert knobs[4:] == (want_d, want_m), (mesh_d, mesh_m)
+        args = build_parser().parse_args(["serve", "--store", "s"])
+        assert (args.mesh_data, args.mesh_model) == (want_d, want_m)
+
+    docs = generate_manifests(default_pipeline(), store_path="/mnt/store")
+    deployment = next(
+        d for d in docs.values()
+        if d["kind"] == "Deployment" and "serve" in d["metadata"]["name"]
+    )
+    env_names = {
+        e["name"]
+        for e in deployment["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert {"BODYWORK_TPU_MESH_DATA", "BODYWORK_TPU_MESH_MODEL"} <= env_names
+
+
+def test_serve_stage_env_mesh_drives_sharded_serving(store, monkeypatch):
+    """The pipeline path end-to-end: BODYWORK_TPU_MESH_DATA on the pod
+    env shards the serve stage's predictor (the env var must not be
+    dead in the stage path — the PR 6 regression pattern)."""
+    from bodywork_tpu.data import Dataset, generate_day, persist_dataset
+    from bodywork_tpu.pipeline.stages import StageContext, serve_stage
+    from bodywork_tpu.train import train_on_history
+
+    d = date(2026, 4, 1)
+    X, y = generate_day(d)
+    persist_dataset(store, Dataset(X, y, d))
+    train_on_history(store, "mlp", model_kwargs={"hidden": [8, 8],
+                                                 "n_steps": 30})
+    monkeypatch.setenv("BODYWORK_TPU_MESH_DATA", "2")
+    ctx = StageContext(store=store, today=d)
+    handle = serve_stage(ctx, buckets=(1, 8))
+    try:
+        app = handle.app
+        assert isinstance(app.predictor, ShardedMLPPredictor)
+        assert dict(app.predictor.mesh.shape) == {"data": 2, "model": 1}
+        resp = rq.post(
+            f"http://{handle.host}:{handle.port}/score/v1",
+            json={"X": 42.0}, timeout=10,
+        )
+        assert resp.status_code == 200
+    finally:
+        handle.stop()
+
+
+# -- bench config 12 ---------------------------------------------------------
+
+def test_bench_config12_registered():
+    import bench
+
+    assert 12 in bench.ALL_CONFIGS
+    assert 12 in bench.CONFIG_BENCHES
+    assert 12 in bench.CONFIG_TIMEOUT_S
+    assert bench.SHARDED_MESH_SIZES == (1, 2, 4, 8)
+
+
+def test_bench_config12_smoke(tmp_path):
+    """Config 12 at smoke scale (tier-1, seconds): in-process servers on
+    a 2-point mesh sweep over the test env's virtual devices; the full
+    subprocess-isolated sweep is the slow-marked capture."""
+    import bench
+
+    rec = bench.bench_sharded_scaling(
+        mesh_sizes=(1, 2), isolate=False, capacity_window_s=0.5,
+        rate_cap_rps=400.0, dispatch_bucket=64, dispatch_reps=3,
+        mlp_kwargs={"hidden": [8, 8], "n_steps": 30},
+    )
+    assert rec["metric"] == "sharded_scaling_efficiency"
+    points = rec["points"]
+    assert points["1"]["healthz_mesh"] is None
+    assert points["2"]["healthz_mesh"] == {"data": 2, "model": 1}
+    for p in points.values():
+        assert p["capacity_rps"] > 0
+        assert p["device_dispatch_rows_per_s"] > 0
+    assert points["2"]["capacity_scaling_efficiency"] is not None
+    assert "cpu_caveat" in rec
+
+
+@pytest.mark.slow
+def test_bench_config12_full_sweep_subprocess():
+    """The committed-record protocol at reduced duration: subprocess
+    isolation, real --mesh-data servers, per-mesh dispatch probes."""
+    import bench
+
+    rec = bench.bench_sharded_scaling(
+        mesh_sizes=(1, 2), capacity_window_s=1.0, rate_cap_rps=800.0,
+        dispatch_bucket=512, dispatch_reps=5,
+        mlp_kwargs={"hidden": [8, 8], "n_steps": 30},
+    )
+    assert rec["points"]["2"]["healthz_mesh"] == {"data": 2, "model": 1}
+    assert rec["value"] is not None
