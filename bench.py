@@ -103,7 +103,7 @@ from datetime import date
 BASELINE_DAY_S = 1317 * 0.00822  # reference stage-4 scoring loop, see above
 BASELINE_REQUEST_S = 0.00822  # reference per-request scoring latency
 
-ALL_CONFIGS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12)
+ALL_CONFIGS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13)
 HEADLINE_CONFIG = 2  # the north-star day loop
 
 #: config 11's padded-bucket sweep — pinned == serve.predictor.
@@ -1671,10 +1671,14 @@ class _ServeTarget:
     the tier-1 smoke, where rates are too low for contention to
     matter)."""
 
-    def __init__(self, store_path: str, engine: str, window_ms: float,
-                 max_rows: int, buckets, isolate: bool,
+    def __init__(self, store_path: str, engine: str, window_ms: float | None,
+                 max_rows: int | None, buckets, isolate: bool,
                  dtype: str = "float32", mesh_data: int | None = None,
-                 env: dict | None = None):
+                 env: dict | None = None, max_pending: int | None = None,
+                 tuned_config: str | None = None):
+        # window_ms/max_rows/buckets left None are NOT passed (the
+        # config-13 tuned servers boot that way so the tuned document —
+        # not an explicit flag — supplies every knob)
         self.engine = engine
         self._proc = None
         self._handle = None
@@ -1684,10 +1688,17 @@ class _ServeTarget:
             cmd = [sys.executable, "-m", "bodywork_tpu.cli", "serve",
                    "--store", store_path, "--host", "127.0.0.1",
                    "--port", str(port), "--server-engine", engine,
-                   "--reload-interval", "0",
-                   "--batch-window-ms", str(window_ms),
-                   "--batch-max-rows", str(max_rows),
-                   "--buckets", ",".join(str(b) for b in buckets)]
+                   "--reload-interval", "0"]
+            if window_ms is not None:
+                cmd += ["--batch-window-ms", str(window_ms)]
+            if max_rows is not None:
+                cmd += ["--batch-max-rows", str(max_rows)]
+            if buckets is not None:
+                cmd += ["--buckets", ",".join(str(b) for b in buckets)]
+            if max_pending is not None:
+                cmd += ["--max-pending", str(max_pending)]
+            if tuned_config is not None:
+                cmd += ["--tuned-config", tuned_config]
             if dtype != "float32":
                 cmd += ["--dtype", dtype]
             if mesh_data and mesh_data > 1:
@@ -1707,7 +1718,8 @@ class _ServeTarget:
                 FilesystemStore(store_path), host="127.0.0.1", port=0,
                 block=False, buckets=buckets, batch_window_ms=window_ms,
                 batch_max_rows=max_rows, server_engine=engine,
-                dtype=dtype, mesh_data=mesh_data,
+                dtype=dtype, mesh_data=mesh_data, max_pending=max_pending,
+                tuned_config=tuned_config,
             )
             self.base_url = self._handle.url.replace("/score/v1", "")
 
@@ -3005,6 +3017,380 @@ def bench_sharded_scaling(
     }
 
 
+# -- config 13: self-tuning runtime ------------------------------------------
+
+#: profile -> the knob whose mechanism it exercises (the knob the
+#: profile's win is CREDITED to; acceptance needs >=2 distinct knobs
+#: beating their hand-set defaults across >=2 profiles).
+#: `max_pending` keeps its Little's-law model + decision trace but is
+#: deliberately NOT a credited knob here: on this box's aio engine the
+#: overload tail is dominated by pre-admission event-loop/accept
+#: backlog the budget cannot see (the config-9 front-end ceiling), so
+#: budget changes move the goodput/shed balance, not p99 — measured
+#: (budgets 512/150/64 at 2000 rps: p99 0.76/1.29/0.30 s,
+#: non-monotonic = stall-noise-bound) and documented in-record. The
+#: bursty profile's `batch_max_rows` credit is the same box-limited
+#: story: a squall's backlog drains at the FRONT END's per-request
+#: rate, so flush-size gains are masked here — the committed capture
+#: shows the profile beating defaults on p50/p99 via its OTHER fitted
+#: knobs while the knee honestly matched the default flush size
+#: (uncredited); the mechanism's regime is a dispatch-bound box
+#: (TPU/multi-core), where the credited knob earns its place.
+SELF_TUNING_PROFILES = {
+    "uniform_row": "batch_window_ms",
+    "heavy_tail_row": "buckets",
+    "bursty_mmpp": "batch_max_rows",
+}
+
+
+def _merge_request_logs(*logs):
+    """Deterministically interleave request logs by scheduled arrival
+    (stable sort: composition of seeded logs stays seeded)."""
+    merged = [r for log in logs for r in log]
+    merged.sort(key=lambda r: r.t_s)
+    return merged
+
+
+def _profile_request_log(profile: str, rate_rps: float, duration_s: float,
+                         heavy_batch_rows: int = 700):
+    """The seeded request log for one config-13 traffic profile.
+
+    - ``uniform_row``: Poisson single-row arrivals — the regime where
+      the default 2 ms coalescer window is pure latency tax.
+    - ``heavy_tail_row``: 75% single-row + 25% ``heavy_batch_rows``-row
+      batch requests (two seeded logs merged by arrival time) — a
+      row-shape distribution whose tail the default bucket ladder pads
+      to 4096.
+    - ``bursty_mmpp``: MMPP squalls at the same mean rate — the
+      admission-budget regime (drive it above capacity).
+    """
+    from bodywork_tpu.traffic import TrafficConfig, generate_request_log
+
+    if profile == "uniform_row":
+        return generate_request_log(TrafficConfig(
+            rate_rps=rate_rps, duration_s=duration_s, seed=131,
+        ))
+    if profile == "heavy_tail_row":
+        singles = generate_request_log(TrafficConfig(
+            rate_rps=rate_rps * 0.75, duration_s=duration_s, seed=132,
+        ))
+        batches = generate_request_log(TrafficConfig(
+            rate_rps=rate_rps * 0.25, duration_s=duration_s, seed=133,
+            batch_fraction=1.0, batch_rows=heavy_batch_rows,
+        ))
+        return _merge_request_logs(singles, batches)
+    if profile == "bursty_mmpp":
+        return generate_request_log(TrafficConfig(
+            rate_rps=rate_rps, duration_s=duration_s, arrival="mmpp",
+            seed=134,
+        ))
+    raise ValueError(f"unknown profile {profile!r}")
+
+
+def _tuned_beats_default(default_rep: dict, tuned_rep: dict) -> tuple[bool, dict]:
+    """Did the tuned config beat the hand-set defaults on in-window
+    goodput OR p99 (without materially regressing the other)?"""
+    d_p99 = (default_rep.get("latency") or {}).get("p99_s")
+    t_p99 = (tuned_rep.get("latency") or {}).get("p99_s")
+    d_good = default_rep.get("goodput_in_window_rps") or 0.0
+    t_good = tuned_rep.get("goodput_in_window_rps") or 0.0
+    p99_improved = (
+        d_p99 is not None and t_p99 is not None
+        and t_p99 <= 0.95 * d_p99
+        and t_good >= 0.95 * d_good
+    )
+    goodput_improved = (
+        t_good >= 1.05 * d_good
+        and (d_p99 is None or t_p99 is None or t_p99 <= 1.2 * d_p99)
+    )
+    return p99_improved or goodput_improved, {
+        "default_p99_s": d_p99, "tuned_p99_s": t_p99,
+        "default_goodput_in_window_rps": d_good,
+        "tuned_goodput_in_window_rps": t_good,
+        "p99_improved": p99_improved,
+        "goodput_improved": goodput_improved,
+    }
+
+
+def bench_self_tuning(
+    drive_s: float = 8.0,
+    uniform_rate_rps: float = 150.0,
+    heavy_rate_rps: float = 40.0,
+    heavy_batch_rows: int = 700,
+    burst_load_factor: float = 0.9,
+    rate_cap_rps: float = OPEN_LOOP_RATE_CAP_RPS,
+    capacity_window_s: float = 3.0,
+    isolate: bool = True,
+    probe_reps: int = 5,
+    mlp_kwargs: dict | None = None,
+    profiles_run: tuple = tuple(SELF_TUNING_PROFILES),
+    probe_buckets: tuple = (1, 8, 64, 256, 512, 1024, 4096),
+) -> dict:
+    """Config 13: the self-tuning runtime (``bodywork_tpu/tune``,
+    ROADMAP item 5) — ``cli tune`` on a profile's own traces must beat
+    the hand-set serving defaults when the SAME seeded traffic is
+    re-driven under the tuned config.
+
+    Per seeded profile (uniform-row / heavy-tail-row / bursty MMPP):
+
+    1. drive the profile's request log against a DEFAULT-knob aio
+       server (window 2 ms, max_rows 64, the 5-rung default bucket
+       ladder, admission 512), request + results logs written;
+    2. tune exactly as ``cli tune`` would: ingest both logs, probe the
+       serving checkpoint's per-bucket dispatch-cost curve, fit the
+       cost model, persist the tuned document under ``tuning/``;
+    3. re-drive the IDENTICAL log against a server booted with ONLY
+       ``--tuned-config <key>`` (no explicit knob flags — the document
+       supplies every value; /healthz ``effective_config.tuned_config``
+       is captured as proof of consumption);
+    4. compare in-window goodput and p99. A profile's win is credited
+       to the knob whose mechanism it exercises
+       (:data:`SELF_TUNING_PROFILES`); acceptance = >=2 distinct knobs
+       beating their defaults across >=2 profiles, decision traces
+       in-record.
+
+    A sabotage block additionally boots a server against a garbage
+    tuned document and records that it serves with the built-in
+    defaults (effective_config.tuned_config null) — the
+    malformed-degrades contract, measured not assumed.
+
+    CPU-safe: every mechanism (window latency tax, padding waste,
+    burst-backlog drain) exists wherever the dispatch cost is nonzero;
+    the record carries cpu_count and backend.
+    """
+    from datetime import timedelta
+
+    import requests as rq
+
+    from bodywork_tpu.data import Dataset, generate_day, persist_dataset
+    from bodywork_tpu.serve.admission import DEFAULT_MAX_PENDING
+    from bodywork_tpu.serve.batcher import DEFAULT_MAX_ROWS, DEFAULT_WINDOW_MS
+    from bodywork_tpu.serve.predictor import DEFAULT_BUCKETS
+    from bodywork_tpu.store import FilesystemStore
+    from bodywork_tpu.traffic import run_open_loop, write_request_log
+    from bodywork_tpu.traffic.generator import TrafficConfig
+    from bodywork_tpu.train import train_on_history
+    from bodywork_tpu.tune.collect import (
+        ObservationTable,
+        ingest_request_log,
+        ingest_results_log,
+        probe_dispatch_costs,
+    )
+    from bodywork_tpu.tune.config import write_tuned_config
+    from bodywork_tpu.tune.model import fit_tuned_config
+
+    store_path = tempfile.mkdtemp(prefix="bench-selftune-")
+    store = FilesystemStore(store_path)
+    d0 = date(2026, 1, 1)
+    X, y = generate_day(d0)
+    persist_dataset(store, Dataset(X, y, d0))
+    # an MLP checkpoint: enough per-row compute that padding a 700-row
+    # request to 4096 instead of its 1024 cover is a dispatch-cost
+    # delta (tens of ms) far above the box's ~10 ms scheduling-noise
+    # tail — the heavy-tail profile's p99 must measure the LADDER, not
+    # the noise floor (single-row dispatch stays trivial, so the other
+    # profiles' dynamics are unchanged)
+    train_on_history(
+        store, "mlp",
+        model_kwargs=mlp_kwargs or {"hidden": [256, 256], "n_steps": 60},
+    )
+    defaults = {
+        "window_ms": DEFAULT_WINDOW_MS, "max_rows": DEFAULT_MAX_ROWS,
+        "buckets": tuple(DEFAULT_BUCKETS),
+        "max_pending": DEFAULT_MAX_PENDING,
+    }
+
+    def start_default():
+        return _ServeTarget(
+            store_path, "aio", defaults["window_ms"], defaults["max_rows"],
+            defaults["buckets"], isolate,
+            max_pending=defaults["max_pending"],
+        )
+
+    def healthz(target):
+        return rq.get(target.base_url + "/healthz", timeout=10).json()
+
+    profiles: dict = {}
+    scratch = tempfile.mkdtemp(prefix="bench-selftune-logs-")
+    for i, profile in enumerate(profiles_run):
+        primary_knob = SELF_TUNING_PROFILES[profile]
+        # -- offered traffic (capacity-relative for the overload profile)
+        target = start_default()
+        try:
+            if profile == "bursty_mmpp":
+                # 0.9x the default server's measured capacity: the MEAN
+                # rate fits, but the MMPP burst state (4x the calm
+                # rate) transiently exceeds it — the burst-absorption
+                # regime where flush size decides how fast a squall's
+                # backlog drains (overload p99 on this box is
+                # front-end-backlog-bound and admission-insensitive —
+                # see SELF_TUNING_PROFILES)
+                capacity, _ramp = _open_loop_capacity(
+                    target.url, rate_cap_rps, window_s=capacity_window_s
+                )
+                rate = min(burst_load_factor * capacity, rate_cap_rps)
+            else:
+                capacity = None
+                rate = (
+                    uniform_rate_rps if profile == "uniform_row"
+                    else heavy_rate_rps
+                )
+            request_log = _profile_request_log(
+                profile, rate, drive_s, heavy_batch_rows
+            )
+            log_path = os.path.join(scratch, f"{profile}.requests.jsonl")
+            results_path = os.path.join(scratch, f"{profile}.results.jsonl")
+            # the header's config is nominal (the heavy-tail profile is
+            # a merged composition) — the tuner reads only the entries
+            write_request_log(
+                log_path,
+                TrafficConfig(rate_rps=rate, duration_s=drive_s, seed=131),
+                request_log,
+            )
+            default_report = run_open_loop(
+                target.url, request_log, timeout_s=30.0,
+                duration_s=drive_s, results_log=results_path,
+            ).to_dict()
+        finally:
+            target.stop()
+
+        # -- tune on this profile's traces (the cli tune flow)
+        table = ObservationTable()
+        ingest_request_log(table, log_path)
+        ingest_results_log(table, results_path)
+        table.dispatch_cost_s = probe_dispatch_costs(
+            store, probe_buckets, reps=probe_reps
+        )
+        table.sources.append("dispatch_probe")
+        doc = fit_tuned_config(table)
+        tuned_key, tuned_digest = write_tuned_config(
+            store, doc, day=d0 + timedelta(days=i + 1)
+        )
+
+        # -- re-drive the identical log under the tuned config
+        tuned_target = _ServeTarget(
+            store_path, "aio", None, None, None, isolate,
+            tuned_config=tuned_key,
+        )
+        try:
+            applied = healthz(tuned_target).get("effective_config")
+            tuned_report = run_open_loop(
+                tuned_target.url, request_log, timeout_s=30.0,
+                duration_s=drive_s,
+            ).to_dict()
+        finally:
+            tuned_target.stop()
+        beats, comparison = _tuned_beats_default(default_report, tuned_report)
+        changed = {
+            dec["knob"] for dec in doc["decisions"]
+            if dec["source"] == "fitted" and dec["chosen"] != dec["default"]
+        }
+        print(
+            f"  {profile}: default p99 {comparison['default_p99_s']}s / "
+            f"{comparison['default_goodput_in_window_rps']:.0f} rps -> "
+            f"tuned p99 {comparison['tuned_p99_s']}s / "
+            f"{comparison['tuned_goodput_in_window_rps']:.0f} rps "
+            f"({'BEATS' if beats else 'no win'}; primary={primary_knob})",
+            file=sys.stderr,
+        )
+        profiles[profile] = {
+            "offered_rate_rps": rate,
+            "measured_capacity_rps": capacity,
+            "primary_knob": primary_knob,
+            "tuned_config_key": tuned_key,
+            "tuned_config_digest": tuned_digest,
+            "effective_config_applied": applied,
+            "knobs": doc["knobs"],
+            "decisions": doc["decisions"],
+            "changed_knobs": sorted(changed),
+            "default": default_report,
+            "tuned": tuned_report,
+            "comparison": comparison,
+            "tuned_beats_default": beats,
+            "primary_knob_credited": beats and primary_knob in changed,
+        }
+
+    # -- sabotage: a garbage tuned document must degrade, not crash ---------
+    sabotage_key = "tuning/tuned-config-2026-09-01.json"
+    store.put_bytes(sabotage_key, b'{"schema": "nope", "knobs": 17')
+    from bodywork_tpu.serve import serve_latest_model
+
+    handle = serve_latest_model(
+        store, host="127.0.0.1", port=0, block=False,
+        server_engine="aio", tuned_config=sabotage_key,
+    )
+    try:
+        sab = rq.get(
+            handle.url.replace("/score/v1", "") + "/healthz", timeout=10
+        ).json()
+        score = rq.post(
+            handle.url, json={"X": [50.0]}, timeout=10
+        )
+        sabotage = {
+            "healthz_status": sab.get("status"),
+            "effective_config": sab.get("effective_config"),
+            "score_status": score.status_code,
+            "degraded_to_defaults": (
+                (sab.get("effective_config") or {}).get("tuned_config")
+                is None
+                and score.status_code == 200
+            ),
+        }
+    finally:
+        handle.stop()
+
+    credited = sorted({
+        p["primary_knob"] for p in profiles.values()
+        if p["primary_knob_credited"]
+    })
+    beating_profiles = [
+        name for name, p in profiles.items() if p["tuned_beats_default"]
+    ]
+    return {
+        "metric": "self_tuning_knobs_beating_defaults",
+        "unit": "distinct knobs credited with a tuned win",
+        "value": len(credited),
+        "vs_baseline": None,
+        "baseline_note": (
+            "the baseline IS the hand-set defaults (window "
+            f"{defaults['window_ms']} ms, max_rows "
+            f"{defaults['max_rows']}, buckets {list(defaults['buckets'])}, "
+            f"max_pending {defaults['max_pending']}) driven on the same "
+            "seeded logs in the same run — no external number applies"
+        ),
+        "cpu_count": os.cpu_count(),
+        "knobs_beating_defaults": credited,
+        "profiles_beating": beating_profiles,
+        "acceptance": {
+            "required": ">=2 distinct knobs beating their hand-set "
+                        "defaults on in-window goodput or p99, across "
+                        ">=2 seeded profiles, and the sabotaged config "
+                        "degrading to defaults",
+            "passed": (
+                len(credited) >= 2
+                and len(beating_profiles) >= 2
+                and sabotage["degraded_to_defaults"]
+            ),
+        },
+        "sabotage": sabotage,
+        "profiles": profiles,
+        "protocol": (
+            "one MLP checkpoint; per seeded profile (uniform-row "
+            f"Poisson @{uniform_rate_rps} rps, heavy-tail-row 75/25 "
+            f"single/{heavy_batch_rows}-row mix @{heavy_rate_rps} rps, "
+            f"bursty MMPP @{burst_load_factor}x measured capacity, "
+            "4x burst multiplier): drive "
+            "the log against default knobs (request+results logs "
+            "written), tune from those traces + the dispatch-cost "
+            "probe (the cli tune flow), persist under tuning/, "
+            "re-drive the IDENTICAL log against a server booted with "
+            "only --tuned-config, compare in-window goodput/p99; wins "
+            "credited to each profile's primary knob; plus the "
+            "garbage-document degrade check"
+        ),
+    }
+
+
 #: CONFIG_TIMEOUT_S budget and appear in ALL_CONFIGS — pinned by
 #: tests/test_bench.py::test_config_registry_sync so a new config can
 #: never silently miss one of the three tables (config 7 was once wired
@@ -3024,6 +3410,7 @@ CONFIG_BENCHES = {
     10: lambda: bench_incremental_train(),
     11: lambda: bench_compiled_serving(),
     12: lambda: bench_sharded_scaling(),
+    13: lambda: bench_self_tuning(),
 }
 
 
@@ -3093,9 +3480,13 @@ RESUME_MAX_AGE_S = 6 * 3600
 #: plus four dispatch-probe subprocesses (another cold init each) around
 #: capacity ramps of a few seconds per window — generously sized for a
 #: loaded box
+#: config 13 is host-side HTTP + subprocess serving around small device
+#: calls: 3 profiles x 2 subprocess servers (a cold JAX init each) +
+#: one capacity ramp + ~12 s of timed drives per profile + the
+#: in-process dispatch probe and sabotage boot — generously sized
 CONFIG_TIMEOUT_S = {
     1: 300, 2: 300, 3: 600, 4: 600, 5: 450, 6: 1200, 7: 600, 8: 300,
-    9: 600, 10: 1800, 11: 1200, 12: 1200,
+    9: 600, 10: 1800, 11: 1200, 12: 1200, 13: 900,
 }
 
 
@@ -3399,14 +3790,15 @@ def compact_output(records: list[dict], backend: str,
             # recreate the parsed-as-null failure (full text is in the
             # full record). 80 chars each (plus the float rounding) keeps
             # the worst case — a failed config AND flagged configs — under
-            # the 2000-char tail now that the run list holds 11 configs;
-            # per-config `unit` (at 10 configs) and `vs_baseline` (at 11)
-            # are dropped from the one-liners for the same budget (the
-            # headline keeps both, the full record has them all)
+            # the 2000-char tail now that the run list holds 13 configs;
+            # per-config `unit` (at 10 configs), `vs_baseline` (at 11),
+            # and `resumed` (at 13) are dropped from the one-liners for
+            # the same budget (the headline keeps metric/unit/
+            # vs_baseline, the full record has them all)
             k: (r[k][:80] if k in ("error", "cpu_scaled_protocol",
                                    "timing_anomaly") else _sig(r[k]))
             for k in ("config", "metric", "value",
-                      "backend", "elapsed_s", "resumed", "error",
+                      "backend", "elapsed_s", "error",
                       "cpu_scaled_protocol", "timing_anomaly")
             if k in r
         }

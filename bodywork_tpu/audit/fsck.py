@@ -78,6 +78,7 @@ from bodywork_tpu.store.schema import (
     SNAPSHOTS_PREFIX,
     TEST_METRICS_PREFIX,
     TRAINSTATE_PREFIX,
+    TUNING_PREFIX,
     audit_digest_key,
     audit_primary_key,
 )
@@ -724,6 +725,75 @@ def _check_flightrec(ctx: FsckContext) -> list[Finding]:
     return out
 
 
+def _check_tuning(ctx: FsckContext) -> list[Finding]:
+    """Tuned serving-config documents (``tune/config.py``):
+    schema-tagged JSON with an embedded ``doc_digest`` plus a raw-byte
+    sidecar carrying a compressed replica. Rot is RESTORABLE while the
+    replica survives; without one the document is merely rebuildable-
+    by-deletion — serving already degrades to the built-in defaults on
+    any validation failure, so dropping the corrupt document converges
+    the store to the same state serving sees (re-running ``cli tune``
+    re-fits it)."""
+    from bodywork_tpu.tune.config import TUNED_CONFIG_SCHEMA
+
+    out = []
+    for key in ctx.keys[TUNING_PREFIX]:
+        data = _get(ctx.store, key)
+        if data is None:
+            continue
+        sidecar_doc, status = ctx.sidecar(key)
+        doc = _json_doc(data)
+        # validity deliberately MATCHES the serving loader's integrity
+        # checks (schema tag + doc_digest + knobs-field shape), NOT its
+        # per-knob value validation: a digest-valid document holding a
+        # knob this version rejects (or none at all) was WRITTEN that
+        # way — e.g. an evidence-poor fit or a newer schema — and the
+        # loader degrades per knob; fsck flagging it would restore-flap
+        # (replica == primary) or quarantine a healthy document
+        valid = (
+            doc is not None
+            and doc.get("schema") == TUNED_CONFIG_SCHEMA
+            and verify_doc(doc) is not False
+            and (doc.get("knobs") is None or isinstance(doc["knobs"], dict))
+        )
+        digest_ok = (
+            status != "ok" or sidecar_doc["sha256"] == artefact_sha256(data)
+        )
+        if valid:
+            if status == "absent":
+                out.append(Finding(
+                    key, TUNING_PREFIX, "undigested", "advisory",
+                    detail="no write-time digest recorded (tuned config "
+                           "written outside an audited store); "
+                           "whitespace rot here would be invisible",
+                    repair="backfill_digest",
+                ))
+            elif not digest_ok:
+                # primary verifies its own embedded digest: the SIDECAR
+                # is the stale half (registry rule) — re-record it
+                out.append(Finding(
+                    audit_digest_key(key), AUDIT_PREFIX, "stale_sidecar",
+                    "restorable",
+                    detail=f"sidecar digest disagrees with a healthy "
+                           f"{key!r} (doc digest verifies)",
+                    repair="rebuild_sidecar",
+                ))
+            continue
+        restorable = status == "ok" and sidecar_doc.get("replica")
+        out.append(Finding(
+            key, TUNING_PREFIX, "unreadable",
+            "restorable" if restorable else "rebuildable",
+            detail="tuned config fails schema/doc-digest/knob validation"
+                   + (" — restored from the sidecar replica"
+                      if restorable else
+                      " and no sidecar replica survives — derived "
+                      "artefact: dropped (serving already degrades to "
+                      "the built-in defaults; `cli tune` re-fits it)"),
+            repair="restore_replica" if restorable else "drop_tuned_config",
+        ))
+    return out
+
+
 def _check_quarantine(ctx: FsckContext) -> list[Finding]:
     out = []
     keys = set(ctx.keys[QUARANTINE_PREFIX])
@@ -772,6 +842,7 @@ CHECKERS = {
     TRAINSTATE_PREFIX: _check_trainstate,
     RUNS_PREFIX: _check_runs,
     REGISTRY_PREFIX: _check_registry,
+    TUNING_PREFIX: _check_tuning,
     AUDIT_PREFIX: _check_audit,
     QUARANTINE_PREFIX: _check_quarantine,
     FLIGHTREC_PREFIX: _check_flightrec,

@@ -54,6 +54,7 @@ from bodywork_tpu.store.schema import (
     REGISTRY_PREFIX,
     SNAPSHOTS_PREFIX,
     TEST_METRICS_PREFIX,
+    TUNING_PREFIX,
     audit_digest_key,
 )
 from bodywork_tpu.utils.integrity import sha256_digest, stamp_doc, verify_doc
@@ -77,6 +78,11 @@ PUT_SIDECAR_PREFIXES = (
     # rebuild them — the sidecar (with replica, below) is their only
     # redundancy against at-rest rot
     FLIGHTREC_PREFIX,
+    # tuned serving configs (tune/config.py): the traces they were
+    # fitted from may be gone by scrub time, so the sidecar replica is
+    # what makes at-rest rot restorable instead of a silent revert to
+    # the hand-set defaults
+    TUNING_PREFIX,
 )
 
 #: CAS-mutated classes that also get a sidecar, written after each
@@ -96,6 +102,8 @@ REPLICA_PREFIXES = (
     # dumps are ring-buffer bounded (a few hundred KB at most), so the
     # compressed replica is cheap insurance for unrebuildable evidence
     FLIGHTREC_PREFIX,
+    # tuned configs are a few KB of knobs + decision trace
+    TUNING_PREFIX,
 )
 
 #: fixed zlib level: replica bytes must be deterministic across

@@ -60,6 +60,7 @@ REPAIR_ORDER = (
     "drop_orphan_sidecar",
     "drop_trainstate",
     "drop_journal",
+    "drop_tuned_config",
     "clear_previous",
     "repair_canary",
     "rebuild_snapshot",
@@ -362,6 +363,10 @@ def execute_repairs(ctx, findings) -> list[dict]:
         "drop_orphan_sidecar": _drop_orphan_sidecar,
         "drop_trainstate": _drop_and_quarantine,
         "drop_journal": _drop_and_quarantine,
+        # a replica-less corrupt tuned config: serving already degrades
+        # to the built-in defaults on it, so dropping converges the
+        # store to what serving sees (`cli tune` re-fits it)
+        "drop_tuned_config": _drop_and_quarantine,
         "clear_previous": _clear_previous,
         "repair_canary": _repair_canary,
     }

@@ -106,9 +106,14 @@ class FaultPlan:
     #: share this one plan format (and the flag > plan > env
     #: precedence), so a run-sim soak and an fsck soak reproduce from
     #: the same JSON document.
+    #: ``tuning/`` readers (``tune/config.py``) schema+digest-validate
+    #: and degrade to the built-in serving defaults on any failure —
+    #: corruption can cost the tuned knob values for one boot, never a
+    #: crash or a wrong artefact, so the prefix is in-flight-corruption
+    #: safe by the same argument as ``trainstate/``.
     corrupt_read_p: float = 0.0
     corrupt_prefixes: tuple[str, ...] = (
-        "snapshots/", "registry/", "runs/", "trainstate/"
+        "snapshots/", "registry/", "runs/", "trainstate/", "tuning/"
     )
     #: AT-REST bit rot (``chaos/bitrot.py``, ``cli chaos run-sim
     #: --bit-rot``): per-KEY seeded decision over a FINISHED store's

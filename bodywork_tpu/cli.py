@@ -22,6 +22,7 @@ its own driver:
     python -m bodywork_tpu.cli registry canary start|stop|promote|status --store DIR ...
     python -m bodywork_tpu.cli traffic run --url URL [--rate R] [--duration S] ...
     python -m bodywork_tpu.cli trace show|tail|export --store DIR ...
+    python -m bodywork_tpu.cli tune     --store DIR [--traffic-log F] [--dry-run]
 
 Every command exits 0 on success and 1 with a logged error otherwise — the
 exit-code contract the reference implements per-script
@@ -181,8 +182,15 @@ def cmd_serve(args) -> int:
     )
 
     watch = args.reload_interval if args.reload_interval > 0 else None
-    batch_window = args.batch_window_ms if args.batch_window_ms > 0 else None
-    if args.batch_max_rows and batch_window is None:
+    # None = unset (a tuned config may fill it), 0 = EXPLICIT coalescing
+    # off (beats the tuned document — explicit always wins), > 0 = on;
+    # a negative value degrades to unset as before
+    batch_window = (
+        args.batch_window_ms
+        if args.batch_window_ms is not None and args.batch_window_ms >= 0
+        else None
+    )
+    if args.batch_max_rows and not batch_window and not args.tuned_config:
         # max-rows alone would silently serve unbatched — the window is
         # the coalescer's on-switch
         log.warning(
@@ -212,6 +220,7 @@ def cmd_serve(args) -> int:
             max_pending=args.max_pending,
             retry_after_max_s=args.retry_after_max_s,
             dtype=args.dtype,
+            tuned_config=args.tuned_config,
         ).start()
         if svc.metrics_url:
             log.info(f"aggregated metrics at {svc.metrics_url}")
@@ -257,10 +266,113 @@ def cmd_serve(args) -> int:
                 max_pending=args.max_pending,
                 retry_after_max_s=args.retry_after_max_s,
                 dtype=args.dtype,
+                tuned_config=args.tuned_config,
             )
         except ShutdownRequested:
             log.warning("SIGTERM during service startup; exiting")
     return SIGTERM_EXIT if sigterm_fired.is_set() else 0
+
+
+def cmd_tune(args) -> int:
+    """Fit the serving knobs from observed traces (ROADMAP item 5,
+    docs/PERF.md §config 13): ingest traffic request/results logs, obs
+    snapshots, and day reports into one observation table, probe the
+    serving checkpoint's per-bucket dispatch-cost curve, fit the cost
+    model, and persist the tuned config under ``tuning/`` — the
+    document ``serve --tuned-config latest`` (or the deployed
+    BODYWORK_TPU_TUNED_CONFIG env knob) consumes. stdout is exactly ONE
+    JSON document (key, digest, knobs, decision trace)."""
+    from bodywork_tpu.obs.spans import SpanRecorder, write_chrome_trace
+    from bodywork_tpu.tune.collect import (
+        ObservationTable,
+        ingest_day_report,
+        ingest_obs_snapshot,
+        ingest_request_log,
+        ingest_results_log,
+        probe_dispatch_costs,
+    )
+    from bodywork_tpu.tune.config import KNOB_DEFAULTS, write_tuned_config
+    from bodywork_tpu.tune.model import fit_tuned_config
+
+    configure_logger(stream=sys.stderr)
+    import json
+
+    store = _store(args)
+    table = ObservationTable()
+    try:
+        for path in args.traffic_log or ():
+            n = ingest_request_log(table, path)
+            log.info(f"ingested {n} scheduled requests from {path}")
+        for path in args.results_log or ():
+            n = ingest_results_log(table, path)
+            log.info(f"ingested {n} request outcomes from {path}")
+        for path in args.obs_snapshot or ():
+            ingest_obs_snapshot(table, path)
+            log.info(f"ingested obs snapshot {path}")
+        for path in args.day_report or ():
+            ingest_day_report(table, path)
+            log.info(f"ingested day report {path}")
+    except (OSError, ValueError, KeyError) as exc:
+        log.error(f"trace ingestion failed: {exc}")
+        return 1
+    if args.probe:
+        try:
+            table.dispatch_cost_s = probe_dispatch_costs(
+                store, tuple(args.probe_buckets), reps=args.probe_reps
+            )
+            table.sources.append("dispatch_probe")
+        except Exception as exc:
+            # no serviceable checkpoint (empty store) or a device fault:
+            # the probe is one evidence source, not a precondition
+            log.warning(f"dispatch-cost probe unavailable ({exc!r}); "
+                        "fitting from passive traces only")
+    if not table.sources:
+        log.error(
+            "nothing to tune from: no traces ingested and no probe — "
+            "pass --traffic-log/--results-log/--obs-snapshot/"
+            "--day-report or point --store at a store with a "
+            "serviceable checkpoint"
+        )
+        return 1
+    recorder = SpanRecorder(label="tune")
+    doc = fit_tuned_config(table, recorder=recorder)
+    if args.trace_out:
+        write_chrome_trace(args.trace_out, recorder.spans())
+        log.info(f"decision trace -> {args.trace_out}")
+    out: dict = {
+        "knobs": doc["knobs"],
+        "defaults": {
+            k: (list(v) if isinstance(v, tuple) else v)
+            for k, v in KNOB_DEFAULTS.items()
+        },
+        "decisions": doc["decisions"],
+        "observations": doc["observations"],
+    }
+    if args.dry_run:
+        out["key"] = None
+        out["dry_run"] = True
+    elif not doc["knobs"]:
+        # every knob kept its default (evidence insufficient): there is
+        # nothing for serving to consume, and an empty document would
+        # only make `--tuned-config latest` degrade with a warning —
+        # report the (still useful) decision trace, persist nothing
+        log.warning(
+            "no knob left its default (insufficient evidence) — "
+            "nothing persisted; see the decision trace for what was "
+            "missing"
+        )
+        out["key"] = None
+        out["nothing_fitted"] = True
+    else:
+        try:
+            key, digest = write_tuned_config(store, doc, day=_date(args))
+        except (OSError, ValueError) as exc:
+            log.error(f"failed to persist tuned config: {exc}")
+            return 1
+        out["key"] = key
+        out["digest"] = digest
+    print(json.dumps(out, indent=2))
+    return 0
 
 
 def cmd_traffic_run(args) -> int:
@@ -1466,15 +1578,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--batch-window-ms", type=float, metavar="MS",
-        default=_env_number("BODYWORK_TPU_BATCH_WINDOW_MS", float, 0.0)
-        or 0.0,
+        default=_env_number("BODYWORK_TPU_BATCH_WINDOW_MS", float, 0.0),
         help="coalesce concurrent single-row /score/v1 requests into "
              "shared padded device calls, flushing each batch after at "
              "most this many milliseconds (serve.batcher; ~1-2 ms is a "
-             "good start). 0 disables (default; env "
-             "BODYWORK_TPU_BATCH_WINDOW_MS overrides). Adds at most one "
-             "window of latency per request; under concurrency, device "
-             "dispatches scale with bucket size instead of request count",
+             "good start). Default off (env "
+             "BODYWORK_TPU_BATCH_WINDOW_MS overrides); an EXPLICIT 0 "
+             "forces coalescing off even against a --tuned-config "
+             "window. Adds at most one window of latency per request; "
+             "under concurrency, device dispatches scale with bucket "
+             "size instead of request count",
     )
     p.add_argument(
         "--batch-max-rows", type=_positive_int, metavar="N",
@@ -1522,6 +1635,68 @@ def build_parser() -> argparse.ArgumentParser:
              "and degraded 503s carry (default 30; env "
              "BODYWORK_TPU_RETRY_AFTER_MAX_S overrides)",
     )
+    p.add_argument(
+        "--tuned-config", default=(
+            os.environ.get("BODYWORK_TPU_TUNED_CONFIG", "").strip() or None
+        ), metavar="REF",
+        help="serve with a fitted knob set from `cli tune`: a tuning/ "
+             "store key or 'latest' (env BODYWORK_TPU_TUNED_CONFIG "
+             "overrides — the knob the k8s serve Deployment "
+             "materialises). Tuned values fill every knob not set "
+             "explicitly (window/max-rows/buckets/max-pending); a "
+             "missing or malformed document degrades to the built-in "
+             "defaults with a warning, never a failed boot",
+    )
+
+    p = add(
+        "tune", cmd_tune,
+        help="fit the serving knobs from observed traces (docs/PERF.md "
+             "§config 13)",
+    )
+    p.add_argument("--store", **common_store)
+    p.add_argument("--date", default=None,
+                   help="date key for the tuned document (default: today)")
+    p.add_argument(
+        "--traffic-log", action="append", default=[], metavar="FILE",
+        help="a `traffic run --log-out` request log to ingest (arrival "
+             "process + offered row shapes); repeatable",
+    )
+    p.add_argument(
+        "--results-log", action="append", default=[], metavar="FILE",
+        help="a `traffic run --results-out` outcome log to ingest "
+             "(latencies, goodput — the measured service rate when the "
+             "drive was saturated); repeatable",
+    )
+    p.add_argument(
+        "--obs-snapshot", action="append", default=[], metavar="FILE",
+        help="an obs registry snapshot JSON to ingest (flush occupancy, "
+             "phase histograms, per-op store costs); repeatable",
+    )
+    p.add_argument(
+        "--day-report", action="append", default=[], metavar="FILE",
+        help="a `run-day --report-out` document to ingest (span "
+             "timings); repeatable",
+    )
+    p.add_argument(
+        "--no-probe", dest="probe", action="store_false",
+        help="skip the active dispatch-cost probe (by default the "
+             "serving checkpoint's padded dispatch is timed at each "
+             "candidate bucket — the measured cost curve the bucket and "
+             "window models need)",
+    )
+    p.add_argument(
+        "--probe-buckets", default=(1, 8, 64, 256, 512, 1024, 4096),
+        type=_bucket_list, metavar="N[,N...]",
+        help="candidate buckets the dispatch-cost probe measures",
+    )
+    p.add_argument("--probe-reps", type=_positive_int, default=5,
+                   help="timed probe repetitions per bucket (median wins)")
+    p.add_argument("--dry-run", action="store_true",
+                   help="fit and print, write nothing")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="write the per-knob decision trace as a Chrome "
+                        "trace-event file (one span per knob with "
+                        "chosen-vs-default meta)")
 
     p = add("test", cmd_test, help="test a live scoring service")
     p.add_argument("--store", **common_store)

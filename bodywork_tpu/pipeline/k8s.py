@@ -243,6 +243,18 @@ def _container(
             # single-device, the pre-mesh behaviour exactly
             ("BODYWORK_TPU_MESH_DATA", ""),
             ("BODYWORK_TPU_MESH_MODEL", ""),
+            # coalescer + bucket knobs and the tuned-config pointer
+            # (tune/config.py, read by stages._serve_tuned_env_knobs):
+            # point BODYWORK_TPU_TUNED_CONFIG at a tuning/ document (or
+            # "latest") with `kubectl set env` and the next rollout
+            # serves `cli tune`'s fitted knobs; the per-knob vars
+            # override individual values; empty = built-in defaults,
+            # and a malformed/deleted document degrades to them too —
+            # a bad tuned config can never crash-loop the pod
+            ("BODYWORK_TPU_BATCH_WINDOW_MS", ""),
+            ("BODYWORK_TPU_BATCH_MAX_ROWS", ""),
+            ("BODYWORK_TPU_BUCKETS", ""),
+            ("BODYWORK_TPU_TUNED_CONFIG", ""),
             # SLO-watchdog breach thresholds (ops/slo.py policy_from_env;
             # empty = the coded defaults): retune the canary abort
             # budget with `kubectl set env`, no rebuild/redeploy

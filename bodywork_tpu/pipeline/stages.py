@@ -318,6 +318,68 @@ def _serve_env_knobs() -> tuple[
         dtype or "float32", mesh_data, mesh_model
 
 
+def _serve_tuned_env_knobs() -> tuple[
+    float | None, int | None, tuple[int, ...] | None, str | None
+]:
+    """The deployed coalescer/bucket/tuned-config knobs
+    (``(batch_window_ms, batch_max_rows, buckets, tuned_config_ref)``)
+    from the pod environment — the second half of the serve Deployment's
+    env materialisation (``pipeline/k8s.py``), split from
+    :func:`_serve_env_knobs` only to keep that function's pinned tuple
+    shape stable. Same malformed-degrades contract: a typo'd value is
+    ignored with a warning, never a crash-looping pod. The knob names
+    are pinned three ways against ``tune.config.TUNED_KNOB_ENV`` and
+    the k8s env list by tests/test_tune.py."""
+    import os
+
+    window_ms: float | None = None
+    raw = os.environ.get("BODYWORK_TPU_BATCH_WINDOW_MS", "").strip()
+    if raw:
+        try:
+            window_ms = float(raw)
+            # 0 is a legitimate EXPLICIT value: coalescing off, beating
+            # a tuned document's window (the tuner itself fits 0.0 at
+            # sparse arrival rates)
+            if window_ms < 0:
+                raise ValueError(raw)
+        except ValueError:
+            log.warning(
+                f"ignoring BODYWORK_TPU_BATCH_WINDOW_MS={raw!r} "
+                "(need a number >= 0)"
+            )
+            window_ms = None
+    max_rows: int | None = None
+    raw = os.environ.get("BODYWORK_TPU_BATCH_MAX_ROWS", "").strip()
+    if raw:
+        try:
+            max_rows = int(raw)
+            if max_rows < 1:
+                raise ValueError(raw)
+        except ValueError:
+            log.warning(
+                f"ignoring BODYWORK_TPU_BATCH_MAX_ROWS={raw!r} "
+                "(need an int >= 1)"
+            )
+            max_rows = None
+    buckets: tuple[int, ...] | None = None
+    raw = os.environ.get("BODYWORK_TPU_BUCKETS", "").strip()
+    if raw:
+        try:
+            buckets = tuple(int(b) for b in raw.split(",") if b.strip())
+            if not buckets or any(b <= 0 for b in buckets):
+                raise ValueError(raw)
+        except ValueError:
+            log.warning(
+                f"ignoring BODYWORK_TPU_BUCKETS={raw!r} "
+                "(need comma-separated positive ints)"
+            )
+            buckets = None
+    from bodywork_tpu.tune.config import TUNED_CONFIG_ENV
+
+    tuned = os.environ.get(TUNED_CONFIG_ENV, "").strip() or None
+    return window_ms, max_rows, buckets, tuned
+
+
 def serve_stage(
     ctx: StageContext,
     host: str = "127.0.0.1",
@@ -331,6 +393,9 @@ def serve_stage(
     retry_after_max_s: float | None = None,
     mesh_data: int | None = None,
     mesh_model: int | None = None,
+    batch_window_ms: float | None = None,
+    batch_max_rows: int | None = None,
+    tuned_config: str | None = None,
 ) -> "ServiceHandle":  # noqa: F821
     """Load the latest model into device HBM and start the scoring service
     on a background thread (reference stage 2). Returns the handle; the
@@ -369,7 +434,17 @@ def serve_stage(
     MLP weights Megatron-split, request rows data-split, programs
     AOT-cached per mesh), again defaulting from the pod environment so
     a deployed service scales onto more chips with one
-    ``kubectl set env``."""
+    ``kubectl set env``.
+
+    ``batch_window_ms``/``batch_max_rows`` opt the stage's replica apps
+    into request coalescing, and ``tuned_config`` names a tuned
+    serving-config document (``cli tune``'s output; ``"latest"`` or a
+    ``tuning/`` key) whose fitted values fill every knob left unset —
+    all three default from the pod environment
+    (:func:`_serve_tuned_env_knobs`); explicit spec args win, then the
+    per-knob env vars, then the tuned document, then the built-in
+    defaults, and a malformed document degrades to defaults instead of
+    crash-looping the pod (``tune/config.py``)."""
     from bodywork_tpu.models.checkpoint import load_model
     from bodywork_tpu.serve import ServiceHandle, create_app
 
@@ -428,6 +503,34 @@ def serve_stage(
         mesh_data = env_mesh_data
     if mesh_model is None:
         mesh_model = env_mesh_model
+    # coalescer/bucket/tuned-config knobs: spec args > per-knob env >
+    # tuned document > built-in defaults (tune/config.py)
+    env_window, env_max_rows, env_buckets, env_tuned = \
+        _serve_tuned_env_knobs()
+    if batch_window_ms is None:
+        batch_window_ms = env_window
+    if batch_max_rows is None:
+        batch_max_rows = env_max_rows
+    if buckets is None and env_buckets:
+        buckets = env_buckets
+    if tuned_config is None:
+        tuned_config = env_tuned
+    tuned_digest = None
+    if tuned_config:
+        from bodywork_tpu.tune.config import resolve_serving_knobs
+
+        resolved = resolve_serving_knobs(
+            ctx.store, tuned_config,
+            batch_window_ms=batch_window_ms,
+            batch_max_rows=batch_max_rows,
+            buckets=tuple(buckets) if buckets else None,
+            max_pending=max_pending,
+        )
+        batch_window_ms = resolved.batch_window_ms
+        batch_max_rows = resolved.batch_max_rows
+        buckets = resolved.buckets
+        max_pending = resolved.max_pending
+        tuned_digest = resolved.tuned_digest
     admission = build_admission(server_engine, max_pending, retry_after_max_s)
     # dtype + mesh from the pod env (BODYWORK_TPU_SERVE_DTYPE /
     # BODYWORK_TPU_MESH_DATA / BODYWORK_TPU_MESH_MODEL): a quantized
@@ -459,9 +562,15 @@ def serve_stage(
             # listen port, so they share the backpressure boundary
             admission=admission,
             model_bounds=model_bounds,
+            # each replica app owns its coalescer, exactly as each
+            # multiproc worker does
+            batch_window_ms=batch_window_ms,
+            batch_max_rows=batch_max_rows,
         )
         for _ in range(max(replicas, 1))
     ]
+    for app in apps:
+        app.tuned_config_digest = tuned_digest
     if server_engine == "aio":
         # the asyncio front-end round-robins replica apps natively
         from bodywork_tpu.serve.aio import AioServiceHandle

@@ -313,6 +313,12 @@ class ScoringApp:
         #: shared snapshot dir for multi-worker /metrics aggregation
         #: (serve.multiproc); None = this process's registry alone
         self.metrics_dir = metrics_dir
+        #: doc_digest of the applied tuned serving config
+        #: (tune/config.py resolve_serving_knobs; the serving wiring
+        #: sets it), or None when serving hand-set/built-in knobs —
+        #: rides /healthz effective_config so a deployed tuned config
+        #: is verifiable without log archaeology
+        self.tuned_config_digest: str | None = None
         #: the process-wide request tracer (obs.tracing): scoring
         #: requests get a W3C-compatible trace id (ingress traceparent
         #: or deterministically minted), head-sampled spans, and the
@@ -1052,6 +1058,37 @@ class ScoringApp:
             )
         return response
 
+    def effective_config(self) -> dict:
+        """The knob values ACTUALLY live in this process — read from the
+        live objects (coalescer, admission controller, predictor), not
+        from whatever configuration named them, so /healthz reports what
+        is running even if a tuned config was partially applied or a
+        knob degraded. ``tuned_config`` is the applied document's
+        doc_digest (null = hand-set/built-in values)."""
+        served = self._served
+        predictor = served.predictor if served is not None else None
+        batcher = self.batcher
+        admission = self.admission
+        buckets = getattr(predictor, "buckets", None)
+        return {
+            "batch_window_ms": (
+                round(batcher.window_s * 1e3, 3) if batcher is not None
+                else None
+            ),
+            "batch_max_rows": (
+                batcher.max_rows if batcher is not None else None
+            ),
+            "buckets": list(buckets) if buckets else None,
+            "max_pending": (
+                admission.max_pending if admission is not None else None
+            ),
+            "dtype": (
+                getattr(predictor, "dtype", "float32")
+                if predictor is not None else None
+            ),
+            "tuned_config": self.tuned_config_digest,
+        }
+
     def healthz_payload(self) -> tuple[dict, int, int | None]:
         """``(payload, status, retry_after_s-or-None)`` — the health
         document BOTH front-ends serve (the threaded route below, the
@@ -1094,6 +1131,10 @@ class ScoringApp:
                     "watchdog": self.slo_state,
                     "queue_depth": queue_depth,
                     "admission": admission_state,
+                    # live knob values (coalescer/admission exist even
+                    # before the first model): a deployed tuned config
+                    # is verifiable during a degraded boot too
+                    "effective_config": self.effective_config(),
                     "latency_exemplars": self._m_latency.exemplars() or None,
                 },
                 503,
@@ -1142,6 +1183,11 @@ class ScoringApp:
             # onto the siblings (readiness semantics, pipeline/k8s.py).
             "queue_depth": queue_depth,
             "admission": admission_state,
+            # the knob values ACTUALLY applied (window/max_rows/buckets/
+            # max_pending/dtype + the tuned-config digest or null) — the
+            # operator's proof that a deployed tuned config (or a
+            # kubectl-set-env knob) took effect, without log archaeology
+            "effective_config": self.effective_config(),
             # tracing exemplars: the last sampled trace id per scoring-
             # latency bucket — a probe reading a fat p99 bucket gets the
             # trace id to replay through `cli trace show` (None when

@@ -155,6 +155,23 @@ class RequestCoalescer:
             "bodywork_tpu_coalescer_saturated_total",
             "submit() rejections: pending queue full or coalescer stopped",
         )
+        # flush telemetry (the tuner's primary window/max_rows signal,
+        # tune/collect.py): occupancy says whether flushes FILL (window
+        # too small / max_rows too big leaves capacity on the table;
+        # ~1.0 under load means max_rows is the binding constraint), the
+        # reason split says WHICH policy edge is firing
+        self._m_occupancy = reg.histogram(
+            "bodywork_tpu_serve_batch_occupancy_ratio",
+            "Coalesced-flush occupancy: rows flushed / max_rows",
+            buckets=(0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+        )
+        self._m_flush_reason = reg.counter(
+            "bodywork_tpu_serve_batch_flush_total",
+            "Coalesced-batch flushes by triggering policy edge "
+            "(window=deadline elapsed, max_rows=batch filled during the "
+            "window, saturation=a full batch was already queued — no "
+            "window wait at all)",
+        )
         self._thread = threading.Thread(
             target=self._run, name="request-coalescer", daemon=True
         )
@@ -291,6 +308,10 @@ class RequestCoalescer:
                 # up — "at most one window of extra latency" holds for
                 # every request, not just batch heads. A stopping
                 # coalescer flushes immediately.
+                # pre-wait depth classifies the flush: a backlog already
+                # holding a full batch means this flush waited for
+                # nothing (saturation — back-to-back full flushes)
+                initial_depth = len(self._pending)
                 deadline = self._pending[0].enqueued_at + self.window_s
                 while not self._stopped and len(self._pending) < self.max_rows:
                     remaining = deadline - time.monotonic()
@@ -298,18 +319,27 @@ class RequestCoalescer:
                         break
                     self._cond.wait(remaining)
                 batch = self._take_batch_locked()
-            self._execute(batch)
+            if initial_depth >= self.max_rows:
+                reason = "saturation"
+            elif len(batch) >= self.max_rows:
+                reason = "max_rows"
+            else:
+                reason = "window"
+            self._execute(batch, reason)
             with self._cond:
                 # single dispatcher: the in-flight set IS this batch
                 self._inflight.clear()
 
-    def _execute(self, batch: list[_Submission]) -> None:
+    def _execute(self, batch: list[_Submission],
+                 reason: str = "window") -> None:
         served = batch[0].served
         now = time.monotonic()
         t_exec = time.perf_counter()
         for sub in batch:
             self._m_queue_wait.observe(now - sub.enqueued_at)
         self._m_batch_rows.observe(len(batch))
+        self._m_occupancy.observe(len(batch) / self.max_rows)
+        self._m_flush_reason.inc(reason=reason)
         # trace fan-in: each SAMPLED member gets its queue-wait span and
         # the batch's shared device-dispatch span, the latter carrying
         # every member's request span id as links — one coalesced

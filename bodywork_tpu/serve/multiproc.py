@@ -110,7 +110,8 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
                  retry_after_max_s: float | None = None,
                  shared_budget=None,
                  slot_index: int = 0,
-                 dtype: str = "float32"):
+                 dtype: str = "float32",
+                 tuned_config: str | None = None):
     """One serving replica: load latest checkpoint -> predictor -> listen
     on the shared port. Runs in a SPAWNED process (a fork would inherit
     the parent's initialized XLA runtime threads — undefined behavior)."""
@@ -126,6 +127,26 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
     from bodywork_tpu.store import open_store
 
     store = open_store(store_path)
+    # tuned-config resolution per worker (each loads the store anyway):
+    # fitted values fill the knobs the supervisor left unset, explicit
+    # values win, malformed degrades (tune/config.py) — every replica
+    # resolves the same document, so the fleet serves one knob set
+    tuned_digest = None
+    if tuned_config:
+        from bodywork_tpu.tune.config import resolve_serving_knobs
+
+        resolved = resolve_serving_knobs(
+            store, tuned_config,
+            batch_window_ms=batch_window_ms,
+            batch_max_rows=batch_max_rows,
+            buckets=tuple(buckets) if buckets else None,
+            max_pending=max_pending,
+        )
+        batch_window_ms = resolved.batch_window_ms
+        batch_max_rows = resolved.batch_max_rows
+        buckets = resolved.buckets
+        max_pending = resolved.max_pending
+        tuned_digest = resolved.tuned_digest
     # registry-aware resolution: the production alias when one exists,
     # else the newest date-keyed checkpoint (models/checkpoint.py)
     served_key, served_source = resolve_serving_key(store)
@@ -163,6 +184,7 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
                      model_key=served_key, model_source=served_source,
                      admission=admission,
                      model_bounds=_registry_bounds(store, served_key))
+    app.tuned_config_digest = tuned_digest
     flusher = None
     if metrics_dir is not None:
         # each replica flushes its registry snapshot to the shared dir;
@@ -264,6 +286,7 @@ class MultiProcessService:
         max_pending: int | None = None,
         retry_after_max_s: float | None = None,
         dtype: str = "float32",
+        tuned_config: str | None = None,
     ):
         assert workers >= 1, "need at least one replica"
         from bodywork_tpu.serve.predictor import SERVE_DTYPES
@@ -297,6 +320,22 @@ class MultiProcessService:
         #: quantized serving dtype, per worker (each runs the shadow
         #: quality gate itself at boot/swap — same store, same verdict)
         self.dtype = dtype
+        #: tuned-config reference (tune/config.py). A "latest" ref is
+        #: pinned to its CONCRETE key HERE, once, so a replica
+        #: respawned after `cli tune` writes a newer document cannot
+        #: resolve a different knob set than its still-running siblings
+        #: — the fleet serves one knob set for its whole lifetime
+        #: (workers still load + validate the pinned document
+        #: themselves, with the malformed-degrades contract).
+        if tuned_config == "latest":
+            from bodywork_tpu.store import open_store
+            from bodywork_tpu.tune.config import _resolve_ref
+
+            pinned = _resolve_ref(open_store(self.store_path), tuned_config)
+            # no tuning/ artefacts yet: keep the symbolic ref so the
+            # workers log the standard degrade warning themselves
+            tuned_config = pinned if pinned is not None else tuned_config
+        self.tuned_config = tuned_config
         # opt-in aggregated /metrics: a shared snapshot dir every worker
         # flushes into, so any replica can answer for the whole service.
         # Created lazily in start() so a failed startup never leaks it.
@@ -351,7 +390,8 @@ class MultiProcessService:
                   self.batch_window_ms, self.batch_max_rows,
                   self.metrics_dir, self.server_engine,
                   self.max_pending, self.retry_after_max_s,
-                  self._shared_budget, slot_index, self.dtype),
+                  self._shared_budget, slot_index, self.dtype,
+                  self.tuned_config),
             daemon=True,
         )
         proc.start()

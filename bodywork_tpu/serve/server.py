@@ -522,8 +522,21 @@ def serve_latest_model(
     retry_after_max_s: float | None = None,
     dtype: str = "float32",
     mesh_model: int = 1,
+    tuned_config: str | None = None,
 ):
     """Load latest model -> HBM, warm up, serve (reference ``stage_2`` main).
+
+    ``tuned_config`` names a tuned serving-config document (a
+    ``tuning/`` store key, or ``"latest"`` — ``cli tune``'s output,
+    env ``BODYWORK_TPU_TUNED_CONFIG``): its fitted knob values fill
+    every knob the caller left unset (coalescer window/max-rows,
+    predictor buckets, admission ``max_pending``), explicit caller
+    values always win, and a missing/malformed document degrades to
+    the built-in defaults with a warning — never a failed boot
+    (``tune/config.py resolve_serving_knobs``). The applied document's
+    digest rides /healthz ``effective_config.tuned_config``. Note: a
+    tuned ``max_pending`` arms admission on either engine (tuning is
+    an explicit opt-in).
 
     ``dtype`` picks the serving precision (``serve.predictor.
     SERVE_DTYPES``): ``bfloat16``/``int8`` serve the quantized variant
@@ -569,6 +582,26 @@ def serve_latest_model(
             f"unknown server engine {server_engine!r}; "
             f"expected one of {SERVER_ENGINES}"
         )
+    # tuned-config resolution BEFORE any predictor/app construction:
+    # the tuned values must flow into the same bucket/batcher/admission
+    # plumbing explicit values do (lazy import keeps the no-tuning boot
+    # path's import closure unchanged)
+    tuned_digest = None
+    if tuned_config:
+        from bodywork_tpu.tune.config import resolve_serving_knobs
+
+        resolved = resolve_serving_knobs(
+            store, tuned_config,
+            batch_window_ms=batch_window_ms,
+            batch_max_rows=batch_max_rows,
+            buckets=buckets,
+            max_pending=max_pending,
+        )
+        batch_window_ms = resolved.batch_window_ms
+        batch_max_rows = resolved.batch_max_rows
+        buckets = resolved.buckets
+        max_pending = resolved.max_pending
+        tuned_digest = resolved.tuned_digest
     try:
         # registry-aware: the production alias when one exists, else the
         # newest date-keyed checkpoint (models/checkpoint.py)
@@ -608,6 +641,7 @@ def serve_latest_model(
         model_key=served_key, model_source=served_source,
         admission=admission, model_bounds=model_bounds,
     )
+    app.tuned_config_digest = tuned_digest
     if server_engine == "aio":
         from bodywork_tpu.serve.aio import AioServiceHandle
 

@@ -72,6 +72,19 @@ Quarantine entries are EVIDENCE, written only through the CAS primitive
 and never deleted by the framework — retention is an operator decision
 (docs/RESILIENCE.md §11 runbook).
 
+``tuning/`` holds tuned serving-config documents (``bodywork_tpu/tune/``):
+date-keyed JSON (schema ``bodywork_tpu.tuned_config/1``, doc_digest
+embedded, digest sidecar + replica via the audit layer) mapping the
+hand-set serving knobs (coalescer window/max-rows, padding-bucket
+ladder, admission budget) to values fitted from observed traces, with
+the decision trace that produced each value in-document. Delete safety:
+tuned configs are DERIVED artefacts — a pure function of the traces
+they were fitted from — and serving only ever consumes them through the
+malformed-degrades loader (``tune/config.py``), so deleting the prefix
+is always safe: every consumer reverts to its built-in default knob
+values (the pre-tuning behaviour exactly); the only cost is re-running
+``cli tune``.
+
 ``obs/flightrec/`` holds flight-recorder dumps (``obs/tracing.py``):
 one content-addressed JSON document per SLO-watchdog abort/promote
 verdict (schema ``bodywork_tpu.flight_record/1``) carrying the sampled
@@ -103,6 +116,9 @@ REGISTRY_RECORDS_PREFIX = "registry/records/"
 #: mapping of ``production``/``previous`` to model keys; written ONLY
 #: via ``put_bytes_if_match`` — see the module docstring's delete note.
 REGISTRY_ALIAS_KEY = "registry/aliases.json"
+#: tuned serving-config documents (bodywork_tpu/tune/) — derived
+#: artefacts; see the module docstring's delete-safety note
+TUNING_PREFIX = "tuning/"
 AUDIT_PREFIX = "audit/"
 AUDIT_DIGESTS_PREFIX = "audit/digests/"
 QUARANTINE_PREFIX = "quarantine/"
@@ -125,6 +141,7 @@ ALL_PREFIXES = (
     TRAINSTATE_PREFIX,
     RUNS_PREFIX,
     REGISTRY_PREFIX,
+    TUNING_PREFIX,
     AUDIT_PREFIX,
     QUARANTINE_PREFIX,
     FLIGHTREC_PREFIX,
@@ -179,6 +196,14 @@ def snapshot_key(d: date) -> str:
     (the embedded date is the most recent covered day, so the standard
     date-key protocol — ``history``/``latest`` — versions snapshots too)."""
     return f"{SNAPSHOTS_PREFIX}history-snapshot-{d}.npz"
+
+
+def tuned_config_key(d: date) -> str:
+    """The tuned serving-config document fitted on day ``d``
+    (``bodywork_tpu/tune/``). Date-keyed so the standard
+    ``history``/``latest`` protocol versions tuned configs — serving's
+    ``--tuned-config latest`` resolves through ``latest(TUNING_PREFIX)``."""
+    return f"{TUNING_PREFIX}tuned-config-{d}.json"
 
 
 def audit_digest_key(key: str) -> str:

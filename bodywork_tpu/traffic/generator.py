@@ -79,6 +79,14 @@ class Request:
     route: str  # "/score/v1" | "/score/v1/batch"
     x: tuple[float, ...]
 
+    @property
+    def rows(self) -> int:
+        """Feature rows this request scores — the offered row-shape
+        unit the tuner's bucket-ladder model conditions on
+        (``tune/collect.py``). Single-row scoring sends one row no
+        matter how many values ride the payload."""
+        return len(self.x) if self.route.endswith("/batch") else 1
+
     def payload(self) -> bytes:
         """The HTTP body this request sends — built here so every
         replay of a log sends byte-identical requests."""
@@ -237,8 +245,14 @@ def write_request_log(path: str | Path, config: TrafficConfig,
             "n_requests": len(requests),
         }) + "\n")
         for r in requests:
+            # "rows" is derivable from (route, x) but recorded
+            # explicitly so the tuner (and any log consumer) can
+            # reconstruct the offered row-shape distribution without
+            # knowing the route->rows rule (tune/collect.py reads it;
+            # read_request_log below tolerates its absence in old logs)
             f.write(json.dumps(
-                {"t_s": r.t_s, "route": r.route, "x": list(r.x)}
+                {"t_s": r.t_s, "route": r.route, "rows": r.rows,
+                 "x": list(r.x)}
             ) + "\n")
     log.info(f"wrote request log: {len(requests)} requests -> {path}")
 

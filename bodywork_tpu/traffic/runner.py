@@ -71,6 +71,10 @@ class _Result:
     #: client-observed latency and server-side spans; None when the
     #: service runs tracing-off
     trace_id: str | None = None
+    #: feature rows this request carried (the offered row-shape unit;
+    #: the tuner reconstructs the row distribution from a results log
+    #: alone — replayed logs previously lost it)
+    rows: int = 1
 
 
 def _percentile(sorted_vals: list, q: float) -> float | None:
@@ -323,6 +327,7 @@ def run_open_loop(
                 t_s=req.t_s, status=status, retry_after_s=retry_after,
                 latency_s=loop.time() - target, send_lag_s=send_lag,
                 model_key=model_key, trace_id=trace_id,
+                rows=req.rows,
             ))
 
         try:
@@ -344,6 +349,11 @@ def run_open_loop(
             for r in sorted(results, key=lambda r: r.t_s):
                 f.write(json.dumps({
                     "t_s": _round6(r.t_s),
+                    # scheduled-vs-actual send, both explicit: the
+                    # tuner reconstructs the ACHIEVED arrival process
+                    # (and driver health) from the log alone
+                    "sent_t_s": _round6(r.t_s + r.send_lag_s),
+                    "rows": r.rows,
                     "status": r.status,
                     "latency_s": _round6(r.latency_s),
                     "send_lag_s": _round6(r.send_lag_s),

@@ -1,0 +1,46 @@
+"""Self-tuning runtime (ROADMAP item 5): a learned cost model over
+observed traces that closes the loop on the hand-set serving knobs.
+
+Three layers:
+
+- :mod:`bodywork_tpu.tune.collect` — the trace collector: obs registry
+  snapshots, day-report spans, and ``traffic run`` request/results logs
+  normalise into ONE :class:`~bodywork_tpu.tune.collect.ObservationTable`,
+  plus the active dispatch-cost probe.
+- :mod:`bodywork_tpu.tune.model` — the analytical+fitted cost model:
+  a pure function of the table -> a tuned knob set with a per-knob
+  decision trace (chosen vs default, basis, evidence).
+- :mod:`bodywork_tpu.tune.config` — the tuned-config artifact: a
+  schema-tagged, digest-stamped JSON document under the ``tuning/``
+  store prefix, consumed by ``serve``/``serve_stage``/the multiproc
+  workers through the malformed-degrades resolver
+  (:func:`~bodywork_tpu.tune.config.resolve_serving_knobs`).
+
+``cli tune`` drives all three; bench config 13 proves tuned >= hand-set
+on seeded traffic profiles. This ``__init__`` re-exports only the
+jax-free config layer — the collector's probe (which needs the real
+predictor) imports lazily, so fsck and the CLI parser stay light.
+"""
+from bodywork_tpu.tune.config import (
+    KNOB_DEFAULTS,
+    TUNED_CONFIG_ENV,
+    TUNED_CONFIG_SCHEMA,
+    TUNED_KNOB_ENV,
+    ResolvedKnobs,
+    load_tuned_config,
+    resolve_serving_knobs,
+    validate_knobs,
+    write_tuned_config,
+)
+
+__all__ = [
+    "KNOB_DEFAULTS",
+    "TUNED_CONFIG_ENV",
+    "TUNED_CONFIG_SCHEMA",
+    "TUNED_KNOB_ENV",
+    "ResolvedKnobs",
+    "load_tuned_config",
+    "resolve_serving_knobs",
+    "validate_knobs",
+    "write_tuned_config",
+]

@@ -423,7 +423,9 @@ def test_cli_serve_batch_flags_parse(monkeypatch):
     assert args.batch_max_rows == 32
     # default: off
     args = parser.parse_args(["serve", "--store", "/tmp/s"])
-    assert args.batch_window_ms == 0.0
+    # None = unset (a tuned config may fill the knob); an EXPLICIT 0
+    # means coalescing off and survives to the tuned-config merge
+    assert args.batch_window_ms is None
     assert args.batch_max_rows is None
     with pytest.raises(SystemExit):
         parser.parse_args(["serve", "--store", "/tmp/s",
@@ -440,7 +442,7 @@ def test_cli_serve_batch_flags_parse(monkeypatch):
     monkeypatch.setenv("BODYWORK_TPU_BATCH_WINDOW_MS", "2ms")
     monkeypatch.setenv("BODYWORK_TPU_BATCH_MAX_ROWS", "-5")
     args = cli.build_parser().parse_args(["serve", "--store", "/tmp/s"])
-    assert args.batch_window_ms == 0.0
+    assert args.batch_window_ms is None
     assert args.batch_max_rows is None
 
 

@@ -395,8 +395,9 @@ def test_compact_output_fits_driver_tail():
         })
     out = bench.compact_output(records, "tpu", "bench_full.json")
     line = _json.dumps(out)
-    # 10 configs of fully-populated one-liners measure ~1.72k; the
-    # archived tail is 2000 — keep a real margin under it
+    # 13 configs of fully-populated one-liners measure ~1.62k (the
+    # per-config `resumed` flag was dropped at 13 — full record keeps
+    # it); the archived tail is 2000 — keep a real margin under it
     assert len(line) < 1800, len(line)
     assert out["metric"] == "e2e_day_wallclock_config_%d" % bench.HEADLINE_CONFIG
     assert out["full_record"] == "bench_full.json"

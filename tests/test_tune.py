@@ -1,0 +1,948 @@
+"""Self-tuning runtime (``bodywork_tpu/tune``, ISSUE 15).
+
+Covers the three tune layers (collector, cost model, tuned-config
+artifact), the serving consumption path (explicit > tuned > default,
+malformed-degrades, /healthz ``effective_config``), the coalescer's
+flush-occupancy telemetry, the traffic-log row/send-time satellite, the
+three-way env-knob drift guard, the ``tuning/`` integrity story (fsck +
+chaos corrupt reads), and the ≤10 s bench config-13 smoke.
+"""
+import json
+import sys
+from datetime import date
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from helpers import make_memory_store
+
+from bodywork_tpu.store.schema import ALL_PREFIXES, TUNING_PREFIX, tuned_config_key
+from bodywork_tpu.tune.collect import (
+    ObservationTable,
+    ingest_obs_snapshot,
+    ingest_request_log,
+    ingest_results_log,
+)
+from bodywork_tpu.tune.config import (
+    KNOB_DEFAULTS,
+    TUNED_CONFIG_ENV,
+    TUNED_CONFIG_SCHEMA,
+    TUNED_KNOB_ENV,
+    load_tuned_config,
+    resolve_serving_knobs,
+    validate_knobs,
+    write_tuned_config,
+)
+from bodywork_tpu.tune.model import MIN_WINDOW_MS, QUEUE_BUDGET_S, fit_tuned_config
+
+
+# --- fixtures ---------------------------------------------------------------
+
+
+def _request_log_file(tmp_path, rate=60.0, duration=5.0, seed=3,
+                      batch_fraction=0.0, batch_rows=64):
+    from bodywork_tpu.traffic import (
+        TrafficConfig,
+        generate_request_log,
+        write_request_log,
+    )
+
+    cfg = TrafficConfig(rate_rps=rate, duration_s=duration, seed=seed,
+                        batch_fraction=batch_fraction, batch_rows=batch_rows)
+    requests = generate_request_log(cfg)
+    path = tmp_path / "requests.jsonl"
+    write_request_log(path, cfg, requests)
+    return path, requests
+
+
+_CURVE = {1: 0.0004, 8: 0.00045, 64: 0.0006, 512: 0.0015, 4096: 0.009}
+
+
+def _tuned_store(doc_overrides=None, day=date(2026, 8, 1)):
+    """An in-memory store holding one written tuned config."""
+    store = make_memory_store()
+    table = ObservationTable()
+    table.interarrival_s = [1.0 / 400] * 500
+    table.row_counts = [1] * 450 + [700] * 50
+    table.dispatch_cost_s = dict(_CURVE)
+    table.sources = ["synthetic"]
+    doc = fit_tuned_config(table)
+    if doc_overrides:
+        doc = {**doc, **doc_overrides}
+    key, digest = write_tuned_config(store, doc, day=day)
+    return store, key, digest, doc
+
+
+# --- satellite: traffic logs record rows + scheduled-vs-actual send ---------
+
+
+def test_request_log_records_rows_and_reader_tolerates_absence(tmp_path):
+    path, requests = _request_log_file(
+        tmp_path, batch_fraction=0.3, batch_rows=48
+    )
+    lines = [json.loads(l) for l in path.read_text().splitlines()[1:]]
+    assert all("rows" in e for e in lines)
+    for entry, req in zip(lines, requests):
+        assert entry["rows"] == (48 if req.route.endswith("/batch") else 1)
+    # round-trip unchanged
+    from bodywork_tpu.traffic import read_request_log
+
+    _cfg, reread = read_request_log(path)
+    assert reread == requests
+    # an OLD log without the rows field still ingests (route/x fallback)
+    stripped = tmp_path / "old.jsonl"
+    with path.open() as f, stripped.open("w") as out:
+        out.write(f.readline())
+        for line in f:
+            entry = json.loads(line)
+            entry.pop("rows")
+            out.write(json.dumps(entry) + "\n")
+    table = ObservationTable()
+    ingest_request_log(table, stripped)
+    assert sorted(set(table.row_counts)) == [1, 48]
+
+
+def test_results_log_records_rows_and_sched_vs_actual_send(tmp_path):
+    from bodywork_tpu.traffic import TrafficConfig, generate_request_log
+    from bodywork_tpu.traffic.runner import run_open_loop
+
+    cfg = TrafficConfig(rate_rps=200.0, duration_s=0.5, seed=7,
+                        batch_fraction=0.5, batch_rows=16)
+    requests = generate_request_log(cfg)
+
+    async def transport(req):
+        return 200, None
+
+    out = tmp_path / "results.jsonl"
+    run_open_loop("http://x", requests, transport=transport,
+                  results_log=str(out))
+    entries = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(entries) == len(requests)
+    by_t = {e["t_s"]: e for e in entries}
+    for req in requests:
+        entry = by_t[round(req.t_s, 6)]
+        assert entry["rows"] == req.rows
+        # scheduled-vs-actual: sent time is explicit and consistent
+        assert entry["sent_t_s"] == pytest.approx(
+            entry["t_s"] + entry["send_lag_s"], abs=2e-6
+        )
+
+
+# --- the collector ----------------------------------------------------------
+
+
+def test_collector_reconstructs_arrival_and_row_shape(tmp_path):
+    path, _requests = _request_log_file(
+        tmp_path, rate=80.0, duration=5.0, batch_fraction=0.25,
+        batch_rows=700,
+    )
+    table = ObservationTable()
+    n = ingest_request_log(table, path)
+    assert n == len(table.row_counts)
+    rate = table.arrival_rate_rps()
+    assert rate == pytest.approx(80.0, rel=0.25)
+    shape = table.row_quantiles()
+    assert shape["max"] == 700
+    assert shape["p50"] == 1
+    assert table.sources == ["request_log:requests.jsonl"]
+
+
+def test_collector_reads_saturated_goodput_from_results_log(tmp_path):
+    # 100 scheduled over ~1s, only 40 answered 200 -> clearly saturated
+    out = tmp_path / "results.jsonl"
+    with out.open("w") as f:
+        for i in range(100):
+            f.write(json.dumps({
+                "t_s": round(i * 0.01, 6), "sent_t_s": round(i * 0.01, 6),
+                "rows": 1, "status": 200 if i < 40 else 429,
+                "latency_s": 0.2, "send_lag_s": 0.0,
+                "retry_after_s": None, "model_key": None, "trace_id": None,
+            }) + "\n")
+    table = ObservationTable()
+    ingest_results_log(table, out)
+    assert table.saturated_goodput_rps == pytest.approx(40 / 0.99, rel=0.01)
+    assert table.service_rate_rps() == table.saturated_goodput_rps
+
+
+def test_collector_ingests_obs_snapshot(tmp_path):
+    from bodywork_tpu.obs.registry import Registry
+
+    reg = Registry()
+    occ = reg.histogram(
+        "bodywork_tpu_serve_batch_occupancy_ratio",
+        buckets=(0.25, 0.5, 1.0),
+    )
+    occ.observe(0.5)
+    occ.observe(1.0)
+    reg.counter("bodywork_tpu_serve_batch_flush_total").inc(3, reason="window")
+    reg.histogram("bodywork_tpu_device_dispatch_seconds").observe(0.002)
+    reg.histogram("bodywork_tpu_store_op_seconds").observe(0.01, op="get_bytes")
+    table = ObservationTable()
+    ingest_obs_snapshot(table, reg.snapshot())
+    assert table.mean_occupancy() == pytest.approx(0.75)
+    assert table.flush_reasons == {"window": 3}
+    assert table.mean_dispatch_s() == pytest.approx(0.002)
+    assert table.store_op_cost_s["get_bytes"] == pytest.approx(0.01)
+    # file form ingests identically
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps(reg.snapshot()))
+    table2 = ObservationTable()
+    ingest_obs_snapshot(table2, path)
+    assert table2.mean_occupancy() == table.mean_occupancy()
+
+
+# --- the cost model ---------------------------------------------------------
+
+
+def test_fit_is_a_pure_function_of_the_table():
+    def build():
+        t = ObservationTable()
+        t.interarrival_s = [0.01] * 200
+        t.row_counts = [1] * 150 + [300] * 50
+        t.dispatch_cost_s = dict(_CURVE)
+        t.sources = ["synthetic"]
+        return t
+
+    a = fit_tuned_config(build())
+    b = fit_tuned_config(build())
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+def test_window_disabled_when_arrivals_cannot_fill_it():
+    sparse = ObservationTable()
+    sparse.interarrival_s = [0.1] * 100  # 10 rps
+    sparse.dispatch_cost_s = dict(_CURVE)
+    doc = fit_tuned_config(sparse)
+    # 0.0 = coalescing OFF: a window sparse traffic can't fill is pure
+    # latency tax (and the dispatcher's wakeups cost tail on small
+    # boxes) — the fitted answer is direct dispatch
+    assert doc["knobs"]["batch_window_ms"] == 0.0
+    dense = ObservationTable()
+    dense.interarrival_s = [0.001] * 100  # 1000 rps
+    dense.dispatch_cost_s = dict(_CURVE)
+    doc2 = fit_tuned_config(dense)
+    assert doc2["knobs"]["batch_window_ms"] > MIN_WINDOW_MS
+    # no arrival evidence at all -> the knob stays OUT of the document
+    # (for the window the default VALUE is not the default BEHAVIOUR: a
+    # bare boot leaves coalescing off, so writing 2.0 ms would turn it
+    # ON under the tuned config) — the decision trace records the kept
+    # default
+    blind = ObservationTable()
+    doc3 = fit_tuned_config(blind)
+    window = next(
+        d for d in doc3["decisions"] if d["knob"] == "batch_window_ms"
+    )
+    assert window["source"] == "default"
+    assert window["chosen"] == KNOB_DEFAULTS["batch_window_ms"]
+    assert "batch_window_ms" not in doc3["knobs"]
+
+
+def test_bucket_ladder_covers_observed_tail_tightly():
+    t = ObservationTable()
+    t.interarrival_s = [0.02] * 200
+    t.row_counts = [1] * 180 + [700] * 20
+    t.dispatch_cost_s = dict(_CURVE)
+    doc = fit_tuned_config(t)
+    buckets = doc["knobs"]["buckets"]
+    # the 700-row tail pads to its 1024 cover, not the default 4096
+    assert max(buckets) == 1024
+    assert 1 in buckets
+    decision = next(d for d in doc["decisions"] if d["knob"] == "buckets")
+    assert decision["source"] == "fitted"
+    assert decision["evidence"]["row_shape"]["max"] == 700
+
+
+def test_max_pending_sized_by_littles_law_or_kept_default():
+    t = ObservationTable()
+    t.saturated_goodput_rps = 800.0
+    doc = fit_tuned_config(t)
+    assert doc["knobs"]["max_pending"] == round(800 * QUEUE_BUDGET_S)
+    blind = ObservationTable()
+    doc2 = fit_tuned_config(blind)
+    decision = next(
+        d for d in doc2["decisions"] if d["knob"] == "max_pending"
+    )
+    assert decision["source"] == "default"
+    # an unmeasured budget never enters the document: applying it would
+    # ARM thread-engine admission at a value nobody measured
+    assert "max_pending" not in doc2["knobs"]
+
+
+def test_decision_trace_metrics_and_spans_move():
+    from bodywork_tpu.obs import get_registry
+    from bodywork_tpu.obs.spans import SpanRecorder
+
+    reg = get_registry()
+    counter = reg.counter("bodywork_tpu_tune_decisions_total")
+    before = counter.value(knob="buckets", source="fitted")
+    t = ObservationTable()
+    t.interarrival_s = [0.01] * 100
+    t.row_counts = [1] * 100
+    t.dispatch_cost_s = dict(_CURVE)
+    recorder = SpanRecorder(label="tune")
+    doc = fit_tuned_config(t, recorder=recorder)
+    assert counter.value(knob="buckets", source="fitted") == before + 1
+    spans = {s.name: s for s in recorder.spans()}
+    assert set(spans) == {
+        "tune-batch_max_rows", "tune-batch_window_ms", "tune-buckets",
+        "tune-max_pending",
+    }
+    for d in doc["decisions"]:
+        span = spans[f"tune-{d['knob']}"]
+        assert span.meta["chosen"] == d["chosen"]
+        assert span.meta["default"] == d["default"]
+        assert span.meta["source"] == d["source"]
+
+
+# --- the tuned-config artifact ----------------------------------------------
+
+
+def test_tuned_config_round_trip_latest_and_digest():
+    store, key, digest, doc = _tuned_store()
+    assert key == tuned_config_key(date(2026, 8, 1))
+    assert key.startswith(TUNING_PREFIX)
+    knobs, loaded_digest, loaded_doc = load_tuned_config(store, "latest")
+    assert loaded_digest == digest
+    assert knobs["batch_window_ms"] == doc["knobs"]["batch_window_ms"]
+    assert knobs["buckets"] == tuple(doc["knobs"]["buckets"])
+    assert loaded_doc["decisions"] == doc["decisions"]  # trace in-document
+
+
+def test_writer_refuses_invalid_knobs():
+    store = make_memory_store()
+    with pytest.raises(ValueError, match="invalid knob"):
+        write_tuned_config(
+            store, {"knobs": {"batch_window_ms": -1}}, day=date(2026, 8, 1)
+        )
+    with pytest.raises(ValueError, match="invalid knob"):
+        write_tuned_config(
+            store, {"knobs": {"unknown_knob": 3}}, day=date(2026, 8, 1)
+        )
+
+
+@pytest.mark.parametrize("sabotage", [
+    "garbage", "wrong_schema", "digest_tamper", "all_knobs_invalid",
+    "absent_key",
+])
+def test_malformed_tuned_config_degrades_to_none(sabotage):
+    store, key, _digest, _doc = _tuned_store()
+    if sabotage == "garbage":
+        store.put_bytes(key, b"{nope")
+    elif sabotage == "wrong_schema":
+        doc = json.loads(store.get_bytes(key))
+        doc["schema"] = "bodywork_tpu.other/9"
+        store.put_bytes(key, json.dumps(doc).encode())
+    elif sabotage == "digest_tamper":
+        doc = json.loads(store.get_bytes(key))
+        doc["knobs"]["max_pending"] = 7  # valid value, unsigned change
+        store.put_bytes(key, json.dumps(doc).encode())
+    elif sabotage == "all_knobs_invalid":
+        doc = json.loads(store.get_bytes(key))
+        doc["knobs"] = {"batch_window_ms": "soon", "max_pending": -2}
+        from bodywork_tpu.utils.integrity import stamp_doc
+
+        store.put_bytes(key, json.dumps(stamp_doc(doc)).encode())
+    elif sabotage == "absent_key":
+        key = "tuning/tuned-config-2030-01-01.json"
+    knobs, digest, doc = load_tuned_config(store, key)
+    assert knobs is None and digest is None and doc is None
+
+
+def test_non_dict_knobs_field_degrades_not_crashes():
+    """A parseable document whose 'knobs' field has the wrong SHAPE
+    (review finding): must degrade to defaults exactly like garbage
+    bytes — an AttributeError here would crash-loop the serving pod."""
+    store = make_memory_store()
+    key = tuned_config_key(date(2026, 8, 1))
+    for bad_knobs in ([1, 2], "window=2", 7):
+        store.put_bytes(key, json.dumps({
+            "schema": TUNED_CONFIG_SCHEMA, "knobs": bad_knobs,
+        }).encode())
+        knobs, digest, doc = load_tuned_config(store, key)
+        assert knobs is None and digest is None and doc is None
+        resolved = resolve_serving_knobs(store, key)
+        assert resolved.tuned_digest is None
+    # validate_knobs itself is shape-safe
+    accepted, rejected = validate_knobs([1, 2])
+    assert accepted == {} and rejected == ["knobs"]
+
+
+def test_explicit_window_zero_beats_tuned_document():
+    """`--batch-window-ms 0` / env `BODYWORK_TPU_BATCH_WINDOW_MS=0` is
+    an EXPLICIT coalescing-off instruction and must win over a tuned
+    window (review finding: 0 used to collapse to 'unset')."""
+    store, _key, _digest, doc = _tuned_store()
+    assert doc["knobs"]["batch_window_ms"] > 0
+    resolved = resolve_serving_knobs(store, "latest", batch_window_ms=0.0)
+    assert resolved.batch_window_ms == 0.0
+    assert resolved.sources["batch_window_ms"] == "explicit"
+    # the cli parser keeps an explicit 0 distinct from unset
+    from bodywork_tpu.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["serve", "--store", "s", "--batch-window-ms", "0"]
+    )
+    assert args.batch_window_ms == 0.0
+    assert build_parser().parse_args(
+        ["serve", "--store", "s"]
+    ).batch_window_ms is None
+
+
+def test_partially_invalid_knobs_drop_individually():
+    store, key, _digest, _doc = _tuned_store()
+    doc = json.loads(store.get_bytes(key))
+    doc["knobs"]["max_pending"] = -5  # one bad knob
+    from bodywork_tpu.utils.integrity import stamp_doc
+
+    doc.pop("doc_digest")
+    store.put_bytes(key, json.dumps(stamp_doc(doc)).encode())
+    knobs, digest, _doc2 = load_tuned_config(store, key)
+    assert knobs is not None and "max_pending" not in knobs
+    assert "batch_window_ms" in knobs
+
+
+def test_resolve_precedence_explicit_beats_tuned_beats_default():
+    store, _key, digest, doc = _tuned_store()
+    resolved = resolve_serving_knobs(
+        store, "latest", max_pending=99, batch_window_ms=None,
+    )
+    assert resolved.max_pending == 99
+    assert resolved.sources["max_pending"] == "explicit"
+    assert resolved.batch_window_ms == doc["knobs"]["batch_window_ms"]
+    assert resolved.sources["batch_window_ms"] == "tuned"
+    assert resolved.tuned_digest == digest
+    # no ref at all: everything None (downstream built-ins apply), state 0
+    from bodywork_tpu.obs import get_registry
+
+    untouched = resolve_serving_knobs(store, None)
+    assert untouched.tuned_digest is None
+    assert all(s == "default" for s in untouched.sources.values())
+    gauge = get_registry().gauge("bodywork_tpu_tune_config_state")
+    assert gauge.value() == 0.0
+    resolve_serving_knobs(store, "latest")
+    assert gauge.value() == 1.0
+    resolve_serving_knobs(store, "tuning/missing.json")
+    assert gauge.value() == 2.0
+
+
+def test_validate_knobs_matrix():
+    accepted, rejected = validate_knobs({
+        "batch_window_ms": 1.5,
+        "batch_max_rows": 128,
+        "buckets": [1, 8, 64],
+        "max_pending": 200,
+    })
+    assert not rejected and accepted["buckets"] == (1, 8, 64)
+    # 0 is VALID for the window (coalescing off) — the sparse-arrival fit
+    ok_zero, rej_zero = validate_knobs({"batch_window_ms": 0.0})
+    assert not rej_zero and ok_zero["batch_window_ms"] == 0.0
+    for bad in (
+        {"batch_window_ms": -0.5},
+        {"batch_window_ms": 5000.0},
+        {"batch_max_rows": 0},
+        {"buckets": []},
+        {"buckets": [4, 2, 1]},
+        {"buckets": [0, 8]},
+        {"buckets": list(range(1, 20))},
+        {"max_pending": 0},
+        {"someday_knob": 1},
+    ):
+        _ok, rej = validate_knobs(bad)
+        assert rej == list(bad), bad
+
+
+# --- serving consumption path -----------------------------------------------
+
+
+def _trained_store(tmp_path, model="linear", **kwargs):
+    from bodywork_tpu.data import Dataset, generate_day, persist_dataset
+    from bodywork_tpu.store import FilesystemStore
+    from bodywork_tpu.train import train_on_history
+
+    store = FilesystemStore(tmp_path / "artefacts")
+    d = date(2026, 1, 1)
+    X, y = generate_day(d)
+    persist_dataset(store, Dataset(X, y, d))
+    train_on_history(store, model, model_kwargs=kwargs or None)
+    return store
+
+
+def test_serve_boots_with_tuned_config_and_reports_effective_config(tmp_path):
+    from bodywork_tpu.serve import serve_latest_model
+
+    store = _trained_store(tmp_path)
+    table = ObservationTable()
+    table.interarrival_s = [0.002] * 200          # 500 rps
+    table.row_counts = [1] * 190 + [100] * 10
+    table.dispatch_cost_s = dict(_CURVE)
+    table.saturated_goodput_rps = 400.0
+    table.sources = ["synthetic"]
+    doc = fit_tuned_config(table)
+    _key, digest = write_tuned_config(store, doc, day=date(2026, 1, 2))
+    handle = serve_latest_model(
+        store, host="127.0.0.1", port=0, block=False,
+        server_engine="thread", tuned_config="latest",
+    )
+    try:
+        app = handle.app
+        payload, status, _ra = app.healthz_payload()
+        assert status == 200
+        effective = payload["effective_config"]
+        assert effective["tuned_config"] == digest
+        assert effective["batch_window_ms"] == pytest.approx(
+            doc["knobs"]["batch_window_ms"]
+        )
+        assert effective["batch_max_rows"] == doc["knobs"]["batch_max_rows"]
+        assert effective["buckets"] == sorted(doc["knobs"]["buckets"])
+        # a tuned max_pending arms admission even on the thread engine
+        assert effective["max_pending"] == doc["knobs"]["max_pending"]
+        assert app.admission is not None
+        assert app.batcher is not None
+        # and the service actually scores through it
+        client = app.test_client()
+        resp = client.post("/score/v1", json={"X": [50.0]})
+        assert resp.status_code == 200
+    finally:
+        handle.stop()
+
+
+def test_explicit_serve_flags_beat_the_tuned_document(tmp_path):
+    from bodywork_tpu.serve import serve_latest_model
+
+    store = _trained_store(tmp_path)
+    table = ObservationTable()
+    table.interarrival_s = [0.002] * 100
+    table.dispatch_cost_s = dict(_CURVE)
+    doc = fit_tuned_config(table)
+    write_tuned_config(store, doc, day=date(2026, 1, 2))
+    handle = serve_latest_model(
+        store, host="127.0.0.1", port=0, block=False,
+        server_engine="thread", tuned_config="latest",
+        batch_window_ms=7.5, buckets=(1, 16),
+    )
+    try:
+        effective = handle.app.healthz_payload()[0]["effective_config"]
+        assert effective["batch_window_ms"] == 7.5
+        assert effective["buckets"] == [1, 16]
+        # unset knobs still came from the document
+        assert effective["batch_max_rows"] == doc["knobs"]["batch_max_rows"]
+    finally:
+        handle.stop()
+
+
+def test_sabotaged_tuned_config_never_crashes_serving(tmp_path):
+    from bodywork_tpu.obs import get_registry
+    from bodywork_tpu.serve import serve_latest_model
+
+    store = _trained_store(tmp_path)
+    key = tuned_config_key(date(2026, 1, 2))
+    store.put_bytes(key, b'{"schema": "bodywork_tpu.tuned_config/1", ')
+    handle = serve_latest_model(
+        store, host="127.0.0.1", port=0, block=False,
+        server_engine="thread", tuned_config=key,
+    )
+    try:
+        payload, status, _ra = handle.app.healthz_payload()
+        assert status == 200
+        assert payload["effective_config"]["tuned_config"] is None
+        # built-in defaults: no batcher/admission was armed by sabotage
+        assert payload["effective_config"]["batch_window_ms"] is None
+        assert payload["effective_config"]["max_pending"] is None
+        resp = handle.app.test_client().post("/score/v1", json={"X": [50.0]})
+        assert resp.status_code == 200
+        gauge = get_registry().gauge("bodywork_tpu_tune_config_state")
+        assert gauge.value() == 2.0  # named but degraded — operator-visible
+    finally:
+        handle.stop()
+
+
+def test_serve_stage_env_tuned_config_drives_knobs(tmp_path, monkeypatch):
+    """The pipeline path end-to-end: BODYWORK_TPU_TUNED_CONFIG on the
+    pod env tunes the serve stage's knobs (the env var must not be dead
+    in the stage path — the PR 6 regression pattern)."""
+    from bodywork_tpu.pipeline.stages import StageContext, serve_stage
+
+    store = _trained_store(tmp_path)
+    table = ObservationTable()
+    table.interarrival_s = [0.002] * 100
+    table.row_counts = [1] * 90 + [60] * 10
+    table.dispatch_cost_s = dict(_CURVE)
+    doc = fit_tuned_config(table)
+    _key, digest = write_tuned_config(store, doc, day=date(2026, 1, 2))
+    monkeypatch.setenv(TUNED_CONFIG_ENV, "latest")
+    ctx = StageContext(store=store, today=date(2026, 1, 1))
+    handle = serve_stage(ctx)
+    try:
+        app = handle.replica_apps[0]
+        effective = app.healthz_payload()[0]["effective_config"]
+        assert effective["tuned_config"] == digest
+        assert effective["batch_window_ms"] == pytest.approx(
+            doc["knobs"]["batch_window_ms"]
+        )
+        # the per-knob env var OVERRIDES the tuned document
+    finally:
+        handle.stop()
+    monkeypatch.setenv("BODYWORK_TPU_BATCH_WINDOW_MS", "4.25")
+    handle = serve_stage(ctx)
+    try:
+        effective = (
+            handle.replica_apps[0].healthz_payload()[0]["effective_config"]
+        )
+        assert effective["batch_window_ms"] == 4.25
+        assert effective["batch_max_rows"] == doc["knobs"]["batch_max_rows"]
+    finally:
+        handle.stop()
+
+
+# --- satellite: coalescer flush telemetry -----------------------------------
+
+
+def test_batcher_occupancy_histogram_and_flush_reasons():
+    import threading
+
+    from bodywork_tpu.obs import get_registry
+    from bodywork_tpu.serve.batcher import RequestCoalescer
+
+    class _Served:
+        class predictor:
+            @staticmethod
+            def predict(X):
+                return np.zeros(len(X))
+
+    reg = get_registry()
+    hist = reg.histogram(
+        "bodywork_tpu_serve_batch_occupancy_ratio",
+        buckets=(0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+    )
+    flush = reg.counter("bodywork_tpu_serve_batch_flush_total")
+    h_before = hist.count()
+    s_before = hist.sum()
+    full_before = (
+        flush.value(reason="max_rows") + flush.value(reason="saturation")
+    )
+    window_before = flush.value(reason="window")
+
+    # a full batch: two submitter threads against max_rows=2 and a LONG
+    # window -> a full-flush edge fired (max_rows when the dispatcher
+    # saw the first row before the second arrived, saturation when both
+    # were already queued at its first look — scheduling decides which)
+    coalescer = RequestCoalescer(window_ms=2000.0, max_rows=2).start()
+    served = _Served()
+    try:
+        threads = [
+            threading.Thread(
+                target=lambda: coalescer.submit(served, np.zeros(1), 10.0)
+            )
+            for _ in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+    finally:
+        coalescer.stop()
+    assert (
+        flush.value(reason="max_rows") + flush.value(reason="saturation")
+    ) == full_before + 1
+    # a lone row against a short window -> the window edge, occupancy 0.5
+    coalescer = RequestCoalescer(window_ms=5.0, max_rows=2).start()
+    try:
+        coalescer.submit(served, np.zeros(1), 10.0)
+    finally:
+        coalescer.stop()
+    assert flush.value(reason="window") == window_before + 1
+    # occupancy observed once per flush: a full 2/2 then a lone 1/2
+    assert hist.count() == h_before + 2
+    assert hist.sum() == pytest.approx(s_before + 1.0 + 0.5)
+
+
+# --- three-way env-knob drift guard ----------------------------------------
+
+
+def test_tuned_knobs_cli_stage_and_k8s_stay_in_sync(monkeypatch):
+    """Tuned-config schema keys == the env vars the stage parsers read
+    == the env vars materialised on the k8s serve Deployment == the
+    cost model's knob set. A knob in only some layers would be either
+    unreachable or silently dead (the PR 6 bug, re-pinned)."""
+    from bodywork_tpu.pipeline import default_pipeline
+    from bodywork_tpu.pipeline.k8s import generate_manifests
+    from bodywork_tpu.pipeline.stages import (
+        _serve_env_knobs,
+        _serve_tuned_env_knobs,
+    )
+    from bodywork_tpu.tune.config import _VALIDATORS
+
+    # one schema = one validator set = one defaults set = one env map
+    assert set(TUNED_KNOB_ENV) == set(KNOB_DEFAULTS) == set(_VALIDATORS)
+
+    # every tuned knob's env var (plus the pointer itself) is on the
+    # k8s serve Deployment
+    docs = generate_manifests(default_pipeline(), store_path="/mnt/store")
+    deployment = next(
+        d for d in docs.values()
+        if d["kind"] == "Deployment" and "serve" in d["metadata"]["name"]
+    )
+    env_names = {
+        e["name"]
+        for e in deployment["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert set(TUNED_KNOB_ENV.values()) | {TUNED_CONFIG_ENV} <= env_names
+
+    # every env var is parsed by the stage boot path, with the
+    # malformed-degrades contract
+    for window, max_rows, buckets, tuned, want in (
+        ("1.5", "128", "1,8,64", "latest",
+         (1.5, 128, (1, 8, 64), "latest")),
+        # "0" is EXPLICIT coalescing-off, not malformed — it must beat
+        # a tuned document's window
+        ("0", "", "", "", (0.0, None, None, None)),
+        ("-1", "zero", "0,8", "", (None, None, None, None)),
+        ("", "", "", "", (None, None, None, None)),
+    ):
+        monkeypatch.setenv("BODYWORK_TPU_BATCH_WINDOW_MS", window)
+        monkeypatch.setenv("BODYWORK_TPU_BATCH_MAX_ROWS", max_rows)
+        monkeypatch.setenv("BODYWORK_TPU_BUCKETS", buckets)
+        monkeypatch.setenv(TUNED_CONFIG_ENV, tuned)
+        assert _serve_tuned_env_knobs() == want
+    # max_pending rides the EXISTING _serve_env_knobs parse
+    monkeypatch.setenv(TUNED_KNOB_ENV["max_pending"], "64")
+    assert _serve_env_knobs()[1] == 64
+
+    # the defaults this module quotes are the real serving constants
+    from bodywork_tpu.serve.admission import DEFAULT_MAX_PENDING
+    from bodywork_tpu.serve.batcher import DEFAULT_MAX_ROWS, DEFAULT_WINDOW_MS
+    from bodywork_tpu.serve.predictor import DEFAULT_BUCKETS
+
+    assert KNOB_DEFAULTS["batch_window_ms"] == DEFAULT_WINDOW_MS
+    assert KNOB_DEFAULTS["batch_max_rows"] == DEFAULT_MAX_ROWS
+    assert KNOB_DEFAULTS["buckets"] == tuple(DEFAULT_BUCKETS)
+    assert KNOB_DEFAULTS["max_pending"] == DEFAULT_MAX_PENDING
+
+
+# --- tuning/ integrity: fsck + chaos ----------------------------------------
+
+
+def test_tuning_prefix_registered_everywhere():
+    from bodywork_tpu.audit.fsck import CHECKERS
+    from bodywork_tpu.audit.manifest import PUT_SIDECAR_PREFIXES, REPLICA_PREFIXES
+    from bodywork_tpu.chaos.plan import FaultPlan
+
+    assert TUNING_PREFIX in ALL_PREFIXES
+    assert TUNING_PREFIX in CHECKERS
+    assert TUNING_PREFIX in PUT_SIDECAR_PREFIXES
+    assert TUNING_PREFIX in REPLICA_PREFIXES
+    assert TUNING_PREFIX in FaultPlan().corrupt_prefixes
+
+
+def test_fsck_detects_and_restores_rotted_tuned_config(tmp_path):
+    from bodywork_tpu.audit.fsck import run_fsck
+    from bodywork_tpu.store import FilesystemStore, open_store
+
+    audited = open_store(str(tmp_path / "artefacts"))
+    table = ObservationTable()
+    table.interarrival_s = [0.01] * 100
+    table.dispatch_cost_s = dict(_CURVE)
+    key, _digest = write_tuned_config(audited, fit_tuned_config(table),
+                                      day=date(2026, 8, 1))
+    healthy = audited.get_bytes(key)
+    report = run_fsck(audited)
+    assert not [f for f in report["findings"] if f["prefix"] == TUNING_PREFIX]
+    # at-rest rot: flip CONTENT bytes UNDER the audited layer (no
+    # sidecar update; a key-name flip defeats schema AND digest checks)
+    raw = FilesystemStore(tmp_path / "artefacts")
+    rotted = healthy.replace(b'"schema"', b'"scheXa"', 1)
+    assert rotted != healthy
+    raw.put_bytes(key, rotted)
+    report = run_fsck(audited, repair=True)
+    findings = [
+        f for f in report["findings"] if f["key"] == key
+    ]
+    assert findings and findings[0]["severity"] == "restorable"
+    assert audited.get_bytes(key) == healthy  # byte-identical restore
+    # and serving would have DEGRADED (not crashed) on the rotted bytes
+    raw.put_bytes(key, rotted)
+    knobs, _d, _doc = load_tuned_config(raw, key)
+    assert knobs is None
+
+
+def test_fsck_drops_replica_less_corrupt_tuned_config(tmp_path):
+    from bodywork_tpu.audit.fsck import run_fsck
+    from bodywork_tpu.store import FilesystemStore, open_store
+    from bodywork_tpu.store.schema import quarantine_key
+
+    raw = FilesystemStore(tmp_path / "artefacts")  # no audit sidecars
+    key = tuned_config_key(date(2026, 8, 1))
+    raw.put_bytes(key, b"not a tuned config")
+    audited = open_store(str(tmp_path / "artefacts"))
+    report = run_fsck(audited, repair=True)
+    finding = next(f for f in report["findings"] if f["key"] == key)
+    assert finding["severity"] == "rebuildable"
+    assert finding["repair"] == "drop_tuned_config"
+    assert not raw.exists(key)  # dropped: serving reverts to defaults
+    assert raw.exists(quarantine_key(key))  # evidence parked
+
+
+def test_fsck_validity_matches_the_serving_loader(tmp_path):
+    """fsck must not be stricter than the loader (review finding): a
+    digest-valid document with empty knobs, or with a knob value this
+    version rejects, was WRITTEN that way — flagging it would
+    restore-flap (replica == primary) or quarantine a healthy doc."""
+    from bodywork_tpu.audit.fsck import run_fsck
+    from bodywork_tpu.store import open_store
+    from bodywork_tpu.utils.integrity import stamp_doc
+
+    audited = open_store(str(tmp_path / "artefacts"))
+    empty = stamp_doc({"schema": TUNED_CONFIG_SCHEMA, "knobs": {}})
+    audited.put_bytes(
+        tuned_config_key(date(2026, 8, 1)), json.dumps(empty).encode()
+    )
+    odd = stamp_doc({
+        "schema": TUNED_CONFIG_SCHEMA,
+        "knobs": {"batch_window_ms": 1.5, "max_pending": -9},
+    })
+    audited.put_bytes(
+        tuned_config_key(date(2026, 8, 2)), json.dumps(odd).encode()
+    )
+    report = run_fsck(audited)
+    assert not [
+        f for f in report["findings"] if f["prefix"] == TUNING_PREFIX
+    ]
+
+
+def test_string_bucket_value_rejected():
+    """'18' must not validate character-wise as the ladder (1, 8)
+    (review finding)."""
+    _ok, rejected = validate_knobs({"buckets": "18"})
+    assert rejected == ["buckets"]
+
+
+def test_cli_tune_with_no_fitted_knob_persists_nothing(tmp_path, capsys):
+    """Insufficient evidence -> decision trace printed, NOTHING written
+    (an empty document would only make --tuned-config latest degrade
+    with a warning)."""
+    from bodywork_tpu.cli import main
+    from bodywork_tpu.obs.registry import Registry
+
+    snap = tmp_path / "empty_snap.json"
+    snap.write_text(json.dumps(Registry().snapshot()))
+    assert main([
+        "tune", "--store", str(tmp_path / "artefacts"),
+        "--obs-snapshot", str(snap), "--no-probe",
+    ]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["key"] is None and out["nothing_fitted"] is True
+    assert not (tmp_path / "artefacts" / "tuning").exists()
+
+
+def test_chaos_corrupt_tuning_reads_degrade_to_defaults():
+    from bodywork_tpu.chaos.plan import FaultPlan
+    from bodywork_tpu.chaos.store import FaultInjectingStore
+
+    store, key, _digest, _doc = _tuned_store()
+    plan = FaultPlan(seed=5, corrupt_read_p=1.0,
+                     corrupt_prefixes=("tuning/",), max_consecutive=100)
+    chaotic = FaultInjectingStore(store, plan)
+    knobs, digest, doc = load_tuned_config(chaotic, key)
+    assert knobs is None and digest is None and doc is None
+
+
+# --- cli --------------------------------------------------------------------
+
+
+def test_cli_tune_writes_config_and_prints_one_json_doc(tmp_path, capsys):
+    from bodywork_tpu.cli import main
+    from bodywork_tpu.store import open_store
+
+    path, _requests = _request_log_file(tmp_path, rate=100.0, duration=3.0)
+    store_dir = str(tmp_path / "artefacts")
+    assert main([
+        "tune", "--store", store_dir, "--traffic-log", str(path),
+        "--no-probe", "--date", "2026-08-01",
+    ]) == 0
+    out = capsys.readouterr().out
+    doc = json.loads(out)  # stdout is exactly ONE JSON document
+    assert doc["key"] == tuned_config_key(date(2026, 8, 1))
+    assert doc["decisions"]
+    store = open_store(store_dir)
+    knobs, digest, _doc = load_tuned_config(store, doc["key"])
+    assert knobs is not None and digest == doc["digest"]
+    # dry-run writes nothing
+    assert main([
+        "tune", "--store", str(tmp_path / "dry"), "--traffic-log",
+        str(path), "--no-probe", "--dry-run",
+    ]) == 0
+    assert not (tmp_path / "dry" / "tuning").exists()
+
+
+def test_cli_tune_with_nothing_to_ingest_exits_1(tmp_path):
+    from bodywork_tpu.cli import main
+
+    assert main([
+        "tune", "--store", str(tmp_path / "empty"), "--no-probe",
+    ]) == 1
+
+
+# --- bench config 13 --------------------------------------------------------
+
+
+def test_bench_config13_registered():
+    import bench
+
+    assert 13 in bench.ALL_CONFIGS
+    assert 13 in bench.CONFIG_BENCHES
+    assert 13 in bench.CONFIG_TIMEOUT_S
+    assert set(bench.SELF_TUNING_PROFILES.values()) == {
+        "batch_window_ms", "buckets", "batch_max_rows",
+    }
+
+
+def test_bench_config13_smoke():
+    """In-process, seconds-scale shape check of the config-13 harness:
+    one profile end-to-end (default drive -> tune -> tuned re-drive ->
+    comparison) plus the sabotage degrade block. The full three-profile
+    acceptance run is the slow-marked capture below."""
+    import bench
+
+    record = bench.bench_self_tuning(
+        drive_s=0.7,
+        uniform_rate_rps=50.0,
+        isolate=False,
+        probe_reps=2,
+        mlp_kwargs={"hidden": [8, 8], "n_steps": 20},
+        profiles_run=("uniform_row",),
+        probe_buckets=(1, 8, 64),
+    )
+    assert record["metric"] == "self_tuning_knobs_beating_defaults"
+    profile = record["profiles"]["uniform_row"]
+    assert profile["decisions"]
+    applied = profile["effective_config_applied"]
+    assert applied["tuned_config"] == profile["tuned_config_digest"]
+    # a fitted window of 0.0 means coalescing OFF -> no live window
+    window = profile["knobs"]["batch_window_ms"]
+    assert applied["batch_window_ms"] == (window if window else None)
+    assert record["sabotage"]["degraded_to_defaults"] is True
+
+
+@pytest.mark.slow
+@pytest.mark.load
+def test_bench_config13_full_acceptance():
+    """The full-scale three-profile run. The >=2-knob acceptance claim
+    belongs to the committed record (BENCH_r10_config13.json, captured
+    on an idle box); re-proving perf deltas on an arbitrarily-loaded CI
+    box is inherently noisy, so this asserts the harness end-to-end
+    (every profile tuned + re-driven, sabotage degrade) and at least
+    ONE credited knob — a total wipeout means the mechanism broke, a
+    one-profile miss means the box was busy."""
+    import bench
+
+    record = bench.bench_self_tuning()
+    assert record["sabotage"]["degraded_to_defaults"] is True
+    assert set(record["profiles"]) == set(bench.SELF_TUNING_PROFILES)
+    for profile in record["profiles"].values():
+        assert profile["effective_config_applied"]["tuned_config"] == (
+            profile["tuned_config_digest"]
+        )
+    assert record["value"] >= 1, record["profiles"]
