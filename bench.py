@@ -103,7 +103,7 @@ from datetime import date
 BASELINE_DAY_S = 1317 * 0.00822  # reference stage-4 scoring loop, see above
 BASELINE_REQUEST_S = 0.00822  # reference per-request scoring latency
 
-ALL_CONFIGS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13)
+ALL_CONFIGS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14)
 HEADLINE_CONFIG = 2  # the north-star day loop
 
 #: config 11's padded-bucket sweep — pinned == serve.predictor.
@@ -1675,7 +1675,8 @@ class _ServeTarget:
                  max_rows: int | None, buckets, isolate: bool,
                  dtype: str = "float32", mesh_data: int | None = None,
                  env: dict | None = None, max_pending: int | None = None,
-                 tuned_config: str | None = None):
+                 tuned_config: str | None = None,
+                 frontends: int | None = None):
         # window_ms/max_rows/buckets left None are NOT passed (the
         # config-13 tuned servers boot that way so the tuned document —
         # not an explicit flag — supplies every knob)
@@ -1703,6 +1704,8 @@ class _ServeTarget:
                 cmd += ["--dtype", dtype]
             if mesh_data and mesh_data > 1:
                 cmd += ["--mesh-data", str(mesh_data)]
+            if frontends is not None:
+                cmd += ["--frontends", str(frontends)]
             self._proc = subprocess.Popen(
                 cmd,
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
@@ -1711,6 +1714,11 @@ class _ServeTarget:
             )
             _wait_healthy(self.base_url, self._proc)
         else:
+            if frontends is not None:
+                raise ValueError(
+                    "the disaggregated fleet is OS processes by "
+                    "definition; use isolate=True"
+                )
             from bodywork_tpu.serve import serve_latest_model
             from bodywork_tpu.store import FilesystemStore
 
@@ -3391,6 +3399,329 @@ def bench_self_tuning(
     }
 
 
+def _scrape_families(base_url: str, prefixes: tuple) -> dict:
+    """Sum every exposition line under each metric-name prefix from the
+    service's aggregated /metrics (labels and exposition suffixes
+    collapse into the family totals the occupancy math needs)."""
+    import requests as rq
+
+    totals = {p: 0.0 for p in prefixes}
+    text = rq.get(base_url + "/metrics", timeout=10).text
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name = line.split("{", 1)[0].split(" ", 1)[0]
+        for p in prefixes:
+            if name.startswith(p):
+                try:
+                    totals[p] += float(line.rsplit(" ", 1)[1])
+                except ValueError:
+                    pass
+    return totals
+
+
+def bench_disaggregated_serving(
+    frontend_counts: tuple = (1, 4),
+    rate_cap_rps: float = OPEN_LOOP_RATE_CAP_RPS,
+    capacity_window_s: float = 3.0,
+    occupancy_rate_rps: float = 250.0,
+    occupancy_window_s: float = 3.0,
+    template_reps: int = 50000,
+) -> dict:
+    """Config 14: disaggregated serving — N parse/admission front-ends
+    feeding ONE device-owning dispatcher over the shared-memory
+    row-queue (``serve --frontends N``).
+
+    The question this record answers: config 9/11 pinned serving
+    capacity to the Python HTTP front-end (~1.6k rps on the round-8 box)
+    while config 8's device dispatch sustains ~2M rows/s — and
+    ``--workers N`` scale-out FRAGMENTS batches (each SO_REUSEPORT
+    replica coalesces only its own connection share). Per N in
+    ``frontend_counts``, a subprocess fleet (CLI path: ``serve
+    --frontends N``, aio front-ends) is measured for
+
+    - **capacity_rps**: config 9's open-loop ramp (goodput peak);
+    - **flush occupancy under the SAME offered load**: a fixed-rate
+      window (``occupancy_rate_rps``) at every N, mean rows/max_rows
+      per coalesced flush read from the dispatcher's
+      ``bodywork_tpu_serve_batch_occupancy_ratio`` histogram through
+      the aggregated /metrics — the anti-fragmentation regression
+      (occupancy at N=4 must not fall below N=1, where ``--workers``
+      would divide it);
+    - **cross-front-end merging**: the multisource-flush counter over
+      the same window (only flushes mixing rows from DIFFERENT
+      front-ends move it);
+    - **json-vs-binary transport**: the same fixed-rate log driven once
+      per framing against the top fleet (satellite: the binary row
+      framing strips request-side JSON cost from the same contract).
+
+    Byte-identity is pinned over real HTTP: in-process server vs the
+    disaggregated fleet (single/batch/malformed), and JSON vs binary
+    framing on the fleet. The single-row response template (the
+    front-end's pre-serialized hot path) is micro-benchmarked against
+    the full ``json.dumps`` build it is byte-pinned to.
+
+    CPU CAVEAT (in-record): front-ends, the dispatcher, and the
+    open-loop driver multiplex the same host cores — on a small box the
+    goodput-vs-N slope is core-limited (N=4 can read BELOW N=1) and the
+    ≥1.5x scale-out claim is a many-core/TPU-host capture; the
+    occupancy/merging regression and the byte contract are
+    box-independent and are the binding assertions here.
+    """
+    import numpy as np
+
+    from bodywork_tpu.data import Dataset, generate_day, persist_dataset
+    from bodywork_tpu.serve.wire import (
+        SingleResponseTemplate,
+        encode_binary_rows,
+        single_score_payload,
+    )
+    from bodywork_tpu.store import FilesystemStore
+    from bodywork_tpu.traffic import (
+        TrafficConfig,
+        generate_request_log,
+        run_open_loop,
+    )
+    from bodywork_tpu.train import train_on_history
+
+    store_path = tempfile.mkdtemp(prefix="bench-disagg-")
+    store = FilesystemStore(store_path)
+    d = date(2026, 1, 1)
+    X, y = generate_day(d)
+    persist_dataset(store, Dataset(X, y, d))
+    train_on_history(store, "linear")
+
+    import requests as rq
+
+    occ_cfg = TrafficConfig(
+        rate_rps=occupancy_rate_rps, duration_s=occupancy_window_s, seed=23
+    )
+    occ_log = generate_request_log(occ_cfg)
+    families = (
+        "bodywork_tpu_serve_batch_occupancy_ratio_sum",
+        "bodywork_tpu_serve_batch_occupancy_ratio_count",
+        "bodywork_tpu_coalesced_multisource_flush_total",
+        "bodywork_tpu_rowqueue_rows_total",
+        "bodywork_tpu_rowqueue_handoff_seconds_count",
+    )
+
+    points: dict = {}
+    fleet_bodies: dict = {}
+    transport_drive: dict = {}
+    for n in frontend_counts:
+        target = _ServeTarget(
+            store_path, "aio", None, None, None, True, frontends=n,
+        )
+        try:
+            health = rq.get(target.base_url + "/healthz", timeout=10).json()
+            capacity, ramp = _open_loop_capacity(
+                target.url, rate_cap_rps, window_s=capacity_window_s
+            )
+            # fixed-rate occupancy window: SAME offered load at every N,
+            # so the flush-occupancy comparison isolates topology from
+            # load. Flusher interval is 0.25 s; the settle sleeps let the
+            # dispatcher's snapshot land before each scrape.
+            time.sleep(0.6)
+            s0 = _scrape_families(target.base_url, families)
+            occ_report = run_open_loop(
+                target.url, occ_log, timeout_s=15.0,
+                duration_s=occupancy_window_s,
+            )
+            time.sleep(0.6)
+            s1 = _scrape_families(target.base_url, families)
+            flushes = (
+                s1["bodywork_tpu_serve_batch_occupancy_ratio_count"]
+                - s0["bodywork_tpu_serve_batch_occupancy_ratio_count"]
+            )
+            occ_sum = (
+                s1["bodywork_tpu_serve_batch_occupancy_ratio_sum"]
+                - s0["bodywork_tpu_serve_batch_occupancy_ratio_sum"]
+            )
+            multisource = (
+                s1["bodywork_tpu_coalesced_multisource_flush_total"]
+                - s0["bodywork_tpu_coalesced_multisource_flush_total"]
+            )
+            # byte-identity bodies from this fleet (compared across
+            # topologies and framings after the sweep)
+            single = rq.post(target.url, json={"X": [50.0]}, timeout=30)
+            binary = rq.post(
+                target.url, data=encode_binary_rows(np.asarray([50.0])),
+                headers={"Content-Type": "application/x-bodywork-rows"},
+                timeout=30,
+            )
+            fleet_bodies[n] = {
+                "single": (single.status_code, single.content),
+                "binary": (binary.status_code, binary.content),
+            }
+            if n == max(frontend_counts):
+                # transport comparison on the biggest fleet: identical
+                # request log, json vs binary framing
+                for kind in ("json", "binary"):
+                    rep = run_open_loop(
+                        target.url, occ_log, timeout_s=15.0,
+                        duration_s=occupancy_window_s, transport_kind=kind,
+                    )
+                    transport_drive[kind] = {
+                        "offered_rps": rep.offered_rps,
+                        "goodput_in_window_rps": rep.goodput_in_window_rps,
+                        "p99_latency_s": rep.latency.get("p99_s"),
+                    }
+        finally:
+            target.stop()
+        last = ramp[-1] if ramp else None
+        truncated = bool(
+            last
+            and last["goodput_in_window_rps"] >= 0.9 * last["offered_rps"]
+            and last["shed_fraction"] == 0.0
+            and 2.0 * last["offered_rps"] > rate_cap_rps
+        )
+        points[str(n)] = {
+            "frontends": n,
+            "healthz_role": health.get("role"),
+            "healthz_dispatcher_up": health.get("dispatcher_up"),
+            "capacity_rps": capacity,
+            "capacity_is_lower_bound": truncated,
+            "capacity_ramp": ramp,
+            "occupancy_window": {
+                "offered_rps": occ_report.offered_rps,
+                "goodput_in_window_rps": occ_report.goodput_in_window_rps,
+                "flushes": flushes,
+                "mean_flush_occupancy": (
+                    round(occ_sum / flushes, 4) if flushes else None
+                ),
+                "multisource_flushes": multisource,
+                "rowqueue_rows": (
+                    s1["bodywork_tpu_rowqueue_rows_total"]
+                    - s0["bodywork_tpu_rowqueue_rows_total"]
+                ),
+            },
+        }
+        print(
+            f"  frontends {n}: capacity {capacity:.0f} rps, mean flush "
+            f"occupancy {points[str(n)]['occupancy_window']['mean_flush_occupancy']}"
+            f", multisource flushes {multisource:.0f}",
+            file=sys.stderr,
+        )
+
+    # cross-topology byte identity over real HTTP: one plain in-process
+    # server vs the disaggregated fleet (plus malformed-400 parity)
+    base_target = _ServeTarget(store_path, "aio", None, None, None, True)
+    fleet_target = _ServeTarget(
+        store_path, "aio", None, None, None, True,
+        frontends=max(frontend_counts),
+    )
+    try:
+        byte_identity = _byte_identity_check({
+            "in_process": base_target.base_url,
+            "disaggregated": fleet_target.base_url,
+        })
+    finally:
+        base_target.stop()
+        fleet_target.stop()
+    framing_identical = all(
+        bodies["single"] == bodies["binary"] for bodies in fleet_bodies.values()
+    )
+
+    # the pre-serialized template vs the full dict-build + dumps it is
+    # byte-pinned to (the single-row serialize hot path)
+    class _Served:
+        model_info = "LinearRegressor(closed_form_ols)"
+        model_date = "2026-07-01"
+
+    template = SingleResponseTemplate(
+        _Served.model_info, _Served.model_date
+    )
+    p0 = 25.999998092651367
+    assert template.render(p0) == json.dumps(
+        single_score_payload(_Served, p0)
+    ).encode()
+    t0 = time.perf_counter()
+    for _ in range(template_reps):
+        template.render(p0)
+    t_template = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(template_reps):
+        json.dumps(single_score_payload(_Served, p0)).encode()
+    t_dumps = time.perf_counter() - t0
+
+    counts = [str(n) for n in frontend_counts]
+    base_cap = points[counts[0]]["capacity_rps"] or None
+    top_cap = points[counts[-1]]["capacity_rps"]
+    occ = {
+        c: points[c]["occupancy_window"]["mean_flush_occupancy"]
+        for c in counts
+    }
+    occupancy_regression_holds = (
+        occ[counts[0]] is not None
+        and occ[counts[-1]] is not None
+        and occ[counts[-1]] >= 0.95 * occ[counts[0]]  # noise floor, not a dip
+    )
+    core_limited = (os.cpu_count() or 1) < (max(frontend_counts) + 2)
+    return {
+        "metric": "disaggregated_frontend_scaling",
+        "cpu_count": os.cpu_count(),
+        "unit": (
+            f"goodput_N{counts[-1]}/goodput_N{counts[0]} (open-loop "
+            "capacity)"
+        ),
+        "value": (
+            round(top_cap / base_cap, 4) if base_cap else None
+        ),
+        "vs_baseline": None,
+        "baseline_note": (
+            "the per-topology baseline is this run's own "
+            f"--frontends {counts[0]} point (same box, same harness); "
+            "config 9/11 single-process capacity records are the "
+            "motivating numbers, not comparable across boxes"
+        ),
+        "core_limited": core_limited,
+        "frontend_counts": list(frontend_counts),
+        "points": points,
+        "occupancy_regression": {
+            "mean_flush_occupancy_by_n": occ,
+            "holds": occupancy_regression_holds,
+            "note": (
+                "same offered load at every N; --workers N would "
+                "DIVIDE occupancy by N (each replica coalesces only "
+                "its own connection share) — the dispatcher-side "
+                "coalescer must keep it flat-or-better as front-ends "
+                "scale"
+            ),
+        },
+        "byte_identity": byte_identity,
+        "binary_framing_identical": framing_identical,
+        "transport_drive": transport_drive,
+        "template_bench": {
+            "reps": template_reps,
+            "template_ns_per_render": round(t_template / template_reps * 1e9),
+            "dumps_ns_per_build": round(t_dumps / template_reps * 1e9),
+            "speedup": round(t_dumps / t_template, 2) if t_template else None,
+        },
+        "cpu_caveat": (
+            "front-ends + dispatcher + the open-loop driver multiplex "
+            f"{os.cpu_count()} host core(s): the goodput-vs-N slope is "
+            "core-limited here and the >=1.5x scale-out claim needs a "
+            "many-core/TPU host; occupancy/merging regression and byte "
+            "identity are box-independent"
+            if core_limited else
+            "virtual-device-free host-side measurement; the goodput "
+            "slope still reflects this box's core count, not TPU "
+            "front-end economics"
+        ),
+        "protocol": (
+            "one linear checkpoint; per N in frontend_counts a "
+            "subprocess fleet (cli serve --frontends N, aio "
+            "front-ends, dispatcher-side coalescing at defaults): "
+            "config-9 capacity ramp + a fixed-rate "
+            f"{occupancy_rate_rps:.0f} rps occupancy window with "
+            "before/after /metrics scrapes (flush occupancy, "
+            "multisource flushes); then in-process vs fleet "
+            "byte-identity, json-vs-binary framing identity + drive, "
+            "and the single-row template micro-bench"
+        ),
+    }
+
+
 #: CONFIG_TIMEOUT_S budget and appear in ALL_CONFIGS — pinned by
 #: tests/test_bench.py::test_config_registry_sync so a new config can
 #: never silently miss one of the three tables (config 7 was once wired
@@ -3411,6 +3742,7 @@ CONFIG_BENCHES = {
     11: lambda: bench_compiled_serving(),
     12: lambda: bench_sharded_scaling(),
     13: lambda: bench_self_tuning(),
+    14: lambda: bench_disaggregated_serving(),
 }
 
 
@@ -3484,9 +3816,13 @@ RESUME_MAX_AGE_S = 6 * 3600
 #: calls: 3 profiles x 2 subprocess servers (a cold JAX init each) +
 #: one capacity ramp + ~12 s of timed drives per profile + the
 #: in-process dispatch probe and sabotage boot — generously sized
+#: config 14 is four subprocess fleets (front-ends are JAX-free and
+#: cheap, but each fleet's dispatcher is a cold JAX init) around two
+#: capacity ramps, three fixed-rate occupancy/transport windows, and
+#: host-only micro-benches — generously sized for a loaded box
 CONFIG_TIMEOUT_S = {
     1: 300, 2: 300, 3: 600, 4: 600, 5: 450, 6: 1200, 7: 600, 8: 300,
-    9: 600, 10: 1800, 11: 1200, 12: 1200, 13: 900,
+    9: 600, 10: 1800, 11: 1200, 12: 1200, 13: 900, 14: 900,
 }
 
 
@@ -3792,12 +4128,13 @@ def compact_output(records: list[dict], backend: str,
             # the worst case — a failed config AND flagged configs — under
             # the 2000-char tail now that the run list holds 13 configs;
             # per-config `unit` (at 10 configs), `vs_baseline` (at 11),
-            # and `resumed` (at 13) are dropped from the one-liners for
-            # the same budget (the headline keeps metric/unit/
-            # vs_baseline, the full record has them all)
+            # `resumed` (at 13), and `metric` (at 14) are dropped from
+            # the one-liners for the same budget (the headline keeps
+            # metric/unit/vs_baseline, the full record has them all —
+            # config numbers alone key the per-config lines)
             k: (r[k][:80] if k in ("error", "cpu_scaled_protocol",
                                    "timing_anomaly") else _sig(r[k]))
-            for k in ("config", "metric", "value",
+            for k in ("config", "value",
                       "backend", "elapsed_s", "error",
                       "cpu_scaled_protocol", "timing_anomaly")
             if k in r

@@ -197,14 +197,25 @@ def cmd_serve(args) -> int:
             "--batch-max-rows has no effect without --batch-window-ms; "
             "request coalescing stays OFF"
         )
-    if args.workers and args.workers > 1:
+    frontends = getattr(args, "frontends", None)
+    if frontends is not None and frontends >= 1 and args.workers > 1:
+        # two incompatible scale-out topologies: replicas each own a
+        # model; front-ends share the one dispatcher's
+        log.error("--frontends and --workers are mutually exclusive "
+                  "scale-out modes; pick one")
+        return 1
+    if (args.workers and args.workers > 1) or (
+        frontends is not None and frontends >= 1
+    ):
         # real OS-process replicas on one SO_REUSEPORT port (the local
         # materialisation of the reference's `replicas: 2` Deployment);
-        # single-device engines only — each worker owns its own params
+        # single-device engines only — each worker owns its own params.
+        # --frontends instead splits roles: N parse/admission processes
+        # + one device-owning dispatcher behind a shared-memory queue
         if (args.mesh_data and args.mesh_data > 1) or args.mesh_model > 1:
             log.error(
-                "--workers is per-process serving; drop --mesh-data/"
-                "--mesh-model"
+                "--workers/--frontends is per-process serving; drop "
+                "--mesh-data/--mesh-model"
             )
             return 1
         from bodywork_tpu.serve import MultiProcessService
@@ -221,6 +232,7 @@ def cmd_serve(args) -> int:
             retry_after_max_s=args.retry_after_max_s,
             dtype=args.dtype,
             tuned_config=args.tuned_config,
+            frontends=frontends,
         ).start()
         if svc.metrics_url:
             log.info(f"aggregated metrics at {svc.metrics_url}")
@@ -421,6 +433,7 @@ def cmd_traffic_run(args) -> int:
         report = run_open_loop(
             args.url, requests, timeout_s=args.timeout,
             results_log=args.results_out,
+            transport_kind=getattr(args, "transport", "json"),
         )
         print(format_report(report))
         return 0
@@ -1571,6 +1584,18 @@ def build_parser() -> argparse.ArgumentParser:
              "(default 1: single process, in-process serving)",
     )
     p.add_argument(
+        "--frontends", type=_positive_int, metavar="N",
+        default=_env_number("BODYWORK_TPU_FRONTENDS", int, 1),
+        help="disaggregated serving: N model-free parse/admission "
+             "front-end processes on this port (SO_REUSEPORT) feeding "
+             "ONE device-owning dispatcher over a shared-memory "
+             "row-queue, so batches coalesce from the UNION of every "
+             "front-end's rows (--workers fragments them per replica). "
+             "Mutually exclusive with --workers > 1. Env "
+             "BODYWORK_TPU_FRONTENDS overrides — the knob the k8s "
+             "serve Deployment materialises (docs/PERF.md §config 14)",
+    )
+    p.add_argument(
         "--buckets", default=None, metavar="N[,N...]", type=_bucket_list,
         help="comma-separated request-size buckets to compile and warm "
              "(positive integers; narrows startup cost when request "
@@ -2109,6 +2134,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         # choices hardcoded to keep parser construction import-light;
         # pinned == traffic.ARRIVAL_PROCESSES by tests/test_traffic.py
+        "--transport", default="json", choices=["json", "binary"],
+        help="wire encoding for the SAME request log (choices pinned == "
+             "traffic.generator.TRANSPORTS): 'json' sends the frozen "
+             "contract body, 'binary' the f32 row framing "
+             "(application/x-bodywork-rows) both serving engines "
+             "accept — a json-vs-binary pair isolates JSON "
+             "parse/format cost from everything else",
+    )
+    p.add_argument(
         "--arrival", default="poisson", choices=["poisson", "mmpp"],
         help="arrival process: memoryless 'poisson' or bursty 'mmpp' "
              "(2-state Markov-modulated: calm/burst squalls at the SAME "

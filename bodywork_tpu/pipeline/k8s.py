@@ -243,6 +243,14 @@ def _container(
             # single-device, the pre-mesh behaviour exactly
             ("BODYWORK_TPU_MESH_DATA", ""),
             ("BODYWORK_TPU_MESH_MODEL", ""),
+            # disaggregated serving (serve --frontends, read by
+            # stages._serve_fleet_env_knobs): N parse/admission
+            # front-end processes feeding ONE device-owning dispatcher
+            # over a shared-memory row-queue — scale parse capacity
+            # with `kubectl set env` while batches keep coalescing from
+            # the UNION of all front-ends' rows; empty = the flat
+            # topology (docs/PERF.md §config 14)
+            ("BODYWORK_TPU_FRONTENDS", ""),
             # coalescer + bucket knobs and the tuned-config pointer
             # (tune/config.py, read by stages._serve_tuned_env_knobs):
             # point BODYWORK_TPU_TUNED_CONFIG at a tuning/ document (or

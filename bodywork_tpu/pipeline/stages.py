@@ -380,6 +380,36 @@ def _serve_tuned_env_knobs() -> tuple[
     return window_ms, max_rows, buckets, tuned
 
 
+def _serve_fleet_env_knobs() -> int | None:
+    """The deployed process-fleet topology knob
+    (``BODYWORK_TPU_FRONTENDS`` — disaggregated serving: N
+    parse/admission front-ends feeding one device-owning dispatcher)
+    from the pod environment. Split from :func:`_serve_env_knobs` only
+    to keep that function's pinned tuple shape stable, exactly as
+    :func:`_serve_tuned_env_knobs` is. ``cli serve`` consumes the knob
+    to build the process fleet; the IN-PROCESS serve stage cannot (one
+    process by construction), so it surfaces and warns instead of
+    silently swallowing a deployed topology choice. Name pinned
+    three ways against the ``cli serve --frontends`` default and the
+    k8s serve Deployment env list by tests. Same malformed-degrades
+    contract: a typo is a warning, never a crash-looping pod."""
+    import os
+
+    raw = os.environ.get("BODYWORK_TPU_FRONTENDS", "").strip()
+    if not raw:
+        return None
+    try:
+        frontends = int(raw)
+        if frontends < 1:
+            raise ValueError(raw)
+    except ValueError:
+        log.warning(
+            f"ignoring BODYWORK_TPU_FRONTENDS={raw!r} (need an int >= 1)"
+        )
+        return None
+    return frontends
+
+
 def serve_stage(
     ctx: StageContext,
     host: str = "127.0.0.1",
@@ -503,6 +533,13 @@ def serve_stage(
         mesh_data = env_mesh_data
     if mesh_model is None:
         mesh_model = env_mesh_model
+    env_frontends = _serve_fleet_env_knobs()
+    if env_frontends:
+        log.warning(
+            f"BODYWORK_TPU_FRONTENDS={env_frontends} selects the "
+            "disaggregated process fleet (`cli serve --frontends`); "
+            "the in-process serve stage runs one process and ignores it"
+        )
     # coalescer/bucket/tuned-config knobs: spec args > per-knob env >
     # tuned document > built-in defaults (tune/config.py)
     env_window, env_max_rows, env_buckets, env_tuned = \

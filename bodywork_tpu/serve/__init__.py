@@ -1,47 +1,55 @@
-from bodywork_tpu.serve.predictor import (
-    EXECUTABLE_CACHE,
-    SERVE_DTYPES,
-    BF16MLPPredictor,
-    Int8MLPPredictor,
-    PaddedPredictor,
-)
-from bodywork_tpu.serve.admission import AdmissionController, SharedBudgetSlot
-from bodywork_tpu.serve.aio import AioServiceHandle
-from bodywork_tpu.serve.app import create_app
-from bodywork_tpu.serve.batcher import CoalescerSaturated, RequestCoalescer
-from bodywork_tpu.serve.multiproc import MultiProcessService
-from bodywork_tpu.serve.reload import CheckpointWatcher
-from bodywork_tpu.serve.server import (
-    SERVER_ENGINES,
-    RoundRobinApp,
-    ServiceHandle,
-    build_admission,
-    build_predictor,
-    build_serving_predictor,
-    resolve_engine,
-    serve_latest_model,
-)
+"""Serving package. Attribute access is lazy (PEP 562): the
+disaggregated front-end processes (``serve.frontend`` / ``serve.wire`` /
+``serve.rowqueue``) live under this package but must stay
+accelerator-free, so importing ``bodywork_tpu.serve.<leaf>`` cannot be
+allowed to drag ``predictor``/``app`` (and therefore JAX) in eagerly.
+``from bodywork_tpu.serve import create_app`` still works — it just pays
+the import at first access instead of at package import."""
+from __future__ import annotations
 
-__all__ = [
-    "AdmissionController",
-    "AioServiceHandle",
-    "BF16MLPPredictor",
-    "CheckpointWatcher",
-    "CoalescerSaturated",
-    "EXECUTABLE_CACHE",
-    "Int8MLPPredictor",
-    "RequestCoalescer",
-    "MultiProcessService",
-    "PaddedPredictor",
-    "RoundRobinApp",
-    "SERVER_ENGINES",
-    "SERVE_DTYPES",
-    "SharedBudgetSlot",
-    "build_admission",
-    "build_predictor",
-    "build_serving_predictor",
-    "create_app",
-    "resolve_engine",
-    "ServiceHandle",
-    "serve_latest_model",
-]
+import importlib
+
+#: public name -> defining submodule; the package namespace resolves
+#: these on first attribute access
+_EXPORTS = {
+    "EXECUTABLE_CACHE": "predictor",
+    "SERVE_DTYPES": "predictor",
+    "BF16MLPPredictor": "predictor",
+    "Int8MLPPredictor": "predictor",
+    "PaddedPredictor": "predictor",
+    "AdmissionController": "admission",
+    "SharedBudgetSlot": "admission",
+    "AioServiceHandle": "aio",
+    "create_app": "app",
+    "CoalescerSaturated": "batcher",
+    "RequestCoalescer": "batcher",
+    "MultiProcessService": "multiproc",
+    "CheckpointWatcher": "reload",
+    "SERVER_ENGINES": "server",
+    "RoundRobinApp": "server",
+    "ServiceHandle": "server",
+    "build_admission": "server",
+    "build_predictor": "server",
+    "build_serving_predictor": "server",
+    "resolve_engine": "server",
+    "serve_latest_model": "server",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    submodule = _EXPORTS.get(name)
+    if submodule is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    value = getattr(
+        importlib.import_module(f"{__name__}.{submodule}"), name
+    )
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -71,6 +71,7 @@ __all__ = [
     "QUEUE_DEPTH_METRIC",
     "AdmissionController",
     "SharedBudgetSlot",
+    "build_admission",
     "count_shed",
 ]
 
@@ -408,3 +409,42 @@ class AdmissionController:
             "admitted_total": admitted,
             "shed_total": shed,
         }
+
+
+def build_admission(
+    server_engine: str,
+    max_pending: int | None,
+    retry_after_max_s: float | None = None,
+    shared_slot=None,
+):
+    """The admission controller for a serving process, or ``None``.
+
+    Admission is armed by an explicit ``max_pending`` on either engine,
+    and BY DEFAULT (at :data:`DEFAULT_MAX_PENDING`) on the aio engine:
+    an event-loop front exists to stay responsive past saturation, which
+    it can only do by bounding the work it holds. The threaded engine
+    keeps its historical admit-everything default — its thread pool is
+    its own (cruder) bound, and the closed-loop parity benches must see
+    an unchanged service.
+
+    ``shared_slot`` (:class:`SharedBudgetSlot`) makes ``max_pending`` a
+    SERVICE-WIDE budget shared by every replica process behind one
+    SO_REUSEPORT port (``serve --workers N`` wires it): the fleet sheds
+    as one unit, which is what makes an N-replica capacity record a
+    number about ONE service rather than N accidental ones.
+
+    Lives here (not ``serve.server``) so the disaggregated front-end
+    processes (``serve.frontend``) can arm the same budget without
+    importing the model-loading — and therefore JAX-importing — serving
+    stack; ``serve.server`` re-exports it from its historical home.
+    """
+    if max_pending is None and server_engine != "aio":
+        return None
+    kwargs: dict = {}
+    if max_pending is not None:
+        kwargs["max_pending"] = max_pending
+    if retry_after_max_s is not None:
+        kwargs["retry_after_max_s"] = retry_after_max_s
+    if shared_slot is not None:
+        kwargs["shared_slot"] = shared_slot
+    return AdmissionController(**kwargs)

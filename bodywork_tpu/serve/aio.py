@@ -66,14 +66,15 @@ from bodywork_tpu.obs.tracing import (
     parse_traceparent,
 )
 from bodywork_tpu.serve.admission import count_shed
-from bodywork_tpu.serve.app import (
-    MODEL_KEY_HEADER,
-    ScoringApp,
-    batch_score_payload,
-    parse_features,
-    single_score_payload,
-)
 from bodywork_tpu.serve.batcher import CoalescerSaturated
+from bodywork_tpu.serve.rowqueue import DispatcherUnavailable, SlotsExhausted
+from bodywork_tpu.serve.wire import (
+    BINARY_CONTENT_TYPE,
+    MODEL_KEY_HEADER,
+    batch_score_payload,
+    parse_binary_rows,
+    parse_features,
+)
 from bodywork_tpu.utils.logging import get_logger
 
 log = get_logger("serve.aio")
@@ -125,7 +126,13 @@ class AioScoringServer:
         self.apps = list(apps) if isinstance(apps, (list, tuple)) else [apps]
         assert self.apps, "need at least one replica app"
         for app in self.apps:
-            assert isinstance(app, ScoringApp)
+            # a ScoringApp (in-process scoring) or a FrontendApp
+            # (disaggregated: is_frontend, enqueues to the dispatcher) —
+            # duck-typed so this module never imports the JAX-heavy
+            # serve.app just to check a type
+            assert hasattr(app, "route_stream") or getattr(
+                app, "is_frontend", False
+            ), f"not a servable app: {type(app).__name__}"
         # ONE admission budget for the whole listener (replicas share the
         # port, so they share the backpressure boundary); default to the
         # apps' controller so create_app wiring needs no duplication
@@ -154,7 +161,7 @@ class AioScoringServer:
         self._executor.shutdown(wait=False)
 
     # -- plumbing ----------------------------------------------------------
-    def _next_app(self) -> ScoringApp:
+    def _next_app(self):
         return self.apps[next(self._rr) % len(self.apps)]
 
     def _active_plan(self):
@@ -330,12 +337,23 @@ class AioScoringServer:
                 parse_traceparent(traceparent) is not None
             ):
                 trace_box[0] = tracer.begin(traceparent, b"")
-        routes = {
-            ("POST", "/score/v1"): self._score_single,
-            ("POST", "/score/v1/batch"): self._score_batch,
-            ("GET", "/healthz"): self._healthz,
-            ("GET", "/metrics"): self._metrics,
-        }
+        if getattr(app, "is_frontend", False):
+            # disaggregated mode: scoring enqueues to the dispatcher
+            # over the row-queue; healthz/metrics read the app directly
+            # (FrontendApp exposes the same payload/metrics-dir seams)
+            routes = {
+                ("POST", "/score/v1"): self._fe_score_single,
+                ("POST", "/score/v1/batch"): self._fe_score_batch,
+                ("GET", "/healthz"): self._healthz,
+                ("GET", "/metrics"): self._metrics,
+            }
+        else:
+            routes = {
+                ("POST", "/score/v1"): self._score_single,
+                ("POST", "/score/v1/batch"): self._score_batch,
+                ("GET", "/healthz"): self._healthz,
+                ("GET", "/metrics"): self._metrics,
+            }
         known_path = any(p == path for _m, p in routes)
         try:
             handler = routes.get((method, path))
@@ -352,7 +370,8 @@ class AioScoringServer:
                 )
             else:
                 status, payload, content_type, extra = await handler(
-                    app, body, trace_box if traced else None
+                    app, body, trace_box if traced else None,
+                    headers.get("content-type", ""),
                 )
         except Exception as exc:  # don't leak tracebacks to clients
             log.error(f"unhandled error serving {path}: {exc!r}")
@@ -393,7 +412,8 @@ class AioScoringServer:
             count_shed("chaos")
         return status, delay, plan.http_retry_after_s
 
-    async def _score_common(self, app, body, score, trace_box=None):
+    async def _score_common(self, app, body, score, trace_box=None,
+                            content_type: str = ""):
         """The shared scoring-request shell: admission, parse, canary
         routing, no-model 503, per-stream accounting — then the
         per-route ``score`` coroutine. (Chaos HTTP injection happens
@@ -428,11 +448,18 @@ class AioScoringServer:
         t_admit = time.perf_counter()
         try:
             t0 = time.perf_counter()
-            try:
-                payload = json.loads(body) if body else None
-            except ValueError:
-                payload = None
-            X, message = parse_features(payload)
+            # binary row-batch framing rides the content type (the JSON
+            # body stays the default) — same decode helpers as the WSGI
+            # engine, so a request's array is identical across framings
+            mimetype = (content_type or "").split(";", 1)[0].strip().lower()
+            if mimetype == BINARY_CONTENT_TYPE:
+                X, message = parse_binary_rows(body)
+            else:
+                try:
+                    payload = json.loads(body) if body else None
+                except ValueError:
+                    payload = None
+                X, message = parse_features(payload)
             t1 = time.perf_counter()
             app._m_parse.observe(t1 - t0)
             if sampled:
@@ -483,8 +510,8 @@ class AioScoringServer:
             if admission is not None:
                 admission.release(time.perf_counter() - t_admit)
 
-    async def _score_single(self, app: ScoringApp, body: bytes,
-                            trace_box=None):
+    async def _score_single(self, app, body: bytes, trace_box=None,
+                            content_type: str = ""):
         async def score(app, served, stream, X, trace):
             sampled = trace is not None and trace.sampled
             X = np.array(X, ndmin=2)  # scalar -> (1, 1), as the reference
@@ -552,9 +579,10 @@ class AioScoringServer:
                 )
                 prediction0 = float(np.asarray(fallback).ravel()[0])
             t0 = time.perf_counter()
-            payload = json.dumps(
-                single_score_payload(served, prediction0)
-            ).encode()
+            # pre-serialized framing (serve.wire.SingleResponseTemplate,
+            # cached on the answering bundle): byte-identical to the
+            # full json.dumps(single_score_payload(...)) it replaces
+            payload = served.single_template.render(prediction0)
             t1 = time.perf_counter()
             app._m_serialize.observe(t1 - t0)
             if sampled:
@@ -565,10 +593,11 @@ class AioScoringServer:
             )
             return 200, payload, "application/json", extra
 
-        return await self._score_common(app, body, score, trace_box)
+        return await self._score_common(app, body, score, trace_box,
+                                        content_type)
 
-    async def _score_batch(self, app: ScoringApp, body: bytes,
-                           trace_box=None):
+    async def _score_batch(self, app, body: bytes, trace_box=None,
+                           content_type: str = ""):
         async def score(app, served, stream, X, trace):
             sampled = trace is not None and trace.sampled
             if X.ndim == 0:
@@ -603,9 +632,112 @@ class AioScoringServer:
             )
             return 200, payload, "application/json", extra
 
-        return await self._score_common(app, body, score, trace_box)
+        return await self._score_common(app, body, score, trace_box,
+                                        content_type)
 
-    async def _healthz(self, app: ScoringApp, body: bytes, trace_box=None):
+    # -- disaggregated front-end handlers ----------------------------------
+    async def _fe_score_single(self, app, body: bytes, trace_box=None,
+                               content_type: str = ""):
+        return await self._fe_score(app, body, trace_box, content_type,
+                                    single=True)
+
+    async def _fe_score_batch(self, app, body: bytes, trace_box=None,
+                              content_type: str = ""):
+        return await self._fe_score(app, body, trace_box, content_type,
+                                    single=False)
+
+    async def _fe_score(self, app, body, trace_box, content_type,
+                        single: bool):
+        """The disaggregated scoring shell: admission (shed BEFORE
+        parse, as everywhere), parse via the shared wire helpers, then a
+        row-queue submit bridged to the loop exactly like a coalescer
+        submission — the dispatcher's reply renders through the
+        FrontendApp core, so responses are byte-identical to the
+        in-process engines'."""
+        trace = trace_box[0] if trace_box is not None else None
+        admission = self.admission
+        if admission is not None and not admission.try_admit():
+            if trace is not None and trace.sampled:
+                now = time.perf_counter()
+                trace.add(
+                    "admission-shed", now, now,
+                    queue_depth=admission.queue_depth,
+                )
+            status, payload, extra = app.shed_parts()
+            return status, payload, "application/json", extra
+        if trace_box is not None and trace is None:
+            trace = trace_box[0] = get_tracer().begin(None, body)
+        sampled = trace is not None and trace.sampled
+        t_admit = time.perf_counter()
+        try:
+            t0 = time.perf_counter()
+            X, message = app.parse_rows(body, content_type)
+            t1 = time.perf_counter()
+            app._m_parse.observe(t1 - t0)
+            if sampled:
+                trace.add("parse", t0, t1)
+            if message is not None:
+                return (
+                    400,
+                    json.dumps({"error": message}).encode(),
+                    "application/json",
+                    (),
+                )
+            loop = asyncio.get_running_loop()
+            future = loop.create_future()
+
+            def _resolve(outcome) -> None:
+                # reader thread -> event loop handoff; the loop may
+                # already be gone on shutdown
+                def _set() -> None:
+                    if future.cancelled():
+                        return
+                    if isinstance(outcome, Exception):
+                        future.set_exception(outcome)
+                    else:
+                        future.set_result(outcome)
+
+                try:
+                    loop.call_soon_threadsafe(_set)
+                except RuntimeError:
+                    pass
+
+            t_submit = time.perf_counter()
+            try:
+                app.submit(
+                    X, single, _resolve,
+                    trace_id=trace.trace_id if sampled else None,
+                )
+            except DispatcherUnavailable:
+                status, payload, extra = app.unavailable_parts()
+                return status, payload, "application/json", extra
+            except SlotsExhausted:
+                count_shed("rowqueue")
+                status, payload, extra = app.shed_parts()
+                return status, payload, "application/json", extra
+            try:
+                reply = await asyncio.wait_for(future, COALESCE_TIMEOUT_S)
+            except DispatcherUnavailable:
+                # died mid-request: the epoch bump failed the wait
+                status, payload, extra = app.unavailable_parts()
+                return status, payload, "application/json", extra
+            except asyncio.TimeoutError:
+                return (
+                    500,
+                    json.dumps({"error": "internal server error"}).encode(),
+                    "application/json",
+                    (),
+                )
+            if sampled:
+                trace.add("rowqueue", t_submit, time.perf_counter())
+            status, payload, extra = app.render_reply(reply, single)
+            return status, payload, "application/json", extra
+        finally:
+            if admission is not None:
+                admission.release(time.perf_counter() - t_admit)
+
+    async def _healthz(self, app, body: bytes, trace_box=None,
+                       content_type: str = ""):
         payload, status, retry_after = app.healthz_payload()
         extra = (
             (("Retry-After", str(retry_after)),) if retry_after is not None
@@ -613,7 +745,8 @@ class AioScoringServer:
         )
         return status, json.dumps(payload).encode(), "application/json", extra
 
-    async def _metrics(self, app: ScoringApp, body: bytes, trace_box=None):
+    async def _metrics(self, app, body: bytes, trace_box=None,
+                       content_type: str = ""):
         from bodywork_tpu.obs.multiproc import aggregated_render
 
         loop = asyncio.get_running_loop()

@@ -63,14 +63,25 @@ from bodywork_tpu.obs.tracing import (
 )
 from bodywork_tpu.serve.batcher import CoalescerSaturated
 from bodywork_tpu.serve.predictor import PaddedPredictor
+
+# the wire formats (request validation, response payloads, binary
+# framing, the pre-serialized response template) live in serve.wire — a
+# JAX-free leaf the disaggregated front-end processes import without
+# paying the accelerator runtime. Re-exported here because this module
+# is their historical home and both engines (and many tests) import
+# them from serve.app.
+from bodywork_tpu.serve.wire import (  # noqa: F401  (re-exports)
+    BINARY_CONTENT_TYPE,
+    MODEL_KEY_HEADER,
+    SingleResponseTemplate,
+    batch_score_payload,
+    parse_binary_rows,
+    parse_features,
+    single_score_payload,
+)
 from bodywork_tpu.utils.logging import get_logger
 
 log = get_logger("serve.app")
-
-#: every scoring response names the model that ANSWERED it (after any
-#: sanity-firewall fallback) — the attribution channel the traffic
-#: harness's per-model-key report and canary sweeps read
-MODEL_KEY_HEADER = "X-Bodywork-Model-Key"
 
 #: parse/serialize are µs-scale host work — the default latency buckets
 #: would dump them all into the first bucket
@@ -157,48 +168,6 @@ def sanity_violation(predictions, bounds: tuple[float, float] | None) -> str | N
     return None
 
 
-def parse_features(payload):
-    """Validate a decoded request body into a float32 feature array.
-
-    Returns ``(X, None)`` or ``(None, error_message)``. Factored out of
-    the WSGI handler so BOTH front-ends (threaded werkzeug and the
-    asyncio event loop, ``serve.aio``) validate with the same code and
-    answer malformed input with byte-identical 400 bodies."""
-    if not isinstance(payload, dict) or "X" not in payload:
-        return None, "request body must be a JSON object with an 'X' field"
-    try:
-        X = np.asarray(payload["X"], dtype=np.float32)
-    except (TypeError, ValueError):
-        return None, "'X' must be numeric"
-    if X.size == 0:
-        return None, "'X' must be non-empty"
-    if not np.all(np.isfinite(X)):
-        return None, "'X' must be finite"
-    return X, None
-
-
-def single_score_payload(served, prediction0: float) -> dict:
-    """The ``/score/v1`` response body. One constructor for both
-    front-ends: key order and value formatting are what make coalesced
-    responses byte-identical across engines."""
-    return {
-        "prediction": prediction0,
-        "model_info": served.model_info,
-        "model_date": served.model_date,
-    }
-
-
-def batch_score_payload(served, predictions) -> dict:
-    """The ``/score/v1/batch`` response body (see
-    :func:`single_score_payload` for why this is factored)."""
-    return {
-        "predictions": [float(p) for p in predictions],
-        "n": int(len(predictions)),
-        "model_info": served.model_info,
-        "model_date": served.model_date,
-    }
-
-
 def _predictor_mesh(predictor) -> dict | None:
     """The device-mesh shape a predictor dispatches over, or None for
     single-device predictors — the /healthz ``mesh`` block."""
@@ -222,7 +191,7 @@ class _Served:
 
     __slots__ = (
         "predictor", "model_info", "model_date", "model_key", "source",
-        "bounds",
+        "bounds", "single_template",
     )
 
     def __init__(
@@ -242,6 +211,12 @@ class _Served:
         #: (lo, hi) prediction-sanity band from the registry record's
         #: training-label statistics; None = finiteness checks only
         self.bounds = bounds
+        #: pre-serialized /score/v1 response framing (serve.wire): the
+        #: body's invariant bytes are fixed per bundle, so the hot path
+        #: splices only the prediction instead of a full json.dumps.
+        #: Living ON the bundle gives invalidation for free — a swap
+        #: builds a new _Served, and with it a new template.
+        self.single_template = SingleResponseTemplate(model_info, model_date)
 
 
 class ScoringApp:
@@ -879,7 +854,16 @@ class ScoringApp:
                 trace.add("parse", t0, t1)
 
     def _parse_features(self, request: Request):
-        X, message = parse_features(request.get_json(silent=True))
+        # binary row-batch framing rides the content type; the JSON
+        # body stays the default. Both decode through serve.wire, so a
+        # request's array — and with it canary routing, predictions,
+        # and response bytes — is identical across framings.
+        if request.mimetype == BINARY_CONTENT_TYPE:
+            X, message = parse_binary_rows(
+                request.get_data(cache=True, parse_form_data=False)
+            )
+        else:
+            X, message = parse_features(request.get_json(silent=True))
         if message is not None:
             return None, _json_response({"error": message}, 400)
         return X, None
@@ -970,7 +954,14 @@ class ScoringApp:
                 self.count_stream_error(routed, stream)
             raise
         t0 = time.perf_counter()
-        response = _json_response(single_score_payload(served, prediction0))
+        # pre-serialized framing: the bundle-invariant bytes are cached
+        # on the _Served (serve.wire.SingleResponseTemplate) — only the
+        # prediction is serialized per response, byte-identical to the
+        # full json.dumps(single_score_payload(...)) it replaces
+        response = Response(
+            served.single_template.render(prediction0),
+            mimetype="application/json",
+        )
         t1 = time.perf_counter()
         self._m_serialize.observe(t1 - t0)
         if sampled:

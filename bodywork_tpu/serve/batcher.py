@@ -82,10 +82,11 @@ class _Submission:
 
     __slots__ = (
         "row", "served", "event", "result", "error", "enqueued_at", "on_done",
-        "trace", "enqueued_perf",
+        "trace", "enqueued_perf", "source",
     )
 
-    def __init__(self, row: np.ndarray, served, on_done=None, trace=None):
+    def __init__(self, row: np.ndarray, served, on_done=None, trace=None,
+                 source=None):
         self.row = row
         self.served = served
         self.event = threading.Event()
@@ -97,6 +98,10 @@ class _Submission:
         # perf_counter twin of enqueued_at: trace spans live on the
         # perf_counter timeline (obs.tracing); only taken when traced
         self.enqueued_perf = time.perf_counter() if trace is not None else 0.0
+        #: which ingress this row arrived through (a front-end id in the
+        #: disaggregated split; None in-process) — flush accounting uses
+        #: it to PROVE batches merge rows across front-ends
+        self.source = source
 
 
 class RequestCoalescer:
@@ -133,6 +138,12 @@ class RequestCoalescer:
         self.batches_dispatched = 0
         self.rows_dispatched = 0
         self.max_batch_rows = 0
+        # cross-ingress merge accounting: the disaggregated split's
+        # whole point is that ONE coalescer sees every front-end's rows,
+        # so flushes mixing sources are the direct evidence that fleet
+        # scale-out concentrates batches instead of fragmenting them
+        self.multi_source_flushes = 0
+        self.sources_seen: set = set()
         # phase histograms (obs.registry): queue wait is the latency the
         # coalescer COSTS, device dispatch the work it AMORTISES — the
         # same bodywork_tpu_device_dispatch_seconds the app's direct
@@ -172,6 +183,12 @@ class RequestCoalescer:
             "window, saturation=a full batch was already queued — no "
             "window wait at all)",
         )
+        self._m_multisource = reg.counter(
+            "bodywork_tpu_coalesced_multisource_flush_total",
+            "Coalesced flushes whose batch merged rows from more than "
+            "one ingress source (disaggregated mode: cross-front-end "
+            "batch formation actually happening)",
+        )
         self._thread = threading.Thread(
             target=self._run, name="request-coalescer", daemon=True
         )
@@ -202,16 +219,18 @@ class RequestCoalescer:
 
     # -- request path ------------------------------------------------------
     def submit_nowait(self, served, row: np.ndarray, on_done=None,
-                      trace=None) -> _Submission:
+                      trace=None, source=None) -> _Submission:
         """Enqueue one row WITHOUT waiting: returns the submission whose
         ``event`` (pull) or ``on_done`` callback (push — must be set
         HERE, before the enqueue, or the dispatcher can complete the
         batch first and the callback never fires) signals completion.
         The asyncio front-end's bridge into the coalescer; raises
         :class:`CoalescerSaturated` exactly as :meth:`submit` does.
-        ``trace``: the request's sampled span context, or None."""
+        ``trace``: the request's sampled span context, or None.
+        ``source``: the ingress this row arrived through (the serving
+        dispatcher tags each row with its front-end id)."""
         sub = _Submission(np.asarray(row, dtype=np.float32), served, on_done,
-                          trace)
+                          trace, source)
         with self._cond:
             if self._stopped or not self._started:
                 self._m_saturated.inc()
@@ -340,6 +359,12 @@ class RequestCoalescer:
         self._m_batch_rows.observe(len(batch))
         self._m_occupancy.observe(len(batch) / self.max_rows)
         self._m_flush_reason.inc(reason=reason)
+        sources = {sub.source for sub in batch if sub.source is not None}
+        if sources:
+            self.sources_seen.update(sources)
+            if len(sources) > 1:
+                self.multi_source_flushes += 1
+                self._m_multisource.inc()
         # trace fan-in: each SAMPLED member gets its queue-wait span and
         # the batch's shared device-dispatch span, the latter carrying
         # every member's request span id as links — one coalesced
@@ -394,4 +419,6 @@ class RequestCoalescer:
                 "max_batch_rows": self.max_batch_rows,
                 "window_ms": round(self.window_s * 1e3, 3),
                 "max_rows": self.max_rows,
+                "multi_source_flushes": self.multi_source_flushes,
+                "sources_seen": sorted(self.sources_seen),
             }

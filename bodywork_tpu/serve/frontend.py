@@ -1,0 +1,438 @@
+"""The disaggregated serving front-end: parse + admission, no model.
+
+``serve --frontends N`` splits the serving plane that ``--workers N``
+replicates: N of THESE processes own the HTTP socket (SO_REUSEPORT) and
+do request parse, feature validation, and admission, while exactly one
+dispatcher process (``serve.dispatch``) owns the predictor, the AOT
+cache, the canary bundles, and the request coalescer. The two halves
+meet over the shared-memory row-queue (``serve.rowqueue``): a front-end
+writes a request's rows once and enqueues a descriptor; the dispatcher
+reads them zero-copy, scores, and replies with predictions plus the
+answering bundle's identity.
+
+What a front-end process deliberately does NOT have: JAX (a guard test
+pins that importing this module never imports it), a model, a
+coalescer. What it keeps, unchanged from the in-process engines:
+
+- **Admission-shed-BEFORE-parse.** The :class:`~bodywork_tpu.serve.
+  admission.AdmissionController` (with its cross-process
+  ``SharedBudgetSlot`` budget) runs first, upstream of body parse — a
+  shed request never touches the row-queue (``rows_submitted`` stays
+  untouched; a regression test pins it), exactly the zero-footprint
+  invariant the in-process engines hold.
+- **Byte-identical responses.** Success bodies are rendered from the
+  reply's bundle identity through the same ``serve.wire`` helpers and
+  the same pre-serialized single-row template; error bodies reuse the
+  in-process strings. The bench pins disaggregated == in-process bytes
+  over real HTTP.
+- **Degrade, never wedge.** A dead dispatcher turns scoring into
+  503 + Retry-After (``/healthz`` flips 503 so probes see it) the
+  moment the supervisor observes the death; in-flight waits are failed
+  by the row-queue epoch bump. The supervisor's respawn flips it back —
+  front-ends hold no dispatcher state beyond the shared handles, so
+  healing requires nothing from them.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from werkzeug.exceptions import HTTPException, MethodNotAllowed, NotFound
+from werkzeug.wrappers import Request, Response
+
+from bodywork_tpu.obs import get_registry
+from bodywork_tpu.obs.tracing import (
+    TRACE_ID_HEADER,
+    TRACEPARENT_HEADER,
+    get_tracer,
+    parse_traceparent,
+)
+from bodywork_tpu.serve.admission import count_shed
+from bodywork_tpu.serve.rowqueue import (
+    KIND_BATCH,
+    KIND_SINGLE,
+    DispatcherUnavailable,
+    SlotsExhausted,
+)
+from bodywork_tpu.serve.wire import (
+    BINARY_CONTENT_TYPE,
+    MODEL_KEY_HEADER,
+    SingleResponseTemplate,
+    batch_score_payload,
+    parse_binary_rows,
+    parse_features,
+)
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("serve.frontend")
+
+__all__ = ["FrontendApp"]
+
+#: mirrors serve.app.RETRY_AFTER_S (the no-admission fallback hint);
+#: duplicated rather than imported because serve.app imports JAX — a
+#: guard test pins the two equal
+RETRY_AFTER_S = 5
+
+#: ceiling on one row-queue rendezvous — mirrors the coalescer's
+#: COALESCE_TIMEOUT_S; the epoch-bump failure path makes hitting it
+#: near-impossible (a dead dispatcher fails waits in <1s)
+DISPATCH_TIMEOUT_S = 60.0
+
+_SCORING_ROUTES = ("/score/v1", "/score/v1/batch")
+
+#: parse/serialize phase buckets — MUST stay equal to serve.app's
+#: _FAST_PHASE_BUCKETS (same histogram names; the registry rejects a
+#: re-registration with different buckets)
+_FAST_PHASE_BUCKETS = (
+    0.00001, 0.00005, 0.0001, 0.0005, 0.001, 0.005, 0.025, 0.1,
+)
+
+
+def _json_response(payload: dict, status: int = 200) -> Response:
+    return Response(
+        json.dumps(payload), status=status, mimetype="application/json"
+    )
+
+
+class FrontendApp:
+    """WSGI front-end over a :class:`~bodywork_tpu.serve.rowqueue.
+    RowQueueClient`. Route set, admission placement, metrics names, and
+    response bytes all mirror :class:`~bodywork_tpu.serve.app.
+    ScoringApp`; the scoring handlers enqueue instead of predict.
+
+    The transport-agnostic core (``parse_rows`` / ``submit`` /
+    ``render_reply`` and the canned backpressure parts) is also what the
+    asyncio engine's front-end handlers drive — one implementation of
+    the wire behaviour, two HTTP fronts, exactly as in-process serving
+    splits ScoringApp from its engines."""
+
+    #: how serve.aio tells a front-end app from a scoring app without
+    #: importing either (isinstance would force the import)
+    is_frontend = True
+
+    def __init__(self, client, admission=None, metrics_dir=None):
+        self.client = client
+        self.admission = admission
+        self.metrics_dir = metrics_dir
+        self.tracer = get_tracer()
+        reg = get_registry()
+        # same metric families as ScoringApp: dashboards see one request
+        # stream regardless of the serving topology
+        self._m_requests = reg.counter(
+            "bodywork_tpu_http_requests_total",
+            "HTTP requests served, by route and status",
+        )
+        self._m_latency = reg.histogram(
+            "bodywork_tpu_scoring_latency_seconds",
+            "End-to-end handler time of successful scoring requests",
+        )
+        self._m_parse = reg.histogram(
+            "bodywork_tpu_request_parse_seconds",
+            "Request-parse phase: JSON body -> validated feature array",
+            buckets=_FAST_PHASE_BUCKETS,
+        )
+        self._m_serialize = reg.histogram(
+            "bodywork_tpu_response_serialize_seconds",
+            "Serialization phase: prediction -> JSON response",
+            buckets=_FAST_PHASE_BUCKETS,
+        )
+        # single-row templates per answering-bundle identity: the
+        # dispatcher names the bundle in each reply; invalidation is
+        # structural (a hot swap changes the identity, hence the key)
+        self._templates: dict = {}
+        self._templates_lock = threading.Lock()
+        self._routes = {
+            ("POST", "/score/v1"): self.score_single,
+            ("POST", "/score/v1/batch"): self.score_batch,
+            ("GET", "/healthz"): self.healthz,
+            ("GET", "/metrics"): self.metrics_endpoint,
+        }
+
+    # -- transport-agnostic core (shared with the aio engine) --------------
+    def retry_after_s(self) -> int:
+        if self.admission is not None:
+            return self.admission.retry_after_s()
+        return RETRY_AFTER_S
+
+    def parse_rows(self, body: bytes, content_type: str):
+        """Decode a scoring request body — JSON ``{"X": [...]}`` or the
+        binary row framing, selected by content type — into ``(X,
+        error_message)``. Same helpers, hence same arrays and same 400
+        strings, as the in-process engines."""
+        mimetype = (content_type or "").split(";", 1)[0].strip().lower()
+        if mimetype == BINARY_CONTENT_TYPE:
+            return parse_binary_rows(body)
+        try:
+            payload = json.loads(body) if body else None
+        except ValueError:
+            payload = None
+        return parse_features(payload)
+
+    def submit(self, X, single: bool, on_done, trace_id=None) -> None:
+        """Enqueue one parsed request; raises
+        :class:`DispatcherUnavailable` / :class:`SlotsExhausted` when
+        nothing was enqueued (the caller maps them to 503/429)."""
+        self.client.submit(
+            X, KIND_SINGLE if single else KIND_BATCH, on_done,
+            trace_id=trace_id,
+        )
+
+    def _template_for(self, reply) -> SingleResponseTemplate:
+        key = (reply.model_info, reply.model_date)
+        template = self._templates.get(key)
+        if template is None:
+            with self._templates_lock:
+                template = self._templates.setdefault(
+                    key,
+                    SingleResponseTemplate(reply.model_info, reply.model_date),
+                )
+        return template
+
+    def render_reply(self, reply, single: bool):
+        """A dispatcher reply -> ``(status, body_bytes, extra_headers)``,
+        byte-identical to the in-process response for the same request:
+        same template splice on the single-row path, same payload
+        builders, same error strings and Retry-After placement."""
+        if reply.status == 200:
+            t0 = time.perf_counter()
+            if single:
+                body = self._template_for(reply).render(
+                    float(reply.predictions[0])
+                )
+            else:
+                body = json.dumps(
+                    batch_score_payload(reply, reply.predictions)
+                ).encode()
+            self._m_serialize.observe(time.perf_counter() - t0)
+            extra = (
+                ((MODEL_KEY_HEADER, reply.model_key),)
+                if reply.model_key else ()
+            )
+            return 200, body, extra
+        if reply.status == 503:
+            return (
+                503,
+                json.dumps(
+                    {"error": "no model loaded yet; retry shortly"}
+                ).encode(),
+                (("Retry-After", str(self.retry_after_s())),),
+            )
+        return (
+            500,
+            json.dumps({"error": "internal server error"}).encode(),
+            (),
+        )
+
+    def unavailable_parts(self):
+        """The dead-dispatcher 503: honest about WHY (distinct from the
+        no-model-yet 503 — an operator must tell "still warming" from
+        "the singleton died"), still retryable."""
+        return (
+            503,
+            json.dumps(
+                {"error": "scoring dispatcher unavailable; retry shortly"}
+            ).encode(),
+            (("Retry-After", str(self.retry_after_s())),),
+        )
+
+    def shed_parts(self):
+        return (
+            429,
+            json.dumps(
+                {"error": "server over capacity; request shed"}
+            ).encode(),
+            (("Retry-After", str(self.retry_after_s())),),
+        )
+
+    def healthz_payload(self):
+        """``(payload, status, retry_after_s-or-None)``: 503 while the
+        dispatcher is down — a front-end that cannot score must leave
+        the endpoints so load concentrates on healthy pods (unlike the
+        in-process degraded-but-serving 200)."""
+        stats = self.client.stats()
+        admission = self.admission
+        payload = {
+            "status": "ok" if stats["dispatcher_up"]
+            else "scoring dispatcher unavailable",
+            "role": "frontend",
+            "dispatcher_up": stats["dispatcher_up"],
+            "queue_depth": (
+                admission.queue_depth if admission is not None
+                else stats["in_flight"]
+            ),
+            "admission": admission.state() if admission is not None else None,
+            "rowqueue": stats,
+        }
+        if stats["dispatcher_up"]:
+            return payload, 200, None
+        return payload, 503, self.retry_after_s()
+
+    # -- WSGI plumbing (mirrors ScoringApp.__call__) -----------------------
+    def __call__(self, environ, start_response):
+        request = Request(environ)
+        t0 = time.perf_counter()
+        scoring_post = (
+            request.method == "POST" and request.path in _SCORING_ROUTES
+        )
+        trace = None
+        tracer = self.tracer
+        traced = scoring_post and tracer.enabled
+        if traced:
+            traceparent = request.headers.get(TRACEPARENT_HEADER)
+            if traceparent is not None and (
+                parse_traceparent(traceparent) is not None
+            ):
+                trace = tracer.begin(traceparent, b"")
+        # admission FIRST — a shed request must leave zero footprint:
+        # no body read, no parse, and (the split's own invariant) no
+        # row-queue slot — rows_submitted stays exactly where it was
+        admission = self.admission
+        admitted = False
+        if admission is not None and scoring_post:
+            if not admission.try_admit():
+                status, body, extra = self.shed_parts()
+                response = Response(
+                    body, status=status, mimetype="application/json"
+                )
+                for name, value in extra:
+                    response.headers[name] = value
+                if trace is not None:
+                    if trace.sampled:
+                        now = time.perf_counter()
+                        trace.add(
+                            "admission-shed", now, now,
+                            queue_depth=admission.queue_depth,
+                        )
+                    tracer.finish(trace, request.path, status)
+                    response.headers[TRACE_ID_HEADER] = trace.trace_id
+                self._m_requests.inc(
+                    route=request.path, status=str(status)
+                )
+                return response(environ, start_response)
+            admitted = True
+        if traced and trace is None:
+            trace = tracer.begin(
+                None, request.get_data(cache=True, parse_form_data=False)
+            )
+        try:
+            handler = self._routes.get((request.method, request.path))
+            if handler is None:
+                if any(path == request.path for _m, path in self._routes):
+                    raise MethodNotAllowed()
+                raise NotFound()
+            response = handler(request, trace)
+        except HTTPException as exc:
+            response = _json_response({"error": exc.description}, exc.code)
+        except Exception as exc:  # don't leak tracebacks to clients
+            log.error(f"unhandled error serving {request.path}: {exc!r}")
+            response = _json_response({"error": "internal server error"}, 500)
+        finally:
+            if admitted:
+                admission.release(time.perf_counter() - t0)
+        route = (
+            request.path
+            if any(path == request.path for _m, path in self._routes)
+            else "unknown"
+        )
+        self._m_requests.inc(route=route, status=str(response.status_code))
+        if request.path in _SCORING_ROUTES and response.status_code == 200:
+            self._m_latency.observe(
+                time.perf_counter() - t0,
+                exemplar=(
+                    trace.trace_id
+                    if trace is not None and trace.sampled else None
+                ),
+            )
+        if trace is not None:
+            tracer.finish(trace, route, response.status_code)
+            response.headers[TRACE_ID_HEADER] = trace.trace_id
+        return response(environ, start_response)
+
+    def test_client(self):
+        from werkzeug.test import Client
+
+        return Client(self)
+
+    # -- routes ------------------------------------------------------------
+    def score_single(self, request: Request, trace=None) -> Response:
+        return self._score(request, trace, single=True)
+
+    def score_batch(self, request: Request, trace=None) -> Response:
+        return self._score(request, trace, single=False)
+
+    def _score(self, request: Request, trace, single: bool) -> Response:
+        sampled = trace is not None and trace.sampled
+        t0 = time.perf_counter()
+        X, message = self.parse_rows(
+            request.get_data(cache=True, parse_form_data=False),
+            request.mimetype,
+        )
+        t1 = time.perf_counter()
+        self._m_parse.observe(t1 - t0)
+        if sampled:
+            trace.add("parse", t0, t1)
+        if message is not None:
+            return _json_response({"error": message}, 400)
+        done = threading.Event()
+        box: list = [None]
+
+        def on_done(outcome) -> None:
+            box[0] = outcome
+            done.set()
+
+        t_submit = time.perf_counter()
+        try:
+            self.submit(
+                X, single, on_done,
+                trace_id=trace.trace_id if sampled else None,
+            )
+        except DispatcherUnavailable:
+            status, body, extra = self.unavailable_parts()
+            return self._respond(status, body, extra)
+        except SlotsExhausted:
+            # queue backpressure sheds exactly like a budget refusal
+            count_shed("rowqueue")
+            status, body, extra = self.shed_parts()
+            return self._respond(status, body, extra)
+        if not done.wait(DISPATCH_TIMEOUT_S):
+            # slot reclamation belongs to the reader/epoch machinery —
+            # never free here, or a late reply could tear a reused slot
+            log.error("row-queue rendezvous timed out")
+            return _json_response({"error": "internal server error"}, 500)
+        outcome = box[0]
+        if sampled:
+            trace.add("rowqueue", t_submit, time.perf_counter())
+        if isinstance(outcome, Exception):
+            # the dispatcher died mid-request: degraded, not wedged
+            status, body, extra = self.unavailable_parts()
+            return self._respond(status, body, extra)
+        status, body, extra = self.render_reply(outcome, single)
+        return self._respond(status, body, extra)
+
+    @staticmethod
+    def _respond(status: int, body: bytes, extra) -> Response:
+        response = Response(body, status=status, mimetype="application/json")
+        for name, value in extra:
+            response.headers[name] = value
+        return response
+
+    def healthz(self, request: Request, trace=None) -> Response:
+        payload, status, retry_after = self.healthz_payload()
+        response = _json_response(payload, status)
+        if retry_after is not None:
+            response.headers["Retry-After"] = str(retry_after)
+        return response
+
+    def metrics_endpoint(self, request: Request, trace=None) -> Response:
+        """One coherent service-wide scrape regardless of which process
+        answers: the front-end merges its live registry with every
+        sibling's (and the dispatcher's) flushed snapshots — which is
+        how dispatcher-side coalescer metrics stay visible from any
+        front-end."""
+        from bodywork_tpu.obs.multiproc import aggregated_render
+
+        return Response(
+            aggregated_render(get_registry(), self.metrics_dir),
+            content_type="text/plain; version=0.0.4; charset=utf-8",
+        )

@@ -49,12 +49,22 @@ RESTART_BACKOFF_MAX_S = 30.0
 RESTART_RESET_AFTER_S = 60.0
 
 
-def _count_worker_restart() -> None:
+def _count_worker_restart(registry=None) -> None:
     from bodywork_tpu.obs import get_registry
 
-    get_registry().counter(
+    (registry or get_registry()).counter(
         "bodywork_tpu_serve_worker_restarts_total",
         "Serving replica processes respawned by the supervisor",
+    ).inc()
+
+
+def _count_dispatcher_restart(registry=None) -> None:
+    from bodywork_tpu.obs import get_registry
+
+    (registry or get_registry()).counter(
+        "bodywork_tpu_serve_dispatcher_restarts_total",
+        "Device-owning dispatcher processes respawned by the supervisor "
+        "(disaggregated serving)",
     ).inc()
 
 
@@ -253,6 +263,74 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
         app.close()  # flush + stop the worker's coalescer
 
 
+def _frontend_main(queue, host: str, port: int, ready,
+                   server_engine: str = "thread",
+                   metrics_dir: str | None = None,
+                   shared_budget=None,
+                   slot_index: int = 0,
+                   max_pending: int | None = None,
+                   retry_after_max_s: float | None = None):
+    """One parse/admission front-end of the disaggregated split: HTTP
+    parse + admission + row-queue handoff, NO model. Deliberately
+    JAX-free (pinned by a test) — front-end processes must stay cheap to
+    spawn and must not touch the accelerator runtime; everything
+    device-shaped lives in the single dispatcher
+    (``serve.dispatch.dispatcher_main``)."""
+    from bodywork_tpu.serve.admission import SharedBudgetSlot, build_admission
+    from bodywork_tpu.serve.frontend import FrontendApp
+    from bodywork_tpu.serve.rowqueue import RowQueueClient
+
+    signal.signal(signal.SIGTERM, lambda signum, frame: sys.exit(0))
+    client = RowQueueClient(queue, slot_index).start()
+    # same service-wide admission budget shape as --workers: each
+    # front-end holds a slot in the shared array, so max_pending bounds
+    # the SERVICE's held work and the supervisor can zero a dead
+    # front-end's contribution
+    shared_slot = None
+    if shared_budget is not None:
+        shared_slot = SharedBudgetSlot(shared_budget, slot_index)
+    admission = build_admission(server_engine, max_pending,
+                                retry_after_max_s,
+                                shared_slot=shared_slot)
+    app = FrontendApp(client, admission=admission, metrics_dir=metrics_dir)
+    flusher = None
+    if metrics_dir is not None:
+        # front-ends flush their registries into the same dir as the
+        # dispatcher: any front-end's /metrics scrape merges the whole
+        # fleet, dispatcher-side coalescer occupancy included
+        from bodywork_tpu.obs import get_registry
+        from bodywork_tpu.obs.multiproc import MetricsFlusher
+
+        flusher = MetricsFlusher(get_registry(), metrics_dir).start()
+    sock = _reuseport_socket(host, port)
+    aio_handle = None
+    server = None
+    if server_engine == "aio":
+        from bodywork_tpu.serve.aio import AioServiceHandle
+
+        aio_handle = AioServiceHandle(app, host, port, sock=sock)
+    else:
+        from werkzeug.serving import make_server
+
+        sock.listen(128)
+        server = make_server(host, port, app, threaded=True,
+                             fd=sock.fileno())
+    try:
+        if aio_handle is not None:
+            aio_handle.start()
+            ready.put(os.getpid())
+            aio_handle.wait()
+        else:
+            ready.put(os.getpid())
+            server.serve_forever()
+    finally:  # pragma: no cover - only on signal teardown
+        if flusher is not None:
+            flusher.stop()
+        if aio_handle is not None:
+            aio_handle.stop()
+        client.stop()
+
+
 class MultiProcessService:
     """N OS-process serving replicas sharing one ``SO_REUSEPORT`` port.
 
@@ -266,6 +344,15 @@ class MultiProcessService:
     respawned, preserving the declared replica count — the local
     analogue of the reference's Deployment keeping ``replicas: 2`` pods
     alive.
+
+    ``frontends=N`` selects the DISAGGREGATED topology instead (mutually
+    exclusive with ``--workers``, enforced at the CLI): N model-free
+    parse/admission front-ends (``_frontend_main``) on the shared port
+    feed exactly ONE device-owning dispatcher
+    (``serve.dispatch.dispatcher_main``) over a shared-memory row-queue.
+    The same supervisor keeps both roles alive; a dying dispatcher
+    flips the queue down (front-ends answer 503 + Retry-After, never
+    wedge) and is respawned under the same backoff budget.
     """
 
     def __init__(
@@ -287,7 +374,13 @@ class MultiProcessService:
         retry_after_max_s: float | None = None,
         dtype: str = "float32",
         tuned_config: str | None = None,
+        frontends: int | None = None,
     ):
+        if frontends is not None:
+            assert frontends >= 1, "need at least one front-end"
+            # role split: `workers` now counts HTTP processes, which in
+            # this topology are the front-ends (the dispatcher is extra)
+            workers = frontends
         assert workers >= 1, "need at least one replica"
         from bodywork_tpu.serve.predictor import SERVE_DTYPES
         from bodywork_tpu.serve.server import SERVER_ENGINES
@@ -336,14 +429,42 @@ class MultiProcessService:
             # workers log the standard degrade warning themselves
             tuned_config = pinned if pinned is not None else tuned_config
         self.tuned_config = tuned_config
+        self.frontends = frontends
+        if frontends is not None and tuned_config and max_pending is None:
+            # max_pending is the ONE tuned knob that is front-end-scoped
+            # in the split (admission must stay upstream of the queue),
+            # but front-ends are store-free — so the supervisor resolves
+            # it here, once, and hands the concrete value down. The
+            # dispatcher resolves the dispatcher-scoped knobs
+            # (tune.config.DISPATCHER_SCOPED_KNOBS) itself.
+            from bodywork_tpu.store import open_store
+            from bodywork_tpu.tune.config import resolve_serving_knobs
+
+            resolved = resolve_serving_knobs(
+                open_store(self.store_path), tuned_config,
+                batch_window_ms=None, batch_max_rows=None,
+                buckets=None, max_pending=None,
+            )
+            max_pending = resolved.max_pending
+            self.max_pending = max_pending
         # opt-in aggregated /metrics: a shared snapshot dir every worker
         # flushes into, so any replica can answer for the whole service.
         # Created lazily in start() so a failed startup never leaks it.
-        self._metrics_enabled = metrics
+        # Always on in frontends mode: the dispatcher is not scrapeable
+        # directly (it serves no HTTP), so its metrics — coalescer
+        # occupancy, handoff latency, queue depth — are only visible at
+        # all through the shared snapshot dir.
+        self._metrics_enabled = metrics or frontends is not None
         self.metrics_dir: str | None = None
         self.restart = restart
         self.startup_timeout_s = startup_timeout_s
         self._ctx = multiprocessing.get_context("spawn")
+        self._queue = None
+        self._dispatcher = None
+        if frontends is not None:
+            from bodywork_tpu.serve.rowqueue import RowQueue
+
+            self._queue = RowQueue(self._ctx, frontends)
         # ONE service-wide admission budget across the fleet: every
         # worker's controller admits against the sum of this per-slot
         # array, so max_pending bounds the SERVICE's held work (the "N
@@ -361,6 +482,8 @@ class MultiProcessService:
         self._reserved = _reuseport_socket(host, port)
         self.port = self._reserved.getsockname()[1]
         self._procs: list = []
+        self._flusher = None
+        self._sup_registry = None
         self._stopping = threading.Event()
         self._supervisor = threading.Thread(
             target=self._supervise, name="replica-supervisor", daemon=True
@@ -381,7 +504,48 @@ class MultiProcessService:
     def worker_pids(self) -> list[int]:
         return [p.pid for p in self._procs if p.is_alive()]
 
+    @property
+    def dispatcher_pid(self) -> int | None:
+        """PID of the device-owning dispatcher (frontends mode only)."""
+        if self._dispatcher is None or not self._dispatcher.is_alive():
+            return None
+        return self._dispatcher.pid
+
+    def _spawn_dispatcher(self):
+        from bodywork_tpu.serve.dispatch import dispatcher_main
+
+        ready = self._ctx.Queue()
+        proc = self._ctx.Process(
+            target=dispatcher_main,
+            args=(self.store_path, self._queue, ready),
+            kwargs=dict(
+                engine=self.engine,
+                watch_interval_s=self.watch_interval_s,
+                buckets=self.buckets,
+                batch_window_ms=self.batch_window_ms,
+                batch_max_rows=self.batch_max_rows,
+                metrics_dir=self.metrics_dir,
+                dtype=self.dtype,
+                tuned_config=self.tuned_config,
+            ),
+            daemon=True,
+        )
+        proc.start()
+        return proc, ready
+
     def _spawn_one(self, slot_index: int = 0):
+        if self.frontends is not None:
+            ready = self._ctx.Queue()
+            proc = self._ctx.Process(
+                target=_frontend_main,
+                args=(self._queue, self.host, self.port, ready,
+                      self.server_engine, self.metrics_dir,
+                      self._shared_budget, slot_index,
+                      self.max_pending, self.retry_after_max_s),
+                daemon=True,
+            )
+            proc.start()
+            return proc, ready
         ready = self._ctx.Queue()
         proc = self._ctx.Process(
             target=_worker_main,
@@ -421,6 +585,12 @@ class MultiProcessService:
             self.metrics_dir = tempfile.mkdtemp(prefix="bodywork-tpu-obs-")
         spawned: list = []
         try:
+            if self.frontends is not None:
+                # dispatcher first: its readiness IS model readiness —
+                # once it arms `queue.up`, the (fast-booting, model-free)
+                # front-ends answer /healthz 200 from their first request
+                self._dispatcher, dready = self._spawn_dispatcher()
+                self._wait_ready(dready, self._dispatcher)
             for i in range(self.workers):
                 spawned.append(self._spawn_one(i))
             for proc, ready in spawned:
@@ -430,6 +600,9 @@ class MultiProcessService:
             # without stop() ever running — don't leak the snapshot dir
             # (or the already-spawned siblings). Join before rmtree so a
             # terminating worker's final flush cannot race the removal.
+            if self._dispatcher is not None:
+                spawned.append((self._dispatcher, None))
+                self._dispatcher = None
             for proc, _ready in spawned:
                 if proc.is_alive():
                     proc.terminate()
@@ -440,10 +613,30 @@ class MultiProcessService:
                 self.metrics_dir = None
             raise
         self._procs = [p for p, _ in spawned]
+        # respawn counters are incremented where the respawn happens —
+        # the supervisor — so they need their own flusher to reach the
+        # merged /metrics view the workers serve. A DEDICATED registry,
+        # not the process-global one: in library use the supervisor runs
+        # in the caller's process, and flushing the caller's registry
+        # would leak every unrelated metric it holds into this service's
+        # view.
+        if self.metrics_dir is not None:
+            from bodywork_tpu.obs import Registry
+            from bodywork_tpu.obs.multiproc import MetricsFlusher
+
+            self._sup_registry = Registry()
+            self._flusher = MetricsFlusher(
+                self._sup_registry, self.metrics_dir
+            ).start()
         self._supervisor.start()
+        role = "front-end" if self.frontends is not None else "replica"
         log.info(
-            f"{self.workers} replica process(es) listening on "
+            f"{self.workers} {role} process(es) listening on "
             f"{self.url} (SO_REUSEPORT, pids {self.worker_pids})"
+            + (
+                f"; dispatcher pid {self._dispatcher.pid}"
+                if self._dispatcher is not None else ""
+            )
         )
         return self
 
@@ -456,8 +649,12 @@ class MultiProcessService:
              "respawn_at": None}
             for _ in self._procs
         ]
+        dslot = {"policy": RespawnPolicy(), "spawned_at": time.monotonic(),
+                 "respawn_at": None}
         while not self._stopping.wait(0.5):
             now = time.monotonic()
+            if self._dispatcher is not None:
+                self._supervise_dispatcher(dslot, now)
             for i, proc in enumerate(self._procs):
                 if self._stopping.is_set():
                     break
@@ -509,7 +706,7 @@ class MultiProcessService:
                     continue  # still backing off
                 slot["respawn_at"] = None
                 new_proc, ready = self._spawn_one(i)
-                _count_worker_restart()
+                _count_worker_restart(self._sup_registry)
                 try:
                     self._wait_ready(ready, new_proc)
                 except Exception as exc:  # keep supervising the rest:
@@ -527,8 +724,71 @@ class MultiProcessService:
                 slot["spawned_at"] = time.monotonic()
                 log.info(f"replica respawned as pid {new_proc.pid}")
 
+    def _supervise_dispatcher(self, slot, now: float) -> None:
+        """One supervision tick for the singleton dispatcher (frontends
+        mode). Same budget/backoff as a replica slot, plus the liveness
+        contract the front-ends depend on: the FIRST observation of a
+        death downs the queue and bumps its epoch, failing every
+        in-flight front-end wait into 503 + Retry-After immediately —
+        waiters must not ride out the whole backoff window."""
+        proc = self._dispatcher
+        if proc.is_alive() or slot["policy"].exhausted:
+            return
+        if slot["respawn_at"] is None:
+            self._queue.up.value = 0
+            self._queue.epoch.value += 1
+            alive_s = now - slot["spawned_at"]
+            delay = slot["policy"].on_death(alive_s)
+            if delay is None:
+                log.error(
+                    f"dispatcher (pid {proc.pid}) died "
+                    f"{slot['policy'].consecutive} consecutive time(s); "
+                    f"restart budget ({slot['policy'].budget}) exhausted "
+                    "— front-ends will answer 503 until restarted"
+                )
+                return
+            log.warning(
+                f"dispatcher pid {proc.pid} died "
+                f"(exitcode={proc.exitcode}, alive {alive_s:.1f}s)"
+                + (
+                    f"; respawning in {delay:.1f}s "
+                    f"(streak {slot['policy'].consecutive})"
+                    if self.restart else ""
+                )
+            )
+            if not self.restart:
+                slot["policy"].exhausted = True
+                return
+            slot["respawn_at"] = now + delay
+            return
+        if now < slot["respawn_at"]:
+            return
+        slot["respawn_at"] = None
+        new_proc, ready = self._spawn_dispatcher()
+        _count_dispatcher_restart(self._sup_registry)
+        try:
+            # the respawned dispatcher re-arms `queue.up` itself, only
+            # after its model is loaded — serving resumes atomically
+            self._wait_ready(ready, new_proc)
+        except Exception as exc:
+            log.error(f"dispatcher respawn failed: {exc!r}")
+            self._dispatcher = new_proc  # dead; next tick backs off
+            slot["spawned_at"] = time.monotonic()
+            return
+        self._dispatcher = new_proc
+        slot["spawned_at"] = time.monotonic()
+        log.info(f"dispatcher respawned as pid {new_proc.pid}")
+
     def kill_worker(self, pid: int) -> None:
         """SIGKILL one replica (fault-injection hook for tests/drills)."""
+        os.kill(pid, signal.SIGKILL)
+
+    def kill_dispatcher(self) -> None:
+        """SIGKILL the dispatcher (chaos hook: the disaggregated fleet's
+        worst-case single fault)."""
+        pid = self.dispatcher_pid
+        if pid is None:
+            raise RuntimeError("no live dispatcher to kill")
         os.kill(pid, signal.SIGKILL)
 
     def wait(self) -> None:
@@ -538,13 +798,21 @@ class MultiProcessService:
 
     def stop(self) -> None:
         self._stopping.set()
-        for proc in self._procs:
+        procs = list(self._procs)
+        if self._dispatcher is not None:
+            procs.append(self._dispatcher)
+        for proc in procs:
             if proc.is_alive():
                 proc.terminate()
-        for proc in self._procs:
+        for proc in procs:
             proc.join(timeout=10)
         if self._supervisor.ident is not None:
             self._supervisor.join(timeout=5)
+        if self._flusher is not None:
+            self._flusher.stop()
+            self._flusher = None
+        if self._queue is not None:
+            self._queue.close()
         self._reserved.close()
         if self.metrics_dir is not None:
             shutil.rmtree(self.metrics_dir, ignore_errors=True)

@@ -453,41 +453,10 @@ def build_serving_predictor(
     return quant_predictor, dtype
 
 
-def build_admission(
-    server_engine: str,
-    max_pending: int | None,
-    retry_after_max_s: float | None = None,
-    shared_slot=None,
-):
-    """The admission controller for a serving process, or ``None``.
-
-    Admission is armed by an explicit ``max_pending`` on either engine,
-    and BY DEFAULT (at :data:`~bodywork_tpu.serve.admission.
-    DEFAULT_MAX_PENDING`) on the aio engine: an event-loop front exists
-    to stay responsive past saturation, which it can only do by bounding
-    the work it holds. The threaded engine keeps its historical
-    admit-everything default — its thread pool is its own (cruder)
-    bound, and the closed-loop parity benches must see an unchanged
-    service.
-
-    ``shared_slot`` (:class:`~bodywork_tpu.serve.admission.
-    SharedBudgetSlot`) makes ``max_pending`` a SERVICE-WIDE budget
-    shared by every replica process behind one SO_REUSEPORT port
-    (``serve --workers N`` wires it): the fleet sheds as one unit, which
-    is what makes an N-replica capacity record a number about ONE
-    service rather than N accidental ones."""
-    from bodywork_tpu.serve.admission import AdmissionController
-
-    if max_pending is None and server_engine != "aio":
-        return None
-    kwargs: dict = {}
-    if max_pending is not None:
-        kwargs["max_pending"] = max_pending
-    if retry_after_max_s is not None:
-        kwargs["retry_after_max_s"] = retry_after_max_s
-    if shared_slot is not None:
-        kwargs["shared_slot"] = shared_slot
-    return AdmissionController(**kwargs)
+# build_admission moved to serve.admission (its JAX-free home, so the
+# disaggregated front-ends can arm the shared budget without importing
+# the model-loading stack); re-exported here for its historical callers
+from bodywork_tpu.serve.admission import build_admission  # noqa: E402,F401
 
 
 def _registry_bounds(store: ArtefactStore, key: str | None):

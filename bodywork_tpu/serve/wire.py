@@ -1,0 +1,178 @@
+"""The scoring service's wire formats — a dependency-leaf module.
+
+Everything here is pure ``numpy + json``: request validation, response
+payload construction, the binary row-batch framing, and the
+pre-serialized single-row response template. It exists as its own module
+(rather than living in ``serve.app``, which re-exports it) because the
+disaggregated front-end processes (``serve.frontend``) import it on
+their hot path and must stay **accelerator-free**: ``serve.app`` pulls
+``models.base`` which imports JAX, and N parse/admission front-ends each
+paying the JAX import (time and RSS) would defeat the point of keeping
+the device in exactly one dispatcher process. A guard test pins that
+importing this module (and the front-end stack over it) never imports
+``jax``.
+
+Byte-identity is this module's real contract: the WSGI engine, the
+asyncio engine, and the disaggregated front-end all build scoring
+responses through these helpers with ``json.dumps`` default separators,
+which is what lets the bench assert that in-process, disaggregated, and
+binary-framed requests produce identical response bytes.
+"""
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+__all__ = [
+    "BINARY_CONTENT_TYPE",
+    "MODEL_KEY_HEADER",
+    "SingleResponseTemplate",
+    "batch_score_payload",
+    "encode_binary_rows",
+    "parse_binary_rows",
+    "parse_features",
+    "single_score_payload",
+]
+
+#: which model bundle ANSWERED a scoring request (canary releases may
+#: route a request to a different model than its neighbour's) — the
+#: response header the traffic harness and the byte-identity comparator
+#: read. Headers are invisible to the frozen JSON body contract.
+MODEL_KEY_HEADER = "X-Bodywork-Model-Key"
+
+#: request content type for the binary row-batch framing (the JSON
+#: ``{"X": [...]}`` body stays the default): a little-endian
+#: ``u32 n_rows, u32 n_features`` header followed by ``n_rows *
+#: n_features`` little-endian f32s. Responses stay JSON either way — the
+#: framing removes the client-side float formatting and server-side JSON
+#: parse from the request path, nothing else.
+BINARY_CONTENT_TYPE = "application/x-bodywork-rows"
+
+#: the binary header: little-endian (n_rows, n_features)
+_BINARY_HEADER = struct.Struct("<II")
+
+
+def parse_features(payload):
+    """Validate a decoded request body into a float32 feature array.
+
+    Returns ``(X, None)`` or ``(None, error_message)``. Factored out of
+    the WSGI handler so BOTH front-ends (threaded werkzeug and the
+    asyncio event loop, ``serve.aio``) validate with the same code and
+    answer malformed input with byte-identical 400 bodies."""
+    if not isinstance(payload, dict) or "X" not in payload:
+        return None, "request body must be a JSON object with an 'X' field"
+    try:
+        X = np.asarray(payload["X"], dtype=np.float32)
+    except (TypeError, ValueError):
+        return None, "'X' must be numeric"
+    if X.size == 0:
+        return None, "'X' must be non-empty"
+    if not np.all(np.isfinite(X)):
+        return None, "'X' must be finite"
+    return X, None
+
+
+def encode_binary_rows(X) -> bytes:
+    """Frame a feature array as a binary row-batch request body.
+
+    1-D input is framed as ``(n_rows, 1)`` — the shape the JSON path's
+    ``{"X": [a, b, c]}`` produces — so a JSON request and its binary
+    twin parse to byte-identical arrays (same canary routing hash, same
+    predictions, same response bytes)."""
+    arr = np.asarray(X, dtype="<f4")
+    if arr.ndim == 0:
+        arr = arr[None]
+    if arr.ndim == 1:
+        n_rows, n_features = arr.shape[0], 1
+    elif arr.ndim == 2:
+        n_rows, n_features = arr.shape
+    else:
+        raise ValueError(f"need 1-D or 2-D features, got shape {arr.shape}")
+    return _BINARY_HEADER.pack(n_rows, n_features) + np.ascontiguousarray(
+        arr
+    ).tobytes()
+
+
+def parse_binary_rows(body: bytes):
+    """Decode a binary row-batch request body into a float32 feature
+    array. Same ``(X, None) | (None, error_message)`` contract — and the
+    same *semantic* validations (non-empty, finite) with the same
+    messages — as :func:`parse_features`, so a client switching framings
+    sees one validation behaviour. ``n_features == 1`` decodes to a 1-D
+    array, exactly what the JSON path's flat ``"X"`` list produces."""
+    if len(body) < _BINARY_HEADER.size:
+        return None, "binary body too short for the row header"
+    n_rows, n_features = _BINARY_HEADER.unpack_from(body)
+    expected = _BINARY_HEADER.size + n_rows * n_features * 4
+    if n_features < 1 or n_rows < 1:
+        return None, "'X' must be non-empty"
+    if len(body) != expected:
+        return None, (
+            f"binary body length mismatch: header says {n_rows}x"
+            f"{n_features} rows ({expected} bytes), got {len(body)}"
+        )
+    X = np.frombuffer(body, dtype="<f4", offset=_BINARY_HEADER.size).astype(
+        np.float32, copy=False
+    )
+    if n_features > 1:
+        X = X.reshape(n_rows, n_features)
+    if not np.all(np.isfinite(X)):
+        return None, "'X' must be finite"
+    return X, None
+
+
+def single_score_payload(served, prediction0: float) -> dict:
+    """The ``/score/v1`` response body. One constructor for both
+    front-ends: key order and value formatting are what make coalesced
+    responses byte-identical across engines."""
+    return {
+        "prediction": prediction0,
+        "model_info": served.model_info,
+        "model_date": served.model_date,
+    }
+
+
+def batch_score_payload(served, predictions) -> dict:
+    """The ``/score/v1/batch`` response body (see
+    :func:`single_score_payload` for why this is factored)."""
+    return {
+        "predictions": [float(p) for p in predictions],
+        "n": int(len(predictions)),
+        "model_info": served.model_info,
+        "model_date": served.model_date,
+    }
+
+
+class SingleResponseTemplate:
+    """Pre-serialized framing for the single-row 200 response.
+
+    Everything in the body except the prediction is invariant per served
+    bundle (``model_info``/``model_date`` change only on a swap, which
+    builds a new bundle and therefore a new template), so the hot path
+    splices the prediction's own JSON bytes between two cached byte
+    strings instead of building and serializing a fresh dict per
+    response. ``render`` is pinned byte-identical to
+    ``json.dumps(single_score_payload(served, p))`` by construction —
+    the framing below IS ``json.dumps``'s default-separator output for
+    that dict — and by a regression test sweeping awkward floats.
+    """
+
+    __slots__ = ("prefix", "suffix")
+
+    def __init__(self, model_info, model_date):
+        # json.dumps default separators: '", "' between items and
+        # '": "' after keys; insertion order "prediction", "model_info",
+        # "model_date" — exactly single_score_payload's dict
+        self.prefix = b'{"prediction": '
+        self.suffix = (
+            ", \"model_info\": " + json.dumps(model_info)
+            + ", \"model_date\": " + json.dumps(model_date) + "}"
+        ).encode()
+
+    def render(self, prediction0: float) -> bytes:
+        # the prediction still goes through json.dumps (a scalar dump is
+        # ~free): float repr, NaN/Infinity spelling, and int-vs-float
+        # formatting stay exactly the full-dump path's
+        return self.prefix + json.dumps(prediction0).encode() + self.suffix

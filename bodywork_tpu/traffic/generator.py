@@ -53,6 +53,7 @@ log = get_logger("traffic.generator")
 
 __all__ = [
     "ARRIVAL_PROCESSES",
+    "TRANSPORTS",
     "Request",
     "TrafficConfig",
     "generate_request_log",
@@ -63,6 +64,13 @@ __all__ = [
 #: supported arrival processes (kept in sync with ``cli traffic run
 #: --arrival`` choices by tests/test_traffic.py)
 ARRIVAL_PROCESSES = ("poisson", "mmpp")
+
+#: supported wire encodings for the same request log (kept in sync with
+#: ``cli traffic run --transport`` choices by tests): "json" is the
+#: frozen /score contract body, "binary" the f32 row framing
+#: (serve.wire.BINARY_CONTENT_TYPE) that skips JSON float formatting on
+#: both ends — same schedule, same rows, different bytes on the wire
+TRANSPORTS = ("json", "binary")
 
 #: request-log file schema tag — readers refuse logs they would
 #: misinterpret instead of replaying garbage traffic
@@ -93,6 +101,18 @@ class Request:
         if self.route == "/score/v1":
             return json.dumps({"X": [self.x[0]]}).encode()
         return json.dumps({"X": list(self.x)}).encode()
+
+    def payload_binary(self) -> bytes:
+        """The same request as binary row framing
+        (``serve.wire.BINARY_CONTENT_TYPE``): the rows :meth:`payload`
+        encodes as JSON, framed as little-endian f32 — what
+        ``--transport binary`` puts on the wire. Deterministic for the
+        same log entry, like :meth:`payload`."""
+        from bodywork_tpu.serve.wire import encode_binary_rows
+
+        if self.route == "/score/v1":
+            return encode_binary_rows(np.asarray([self.x[0]]))
+        return encode_binary_rows(np.asarray(self.x))
 
 
 @dataclasses.dataclass(frozen=True)

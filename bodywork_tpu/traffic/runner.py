@@ -166,12 +166,18 @@ class _ConnectionPool:
             writer.close()
 
 
-async def _http_transport(pool: _ConnectionPool, request: Request):
+async def _http_transport(pool: _ConnectionPool, request: Request,
+                          kind: str = "json"):
     """One request over a pooled keep-alive connection. Returns
     ``(status, retry_after_s)``; raises on transport failure (the
     driver counts). On ANY failure — including a cancellation from the
     driver's timeout — the connection is discarded, so a half-read
     response can never bleed into the next request.
+
+    ``kind`` selects the wire encoding (``generator.TRANSPORTS``):
+    "json" sends the frozen contract body, "binary" the f32 row
+    framing — the same log drives either, so a json-vs-binary pair
+    isolates serialization cost from everything else.
 
     A *reused* connection the server closed while it idled in the pool
     (thread-per-request servers time out keep-alive sockets) fails
@@ -179,11 +185,18 @@ async def _http_transport(pool: _ConnectionPool, request: Request):
     nothing was answered, so the request retries exactly once on a
     fresh dial — the same reused-idempotent rule urllib3 applies. A
     FRESH connection failing is a real transport error and propagates."""
-    body = request.payload()
+    if kind == "binary":
+        from bodywork_tpu.serve.wire import BINARY_CONTENT_TYPE
+
+        body = request.payload_binary()
+        content_type = BINARY_CONTENT_TYPE
+    else:
+        body = request.payload()
+        content_type = "application/json"
     head = (
         f"POST {request.route} HTTP/1.1\r\n"
         f"Host: {pool.host}:{pool.port}\r\n"
-        "Content-Type: application/json\r\n"
+        f"Content-Type: {content_type}\r\n"
         f"Content-Length: {len(body)}\r\n\r\n"
     ).encode("latin-1")
     for attempt in (0, 1):
@@ -253,6 +266,7 @@ def run_open_loop(
     transport=None,
     duration_s: float | None = None,
     results_log: str | None = None,
+    transport_kind: str = "json",
 ) -> LoadReport:
     """Fire ``requests_log`` at its scheduled arrival times against
     ``url`` (scheme://host:port — any path component is ignored; each
@@ -275,10 +289,17 @@ def run_open_loop(
     port = parsed.port or 80
     pool: _ConnectionPool | None = None
     if transport is None:
+        from bodywork_tpu.traffic.generator import TRANSPORTS
+
+        if transport_kind not in TRANSPORTS:
+            raise ValueError(
+                f"transport_kind must be one of {TRANSPORTS}, "
+                f"got {transport_kind!r}"
+            )
         pool = _ConnectionPool(host, port)
 
         async def transport(req: Request):
-            return await _http_transport(pool, req)
+            return await _http_transport(pool, req, kind=transport_kind)
 
     span = duration_s if duration_s is not None else max(
         r.t_s for r in requests_log

@@ -55,6 +55,7 @@ __all__ = [
     "TUNED_CONFIG_SCHEMA",
     "TUNED_KNOB_ENV",
     "KNOB_DEFAULTS",
+    "DISPATCHER_SCOPED_KNOBS",
     "ResolvedKnobs",
     "load_tuned_config",
     "resolve_serving_knobs",
@@ -92,6 +93,18 @@ KNOB_DEFAULTS = {
     "buckets": (1, 8, 64, 512, 4096),  # serve.predictor.DEFAULT_BUCKETS
     "max_pending": 512,       # serve.admission.DEFAULT_MAX_PENDING
 }
+
+#: which tuned knobs bind WHERE in disaggregated serving
+#: (``serve --frontends N``): these three shape the single
+#: device-owning dispatcher — the ONE coalescer batches form in, the
+#: ONE predictor's compiled shape set — and are resolved by
+#: ``serve.dispatch.dispatcher_main``. ``max_pending`` is the odd one
+#: out: admission must stay UPSTREAM of the row-queue (shed before
+#: parse), so the supervisor (``serve.multiproc``) resolves it once and
+#: hands the concrete value to every front-end's shared budget. In the
+#: flat topologies every knob binds in the one serving process and this
+#: split is invisible.
+DISPATCHER_SCOPED_KNOBS = ("batch_window_ms", "batch_max_rows", "buckets")
 
 
 def _valid_window(v) -> float | None:

@@ -395,9 +395,10 @@ def test_compact_output_fits_driver_tail():
         })
     out = bench.compact_output(records, "tpu", "bench_full.json")
     line = _json.dumps(out)
-    # 13 configs of fully-populated one-liners measure ~1.62k (the
-    # per-config `resumed` flag was dropped at 13 — full record keeps
-    # it); the archived tail is 2000 — keep a real margin under it
+    # 14 configs of fully-populated one-liners measure ~1.2k (the
+    # per-config `resumed` flag was dropped at 13 and `metric` at 14 —
+    # the full record keeps both); the archived tail is 2000 — keep a
+    # real margin under it
     assert len(line) < 1800, len(line)
     assert out["metric"] == "e2e_day_wallclock_config_%d" % bench.HEADLINE_CONFIG
     assert out["full_record"] == "bench_full.json"

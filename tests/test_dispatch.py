@@ -1,0 +1,688 @@
+"""Disaggregated serving split (PR 16): front-ends + one dispatcher.
+
+Three layers of proof, cheapest first:
+
+1. **In-process unit tests** of the shared-memory row-queue (the SPSC
+   control rings, generation guards, epoch-bump failure, backpressure),
+   the pre-serialized single-row template (byte-pinned against the full
+   ``json.dumps`` path over awkward floats), the binary row framing, and
+   the front-end's shed-before-parse / degrade behaviour against a stub
+   client — none of which need a process or JAX.
+2. **Drift guards**: the ``--frontends`` knob exists identically in the
+   cli parser env default, the pod-boot stage parse, and the k8s serve
+   Deployment env list; the ``--transport`` choices equal
+   ``traffic.generator.TRANSPORTS``; the front-end import stack never
+   pulls JAX; the front-end's canned constants equal ``serve.app``'s.
+3. **Process chaos** against a real fleet (2 front-ends + 1 dispatcher,
+   spawned JAX dispatcher, so one module fixture): byte-identical
+   serving across transports, cross-front-end batch merging visible in
+   the aggregated metrics, and the dispatcher-death drill — SIGKILL the
+   singleton, observe 503 + Retry-After with zero torn responses, then
+   supervised respawn and byte-identical healing.
+"""
+import json
+import multiprocessing
+import os
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from datetime import date
+
+import numpy as np
+import pytest
+import requests
+
+from bodywork_tpu.models import LinearRegressor
+from bodywork_tpu.models.checkpoint import save_model
+from bodywork_tpu.serve.rowqueue import (
+    KIND_BATCH,
+    KIND_SINGLE,
+    DispatcherUnavailable,
+    RowQueue,
+    RowQueueClient,
+    RowQueueServer,
+    SlotsExhausted,
+    _SpscRing,
+)
+from bodywork_tpu.serve.wire import (
+    BINARY_CONTENT_TYPE,
+    SingleResponseTemplate,
+    encode_binary_rows,
+    parse_binary_rows,
+    parse_features,
+    single_score_payload,
+)
+from bodywork_tpu.store import FilesystemStore
+from tests.helpers import hermetic_env
+
+CTX = multiprocessing.get_context("spawn")
+
+
+# --- the lock-free control ring ---------------------------------------------
+
+
+def test_spsc_ring_semantics():
+    """Push publishes by advancing the tail LAST, pop by the head; an
+    empty ring pops None, a full ring refuses the push, and the cursors
+    wrap the storage without ever resetting."""
+    ring = _SpscRing(CTX, 4)
+    assert ring.pop() is None
+    for v in (10, 20, 30, 40):
+        assert ring.push(v)
+    assert not ring.push(50)  # full: 4 in flight, cap 4
+    assert ring.pop() == 10
+    assert ring.push(50)  # freed one, room again
+    assert [ring.pop() for _ in range(4)] == [20, 30, 40, 50]
+    assert ring.pop() is None
+    # monotonic cursors: run several times around the storage
+    for v in range(100, 200):
+        assert ring.push(v)
+        assert ring.pop() == v
+
+
+# --- row-queue roundtrip (threads, no processes, no JAX) --------------------
+
+
+class _Bundle:
+    """Duck-typed served bundle: what RowQueueServer.reply reads."""
+
+    def __init__(self, key="k-2026-07-01", info="Stub(x2)", d="2026-07-01"):
+        self.model_key = key
+        self.model_info = info
+        self.model_date = d
+
+
+def _serve_n(queue, n, status=200, scale=2.0, bundle=None):
+    """Drain n submissions from a RowQueueServer in a thread, replying
+    like a dispatcher with a trivial scorer."""
+    server = RowQueueServer(queue)
+    polled = []
+
+    def loop():
+        served = 0
+        deadline = time.monotonic() + 10
+        while served < n and time.monotonic() < deadline:
+            sub = server.poll(0.2)
+            if sub is None:
+                continue
+            polled.append(sub)
+            server.reply(
+                sub, status, np.asarray(sub.X, dtype=np.float32) * scale,
+                bundle or _Bundle(),
+            )
+            served += 1
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return t, polled
+
+
+def test_rowqueue_roundtrip_in_process():
+    """Submit -> zero-copy dispatcher view -> reply -> callback, with
+    the answering bundle's identity and the trace id riding the slot."""
+    queue = RowQueue(CTX, frontends=2, slots=8, slot_floats=16)
+    queue.up.value = 1
+    client = RowQueueClient(queue, frontend_id=1).start()
+    try:
+        t, polled = _serve_n(queue, 2)
+        done = threading.Event()
+        box = []
+        client.submit(np.float32(21.0), KIND_SINGLE,
+                      lambda r: (box.append(r), done.set()),
+                      trace_id="0af7651916cd43dd8448eb211c80319c")
+        assert done.wait(5)
+        reply = box[0]
+        assert reply.status == 200
+        assert reply.predictions.tolist() == [42.0]
+        assert reply.model_key == "k-2026-07-01"
+        assert reply.model_info == "Stub(x2)"
+        assert reply.model_date == "2026-07-01"
+        # the trace context crossed the queue with the rows
+        assert polled[0].trace_id == "0af7651916cd43dd8448eb211c80319c"
+        assert polled[0].frontend_id == 1
+        # batch kind: 2-D rows survive the shared stride
+        done2 = threading.Event()
+        box2 = []
+        client.submit(np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32),
+                      KIND_BATCH, lambda r: (box2.append(r), done2.set()))
+        assert done2.wait(5)
+        assert box2[0].predictions.tolist() == [2.0, 4.0, 6.0, 8.0]
+        assert polled[1].kind == KIND_BATCH
+        assert polled[1].X.shape == (2, 2)
+        t.join(timeout=5)
+        stats = client.stats()
+        assert stats["requests_submitted"] == 2
+        assert stats["rows_submitted"] == 3
+        assert stats["replies_received"] == 2
+        assert stats["in_flight"] == 0
+        assert stats["slots_free"] == queue.slots  # every slot returned
+    finally:
+        client.stop()
+
+
+def test_rowqueue_epoch_bump_fails_inflight_and_frees_slots():
+    """The supervisor's death observation (epoch bump) must fail every
+    in-flight wait with DispatcherUnavailable and return the slots —
+    degrade to 503, never wedge, never leak."""
+    queue = RowQueue(CTX, frontends=1, slots=4, slot_floats=8)
+    queue.up.value = 1
+    client = RowQueueClient(queue, frontend_id=0).start()
+    try:
+        outcomes = []
+        done = threading.Event()
+        for _ in range(3):  # no dispatcher consuming
+            client.submit(np.float32(1.0), KIND_SINGLE,
+                          lambda r: (outcomes.append(r),
+                                     done.set() if len(outcomes) == 3
+                                     else None))
+        assert client.stats()["in_flight"] == 3
+        queue.up.value = 0
+        queue.epoch.value += 1
+        assert done.wait(5)
+        assert all(isinstance(o, DispatcherUnavailable) for o in outcomes)
+        stats = client.stats()
+        assert stats["failures"] == 3
+        assert stats["in_flight"] == 0
+        assert stats["slots_free"] == queue.slots
+        # and submissions are refused while the dispatcher is down
+        with pytest.raises(DispatcherUnavailable):
+            client.submit(np.float32(1.0), KIND_SINGLE, lambda r: None)
+    finally:
+        client.stop()
+
+
+def test_rowqueue_backpressure_and_stale_descriptors():
+    queue = RowQueue(CTX, frontends=1, slots=1, slot_floats=4)
+    queue.up.value = 1
+    client = RowQueueClient(queue, frontend_id=0)  # reader not started
+    # a request bigger than one slot's stride is backpressure, not a tear
+    with pytest.raises(SlotsExhausted):
+        client.submit(np.ones(5, np.float32), KIND_BATCH, lambda r: None)
+    client.submit(np.float32(1.0), KIND_SINGLE, lambda r: None)
+    with pytest.raises(SlotsExhausted):  # pool of 1 is in flight
+        client.submit(np.float32(2.0), KIND_SINGLE, lambda r: None)
+    # a stale descriptor (gen moved on: the epoch path freed the slot
+    # and a new submission reused it) is dropped by the server, and a
+    # stale reply is dropped by the gen guard on the client side
+    server = RowQueueServer(queue)
+    sub = server.poll(0.5)
+    assert sub is not None
+    queue.epoch.value += 1
+    client._epoch_seen = queue.epoch.value  # reader isn't running
+    client._fail_pending(DispatcherUnavailable("test"))
+    client.submit(np.float32(3.0), KIND_SINGLE, lambda r: None)  # reuses slot
+    server.reply(sub, 200, [99.0], _Bundle())  # stale gen: must be inert
+    sub2 = server.poll(0.5)
+    assert sub2 is not None and float(np.ravel(sub2.X)[0]) == 3.0
+    assert int(sub2.gen) == int(sub.gen) + 1
+
+
+# --- pre-serialized single-row template -------------------------------------
+
+
+def test_single_response_template_matches_full_dump():
+    """The hot-path splice is byte-identical to
+    ``json.dumps(single_score_payload(...))`` over awkward floats and
+    awkward bundle identities — the byte contract the disaggregated
+    front-end (and both in-process engines) serve from."""
+    cases = [
+        ("LinearRegressor(closed_form_ols)", "2026-07-01"),
+        ('quote"backslash\\', None),  # identity needs real JSON escaping
+        ("", "2026-01-01"),
+    ]
+    floats = [
+        25.999998092651367, 0.0, -0.0, 1.5, -3.25, 1e-12, 1e300,
+        float("nan"), float("inf"), float("-inf"), 7.0, 1 / 3,
+    ]
+    for info, d in cases:
+        template = SingleResponseTemplate(info, d)
+        served = _Bundle(info=info, d=d)
+        for p in floats:
+            assert template.render(p) == json.dumps(
+                single_score_payload(served, p)
+            ).encode()
+
+
+# --- binary row framing ------------------------------------------------------
+
+
+def test_binary_rows_roundtrip_and_json_equivalence():
+    """A JSON request and its binary twin must parse to identical
+    arrays (same canary hash, same predictions, same bytes out)."""
+    for X in ([1.0, 2.0, 3.0], [[1.0, 2.0], [3.0, 4.0]], [0.5]):
+        expected, err = parse_features({"X": X})
+        assert err is None
+        decoded, err = parse_binary_rows(encode_binary_rows(np.asarray(X)))
+        assert err is None
+        assert decoded.dtype == expected.dtype == np.float32
+        assert decoded.shape == expected.shape
+        assert np.array_equal(decoded, expected)
+
+
+def test_binary_rows_validation_matches_json_path():
+    """Semantic failures answer with the SAME strings as the JSON
+    validator — a client switching framings sees one behaviour."""
+    _, short = parse_binary_rows(b"\x01\x02")
+    assert short == "binary body too short for the row header"
+    import struct
+
+    _, empty = parse_binary_rows(struct.pack("<II", 0, 1))
+    assert empty == "'X' must be non-empty"
+    assert parse_features({"X": []})[1] == empty
+    body = encode_binary_rows(np.ones(3, np.float32))
+    _, mismatch = parse_binary_rows(body + b"\x00\x00\x00\x00")
+    assert "length mismatch" in mismatch
+    _, nonfinite = parse_binary_rows(
+        encode_binary_rows(np.asarray([1.0, float("nan")]))
+    )
+    assert nonfinite == "'X' must be finite"
+    assert parse_features({"X": [1.0, float("nan")]})[1] == nonfinite
+
+
+# --- front-end behaviour against a stub client ------------------------------
+
+
+class _StubClient:
+    """RowQueueClient stand-in recording what reaches the queue."""
+
+    def __init__(self, up=True):
+        self.up = up
+        self.rows_submitted = 0
+        self.submissions = []
+
+    def submit(self, X, kind, on_done, trace_id=None):
+        if not self.up:
+            raise DispatcherUnavailable("down")
+        X = np.asarray(X)
+        self.rows_submitted += int(X.shape[0]) if X.ndim else 1
+        self.submissions.append((X, kind))
+        from bodywork_tpu.serve.rowqueue import _Reply
+
+        on_done(_Reply(200, np.asarray(X, np.float32).ravel() * 2.0,
+                       "k-2026-07-01", "Stub(x2)", "2026-07-01"))
+
+    def dispatcher_up(self):
+        return self.up
+
+    def stats(self):
+        return {
+            "dispatcher_up": self.up,
+            "requests_submitted": len(self.submissions),
+            "rows_submitted": self.rows_submitted,
+            "replies_received": len(self.submissions),
+            "failures": 0,
+            "in_flight": 0,
+            "slots": 16,
+            "slots_free": 16,
+        }
+
+
+def _frontend(client, admission=None):
+    from bodywork_tpu.serve.frontend import FrontendApp
+
+    return FrontendApp(client, admission=admission)
+
+
+def test_shed_before_parse_leaves_rowqueue_untouched():
+    """The zero-footprint shed invariant, extended to the split: a
+    request refused by admission must never be parsed AND never touch
+    the row-queue — ``rows_submitted`` stays exactly where it was."""
+    from bodywork_tpu.serve.admission import AdmissionController
+
+    admission = AdmissionController(max_pending=1)
+    assert admission.try_admit()  # exhaust the budget, never release
+    client = _StubClient()
+    app = _frontend(client, admission=admission)
+    c = app.test_client()
+    # a body that would 400 at parse: a 429 here PROVES parse never ran
+    r = c.post("/score/v1", data=b"this is not json at all",
+               content_type="application/json")
+    assert r.status_code == 429
+    assert "Retry-After" in r.headers
+    assert json.loads(r.data)["error"] == "server over capacity; request shed"
+    assert client.rows_submitted == 0
+    assert client.submissions == []
+
+
+def test_frontend_renders_byte_identical_and_degrades_honestly():
+    client = _StubClient()
+    app = _frontend(client)
+    c = app.test_client()
+    r = c.post("/score/v1", json={"X": 21})
+    assert r.status_code == 200
+    served = _Bundle(info="Stub(x2)", d="2026-07-01")
+    assert r.data == json.dumps(single_score_payload(served, 42.0)).encode()
+    assert r.headers["X-Bodywork-Model-Key"] == "k-2026-07-01"
+    # binary framing reaches the same handler through content-type
+    r2 = c.post("/score/v1", data=encode_binary_rows(np.asarray([21.0])),
+                content_type=BINARY_CONTENT_TYPE)
+    assert r2.status_code == 200 and r2.data == r.data
+    # healthz speaks the front-end role
+    h = c.get("/healthz")
+    assert h.status_code == 200
+    payload = json.loads(h.data)
+    assert payload["role"] == "frontend" and payload["dispatcher_up"]
+    # dead dispatcher: scoring 503s with Retry-After and a body DISTINCT
+    # from the no-model-yet 503 (operators must tell the two apart), and
+    # healthz flips 503 so load concentrates on healthy pods
+    client.up = False
+    r3 = c.post("/score/v1", json={"X": 21})
+    assert r3.status_code == 503
+    assert r3.headers["Retry-After"]
+    assert json.loads(r3.data)["error"] == (
+        "scoring dispatcher unavailable; retry shortly"
+    )
+    h2 = c.get("/healthz")
+    assert h2.status_code == 503 and "Retry-After" in h2.headers
+
+
+def test_frontend_constants_match_in_process_app():
+    """The duplicated-not-imported constants (duplication keeps JAX out
+    of the front-end) are pinned equal to serve.app's."""
+    from bodywork_tpu.serve import app as serve_app
+    from bodywork_tpu.serve import frontend
+
+    assert frontend.RETRY_AFTER_S == serve_app.RETRY_AFTER_S
+    assert frontend._FAST_PHASE_BUCKETS == serve_app._FAST_PHASE_BUCKETS
+
+
+def test_frontend_stack_never_imports_jax():
+    """N front-ends each paying the JAX import would defeat the split:
+    the whole front-end import stack (wire, rowqueue, frontend, aio,
+    multiproc) must come up without it."""
+    code = (
+        "import sys\n"
+        "import bodywork_tpu.serve.wire\n"
+        "import bodywork_tpu.serve.rowqueue\n"
+        "import bodywork_tpu.serve.frontend\n"
+        "import bodywork_tpu.serve.aio\n"
+        "import bodywork_tpu.serve.multiproc\n"
+        "assert 'jax' not in sys.modules, 'front-end stack imported jax'\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + env.get("PYTHONPATH", "").split(os.pathsep)
+    )
+    result = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env=env, timeout=120,
+    )
+    assert result.returncode == 0, result.stderr
+
+
+# --- knob-parity drift guards ------------------------------------------------
+
+
+def test_frontends_knob_cli_stage_and_k8s_stay_in_sync(monkeypatch):
+    """``BODYWORK_TPU_FRONTENDS`` means the same thing in the cli
+    parser's env default, the pod-boot stage parse, and the k8s serve
+    Deployment env list — a knob in only some layers would be either
+    unreachable or silently dead in the pipeline path."""
+    from bodywork_tpu.cli import build_parser
+    from bodywork_tpu.pipeline import default_pipeline
+    from bodywork_tpu.pipeline.k8s import generate_manifests
+    from bodywork_tpu.pipeline.stages import _serve_fleet_env_knobs
+
+    for raw, want in (
+        ("3", 3),       # well-formed
+        ("0", None),    # out-of-range -> degrade
+        ("two", None),  # malformed -> degrade, never a crash-looping pod
+        ("", None),     # unset-equivalent
+    ):
+        monkeypatch.setenv("BODYWORK_TPU_FRONTENDS", raw)
+        assert _serve_fleet_env_knobs() == want, raw
+        args = build_parser().parse_args(["serve", "--store", "s"])
+        assert args.frontends == want, raw
+
+    docs = generate_manifests(default_pipeline(), store_path="/mnt/store")
+    deployment = next(
+        d for d in docs.values()
+        if d["kind"] == "Deployment" and "serve" in d["metadata"]["name"]
+    )
+    env_names = {
+        e["name"]
+        for e in deployment["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert "BODYWORK_TPU_FRONTENDS" in env_names
+
+
+def test_transport_choices_cli_and_traffic_stay_in_sync():
+    """cli ``traffic run --transport`` choices == the generator's
+    TRANSPORTS tuple, and the runner refuses anything else."""
+    from bodywork_tpu.cli import build_parser
+    from bodywork_tpu.traffic.generator import TRANSPORTS
+    from bodywork_tpu.traffic.runner import run_open_loop
+
+    parser = build_parser()
+    args = parser.parse_args(["traffic", "run", "--url", "http://x"])
+    assert args.transport == "json"
+    serve_action = next(
+        a for sub in parser._subparsers._group_actions
+        for name, sp in sub.choices.items() if name == "traffic"
+        for sub2 in sp._subparsers._group_actions
+        for name2, sp2 in sub2.choices.items() if name2 == "run"
+        for a in sp2._actions if "--transport" in a.option_strings
+    )
+    assert tuple(serve_action.choices) == TRANSPORTS
+    from bodywork_tpu.traffic.generator import Request
+
+    log = [Request(0.0, "/score/v1", (50.0,))]
+    with pytest.raises(ValueError, match="transport"):
+        run_open_loop("http://localhost:1", log,
+                      transport_kind="carrier-pigeon")
+
+
+def test_dispatcher_scoped_knobs_partition_the_tuned_schema():
+    """Every tuned serving knob is either dispatcher-scoped (applied by
+    the one process that owns the coalescer/predictor) or front-end
+    scoped (max_pending: admission upstream of the queue) — no knob
+    unowned, no knob double-owned."""
+    from bodywork_tpu.tune.config import (
+        DISPATCHER_SCOPED_KNOBS,
+        TUNED_KNOB_ENV,
+    )
+
+    assert set(DISPATCHER_SCOPED_KNOBS) | {"max_pending"} == set(
+        TUNED_KNOB_ENV
+    )
+    assert "max_pending" not in DISPATCHER_SCOPED_KNOBS
+
+
+def test_new_metric_families_pass_the_name_lint():
+    """The split's new families obey the registration lint (namespace +
+    unit suffix; note ``_occupancy`` alone would FAIL — hence
+    ``_occupancy_ratio``), so the obs-layer lint covers them."""
+    from bodywork_tpu.obs.registry import validate_metric_name
+
+    validate_metric_name("bodywork_tpu_rowqueue_handoff_seconds", "histogram")
+    validate_metric_name("bodywork_tpu_rowqueue_wait_seconds", "histogram")
+    validate_metric_name("bodywork_tpu_rowqueue_rows_total", "counter")
+    validate_metric_name("bodywork_tpu_rowqueue_depth", "gauge")
+    validate_metric_name("bodywork_tpu_rowqueue_occupancy_ratio", "gauge")
+    validate_metric_name(
+        "bodywork_tpu_coalesced_multisource_flush_total", "counter"
+    )
+    validate_metric_name(
+        "bodywork_tpu_serve_dispatcher_restarts_total", "counter"
+    )
+
+
+# --- cross-source batch formation (the split's whole point) -----------------
+
+
+def test_coalescer_merges_rows_across_sources():
+    """One dispatcher-side coalescer flushing rows tagged by DIFFERENT
+    front-ends into one batch — the accounting the flush-occupancy
+    regression (bench config 14) and the multisource counter read."""
+    from bodywork_tpu.serve.batcher import RequestCoalescer
+
+    class _Predictor:
+        def predict(self, X):
+            return np.asarray(X, np.float32).ravel() * 2.0
+
+    served = _Bundle()
+    served.predictor = _Predictor()
+    coalescer = RequestCoalescer(window_ms=40.0, max_rows=8).start()
+    try:
+        subs = [
+            coalescer.submit_nowait(
+                served, np.asarray([float(i)], np.float32),
+                source=f"frontend-{i % 2}",
+            )
+            for i in range(4)
+        ]
+        for sub in subs:
+            assert sub.event.wait(5)
+            assert sub.error is None
+        stats = coalescer.stats()
+        # all four rows merged across the two sources into shared flushes
+        assert stats["sources_seen"] == ["frontend-0", "frontend-1"]
+        assert stats["multi_source_flushes"] >= 1
+        assert stats["rows_dispatched"] == 4
+        assert stats["batches_dispatched"] < 4  # merged, not serialized
+    finally:
+        coalescer.stop()
+
+
+# --- process chaos: the real fleet ------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fe_service(tmp_path_factory):
+    """2 parse/admission front-ends + 1 spawned JAX dispatcher sharing
+    one SO_REUSEPORT port (the dispatcher takes seconds to import and
+    warm, so the whole file shares one fleet)."""
+    from bodywork_tpu.serve import MultiProcessService
+
+    root = tmp_path_factory.mktemp("fe-store")
+    store = FilesystemStore(root)
+    rng = np.random.default_rng(3)
+    X = rng.uniform(0, 100, 500).astype(np.float32)
+    y = (1.0 + 0.5 * X).astype(np.float32)
+    save_model(store, LinearRegressor().fit(X, y), date(2026, 7, 1))
+    with hermetic_env():
+        svc = MultiProcessService(str(root), frontends=2, engine="xla").start()
+        try:
+            yield svc
+        finally:
+            svc.stop()
+
+
+def _base(svc) -> str:
+    return svc.url.rsplit("/score/v1", 1)[0]
+
+
+def test_disaggregated_fleet_serves_byte_stable_responses(fe_service):
+    svc = fe_service
+    assert len(svc.worker_pids) == 2
+    assert svc.dispatcher_pid is not None
+    assert svc.dispatcher_pid not in svc.worker_pids
+    r = requests.post(svc.url, json={"X": 50}, timeout=30)
+    assert r.status_code == 200
+    assert abs(r.json()["prediction"] - 26.0) < 2.0
+    # the same request through the binary framing answers the SAME bytes
+    r_bin = requests.post(
+        svc.url, data=encode_binary_rows(np.asarray([50.0])),
+        headers={"Content-Type": BINARY_CONTENT_TYPE}, timeout=30,
+    )
+    assert r_bin.status_code == 200
+    assert r_bin.content == r.content
+    # batch route works through the queue too
+    rb = requests.post(svc.url + "/batch", json={"X": [10, 50, 90]},
+                       timeout=30)
+    assert rb.status_code == 200 and rb.json()["n"] == 3
+    # front-end healthz speaks the split
+    h = requests.get(_base(svc) + "/healthz", timeout=30)
+    assert h.status_code == 200
+    assert h.json()["role"] == "frontend"
+    assert h.json()["dispatcher_up"] is True
+
+
+def test_cross_frontend_merging_visible_in_aggregated_metrics(fe_service):
+    """Concurrent singles land on BOTH front-ends (SO_REUSEPORT) and the
+    dispatcher-side coalescer merges them: the multisource-flush counter
+    — flushed by the dispatcher, scraped through any front-end — must
+    move. This is the live-fleet half of the flush-occupancy regression
+    (bench config 14 holds the N=1 vs N=4 comparison)."""
+    svc = fe_service
+
+    def one(_):
+        # fresh connection per request so the kernel keeps rebalancing
+        # across both listeners
+        return requests.post(svc.url, json={"X": 50}, timeout=30).status_code
+
+    with ThreadPoolExecutor(max_workers=16) as pool:
+        codes = list(pool.map(one, range(160)))
+    assert codes.count(200) == len(codes)
+
+    deadline = time.monotonic() + 30  # metrics flush interval + slack
+    while time.monotonic() < deadline:
+        scrape = requests.get(_base(svc) + "/metrics", timeout=30).text
+        lines = {
+            line.split(" ")[0]: float(line.rsplit(" ", 1)[1])
+            for line in scrape.splitlines()
+            if line and not line.startswith("#")
+        }
+        merged = sum(
+            v for k, v in lines.items()
+            if k.startswith("bodywork_tpu_coalesced_multisource_flush_total")
+        )
+        rows = sum(
+            v for k, v in lines.items()
+            if k.startswith("bodywork_tpu_rowqueue_rows_total")
+        )
+        if merged >= 1 and rows >= 160:
+            break
+        time.sleep(1)
+    assert rows >= 160, "rowqueue row accounting never reached the scrape"
+    assert merged >= 1, "no coalesced flush ever merged both front-ends"
+    # the handoff histogram (the disaggregation hop's cost) is exposed too
+    assert "bodywork_tpu_rowqueue_handoff_seconds_count" in scrape
+
+
+def test_dispatcher_death_degrades_to_503_then_heals(fe_service):
+    """The drill: SIGKILL the singleton dispatcher mid-traffic. Every
+    response from then until the heal is EITHER a byte-perfect 200 or a
+    503 with Retry-After — zero torn responses, zero wedged connections
+    — and the supervised respawn restores byte-identical serving."""
+    svc = fe_service
+    baseline = requests.post(svc.url, json={"X": 50}, timeout=30)
+    assert baseline.status_code == 200
+    old_pid = svc.dispatcher_pid
+    svc.kill_dispatcher()
+
+    saw_503 = False
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not saw_503:
+        r = requests.post(svc.url, json={"X": 50}, timeout=30)
+        assert r.status_code in (200, 503), r.status_code
+        if r.status_code == 200:
+            assert r.content == baseline.content  # never torn
+        else:
+            saw_503 = True
+            assert r.headers["Retry-After"]
+            assert json.loads(r.content)["error"] == (
+                "scoring dispatcher unavailable; retry shortly"
+            )
+    assert saw_503, "the dispatcher death was never surfaced as a 503"
+
+    # supervised respawn: a NEW dispatcher process, then 200s again
+    deadline = time.monotonic() + 120
+    healed = None
+    while time.monotonic() < deadline:
+        r = requests.post(svc.url, json={"X": 50}, timeout=30)
+        assert r.status_code in (200, 503), r.status_code
+        if r.status_code == 200:
+            healed = r
+            break
+        time.sleep(0.25)
+    assert healed is not None, "service never healed after the respawn"
+    assert healed.content == baseline.content  # byte-identical after heal
+    assert svc.dispatcher_pid is not None
+    assert svc.dispatcher_pid != old_pid
+    # healthz is green again
+    h = requests.get(_base(svc) + "/healthz", timeout=30)
+    assert h.status_code == 200 and h.json()["dispatcher_up"] is True
