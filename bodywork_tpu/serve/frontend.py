@@ -311,11 +311,15 @@ class FrontendApp:
                 )
                 return response(environ, start_response)
             admitted = True
-        if traced and trace is None:
-            trace = tracer.begin(
-                None, request.get_data(cache=True, parse_form_data=False)
-            )
         try:
+            # inside the try: reading the body can raise (client abort,
+            # bad Content-Length), and the finally below must still
+            # release the admission unit — this is the service-wide
+            # shared budget, so one leak here would shrink it forever
+            if traced and trace is None:
+                trace = tracer.begin(
+                    None, request.get_data(cache=True, parse_form_data=False)
+                )
             handler = self._routes.get((request.method, request.path))
             if handler is None:
                 if any(path == request.path for _m, path in self._routes):

@@ -676,6 +676,18 @@ class MultiProcessService:
                         )
 
                         SharedBudgetSlot.clear(self._shared_budget, i)
+                    # frontends mode: the dead front-end's _pending map
+                    # died with it, so the slots it held in the shared
+                    # row-queue pool are unreachable to its successor —
+                    # reclaim them here or every crash permanently
+                    # shrinks the pool toward total 429 shedding
+                    if self._queue is not None:
+                        freed = self._queue.reclaim_frontend(i)
+                        if freed:
+                            log.warning(
+                                f"reclaimed {freed} row-queue slot(s) "
+                                f"from dead front-end {i}"
+                            )
                     alive_s = now - slot["spawned_at"]
                     delay = slot["policy"].on_death(alive_s)
                     if delay is None:

@@ -100,6 +100,10 @@ _M_ROWS = 2
 _M_FEATURES = 3
 _M_STATUS = 4
 _M_REPLY_ROWS = 5
+#: 1 + owning frontend_id while allocated, 0 while free — written only
+#: under the free-list lock, so the supervisor can reclaim a SIGKILLed
+#: front-end's slots (reclaim_frontend) without racing live allocators
+_M_OWNER = 6
 META_INTS = 8
 
 #: per-slot text region: trace id (request) + answering-bundle identity
@@ -131,6 +135,27 @@ class _SpscRing:
     instruction leaves the ring consistent — an entry is either fully
     visible or not there at all. A respawned process just keeps
     consuming from ``head``.
+
+    Two caveats the callers own:
+
+    - **Single producer means ONE THREAD.** The payload-then-tail
+      publish protocol is safe against a concurrent consumer, not
+      against a second producer: two threads that read the same tail
+      overwrite each other's payload and advance it once, silently
+      dropping an entry. The client serializes its HTTP handler
+      threads through ``RowQueueClient._lock`` and the server
+      serializes its serve-loop/coalescer threads through
+      ``RowQueueServer._lock`` — any new producer call site must take
+      the owning side's lock.
+    - **Cross-process ordering assumes x86-TSO.** ctypes RawArray
+      writes are plain stores with no fence; total store order is what
+      makes the consumer see the payload before the advanced tail. On
+      weakly-ordered architectures (aarch64) a consumer in another
+      process could observe the new tail first and read a stale
+      descriptor. The descriptor's generation guard downgrades that
+      from a torn response to a dropped request (both sides discard
+      gen mismatches), but a port to ARM should publish ``tail``
+      through a fencing primitive instead.
     """
 
     __slots__ = ("data", "pos", "cap")
@@ -220,6 +245,32 @@ class RowQueue:
         memory — reclaimed with the last process holding it — so there
         is nothing to release eagerly; kept for symmetry with resource
         owners the supervisor tears down."""
+
+    def reclaim_frontend(self, frontend_id: int) -> int:
+        """Free every slot a dead front-end still owned (supervisor
+        hook, called at the FIRST observation of a front-end death).
+
+        A SIGKILLed front-end takes its ``_pending`` map with it, so
+        the respawned client has no record of the slots the old process
+        held — without this, every front-end crash permanently shrinks
+        the shared pool until the service sheds everything. Ownership
+        is recorded per-slot under the free-list lock (``_M_OWNER``),
+        so the scan here cannot race a live allocator. Each reclaimed
+        slot's generation is bumped first: a dispatcher still scoring
+        it drops the reply on its gen guard, and stale descriptors in
+        either ring become inert. Returns the number of slots freed."""
+        views = _Views(self)
+        freed = 0
+        with self.free.get_lock():
+            for slot in range(self.slots):
+                if int(views.meta[slot, _M_OWNER]) != frontend_id + 1:
+                    continue
+                views.meta[slot, _M_GEN] += 1
+                views.meta[slot, _M_OWNER] = 0
+                self.free[0] += 1
+                self.free[self.free[0]] = slot
+                freed += 1
+        return freed
 
 
 class _Reply:
@@ -332,11 +383,15 @@ class RowQueueClient:
                 raise SlotsExhausted("no free row-queue slot")
             slot = free[count]  # stack top is free[count], count preceding
             free[0] = count - 1
+            # ownership stamp, inside the lock: the supervisor's
+            # dead-front-end reclaim scans owners under the same lock
+            self._views.meta[slot, _M_OWNER] = self.frontend_id + 1
         return slot
 
     def _free_slot(self, slot: int) -> None:
         free = self.queue.free
         with free.get_lock():
+            self._views.meta[slot, _M_OWNER] = 0
             free[0] += 1
             free[free[0]] = slot
 
@@ -377,17 +432,23 @@ class RowQueueClient:
         )
         views.stamps[slot] = time.monotonic()
         with self._lock:
-            self._pending[slot] = (gen, on_done)
-            self.requests_submitted += 1
-            self.rows_submitted += n_rows
-        self._m_rows.inc(n_rows)
-        if not self.queue.sub_rings[self.frontend_id].push(
-            (gen << _SLOT_BITS) | slot
-        ):  # pragma: no cover - ring cap exceeds the slot pool
-            with self._lock:
-                self._pending.pop(slot, None)
+            # the descriptor push stays inside the lock: werkzeug's
+            # threaded engine calls submit from concurrent request
+            # threads, and the sub ring is single-PRODUCER — two
+            # unserialized pushes can read the same tail and silently
+            # drop one descriptor (its handler would hang into the
+            # rendezvous timeout and leak the slot)
+            pushed = self.queue.sub_rings[self.frontend_id].push(
+                (gen << _SLOT_BITS) | slot
+            )
+            if pushed:
+                self._pending[slot] = (gen, on_done)
+                self.requests_submitted += 1
+                self.rows_submitted += n_rows
+        if not pushed:  # pragma: no cover - ring cap exceeds the slot pool
             self._free_slot(slot)
             raise SlotsExhausted("row-queue descriptor ring full")
+        self._m_rows.inc(n_rows)
 
     # -- reply path ----------------------------------------------------------
     def _reader_loop(self) -> None:
@@ -517,6 +578,12 @@ class RowQueueServer:
         )
         self._in_flight = 0
         self._next_ring = 0
+        # reply() runs on TWO threads — the serve_forever loop (batch /
+        # 503 / error / coalescer-saturated paths) and the coalescer's
+        # dispatcher thread — and the rep rings are single-producer:
+        # every reply (and the _in_flight accounting poll shares)
+        # serializes through this lock
+        self._lock = threading.Lock()
 
     def _pop_submission(self) -> tuple[int, int] | None:
         """One round-robin sweep over the front-ends' descriptor rings
@@ -573,8 +640,9 @@ class RowQueueServer:
         trace_id = _read_text(views.text[slot], 0, REQ_TEXT_BYTES).decode(
             "ascii", "replace"
         ) or None
-        self._in_flight += 1
-        self._m_depth.set(float(self._in_flight))
+        with self._lock:
+            self._in_flight += 1
+            self._m_depth.set(float(self._in_flight))
         return _Submission(slot, gen, frontend_id, int(meta[_M_KIND]), X,
                            trace_id)
 
@@ -583,32 +651,41 @@ class RowQueueServer:
         """Write one reply and signal the owning front-end. ``bundle``
         is the ANSWERING served bundle (post-firewall) — its identity is
         what the front-end splices into the response, keeping
-        disaggregated bytes identical to in-process bytes."""
+        disaggregated bytes identical to in-process bytes.
+
+        Thread-safe: the serve loop and the coalescer's dispatcher
+        thread both land here, and the rep rings are single-producer —
+        an unserialized pair of pushes to the same ring can drop a
+        reply descriptor (the waiting front-end would hang into its
+        rendezvous timeout), so the whole reply serializes through
+        ``self._lock``."""
         views = self._views
-        meta = views.meta[sub.slot]
-        if int(meta[_M_GEN]) != sub.gen:
-            return  # the front-end moved on; never write a stale slot
-        n = 0
-        if predictions is not None:
-            arr = np.asarray(predictions, dtype=np.float32).ravel()
-            n = int(arr.shape[0])
-            views.reply[sub.slot, :n] = arr
-        blob = b"[null, null, null]"
-        if bundle is not None:
-            encoded = json.dumps([
-                bundle.model_key, bundle.model_info, bundle.model_date,
-            ]).encode()
-            if len(encoded) <= REP_TEXT_BYTES:
-                blob = encoded
-            else:  # never tear the region; degrade to an identity-less reply
-                log.error("reply bundle identity exceeds the text region")
-        _write_text(views.text[sub.slot], REQ_TEXT_BYTES, REP_TEXT_BYTES, blob)
-        meta[_M_REPLY_ROWS] = n
-        meta[_M_STATUS] = status
-        self._in_flight = max(0, self._in_flight - 1)
-        self._m_depth.set(float(self._in_flight))
-        # cannot fill (ring cap exceeds the slot pool); a dead front-end
-        # simply never consumes — shared memory doesn't error
-        self.queue.rep_rings[sub.frontend_id].push(
-            (sub.gen << _SLOT_BITS) | sub.slot
-        )
+        with self._lock:
+            meta = views.meta[sub.slot]
+            if int(meta[_M_GEN]) != sub.gen:
+                return  # the front-end moved on; never write a stale slot
+            n = 0
+            if predictions is not None:
+                arr = np.asarray(predictions, dtype=np.float32).ravel()
+                n = int(arr.shape[0])
+                views.reply[sub.slot, :n] = arr
+            blob = b"[null, null, null]"
+            if bundle is not None:
+                encoded = json.dumps([
+                    bundle.model_key, bundle.model_info, bundle.model_date,
+                ]).encode()
+                if len(encoded) <= REP_TEXT_BYTES:
+                    blob = encoded
+                else:  # never tear the region; degrade identity-less
+                    log.error("reply bundle identity exceeds the text region")
+            _write_text(views.text[sub.slot], REQ_TEXT_BYTES, REP_TEXT_BYTES,
+                        blob)
+            meta[_M_REPLY_ROWS] = n
+            meta[_M_STATUS] = status
+            self._in_flight = max(0, self._in_flight - 1)
+            self._m_depth.set(float(self._in_flight))
+            # cannot fill (ring cap exceeds the slot pool); a dead
+            # front-end simply never consumes — shared memory doesn't error
+            self.queue.rep_rings[sub.frontend_id].push(
+                (sub.gen << _SLOT_BITS) | sub.slot
+            )

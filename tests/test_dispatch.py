@@ -193,6 +193,103 @@ def test_rowqueue_epoch_bump_fails_inflight_and_frees_slots():
         client.stop()
 
 
+def test_rowqueue_concurrent_submit_and_reply_lose_nothing():
+    """The SPSC rings must stay single-producer under real threading:
+    werkzeug's threaded engine submits from concurrent request threads,
+    and the dispatcher replies from two threads (serve loop + the
+    coalescer's dispatcher thread). Each side serializes its pushes
+    through its own lock — a lost descriptor would hang a request into
+    the 60s rendezvous timeout and leak its slot forever."""
+    queue = RowQueue(CTX, frontends=1, slots=64, slot_floats=8)
+    queue.up.value = 1
+    client = RowQueueClient(queue, frontend_id=0).start()
+    n_threads, per_thread = 8, 50
+    total = n_threads * per_thread
+    server = RowQueueServer(queue)
+    stop = threading.Event()
+    repliers = ThreadPoolExecutor(max_workers=2)
+
+    def serve_loop():
+        while not stop.is_set():
+            sub = server.poll(0.05)
+            if sub is not None:
+                repliers.submit(
+                    server.reply, sub, 200,
+                    np.asarray(sub.X, np.float32) * 2.0, _Bundle(),
+                )
+
+    serving = threading.Thread(target=serve_loop, daemon=True)
+    serving.start()
+    done = threading.Event()
+    replies = []
+    replies_lock = threading.Lock()
+
+    def on_done(reply):
+        with replies_lock:
+            replies.append(reply)
+            if len(replies) == total:
+                done.set()
+
+    def submit_loop(k):
+        for j in range(per_thread):
+            while True:
+                try:
+                    client.submit(np.float32(k * per_thread + j),
+                                  KIND_SINGLE, on_done)
+                    break
+                except SlotsExhausted:  # pool backpressure: retry
+                    time.sleep(0.001)
+
+    try:
+        workers = [threading.Thread(target=submit_loop, args=(k,))
+                   for k in range(n_threads)]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join(timeout=30)
+        assert done.wait(30), f"lost {total - len(replies)} of {total}"
+        assert all(r.status == 200 for r in replies)
+        stats = client.stats()
+        assert stats["requests_submitted"] == total
+        assert stats["replies_received"] == total
+        assert stats["in_flight"] == 0
+        assert stats["slots_free"] == queue.slots  # nothing leaked
+    finally:
+        stop.set()
+        serving.join(timeout=5)
+        repliers.shutdown(wait=True)
+        client.stop()
+
+
+def test_dead_frontend_slot_reclaim_restores_pool_and_stales_descriptors():
+    """A SIGKILLed front-end takes its pending map with it, so its
+    successor can never free the slots the old process held: the
+    supervisor's reclaim (RowQueue.reclaim_frontend) must return
+    exactly ITS slots to the pool and stale out its enqueued
+    descriptors, without touching a live sibling's slots."""
+    queue = RowQueue(CTX, frontends=2, slots=4, slot_floats=8)
+    queue.up.value = 1
+    victim = RowQueueClient(queue, frontend_id=0)  # readers not started
+    survivor = RowQueueClient(queue, frontend_id=1)
+    victim.submit(np.float32(1.0), KIND_SINGLE, lambda r: None)
+    victim.submit(np.float32(2.0), KIND_SINGLE, lambda r: None)
+    survivor.submit(np.float32(3.0), KIND_SINGLE, lambda r: None)
+    assert int(queue.free[0]) == 1
+    # front-end 0 is SIGKILLed: first death observation reclaims
+    assert queue.reclaim_frontend(0) == 2
+    assert int(queue.free[0]) == 3
+    assert queue.reclaim_frontend(0) == 0  # idempotent
+    # the dead front-end's enqueued descriptors are now stale — the
+    # dispatcher drops them on the gen guard instead of scoring a slot
+    # someone else may reuse; the survivor's submission still serves
+    server = RowQueueServer(queue)
+    polled = [server.poll(0.2) for _ in range(3)]
+    live = [s for s in polled if s is not None]
+    assert len(live) == 1
+    assert live[0].frontend_id == 1
+    assert float(np.ravel(live[0].X)[0]) == 3.0
+
+
 def test_rowqueue_backpressure_and_stale_descriptors():
     queue = RowQueue(CTX, frontends=1, slots=1, slot_floats=4)
     queue.up.value = 1
@@ -344,6 +441,49 @@ def test_shed_before_parse_leaves_rowqueue_untouched():
     assert json.loads(r.data)["error"] == "server over capacity; request shed"
     assert client.rows_submitted == 0
     assert client.submissions == []
+
+
+def test_admission_released_when_traced_body_read_fails():
+    """The traced path reads the body AFTER admission: an exception
+    mid-read (client abort, lying Content-Length) must still release
+    the admission unit — it's the service-wide shared budget, so one
+    leak here would shrink capacity forever."""
+    from werkzeug.test import create_environ
+
+    from bodywork_tpu.serve.admission import AdmissionController
+
+    admission = AdmissionController(max_pending=1)
+    app = _frontend(_StubClient(), admission=admission)
+
+    class _Tracer:
+        enabled = True  # forces the body pre-read for span capture
+
+        def begin(self, traceparent, body):
+            return None
+
+        def finish(self, trace, route, status):
+            return None
+
+    app.tracer = _Tracer()
+
+    class _BrokenBody:
+        def read(self, *a, **k):
+            raise OSError("client went away mid-body")
+
+        def readline(self, *a, **k):
+            raise OSError("client went away mid-body")
+
+    environ = create_environ("/score/v1", method="POST",
+                             content_type="application/json")
+    environ["wsgi.input"] = _BrokenBody()
+    environ["CONTENT_LENGTH"] = "11"
+    statuses = []
+    app(environ, lambda status, headers: statuses.append(status))
+    # werkzeug surfaces the abort as ClientDisconnected (400); a raw
+    # OSError would 500 — either way it must be an error, not a score
+    assert statuses and statuses[0][:3] in ("400", "500")
+    # the budget came back: the next request is admitted, not shed
+    assert admission.try_admit()
 
 
 def test_frontend_renders_byte_identical_and_degrades_honestly():
@@ -686,3 +826,35 @@ def test_dispatcher_death_degrades_to_503_then_heals(fe_service):
     # healthz is green again
     h = requests.get(_base(svc) + "/healthz", timeout=30)
     assert h.status_code == 200 and h.json()["dispatcher_up"] is True
+
+
+def test_dead_frontend_slots_reclaimed_by_supervisor(fe_service):
+    """SIGKILL a front-end that holds row-queue slots: the supervisor's
+    first death observation must return them to the shared pool (a
+    leak here would ratchet the service toward permanent 429 shedding),
+    then respawn the front-end and keep serving."""
+    svc = fe_service
+    queue = svc._queue
+    slots_total = queue.slots
+    assert int(queue.free[0]) == slots_total  # quiescent before the drill
+    victim_pid = svc._procs[0].pid
+    # stand in for the victim's in-flight requests: allocate AS
+    # front-end 0 from the parent (only the free list + the per-slot
+    # owner stamp are touched — no ring push, so the SPSC rings stay
+    # single-producer)
+    parent_client = RowQueueClient(queue, frontend_id=0)
+    for _ in range(3):
+        parent_client._alloc_slot()
+    assert int(queue.free[0]) == slots_total - 3
+    svc.kill_worker(victim_pid)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and int(queue.free[0]) < slots_total:
+        time.sleep(0.1)
+    assert int(queue.free[0]) == slots_total, "slots leaked past the respawn"
+    # the fleet heals: both front-ends live again and serving works
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and len(svc.worker_pids) < 2:
+        time.sleep(0.25)
+    assert len(svc.worker_pids) == 2
+    r = requests.post(svc.url, json={"X": 50}, timeout=30)
+    assert r.status_code == 200
