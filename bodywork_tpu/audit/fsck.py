@@ -76,6 +76,7 @@ from bodywork_tpu.store.schema import (
     REGISTRY_RECORDS_PREFIX,
     RUNS_PREFIX,
     SNAPSHOTS_PREFIX,
+    TENANTS_PREFIX,
     TEST_METRICS_PREFIX,
     TRAINSTATE_PREFIX,
     TUNING_PREFIX,
@@ -831,6 +832,51 @@ def _check_quarantine(ctx: FsckContext) -> list[Finding]:
     return out
 
 
+def _check_tenants(ctx: FsckContext) -> list[Finding]:
+    """Tenant namespaces (``tenancy/namespace.py``): every
+    ``tenants/<id>/`` subtree is a complete artefact store in
+    miniature, so fsck RECURSES — a tenant-scoped view of the store is
+    scanned with the same per-prefix checkers, and each finding
+    resurfaces here under its tenant-qualified key. Repair actions are
+    deliberately stripped from the recursed findings: the repair
+    planner resolves restore evidence (snapshots, sidecars, journals)
+    relative to its own store root, so repair must run IN tenant scope
+    — ``cli fsck --tenant <id>`` — never from the root scan. Keys whose
+    first segment fails tenant-id validation cannot have been written
+    through a scoped store and are flagged as hygiene defects."""
+    from bodywork_tpu.store.schema import validate_tenant_id
+    from bodywork_tpu.tenancy.namespace import TenantStore, list_tenants
+
+    out = []
+    for tid in list_tenants(ctx.store):
+        sub = FsckContext(TenantStore(ctx.store, tid))
+        for prefix in ALL_PREFIXES:
+            for f in CHECKERS[prefix](sub):
+                out.append(dataclasses.replace(
+                    f,
+                    key=f"{TENANTS_PREFIX}{tid}/{f.key}",
+                    prefix=TENANTS_PREFIX,
+                    detail=(
+                        f"[tenant {tid}] {f.detail}" if f.detail
+                        else f"[tenant {tid}] repairable only in tenant "
+                             f"scope: cli fsck --tenant {tid}"
+                    ),
+                    repair=None,
+                ))
+    for key in ctx.keys[TENANTS_PREFIX]:
+        seg = key[len(TENANTS_PREFIX):].split("/", 1)[0]
+        try:
+            validate_tenant_id(seg)
+        except ValueError:
+            out.append(Finding(
+                key, TENANTS_PREFIX, "invalid_tenant_id", "advisory",
+                detail=f"first segment {seg!r} fails tenant-id "
+                       "validation; no scoped store can have written "
+                       "this key",
+            ))
+    return out
+
+
 #: prefix -> auditor. Guard-pinned == schema.ALL_PREFIXES == the
 #: docs/RESILIENCE.md §11 integrity table (tests/test_audit.py).
 CHECKERS = {
@@ -846,6 +892,7 @@ CHECKERS = {
     AUDIT_PREFIX: _check_audit,
     QUARANTINE_PREFIX: _check_quarantine,
     FLIGHTREC_PREFIX: _check_flightrec,
+    TENANTS_PREFIX: _check_tenants,
 }
 
 

@@ -51,8 +51,28 @@ log = get_logger("cli")
 
 def _store(args):
     from bodywork_tpu.store import open_store
+    from bodywork_tpu.tenancy.namespace import scoped_store
 
-    return open_store(args.store)
+    return scoped_store(open_store(args.store), _tenant_id(args))
+
+
+def _tenant_id(args) -> str:
+    """Resolve the command's tenant: ``--tenant`` flag > env
+    ``BODYWORK_TPU_TENANT`` > the default (root) namespace. The flag is
+    validated STRICTLY — a typo'd ``--tenant`` must fail loudly, never
+    silently read/write the root namespace — while the env degrades to
+    default with a warning (the stages convention for malformed env).
+    Both funnel through ``schema.validate_tenant_id``, so flag, env,
+    and key charset can never drift apart (guard-pinned by
+    tests/test_tenancy.py)."""
+    from bodywork_tpu.store.schema import validate_tenant_id
+    from bodywork_tpu.tenancy.namespace import tenant_from_env
+
+    tenant = getattr(args, "tenant", None)
+    if tenant is not None:
+        validate_tenant_id(tenant)
+        return tenant
+    return tenant_from_env()
 
 
 def _date(args) -> date:
@@ -582,7 +602,8 @@ def cmd_run_day(args) -> int:
             print(f"trace: {path}")
         report_path = report_out or _derived_report_path(trace_out)
         path = write_day_report(
-            report_path, day_report(result, fsck=fsck_report)
+            report_path,
+            day_report(result, fsck=fsck_report, tenant=_tenant_id(args))
         )
         print(f"report: {path}")
         # retention for date-templated outputs (the daily CronJob path):
@@ -887,6 +908,57 @@ def cmd_fsck(args) -> int:
         if report["residual"]:
             print(f"{len(report['residual'])} actionable finding(s) remain")
     return 0 if report["ok"] else FSCK_FINDINGS_EXIT
+
+
+def cmd_fleet_sim(args) -> int:
+    """Multi-tenant fleet soak (``tenancy/fleet.py``): run N scenario-zoo
+    tenants' daily pipelines interleaved in ONE shared store under
+    ``tenants/<id>/``, optionally NaN-sabotage one tenant's final
+    training day, then re-run every healthy tenant SOLO in a fresh store
+    and require its artefacts byte-identical to its fleet namespace —
+    zero cross-tenant blast radius, proven at the byte level. The
+    sabotaged tenant's registry gate must reject the poisoned candidate
+    with production held on the prior healthy model. Exit 0 on a
+    verified pass, 1 otherwise."""
+    import json as _json
+
+    from bodywork_tpu.tenancy import zoo
+    from bodywork_tpu.tenancy.fleet import run_fleet_sim
+
+    # stdout carries exactly ONE JSON document with --json (the
+    # fsck/traffic/chaos CLI convention); logs go to stderr either way
+    # so the per-day pipeline chatter never interleaves with the report
+    configure_logger(stream=sys.stderr)
+    if args.store.startswith("gs://"):
+        log.error(
+            "fleet-sim needs fresh local stores for the byte-level "
+            "twin comparison; point --store at a directory, not gs://"
+        )
+        return 1
+    specs = zoo(args.tenants, base_seed=args.seed,
+                n_samples=args.samples_per_day)
+    summary = run_fleet_sim(
+        args.store, _date(args), args.days, specs,
+        sabotage_tenant=args.sabotage,
+        model_type=args.model,
+    )
+    if args.json:
+        print(_json.dumps(summary, indent=2, sort_keys=True))
+    else:
+        for tid, cmp_ in summary["comparisons"].items():
+            state = "byte-identical" if cmp_["ok"] else (
+                f"DIVERGED (mismatched={len(cmp_['mismatched'])} "
+                f"missing={len(cmp_['missing'])} extra={len(cmp_['extra'])})"
+            )
+            print(f"  {tid}: solo twin {state}")
+        if summary["sabotage_tenant"]:
+            print(
+                f"  {summary['sabotage_tenant']}: gate_rejected="
+                f"{summary['gate_rejected']} "
+                f"production_held={summary['production_held']}"
+            )
+        print("fleet soak " + ("PASS" if summary["ok"] else "FAIL"))
+    return 0 if summary["ok"] else 1
 
 
 def cmd_chaos_run_sim(args) -> int:
@@ -1983,6 +2055,38 @@ def build_parser() -> argparse.ArgumentParser:
                         "document on stdout (logs go to stderr) — the "
                         "traffic/chaos CLI convention")
 
+    p = add(
+        "fleet-sim", cmd_fleet_sim,
+        help="multi-tenant fleet soak: N scenario-zoo tenants "
+             "interleaved in ONE store under tenants/<id>/, optional "
+             "single-tenant NaN sabotage, every healthy tenant proven "
+             "byte-identical to a solo twin (zero cross-tenant blast "
+             "radius)",
+    )
+    p.add_argument("--store", required=True,
+                   help="fresh local root dir (fleet/ + solo twins are "
+                        "created under it)")
+    p.add_argument("--date", default=None, help="simulation start date")
+    p.add_argument("--days", type=_positive_int, default=3, metavar="N")
+    p.add_argument("--tenants", type=_positive_int, default=4, metavar="N",
+                   help="fleet size; specs cycle the scenario zoo "
+                        "(tenant-00 is always baseline/steady)")
+    p.add_argument("--sabotage", default=None, metavar="TENANT",
+                   help="NaN-poison this tenant's final training day; "
+                        "its gate must reject, everyone else must stay "
+                        "byte-identical to their solo twins")
+    p.add_argument("--seed", type=int, default=42,
+                   help="base seed folded into every tenant's data seed")
+    p.add_argument("--samples-per-day", type=_positive_int, default=96,
+                   metavar="N",
+                   help="rows/day per tenant (default 96 — the soak "
+                        "tests isolation, not the fit)")
+    p.add_argument("--model", default="linear",
+                   choices=["linear", "mlp"])
+    p.add_argument("--json", action="store_true",
+                   help="print the full summary as exactly one JSON "
+                        "document on stdout")
+
     p = sub.add_parser(
         "registry",
         help="model registry: gated promotion, shadow eval, rollback "
@@ -2252,7 +2356,36 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model", default="linear", choices=["linear", "mlp"])
     p.add_argument("--mode", default="batch", choices=["single", "batch"])
 
+    _inject_tenant_arg(parser)
     return parser
+
+
+def _inject_tenant_arg(parser: argparse.ArgumentParser) -> None:
+    """Give every (sub)command that opens a store a ``--tenant`` flag.
+
+    One walk over the finished parser tree instead of 30 per-command
+    declarations, so a new store-opening command can never forget the
+    flag. ``_store()`` scopes all keys under ``tenants/<ID>/``;
+    ``default`` (or unset) is the root namespace, byte-identical to
+    pre-tenancy layouts. Env ``BODYWORK_TPU_TENANT`` is the soft
+    default when the flag is absent."""
+    for action in parser._actions:
+        if isinstance(action, argparse._SubParsersAction):
+            seen = set()
+            for child in action.choices.values():
+                if id(child) in seen:  # aliases share one parser
+                    continue
+                seen.add(id(child))
+                _inject_tenant_arg(child)
+    options = {s for a in parser._actions for s in a.option_strings}
+    if "--store" in options and "--tenant" not in options:
+        parser.add_argument(
+            "--tenant", default=None, metavar="ID",
+            help="tenant namespace to operate in (strictly validated "
+                 "against the schema tenant-id charset; env "
+                 "BODYWORK_TPU_TENANT is the soft default; 'default' = "
+                 "the root namespace)",
+        )
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -26,3 +26,10 @@ class DriftConfig:
     x_low: float = 0.0
     x_high: float = 100.0
     seed: int = 42                    # global seed folded with the date
+    #: heteroscedasticity: noise scale grows linearly with x, from
+    #: ``sigma`` at ``x_low`` to ``sigma * (1 + hetero)`` at ``x_high``.
+    #: 0.0 (the default) traces the EXACT pre-tenancy sampler graph —
+    #: the generator branches in Python on this static field — so every
+    #: existing dataset stays byte-identical. Used by the scenario zoo
+    #: (``tenancy/scenarios.py``).
+    hetero: float = 0.0

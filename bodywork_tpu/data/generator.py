@@ -67,7 +67,15 @@ def _sample_day(key: jax.Array, day: jax.Array, cfg: DriftConfig):
         kx, (cfg.n_samples,), minval=cfg.x_low, maxval=cfg.x_high
     )
     eps = jax.random.normal(ke, (cfg.n_samples,))
-    y = alpha(day, cfg) + cfg.beta * x + cfg.sigma * eps
+    if cfg.hetero:
+        # heteroscedastic scenario (tenancy/scenarios.py): noise scale
+        # ramps with x. Python-branched on the static cfg so hetero=0.0
+        # traces the exact pre-tenancy graph — byte-identical datasets.
+        span = max(cfg.x_high - cfg.x_low, 1e-9)
+        scale = cfg.sigma * (1.0 + cfg.hetero * (x - cfg.x_low) / span)
+        y = alpha(day, cfg) + cfg.beta * x + scale * eps
+    else:
+        y = alpha(day, cfg) + cfg.beta * x + cfg.sigma * eps
     return jnp.stack([x, y, (y >= 0.0).astype(x.dtype)])
 
 

@@ -143,7 +143,8 @@ class SpanRecorder:
 
 
 def day_report(
-    result, spans: list[Span] | None = None, fsck: dict | None = None
+    result, spans: list[Span] | None = None, fsck: dict | None = None,
+    tenant: str | None = None,
 ) -> dict:
     """Structured JSON-able run report for one ``DayResult``.
 
@@ -159,7 +160,12 @@ def day_report(
          "stage_seconds": {stage: float},
          "spans": [{name, category, start_s, duration_s, thread, meta?}],
          "fsck"?: {"clean", "ok", "keys_scanned", "by_severity",
-                   "findings": [...]}}
+                   "findings": [...]},
+         "tenant"?: str}
+
+    ``tenant`` names the tenant namespace the day ran in; the default
+    (root) namespace OMITS the field, keeping default-tenant reports
+    byte-identical to pre-tenancy ones.
     """
     spans = result.spans if spans is None else spans
     report = {
@@ -172,6 +178,8 @@ def day_report(
         },
         "spans": [s.to_dict() for s in spans],
     }
+    if tenant is not None and tenant != "default":
+        report["tenant"] = tenant
     if fsck is not None:
         report["fsck"] = {
             "clean": fsck["clean"],

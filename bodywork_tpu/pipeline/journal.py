@@ -133,6 +133,17 @@ def artefact_digest(data: bytes) -> str:
     return sha256_digest(data)
 
 
+def _journal_tenant(store) -> str | None:
+    """The tenant namespace this journal's store is scoped to, or None
+    for the root namespace (whose journals must stay byte-identical to
+    pre-tenancy ones — the field is simply omitted)."""
+    from bodywork_tpu.store.schema import DEFAULT_TENANT
+    from bodywork_tpu.tenancy.namespace import tenant_of
+
+    tenant = tenant_of(store)
+    return None if tenant == DEFAULT_TENANT else tenant
+
+
 def _count_corrupt() -> None:
     from bodywork_tpu.obs import get_registry
 
@@ -320,6 +331,11 @@ class RunJournal:
                     int(prior_lease.get("fence", 0)) + 1
                 ),
             }
+            tenant = _journal_tenant(self.store)
+            if tenant is not None:
+                # provenance only; the default (root) namespace omits
+                # the field so pre-tenancy journals stay byte-identical
+                new_doc["tenant"] = tenant
             try:
                 self._token = self.store.put_bytes_if_match(
                     self.key, _dumps(new_doc), token

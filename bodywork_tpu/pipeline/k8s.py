@@ -576,6 +576,75 @@ def generate_manifests(
                         "type": "ClusterIP",
                     },
                 }
+                # queue-pressure autoscaling (docs/RESILIENCE.md §12-13):
+                # scale serving replicas on the row-queue's OWN saturation
+                # signals rather than CPU — occupancy_ratio ~1.0 means the
+                # slot pool (not admission) is the backpressure boundary,
+                # and wait_seconds is the whole disaggregation rendezvous
+                # a request pays. Both are Pods metrics through the
+                # Prometheus adapter reading the per-pod scrape
+                # annotations above (wait_seconds as the adapter's p90
+                # rollup of the histogram). Scale-up reacts in ~30 s;
+                # scale-down waits 5 min so a retry-storm's geometric
+                # tail can't flap the fleet.
+                docs[f"{i:02d}-{stage.name}-hpa.yaml"] = {
+                    "apiVersion": "autoscaling/v2",
+                    "kind": "HorizontalPodAutoscaler",
+                    "metadata": meta,
+                    "spec": {
+                        "scaleTargetRef": {
+                            "apiVersion": "apps/v1",
+                            "kind": "Deployment",
+                            "name": meta["name"],
+                        },
+                        "minReplicas": max(stage.replicas, 1),
+                        "maxReplicas": max(stage.replicas, 1) * 4,
+                        "metrics": [
+                            {
+                                "type": "Pods",
+                                "pods": {
+                                    "metric": {
+                                        "name": "bodywork_tpu_rowqueue"
+                                                "_occupancy_ratio",
+                                    },
+                                    "target": {
+                                        "type": "AverageValue",
+                                        "averageValue": "750m",
+                                    },
+                                },
+                            },
+                            {
+                                "type": "Pods",
+                                "pods": {
+                                    "metric": {
+                                        "name": "bodywork_tpu_rowqueue"
+                                                "_wait_seconds_p90",
+                                    },
+                                    "target": {
+                                        "type": "AverageValue",
+                                        "averageValue": "50m",
+                                    },
+                                },
+                            },
+                        ],
+                        "behavior": {
+                            "scaleUp": {
+                                "stabilizationWindowSeconds": 30,
+                                "policies": [{
+                                    "type": "Percent", "value": 100,
+                                    "periodSeconds": 30,
+                                }],
+                            },
+                            "scaleDown": {
+                                "stabilizationWindowSeconds": 300,
+                                "policies": [{
+                                    "type": "Pods", "value": 1,
+                                    "periodSeconds": 60,
+                                }],
+                            },
+                        },
+                    },
+                }
                 if stage.ingress:
                     # the reference's per-service `ingress` knob
                     # (bodywork.yaml:42); Bodywork exposes the service at

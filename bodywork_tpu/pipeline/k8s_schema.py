@@ -439,6 +439,41 @@ _JOB_SPEC = {
 }
 
 
+#: autoscaling/v2 MetricTarget: exactly one value form per target type
+_HPA_METRIC_TARGET = {
+    "type": "object", "additionalProperties": False,
+    "required": ["type"],
+    "properties": {
+        "type": {"enum": ["Utilization", "Value", "AverageValue"]},
+        "value": _QUANTITY,
+        "averageValue": _QUANTITY,
+        "averageUtilization": _INT,
+    },
+}
+
+#: autoscaling/v2 HPAScalingRules (the behavior block's two arms)
+_HPA_SCALING_RULES = {
+    "type": "object", "additionalProperties": False,
+    "properties": {
+        "stabilizationWindowSeconds": _INT,
+        "selectPolicy": {"enum": ["Max", "Min", "Disabled"]},
+        "policies": {
+            "type": "array",
+            "items": {
+                "type": "object", "additionalProperties": False,
+                "required": ["type", "value", "periodSeconds"],
+                "properties": {
+                    "type": {"enum": ["Pods", "Percent"]},
+                    "value": _INT,
+                    "periodSeconds": _INT,
+                },
+            },
+        },
+        "tolerance": _QUANTITY,
+    },
+}
+
+
 def _top(api_version: str, kind: str, spec, extra: dict | None = None,
          required: tuple = ("metadata",)) -> dict:
     props = {
@@ -646,6 +681,72 @@ K8S_KIND_SCHEMAS: dict[str, dict] = {
                                 },
                             },
                         },
+                    },
+                },
+            },
+        },
+    ),
+    "HorizontalPodAutoscaler": _top(
+        "autoscaling/v2", "HorizontalPodAutoscaler",
+        {
+            "type": "object", "additionalProperties": False,
+            "required": ["scaleTargetRef", "maxReplicas"],
+            "properties": {
+                "scaleTargetRef": {
+                    "type": "object", "additionalProperties": False,
+                    "required": ["apiVersion", "kind", "name"],
+                    "properties": {
+                        "apiVersion": _STR, "kind": _STR, "name": _STR,
+                    },
+                },
+                "minReplicas": {"type": "integer", "minimum": 1},
+                "maxReplicas": {"type": "integer", "minimum": 1},
+                "metrics": {
+                    "type": "array",
+                    "items": {
+                        "type": "object", "additionalProperties": False,
+                        "required": ["type"],
+                        "properties": {
+                            "type": {"enum": ["Pods", "Resource", "Object",
+                                              "External",
+                                              "ContainerResource"]},
+                            "pods": {
+                                "type": "object",
+                                "additionalProperties": False,
+                                "required": ["metric", "target"],
+                                "properties": {
+                                    "metric": {
+                                        "type": "object",
+                                        "additionalProperties": False,
+                                        "required": ["name"],
+                                        "properties": {
+                                            "name": _STR,
+                                            "selector": _LABEL_SELECTOR,
+                                        },
+                                    },
+                                    "target": _HPA_METRIC_TARGET,
+                                },
+                            },
+                            "resource": {
+                                "type": "object",
+                                "additionalProperties": False,
+                                "required": ["name", "target"],
+                                "properties": {
+                                    "name": _STR,
+                                    "target": _HPA_METRIC_TARGET,
+                                },
+                            },
+                            "object": {"type": "object"},
+                            "external": {"type": "object"},
+                            "containerResource": {"type": "object"},
+                        },
+                    },
+                },
+                "behavior": {
+                    "type": "object", "additionalProperties": False,
+                    "properties": {
+                        "scaleUp": _HPA_SCALING_RULES,
+                        "scaleDown": _HPA_SCALING_RULES,
                     },
                 },
             },

@@ -32,6 +32,7 @@ EXPECTED_API_VERSION = {
     "CronJob": "batch/v1",
     "Deployment": "apps/v1",
     "Ingress": "networking.k8s.io/v1",
+    "HorizontalPodAutoscaler": "autoscaling/v2",
 }
 
 
@@ -233,6 +234,27 @@ _job_spec = _mapping(
     required=("template",),
 )
 
+_hpa_metric_target = _mapping(
+    {
+        "type": _scalar,
+        "value": _scalar,
+        "averageValue": _scalar,
+        "averageUtilization": _scalar,
+    },
+    required=("type",),
+)
+
+_hpa_scaling_rules = _mapping(
+    {
+        "stabilizationWindowSeconds": _scalar,
+        "selectPolicy": _scalar,
+        "policies": _each(_mapping(
+            {"type": _scalar, "value": _scalar, "periodSeconds": _scalar},
+            required=("type", "value", "periodSeconds"),
+        )),
+    },
+)
+
 _KIND_SPEC_VALIDATORS: dict[str, Any] = {
     "Namespace": _mapping({"metadata": _metadata}, required=("metadata",)),
     "ConfigMap": _mapping(
@@ -373,6 +395,49 @@ _KIND_SPEC_VALIDATORS: dict[str, Any] = {
                         {"hosts": _each(_scalar), "secretName": _scalar},
                     )),
                 },
+            ),
+        },
+        required=("metadata", "spec"),
+    ),
+    "HorizontalPodAutoscaler": _mapping(
+        {
+            "metadata": _metadata,
+            "spec": _mapping(
+                {
+                    "scaleTargetRef": _mapping(
+                        {"apiVersion": _scalar, "kind": _scalar,
+                         "name": _scalar},
+                        required=("apiVersion", "kind", "name"),
+                    ),
+                    "minReplicas": _scalar,
+                    "maxReplicas": _scalar,
+                    "metrics": _each(_mapping(
+                        {
+                            "type": _scalar,
+                            "pods": _mapping(
+                                {
+                                    "metric": _mapping(
+                                        {"name": _scalar},
+                                        required=("name",),
+                                    ),
+                                    "target": _hpa_metric_target,
+                                },
+                                required=("metric", "target"),
+                            ),
+                            "resource": _mapping(
+                                {"name": _scalar,
+                                 "target": _hpa_metric_target},
+                                required=("name", "target"),
+                            ),
+                        },
+                        required=("type",),
+                    )),
+                    "behavior": _mapping(
+                        {"scaleUp": _hpa_scaling_rules,
+                         "scaleDown": _hpa_scaling_rules},
+                    ),
+                },
+                required=("scaleTargetRef", "maxReplicas"),
             ),
         },
         required=("metadata", "spec"),

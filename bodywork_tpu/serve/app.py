@@ -73,8 +73,8 @@ from bodywork_tpu.serve.predictor import PaddedPredictor
 from bodywork_tpu.serve.wire import (  # noqa: F401  (re-exports)
     BINARY_CONTENT_TYPE,
     MODEL_KEY_HEADER,
+    BatchResponseTemplate,
     SingleResponseTemplate,
-    batch_score_payload,
     parse_binary_rows,
     parse_features,
     single_score_payload,
@@ -191,7 +191,7 @@ class _Served:
 
     __slots__ = (
         "predictor", "model_info", "model_date", "model_key", "source",
-        "bounds", "single_template",
+        "bounds", "single_template", "batch_template",
     )
 
     def __init__(
@@ -217,6 +217,8 @@ class _Served:
         #: Living ON the bundle gives invalidation for free — a swap
         #: builds a new _Served, and with it a new template.
         self.single_template = SingleResponseTemplate(model_info, model_date)
+        #: same framing for the /score/v1/batch body
+        self.batch_template = BatchResponseTemplate(model_info, model_date)
 
 
 class ScoringApp:
@@ -1035,7 +1037,12 @@ class ScoringApp:
                 self.count_stream_error(routed, stream)
             raise
         t0 = time.perf_counter()
-        response = _json_response(batch_score_payload(served, predictions))
+        # pre-serialized framing (serve.wire.BatchResponseTemplate):
+        # byte-identical to json.dumps(batch_score_payload(...))
+        response = Response(
+            served.batch_template.render(predictions),
+            mimetype="application/json",
+        )
         t1 = time.perf_counter()
         self._m_serialize.observe(t1 - t0)
         if sampled:

@@ -190,10 +190,10 @@ def dispatcher_main(store_path: str, queue, ready,
         _registry_bounds,
         build_serving_predictor,
     )
-    from bodywork_tpu.store import open_store
+    from bodywork_tpu.store import open_scoped_store
 
     signal.signal(signal.SIGTERM, lambda signum, frame: sys.exit(0))
-    store = open_store(store_path)
+    store = open_scoped_store(store_path)
     # the tuned document's serving knobs are DISPATCHER-SCOPED in the
     # split (tune.config.DISPATCHER_SCOPED_KNOBS): window/max_rows shape
     # the one coalescer that exists, buckets shape the one predictor.

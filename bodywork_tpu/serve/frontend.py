@@ -58,8 +58,8 @@ from bodywork_tpu.serve.rowqueue import (
 from bodywork_tpu.serve.wire import (
     BINARY_CONTENT_TYPE,
     MODEL_KEY_HEADER,
+    BatchResponseTemplate,
     SingleResponseTemplate,
-    batch_score_payload,
     parse_binary_rows,
     parse_features,
 )
@@ -178,14 +178,14 @@ class FrontendApp:
             trace_id=trace_id,
         )
 
-    def _template_for(self, reply) -> SingleResponseTemplate:
-        key = (reply.model_info, reply.model_date)
+    def _template_for(self, reply, single: bool):
+        key = (reply.model_info, reply.model_date, single)
         template = self._templates.get(key)
         if template is None:
+            cls = SingleResponseTemplate if single else BatchResponseTemplate
             with self._templates_lock:
                 template = self._templates.setdefault(
-                    key,
-                    SingleResponseTemplate(reply.model_info, reply.model_date),
+                    key, cls(reply.model_info, reply.model_date),
                 )
         return template
 
@@ -197,13 +197,16 @@ class FrontendApp:
         if reply.status == 200:
             t0 = time.perf_counter()
             if single:
-                body = self._template_for(reply).render(
+                body = self._template_for(reply, True).render(
                     float(reply.predictions[0])
                 )
             else:
-                body = json.dumps(
-                    batch_score_payload(reply, reply.predictions)
-                ).encode()
+                # same pre-serialized splice on the batch path
+                # (serve.wire.BatchResponseTemplate) — byte-identical
+                # to json.dumps(batch_score_payload(...))
+                body = self._template_for(reply, False).render(
+                    reply.predictions
+                )
             self._m_serialize.observe(time.perf_counter() - t0)
             extra = (
                 ((MODEL_KEY_HEADER, reply.model_key),)

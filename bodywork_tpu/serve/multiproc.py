@@ -134,9 +134,9 @@ def _worker_main(store_path: str, host: str, port: int, engine: str,
         build_admission,
         build_serving_predictor,
     )
-    from bodywork_tpu.store import open_store
+    from bodywork_tpu.store import open_scoped_store
 
-    store = open_store(store_path)
+    store = open_scoped_store(store_path)
     # tuned-config resolution per worker (each loads the store anyway):
     # fitted values fill the knobs the supervisor left unset, explicit
     # values win, malformed degrades (tune/config.py) — every replica
@@ -421,10 +421,12 @@ class MultiProcessService:
         #: (workers still load + validate the pinned document
         #: themselves, with the malformed-degrades contract).
         if tuned_config == "latest":
-            from bodywork_tpu.store import open_store
+            from bodywork_tpu.store import open_scoped_store
             from bodywork_tpu.tune.config import _resolve_ref
 
-            pinned = _resolve_ref(open_store(self.store_path), tuned_config)
+            pinned = _resolve_ref(
+                open_scoped_store(self.store_path), tuned_config
+            )
             # no tuning/ artefacts yet: keep the symbolic ref so the
             # workers log the standard degrade warning themselves
             tuned_config = pinned if pinned is not None else tuned_config
@@ -437,11 +439,11 @@ class MultiProcessService:
             # it here, once, and hands the concrete value down. The
             # dispatcher resolves the dispatcher-scoped knobs
             # (tune.config.DISPATCHER_SCOPED_KNOBS) itself.
-            from bodywork_tpu.store import open_store
+            from bodywork_tpu.store import open_scoped_store
             from bodywork_tpu.tune.config import resolve_serving_knobs
 
             resolved = resolve_serving_knobs(
-                open_store(self.store_path), tuned_config,
+                open_scoped_store(self.store_path), tuned_config,
                 batch_window_ms=None, batch_max_rows=None,
                 buckets=None, max_pending=None,
             )

@@ -28,6 +28,7 @@ import numpy as np
 __all__ = [
     "BINARY_CONTENT_TYPE",
     "MODEL_KEY_HEADER",
+    "BatchResponseTemplate",
     "SingleResponseTemplate",
     "batch_score_payload",
     "encode_binary_rows",
@@ -176,3 +177,39 @@ class SingleResponseTemplate:
         # ~free): float repr, NaN/Infinity spelling, and int-vs-float
         # formatting stay exactly the full-dump path's
         return self.prefix + json.dumps(prediction0).encode() + self.suffix
+
+
+class BatchResponseTemplate:
+    """Pre-serialized framing for the ``/score/v1/batch`` 200 response —
+    :class:`SingleResponseTemplate`'s shape, applied to the batch body.
+
+    Per response only the predictions list and its count vary; the
+    ``model_info``/``model_date`` tail is invariant per served bundle
+    and serializing it per batch is pure rework (it is the largest part
+    of the body for small batches). The predictions themselves still go
+    through ONE ``json.dumps`` C call on a plain float list, so float
+    repr stays exactly the full-dump path's. ``render`` is pinned
+    byte-identical to ``json.dumps(batch_score_payload(served, p))`` by
+    construction and by a regression test sweeping awkward floats and
+    batch sizes.
+    """
+
+    __slots__ = ("prefix", "suffix")
+
+    def __init__(self, model_info, model_date):
+        # json.dumps default separators; insertion order "predictions",
+        # "n", "model_info", "model_date" — exactly batch_score_payload
+        self.prefix = b'{"predictions": '
+        self.suffix = (
+            ", \"model_info\": " + json.dumps(model_info)
+            + ", \"model_date\": " + json.dumps(model_date) + "}"
+        ).encode()
+
+    def render(self, predictions) -> bytes:
+        floats = [float(p) for p in predictions]
+        return (
+            self.prefix
+            + json.dumps(floats).encode()
+            + b', "n": ' + str(len(floats)).encode()
+            + self.suffix
+        )

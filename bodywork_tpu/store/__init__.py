@@ -31,6 +31,8 @@ __all__ = [
     "DelegatingStore",
     "FilesystemStore",
     "ResilientStore",
+    "open_scoped_store",
+    "open_store",
     "schema",
     "DATASETS_PREFIX",
     "MODELS_PREFIX",
@@ -70,3 +72,18 @@ def open_store(url: str) -> ArtefactStore:
     if url.startswith("file://"):
         url = url[len("file://"):]
     return AuditedStore(FilesystemStore(url))
+
+
+def open_scoped_store(url: str) -> ArtefactStore:
+    """:func:`open_store`, then scope to the tenant named by the
+    ``BODYWORK_TPU_TENANT`` environment variable (malformed degrades to
+    the root namespace with a warning — the stages env convention).
+
+    The seam for SPAWNED serving processes (workers, dispatchers,
+    supervisors), which receive their configuration through inherited
+    env rather than flags. CLI entrypoints keep calling
+    :func:`open_store` and apply their own flag-beats-env precedence.
+    """
+    from bodywork_tpu.tenancy.namespace import scoped_store, tenant_from_env
+
+    return scoped_store(open_store(url), tenant_from_env())

@@ -97,8 +97,28 @@ them, so treat the prefix like ``quarantine/``: cheap history whose
 retention is an operator decision. Dumps get a digest sidecar + replica
 via the audit layer (``PUT_SIDECAR_PREFIXES``) so at-rest rot is
 detectable and restorable.
+
+``tenants/`` is the multi-tenant namespace root (``bodywork_tpu/tenancy/``):
+``tenants/<tenant-id>/`` mirrors the ENTIRE schema above for one tenant —
+``tenants/acme/datasets/...``, ``tenants/acme/registry/aliases.json`` and
+so on — so every subsystem (training, registry, journals, snapshots,
+audit sidecars, tuned configs) becomes tenant-aware without learning a
+new key grammar: a tenant-scoped store view (``tenancy.scoped_store``)
+rebases all keys under the tenant prefix and everything else is
+unchanged. The reserved ``default`` tenant is the UNPREFIXED root
+namespace itself — scoping to ``default`` is the identity — which keeps
+every pre-tenancy key byte-identical. Delete safety: ``tenants/<id>/`` is
+one tenant's entire estate — datasets, models, lineage, journals — so
+deleting a subtree is offboarding, not cleanup: it carries exactly the
+union of the per-prefix delete-safety notes above, applied to that
+tenant alone (and, by the namespacing construction, can never touch
+another tenant's keys or the default namespace). The fsck scrubber
+recurses into each tenant subtree with a tenant-scoped view, so per-
+tenant repair is ``cli fsck --tenant <id>``.
 """
 from __future__ import annotations
+
+import re
 
 from datetime import date
 
@@ -125,6 +145,48 @@ QUARANTINE_PREFIX = "quarantine/"
 #: flight-recorder dumps (obs/tracing.py) — diagnostic evidence; see
 #: the module docstring's delete-safety note
 FLIGHTREC_PREFIX = "obs/flightrec/"
+#: multi-tenant namespace root (bodywork_tpu/tenancy/): tenants/<id>/
+#: mirrors the whole schema for one tenant; see the module docstring's
+#: delete-safety note (deleting a subtree is offboarding that tenant)
+TENANTS_PREFIX = "tenants/"
+
+#: the reserved tenant whose namespace IS the unprefixed root — scoping
+#: to it is the identity, keeping every pre-tenancy key byte-identical
+DEFAULT_TENANT = "default"
+
+#: the single source of truth for what a tenant id may look like. DNS-
+#: label-shaped on purpose: lowercase alphanumerics and single interior
+#: hyphens, 1-63 chars, so a tenant id is always safe as a store key
+#: segment, a k8s label value, and a Prometheus label value. The cli
+#: ``--tenant`` flag, the ``BODYWORK_TPU_TENANT`` env knob, and the key
+#: grammar are all guard-pinned to agree with THIS pattern.
+TENANT_ID_PATTERN = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")
+
+
+def validate_tenant_id(tenant_id: str) -> str:
+    """Validate ``tenant_id`` against :data:`TENANT_ID_PATTERN` and
+    return it. Raises ``ValueError`` (with the offending value and the
+    grammar) otherwise — every entry point funnels through here so cli
+    flags, env parsing, and key construction can never disagree."""
+    if not isinstance(tenant_id, str) or not TENANT_ID_PATTERN.match(tenant_id):
+        raise ValueError(
+            f"invalid tenant id {tenant_id!r}: want lowercase DNS-label "
+            "(^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$)"
+        )
+    if "--" in tenant_id:
+        raise ValueError(
+            f"invalid tenant id {tenant_id!r}: consecutive hyphens reserved"
+        )
+    return tenant_id
+
+
+def tenant_prefix(tenant_id: str) -> str:
+    """The store-key prefix rooting ``tenant_id``'s namespace — empty
+    for the reserved :data:`DEFAULT_TENANT` (identity scoping)."""
+    validate_tenant_id(tenant_id)
+    if tenant_id == DEFAULT_TENANT:
+        return ""
+    return f"{TENANTS_PREFIX}{tenant_id}/"
 
 #: every prefix the store schema defines — and therefore every prefix
 #: the integrity scrubber must audit: the fsck checker registry
@@ -145,6 +207,9 @@ ALL_PREFIXES = (
     AUDIT_PREFIX,
     QUARANTINE_PREFIX,
     FLIGHTREC_PREFIX,
+    #: last on purpose: each tenant subtree is audited AFTER the root
+    #: namespace, with a tenant-scoped recursion over the prefixes above
+    TENANTS_PREFIX,
 )
 
 

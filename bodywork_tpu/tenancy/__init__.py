@@ -1,0 +1,31 @@
+"""Multi-tenant model fleet layer.
+
+One device pool serving many pipelines: tenant-namespaced artefact
+lifecycle (:mod:`.namespace`), stacked single-dispatch serving for many
+same-architecture tenants (:mod:`.stacked`), a declarative scenario zoo
+giving each tenant its own data distribution and traffic shape
+(:mod:`.scenarios`), fair round-robin scheduling of per-tenant retrain
+jobs (:mod:`.scheduler`), and the seeded fleet simulation that proves
+zero cross-tenant blast radius under per-tenant chaos (:mod:`.fleet`).
+
+Import discipline: :mod:`.namespace`, :mod:`.scenarios`, and
+:mod:`.scheduler` are jax-free (importable by front-end processes and
+the cli without pulling in a device runtime); :mod:`.stacked` and
+:mod:`.fleet` own the jax-facing pieces. This package ``__init__``
+therefore re-exports only the jax-free surface.
+"""
+from bodywork_tpu.tenancy.namespace import (  # noqa: F401
+    TENANT_ENV,
+    TenantStore,
+    list_tenants,
+    scoped_store,
+    tenant_from_env,
+)
+from bodywork_tpu.tenancy.scenarios import (  # noqa: F401
+    SCENARIOS,
+    TRAFFIC_SHAPES,
+    TenantSpec,
+    traffic_profile,
+    zoo,
+)
+from bodywork_tpu.tenancy.scheduler import FairScheduler  # noqa: F401

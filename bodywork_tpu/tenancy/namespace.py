@@ -1,0 +1,178 @@
+"""Tenant-namespaced store views.
+
+The whole lifecycle becomes multi-tenant through ONE construction: a
+:class:`TenantStore` is a transparent wrapper that rebases every key and
+prefix under ``tenants/<id>/`` (``schema.tenant_prefix``), so training,
+registry, journals, snapshots, audit sidecars, and tuned configs are
+tenant-aware without any of them learning a tenant argument — each
+subsystem keeps speaking the root key grammar against a scoped view.
+
+The reserved ``default`` tenant is the identity: :func:`scoped_store`
+returns the store UNWRAPPED, so the pre-tenancy single-tenant deployment
+is byte-for-byte the default tenant and every existing artefact, test,
+and committed bench record holds unchanged.
+
+Listing stays prefix-bounded: ``list_keys(p)`` on a scoped view maps to
+``list_keys("tenants/<id>/" + p)`` on the backend, so one tenant's
+registry-record listing costs O(records-for-that-tenant) backend work,
+never O(records-ever) across the fleet (op-budget-pinned by
+tests/test_tenancy.py).
+"""
+from __future__ import annotations
+
+import os
+
+from bodywork_tpu.store.base import ArtefactStore, DelegatingStore
+from bodywork_tpu.store.schema import (
+    DEFAULT_TENANT,
+    TENANTS_PREFIX,
+    TENANT_ID_PATTERN,
+    tenant_prefix,
+    validate_tenant_id,
+)
+from bodywork_tpu.utils.logging import get_logger
+
+log = get_logger("tenancy.namespace")
+
+#: pod-environment knob selecting the tenant a stage container works
+#: for — the tenant analogue of ``BODYWORK_TPU_TRAIN_MODE``, parsed with
+#: the same malformed-degrades contract (:func:`tenant_from_env`)
+TENANT_ENV = "BODYWORK_TPU_TENANT"
+
+
+class TenantStore(DelegatingStore):
+    """A store view scoped to one tenant's namespace.
+
+    Every key/prefix is rebased under ``tenants/<id>/`` on the way in
+    and stripped on the way out, so callers see a store that looks
+    exactly like a dedicated single-tenant deployment. Derives from
+    :class:`DelegatingStore` so the backend's ``get_many`` parallelism,
+    CAS protocol, and op instrumentation survive the wrapper.
+
+    ``mutable_cache`` is namespaced per tenant (while still living on
+    the one long-lived backend object): two tenants share logical key
+    names with different content, so a shared parsed-dataset cache
+    would serve one tenant's rows to another.
+    """
+
+    def __init__(self, inner: ArtefactStore, tenant_id: str):
+        super().__init__(inner)
+        self.tenant_id = validate_tenant_id(tenant_id)
+        self._prefix = tenant_prefix(tenant_id)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"TenantStore({self._inner!r}, tenant={self.tenant_id!r})"
+
+    def _rebase(self, key: str) -> str:
+        return f"{self._prefix}{key}"
+
+    def _strip(self, key: str) -> str:
+        return key[len(self._prefix):]
+
+    def put_bytes(self, key: str, data: bytes) -> None:
+        self._inner.put_bytes(self._rebase(key), data)
+
+    def put_bytes_if_match(self, key: str, data: bytes, expected_token=None):
+        return self._inner.put_bytes_if_match(
+            self._rebase(key), data, expected_token
+        )
+
+    def get_bytes(self, key: str) -> bytes:
+        return self._inner.get_bytes(self._rebase(key))
+
+    def list_keys(self, prefix: str = "") -> list[str]:
+        # prefix-bounded on the backend: the tenant-qualified prefix goes
+        # DOWN so the backend walks only this tenant's subtree
+        return [
+            self._strip(k)
+            for k in self._inner.list_keys(self._rebase(prefix))
+        ]
+
+    def delete(self, key: str) -> None:
+        self._inner.delete(self._rebase(key))
+
+    def exists(self, key: str) -> bool:
+        return self._inner.exists(self._rebase(key))
+
+    def get_many(self, keys: list[str]) -> dict[str, bytes]:
+        got = self._inner.get_many([self._rebase(k) for k in keys])
+        return {self._strip(k): v for k, v in got.items()}
+
+    def version_token(self, key: str):
+        return self._inner.version_token(self._rebase(key))
+
+    def version_tokens(self, keys: list[str]) -> dict[str, object]:
+        got = self._inner.version_tokens([self._rebase(k) for k in keys])
+        return {self._strip(k): v for k, v in got.items()}
+
+    def mutable_cache(self, name: str) -> dict:
+        return self._inner.mutable_cache(f"{self._prefix}{name}")
+
+
+def scoped_store(store: ArtefactStore, tenant_id: str) -> ArtefactStore:
+    """``store`` viewed through ``tenant_id``'s namespace.
+
+    The reserved ``default`` tenant returns ``store`` unwrapped — the
+    identity that keeps every pre-tenancy key byte-identical. Scoping an
+    already-scoped view nests (``tenants/a/tenants/b/...``), which the
+    key grammar permits but nothing in the framework produces; callers
+    scope the root store exactly once, at store-open time (``cli
+    --tenant`` / ``BODYWORK_TPU_TENANT``).
+    """
+    validate_tenant_id(tenant_id)
+    if tenant_id == DEFAULT_TENANT:
+        return store
+    return TenantStore(store, tenant_id)
+
+
+def tenant_of(store: ArtefactStore) -> str:
+    """The tenant a store view is scoped to (``default`` for any store
+    that is not a :class:`TenantStore`) — the label value for
+    tenant-labelled metric families."""
+    while store is not None:
+        if isinstance(store, TenantStore):
+            return store.tenant_id
+        store = getattr(store, "_inner", None)
+    return DEFAULT_TENANT
+
+
+def tenant_from_env(environ=None) -> str:
+    """The deployed tenant id from the pod environment (:data:`TENANT_ENV`).
+
+    The k8s stage manifests materialise the tenant as an env var so one
+    image serves every tenant. Malformed values degrade to ``default``
+    with a warning — the same contract as every other env knob
+    (``stages._train_env_mode``): a typo must never crash the pod, and
+    degrading to the default tenant can only ever touch the operator's
+    own root namespace, never another tenant's. Guard-pinned identical
+    to the cli ``--tenant`` validation and the schema key charset by
+    tests/test_tenancy.py.
+    """
+    env = os.environ if environ is None else environ
+    raw = env.get(TENANT_ENV, "").strip()
+    if not raw:
+        return DEFAULT_TENANT
+    try:
+        return validate_tenant_id(raw)
+    except ValueError:
+        log.warning(
+            f"ignoring {TENANT_ENV}={raw!r} "
+            f"(want lowercase DNS-label, pattern {TENANT_ID_PATTERN.pattern})"
+        )
+        return DEFAULT_TENANT
+
+
+def list_tenants(store: ArtefactStore) -> list[str]:
+    """Every tenant id with at least one artefact under ``tenants/``,
+    sorted. Subtrees whose id segment fails validation are skipped (they
+    cannot have been written through :func:`scoped_store`); the
+    ``default`` tenant is NOT listed — its namespace is the root itself,
+    so presence there is not evidence of fleet membership."""
+    seen = set()
+    for key in store.list_keys(TENANTS_PREFIX):
+        segment = key[len(TENANTS_PREFIX):].split("/", 1)[0]
+        if segment in seen:
+            continue
+        if TENANT_ID_PATTERN.match(segment) and "--" not in segment:
+            seen.add(segment)
+    return sorted(seen)

@@ -48,7 +48,9 @@ from bodywork_tpu.serve.rowqueue import (
 )
 from bodywork_tpu.serve.wire import (
     BINARY_CONTENT_TYPE,
+    BatchResponseTemplate,
     SingleResponseTemplate,
+    batch_score_payload,
     encode_binary_rows,
     parse_binary_rows,
     parse_features,
@@ -340,6 +342,36 @@ def test_single_response_template_matches_full_dump():
             assert template.render(p) == json.dumps(
                 single_score_payload(served, p)
             ).encode()
+
+
+def test_batch_response_template_matches_full_dump():
+    """The batch splice is byte-identical to
+    ``json.dumps(batch_score_payload(...))`` over awkward floats, batch
+    sizes (including a single row, where the invariant tail dominates),
+    and awkward bundle identities."""
+    awkward = [
+        25.999998092651367, 0.0, -0.0, 1.5, -3.25, 1e-12, 1e300,
+        float("nan"), float("inf"), float("-inf"), 7.0, 1 / 3,
+    ]
+    batches = [awkward[:1], awkward[:2], awkward, awkward * 6]
+    for info, d in [
+        ("MLPRegressor(hidden=[64, 64])", "2026-07-01"),
+        ('quote"backslash\\', None),
+        ("", "2026-01-01"),
+    ]:
+        template = BatchResponseTemplate(info, d)
+        served = _Bundle(info=info, d=d)
+        for preds in batches:
+            assert template.render(preds) == json.dumps(
+                batch_score_payload(served, preds)
+            ).encode()
+            # numpy scalars must format exactly like the dict path too
+            # (both coerce through float())
+            arr = np.asarray([p for p in preds if p == p], np.float32)
+            if arr.size:
+                assert template.render(arr) == json.dumps(
+                    batch_score_payload(served, arr)
+                ).encode()
 
 
 # --- binary row framing ------------------------------------------------------

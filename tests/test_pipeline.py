@@ -196,6 +196,7 @@ def test_manifests_structure(tmp_path):
     assert kinds == {
         "Namespace": 1, "ConfigMap": 1, "PersistentVolumeClaim": 1,
         "Job": 3, "Deployment": 1, "Service": 1, "CronJob": 4,
+        "HorizontalPodAutoscaler": 1,
     }
     # the second CronJob is the drift GATE: audits each day loop 30 min
     # after it, exits 4 (failed Job = the k8s-native alarm) on
@@ -230,6 +231,18 @@ def test_manifests_structure(tmp_path):
     pod = scrub["spec"]["jobTemplate"]["spec"]["template"]["spec"]
     assert "nodeSelector" not in pod
     assert "limits" not in pod["containers"][0]["resources"]
+    # the serving Deployment carries an HPA scaling on the row-queue's
+    # own saturation signals (occupancy ratio, wait p90) rather than CPU
+    # — see docs/RESILIENCE.md §13
+    hpa = docs["02-stage-2-serve-model-hpa.yaml"]
+    assert hpa["spec"]["scaleTargetRef"]["name"] == hpa["metadata"]["name"]
+    metric_names = [m["pods"]["metric"]["name"] for m in hpa["spec"]["metrics"]]
+    assert metric_names == ["bodywork_tpu_rowqueue_occupancy_ratio",
+                            "bodywork_tpu_rowqueue_wait_seconds_p90"]
+    # asymmetric stabilization: react to a flash crowd in seconds, hold
+    # replicas through a retry-storm tail for minutes
+    assert (hpa["spec"]["behavior"]["scaleUp"]["stabilizationWindowSeconds"]
+            < hpa["spec"]["behavior"]["scaleDown"]["stabilizationWindowSeconds"])
     # default store medium is a ReadWriteMany PVC (multi-node safe): every
     # pod mounts the claim, nothing references the node's own filesystem
     pvc = docs["00-store-pvc.yaml"]

@@ -1,0 +1,688 @@
+"""Multi-tenant fleet: namespacing, stacked serving, scenario zoo, fleet sim.
+
+The tenancy layer's contracts, in the order they compose:
+
+1. ``TenantStore`` rebases every key under ``tenants/<id>/`` and the
+   ``default`` tenant is the identity — the construction that makes the
+   whole lifecycle multi-tenant without any subsystem learning a tenant
+   argument, and keeps every pre-tenancy artefact byte-identical.
+2. Tenant-id validation is ONE function: the cli ``--tenant`` flag, the
+   ``BODYWORK_TPU_TENANT`` env knob, and the store-key charset must
+   accept and reject exactly the same ids (the guard that stops the
+   three from drifting apart).
+3. ``StackedMLPPredictor`` scores N tenants in one dispatch: scan mode
+   byte-identical to each tenant's solo predictor, LRU residency with
+   canary-reserved slots, per-tenant sub-budgets enforced before device
+   work, and residency churn that never compiles (fixed stack shape).
+4. The scenario zoo and fair scheduler are pure functions of their
+   inputs — the determinism the fleet sim's byte-identity proof needs.
+5. Tenant listings stay prefix-bounded on the backend:
+   O(records-per-tenant), never O(records-ever) (CountingStore budget).
+"""
+import json
+
+import numpy as np
+import pytest
+
+from bodywork_tpu.store.schema import (
+    ALL_PREFIXES,
+    DEFAULT_TENANT,
+    REGISTRY_RECORDS_PREFIX,
+    TENANTS_PREFIX,
+    tenant_prefix,
+    validate_tenant_id,
+)
+from bodywork_tpu.tenancy import (
+    SCENARIOS,
+    TRAFFIC_SHAPES,
+    FairScheduler,
+    TenantSpec,
+    TenantStore,
+    list_tenants,
+    scoped_store,
+    tenant_from_env,
+    traffic_profile,
+    zoo,
+)
+from bodywork_tpu.tenancy.namespace import TENANT_ENV, tenant_of
+from bodywork_tpu.tenancy.stacked import (
+    DEFAULT_STACK_BUCKETS,
+    STACK_MODES,
+    StackedMLPPredictor,
+    StackNotCompatible,
+    TenantNotResident,
+    TenantOverBudget,
+)
+from tests.helpers import make_counting_store, make_memory_store
+
+
+# --- namespacing ------------------------------------------------------------
+
+
+def test_tenant_store_rebases_every_op():
+    """Every read/write/list/token op lands under ``tenants/<id>/`` on
+    the backend while the scoped caller sees bare root-grammar keys."""
+    backend = make_memory_store()
+    view = scoped_store(backend, "acme")
+    assert isinstance(view, TenantStore)
+
+    view.put_bytes("datasets/2026-01-01.csv", b"x,y\n1,2\n")
+    assert backend.list_keys() == ["tenants/acme/datasets/2026-01-01.csv"]
+    assert view.list_keys() == ["datasets/2026-01-01.csv"]
+    assert view.get_bytes("datasets/2026-01-01.csv") == b"x,y\n1,2\n"
+    assert view.exists("datasets/2026-01-01.csv")
+    assert not backend.exists("datasets/2026-01-01.csv")
+
+    got = view.get_many(["datasets/2026-01-01.csv"])
+    assert got == {"datasets/2026-01-01.csv": b"x,y\n1,2\n"}
+    toks = view.version_tokens(["datasets/2026-01-01.csv"])
+    assert set(toks) == {"datasets/2026-01-01.csv"}
+    assert toks["datasets/2026-01-01.csv"] == view.version_token(
+        "datasets/2026-01-01.csv"
+    )
+
+    view.delete("datasets/2026-01-01.csv")
+    assert backend.list_keys() == []
+
+
+def test_default_tenant_is_identity():
+    """``scoped_store(store, "default")`` IS the store — the pre-tenancy
+    deployment and the default tenant are the same bytes."""
+    backend = make_memory_store()
+    assert scoped_store(backend, DEFAULT_TENANT) is backend
+    assert tenant_of(backend) == DEFAULT_TENANT
+    assert tenant_prefix(DEFAULT_TENANT) == ""
+    assert tenant_prefix("acme") == "tenants/acme/"
+
+
+def test_two_tenants_share_key_names_not_content():
+    backend = make_memory_store()
+    a = scoped_store(backend, "acme")
+    b = scoped_store(backend, "bravo")
+    a.put_bytes("registry/aliases.json", b'{"production": "a"}')
+    b.put_bytes("registry/aliases.json", b'{"production": "b"}')
+    assert a.get_bytes("registry/aliases.json") != b.get_bytes(
+        "registry/aliases.json"
+    )
+    # the parsed-artefact cache is namespaced too: a shared cache would
+    # serve one tenant's rows to another
+    a.mutable_cache("parsed")["k"] = "from-a"
+    assert "k" not in b.mutable_cache("parsed")
+    assert "k" not in backend.mutable_cache("parsed")
+
+
+def test_tenant_of_walks_wrapper_chain():
+    from bodywork_tpu.store.base import DelegatingStore
+
+    backend = make_memory_store()
+    view = scoped_store(backend, "acme")
+    assert tenant_of(view) == "acme"
+    assert tenant_of(DelegatingStore(view)) == "acme"
+    assert tenant_of(DelegatingStore(backend)) == DEFAULT_TENANT
+
+
+def test_list_tenants_skips_invalid_segments():
+    backend = make_memory_store()
+    scoped_store(backend, "bravo").put_bytes("a.txt", b"1")
+    scoped_store(backend, "acme").put_bytes("a.txt", b"1")
+    # an out-of-band write with an invalid id segment cannot have come
+    # through scoped_store; the listing skips it rather than propagating
+    backend.put_bytes(f"{TENANTS_PREFIX}Bad_Tenant/a.txt", b"1")
+    assert list_tenants(backend) == ["acme", "bravo"]
+    # default is never listed: its namespace is the root itself
+    backend.put_bytes("datasets/2026-01-01.csv", b"x,y\n")
+    assert "default" not in list_tenants(backend)
+
+
+# --- validation: one source of truth (cli flag == env == key charset) -------
+
+
+@pytest.mark.parametrize(
+    "candidate, valid",
+    [
+        ("acme", True),
+        ("tenant-00", True),
+        ("a", True),
+        ("0numeric-start", True),
+        ("a" * 63, True),
+        ("", False),
+        ("Upper", False),
+        ("under_score", False),
+        ("-leading", False),
+        ("trailing-", False),
+        ("dou--ble", False),  # reserved: keeps ids prefix-unambiguous
+        ("a" * 64, False),
+        ("dots.not.ok", False),
+        ("slash/attack", False),
+        ("../escape", False),
+    ],
+)
+def test_tenant_validation_single_source_of_truth(candidate, valid):
+    """The schema charset, the cli ``--tenant`` flag, and the env knob
+    accept/reject EXACTLY the same ids. The flag fails loudly; the env
+    degrades to default — but both decide via ``validate_tenant_id``."""
+    from types import SimpleNamespace
+
+    from bodywork_tpu.cli import _tenant_id
+
+    if valid:
+        assert validate_tenant_id(candidate) == candidate
+        assert _tenant_id(SimpleNamespace(tenant=candidate)) == candidate
+        assert tenant_from_env({TENANT_ENV: candidate}) == candidate
+    else:
+        with pytest.raises(ValueError):
+            validate_tenant_id(candidate)
+        # empty flag/env means "unset", not "invalid"
+        if candidate:
+            with pytest.raises(ValueError):
+                _tenant_id(SimpleNamespace(tenant=candidate))
+        assert tenant_from_env({TENANT_ENV: candidate}) == DEFAULT_TENANT
+
+
+def test_tenant_env_unset_is_default():
+    assert tenant_from_env({}) == DEFAULT_TENANT
+    assert tenant_from_env({TENANT_ENV: "  "}) == DEFAULT_TENANT
+
+
+def test_tenants_prefix_is_schema_covered():
+    """``tenants/`` is part of the key schema (fsck scrubs it; delete
+    tooling sees it as one tenant's entire estate)."""
+    assert TENANTS_PREFIX in ALL_PREFIXES
+    from bodywork_tpu.audit.fsck import CHECKERS
+
+    assert TENANTS_PREFIX in CHECKERS
+
+
+def test_every_store_command_grows_a_tenant_flag():
+    """The post-build parser walk gives EVERY store-opening (sub)command
+    a ``--tenant`` flag — a new command cannot forget it."""
+    import argparse
+
+    from bodywork_tpu.cli import build_parser
+
+    def walk(parser):
+        yield parser
+        for action in parser._actions:
+            if isinstance(action, argparse._SubParsersAction):
+                seen = set()
+                for child in action.choices.values():
+                    if id(child) not in seen:
+                        seen.add(id(child))
+                        yield from walk(child)
+
+    with_store = 0
+    for p in walk(build_parser()):
+        options = {s for a in p._actions for s in a.option_strings}
+        if "--store" in options:
+            with_store += 1
+            assert "--tenant" in options
+    assert with_store >= 10  # the walk actually visited the tree
+
+
+def test_cli_rejects_malformed_tenant_flag(tmp_path):
+    """A typo'd ``--tenant`` must fail the command loudly — silently
+    operating on the root namespace would be a cross-tenant write."""
+    from bodywork_tpu.cli import main
+
+    assert main(
+        ["fsck", "--store", str(tmp_path / "s"), "--tenant", "Bad_Id"]
+    ) == 1
+
+
+def test_tenancy_metric_names_pass_lint():
+    """Every tenant metric family registers cleanly (name lint runs at
+    registration) — and the catalogue/docs sync is pinned by
+    test_obs.py's divergence guard."""
+    from bodywork_tpu.obs.registry import METRIC_NAME_RE
+    from bodywork_tpu.tenancy.stacked import _tenancy_metrics
+
+    instruments = _tenancy_metrics()
+    assert len(instruments) == 5
+    for inst in instruments:
+        assert METRIC_NAME_RE.match(inst.name), inst.name
+
+
+# --- scenario zoo and fair scheduler ----------------------------------------
+
+
+def test_tenant_spec_validates_its_fields():
+    with pytest.raises(ValueError):
+        TenantSpec(tenant_id="Bad_Id")
+    with pytest.raises(ValueError):
+        TenantSpec(tenant_id="ok", scenario="mystery")
+    with pytest.raises(ValueError):
+        TenantSpec(tenant_id="ok", traffic="tsunami")
+
+
+def test_tenant_seeds_deterministic_and_distinct():
+    a1 = TenantSpec(tenant_id="acme", base_seed=42)
+    a2 = TenantSpec(tenant_id="acme", base_seed=42)
+    b = TenantSpec(tenant_id="bravo", base_seed=42)
+    assert a1.seed == a2.seed
+    assert a1.seed != b.seed
+    assert a1.seed != TenantSpec(tenant_id="acme", base_seed=43).seed
+    # the derived generator config is a pure function of the spec —
+    # fleet run and solo twin generate byte-identical datasets from it
+    assert a1.drift_config() == a2.drift_config()
+    configs = {
+        s: TenantSpec(tenant_id="acme", scenario=s).drift_config()
+        for s in SCENARIOS
+    }
+    assert configs["baseline"] == configs["label-delay"]  # delay is scheduling
+    assert configs["covariate-shift"].x_low > configs["baseline"].x_low
+    assert configs["heteroscedastic"].hetero > 0.0
+
+
+def test_traffic_profiles_are_shaped_and_deterministic():
+    n = 40
+    steady = TenantSpec(tenant_id="acme", traffic="steady")
+    assert set(traffic_profile(steady, n)) == {100.0}
+
+    flash = TenantSpec(tenant_id="acme", traffic="flash-crowd", burst_x=4.0)
+    prof = traffic_profile(flash, n)
+    assert prof == traffic_profile(flash, n)  # replayable
+    assert prof.count(400.0) == max(1, int(n * 0.15))
+    assert set(prof) == {100.0, 400.0}
+
+    storm = TenantSpec(tenant_id="acme", traffic="retry-storm", burst_x=4.0)
+    sp = traffic_profile(storm, n)
+    trigger = n // 3
+    assert set(sp[:trigger]) == {100.0}
+    assert sp[trigger] == 400.0
+    # geometric decay of the excess: strictly decreasing back toward base
+    assert all(sp[i] > sp[i + 1] for i in range(trigger, n - 1))
+    assert sp[-1] < 110.0
+
+    diurnal = TenantSpec(tenant_id="acme", traffic="diurnal")
+    dp = traffic_profile(diurnal, n)
+    assert max(dp) > 100.0 > min(dp)
+    assert abs(sum(dp) / n - 100.0) < 2.0
+
+
+def test_zoo_cycles_the_catalogues():
+    specs = zoo(len(SCENARIOS), base_seed=7)
+    assert [s.scenario for s in specs] == list(SCENARIOS)
+    assert specs[0].tenant_id == "tenant-00"
+    assert specs[0].scenario == "baseline" and specs[0].traffic == "steady"
+    for s in specs:
+        assert s.traffic in TRAFFIC_SHAPES
+        if s.scenario == "label-delay":
+            assert s.effective_label_delay >= 1
+        else:
+            assert s.effective_label_delay == 0
+
+
+def test_fair_scheduler_rotates_the_head():
+    sched = FairScheduler()
+    tenants = ["c", "a", "b"]
+    heads = [sched.order(tenants)[0] for _ in range(6)]
+    # over any N-tick window each tenant goes first exactly once — no
+    # tenant's retrain systematically lands last
+    assert heads == ["a", "b", "c", "a", "b", "c"]
+    for _ in range(3):
+        out = sched.order(tenants)
+        assert sorted(out) == ["a", "b", "c"]  # each served exactly once
+    assert sched.order([]) == []
+    # peek shows without advancing
+    nxt = sched.peek(tenants)
+    assert sched.order(tenants) == nxt
+    # a tenant admitted mid-flight joins in sorted position
+    assert set(sched.order(tenants + ["d"])) == {"a", "b", "c", "d"}
+
+
+# --- stacked multi-tenant serving -------------------------------------------
+
+
+def _train_mlps(n, hidden=(8,), n_steps=25):
+    from bodywork_tpu.models.mlp import MLPConfig, MLPRegressor
+
+    rng = np.random.default_rng(0)
+    X = rng.uniform(0.0, 100.0, size=(96, 1)).astype(np.float32)
+    models = []
+    for i in range(n):
+        y = (1.5 + 0.1 * i) * X[:, 0] + rng.normal(0, 2.0, size=96)
+        models.append(
+            MLPRegressor(
+                MLPConfig(hidden=hidden, n_steps=n_steps, seed=100 + i)
+            ).fit(X, y.astype(np.float32))
+        )
+    return models
+
+
+@pytest.fixture(scope="module")
+def fleet_models():
+    return _train_mlps(5)
+
+
+def test_stacked_scan_byte_identical_to_solo(fleet_models):
+    """Scan mode's acceptance bar: each tenant's rows through the
+    stacked dispatch produce EXACTLY the solo predictor's bytes — the
+    property every cross-tenant isolation proof leans on."""
+    from bodywork_tpu.serve.predictor import PaddedPredictor
+
+    stack = StackedMLPPredictor(capacity=4, buckets=(8, 64))
+    tenants = [f"t-{i}" for i in range(3)]
+    for tid, model in zip(tenants, fleet_models):
+        stack.admit(tid, model)
+
+    rng = np.random.default_rng(7)
+    batches = {
+        tid: rng.uniform(0, 100, size=(5 + 3 * i, 1)).astype(np.float32)
+        for i, tid in enumerate(tenants)
+    }
+    out = stack.predict_multi(batches)
+    for tid, model in zip(tenants, fleet_models):
+        solo = PaddedPredictor(model, buckets=(8, 64)).predict(batches[tid])
+        np.testing.assert_array_equal(
+            np.asarray(out[tid]).ravel(), np.asarray(solo).ravel()
+        )
+
+
+def test_stacked_vmap_close_but_opt_in(fleet_models):
+    """vmap mode is the batched-GEMM form: numerically close to solo,
+    not bit-exact (different reduction order) — which is exactly why
+    scan is the default."""
+    assert STACK_MODES == ("scan", "vmap")
+    assert StackedMLPPredictor(capacity=2).stack_mode == "scan"
+    from bodywork_tpu.serve.predictor import PaddedPredictor
+
+    stack = StackedMLPPredictor(capacity=2, buckets=(8,), stack_mode="vmap")
+    stack.admit("t-0", fleet_models[0])
+    X = np.linspace(0, 100, 8, dtype=np.float32)[:, None]
+    got = np.asarray(stack.predict("t-0", X)).ravel()
+    solo = np.asarray(PaddedPredictor(fleet_models[0], buckets=(8,)).predict(X))
+    np.testing.assert_allclose(got, solo.ravel(), rtol=1e-4)
+
+
+def test_stacked_lru_eviction_under_pressure(fleet_models):
+    stack = StackedMLPPredictor(capacity=2, buckets=(8,))
+    m = fleet_models
+    stack.admit("t-a", m[0])
+    stack.admit("t-b", m[1])
+    assert stack.resident() == ("t-a", "t-b")
+    # dispatch touches LRU order: t-a becomes most recent
+    stack.predict("t-a", np.ones((2, 1), np.float32))
+    stack.admit("t-c", m[2])  # full: evicts LRU-oldest = t-b
+    assert stack.resident() == ("t-a", "t-c")
+    assert not stack.is_resident("t-b")
+    # re-admitting a resident refreshes in place, no eviction
+    stack.admit("t-a", m[0])
+    assert set(stack.resident()) == {"t-a", "t-c"}
+    stack.evict("t-c")
+    assert stack.resident() == ("t-a",)
+    stack.evict("t-c")  # idempotent
+
+
+def test_stacked_canary_slots_are_reserved(fleet_models):
+    """Regular admission pressure can never evict an in-flight canary:
+    the two classes evict only within their own slot budget."""
+    m = fleet_models
+    stack = StackedMLPPredictor(capacity=3, buckets=(8,), canary_slots=1)
+    stack.admit("canary-x", m[0], canary=True)
+    stack.admit("t-a", m[1])
+    stack.admit("t-b", m[2])
+    stack.admit("t-c", m[3])  # regular slots full: evicts t-a, NOT the canary
+    assert stack.is_resident("canary-x")
+    assert not stack.is_resident("t-a")
+    # a second canary evicts within the canary class
+    stack.admit("canary-y", m[4], canary=True)
+    assert not stack.is_resident("canary-x")
+    assert stack.is_resident("canary-y")
+    with pytest.raises(ValueError):
+        StackedMLPPredictor(capacity=2, canary_slots=2)  # no regular slot left
+
+
+def test_stacked_admission_budget_enforced_before_dispatch(fleet_models):
+    stack = StackedMLPPredictor(capacity=2, buckets=(8,), row_budget=4)
+    stack.admit("t-a", fleet_models[0])
+    with pytest.raises(TenantNotResident):
+        stack.predict("ghost", np.ones((2, 1), np.float32))
+    before = stack._obs()[1].value()
+    with pytest.raises(TenantOverBudget):
+        stack.predict_multi({
+            "t-a": np.ones((5, 1), np.float32),  # 5 > budget 4
+        })
+    # budget enforcement happened BEFORE any device work
+    assert stack._obs()[1].value() == before
+    stack.predict("t-a", np.ones((4, 1), np.float32))  # at budget: fine
+
+
+def test_stacked_same_arch_only(fleet_models):
+    from bodywork_tpu.models import LinearRegressor
+
+    stack = StackedMLPPredictor(capacity=2, buckets=(8,))
+    stack.admit("t-a", fleet_models[0])
+    X = np.linspace(0, 10, 8, dtype=np.float32)
+    with pytest.raises(StackNotCompatible):
+        stack.admit("t-lin", LinearRegressor().fit(X, 2 * X))
+    with pytest.raises(StackNotCompatible):
+        stack.admit("t-wide", _train_mlps(1, hidden=(16,), n_steps=5)[0])
+
+
+def test_stacked_rejects_unfitted_model():
+    """fit() returns a NEW fitted model; admitting the unfitted receiver
+    (params=None) must fail loudly instead of silently occupying no slot
+    and breaking warmup with a misleading not-resident error."""
+    from bodywork_tpu.models.mlp import MLPConfig, MLPRegressor
+
+    stack = StackedMLPPredictor(capacity=2, buckets=(8,))
+    with pytest.raises(StackNotCompatible, match="unfitted"):
+        stack.admit("t-a", MLPRegressor(MLPConfig(hidden=(8,))))
+
+
+def test_residency_churn_never_compiles(fleet_models):
+    """ISSUE 17 acceptance: the stack's executables are lowered at the
+    FIXED ``[capacity, bucket, features]`` shape, so eviction and
+    re-admission are pure data movement — zero new compiles, even for a
+    tenant the stack has never seen."""
+    from bodywork_tpu.serve.predictor import EXECUTABLE_CACHE
+
+    stack = StackedMLPPredictor(capacity=2, buckets=(8, 64))
+    stack.admit("t-0", fleet_models[0])
+    stack.admit("t-1", fleet_models[1])
+    stack.warmup()
+    misses_before = EXECUTABLE_CACHE.misses  # AFTER warmup: the baseline
+    X = np.ones((3, 1), np.float32)
+    stack.predict_multi({"t-0": X, "t-1": X})
+    stack.evict("t-1")
+    stack.admit("t-2", fleet_models[2])  # never seen before
+    stack.admit("t-3", fleet_models[3])  # evicts t-0
+    stack.predict_multi({"t-2": X, "t-3": X * 2})
+    stack.predict("t-2", np.ones((40, 1), np.float32))  # second bucket
+    assert EXECUTABLE_CACHE.misses == misses_before
+    assert DEFAULT_STACK_BUCKETS == (8, 64, 512)
+
+
+def test_stacked_nan_sabotage_is_isolated(fleet_models):
+    """The serving blast-radius proof: a tenant whose params are NaN-
+    poisoned (the chaos checkpoint fault) yields NaN for ITS rows only —
+    every other tenant's predictions stay byte-identical to before the
+    sabotage. In scan mode each slot runs the solo scalar program, so
+    cross-slot contamination is structurally impossible."""
+    import jax
+
+    from bodywork_tpu.models.mlp import MLPRegressor
+
+    stack = StackedMLPPredictor(capacity=3, buckets=(8,))
+    tenants = ["t-a", "t-b", "t-c"]
+    for tid, model in zip(tenants, fleet_models):
+        stack.admit(tid, model)
+    X = np.linspace(0, 100, 6, dtype=np.float32)[:, None]
+    healthy = {t: np.asarray(stack.predict(t, X)).copy() for t in tenants}
+    for t in tenants:
+        assert np.all(np.isfinite(healthy[t]))
+
+    poisoned_params = jax.tree_util.tree_map(
+        lambda leaf: np.full_like(np.asarray(leaf), np.nan),
+        fleet_models[1].params,
+    )
+    stack.admit("t-b", MLPRegressor(fleet_models[1].config, poisoned_params))
+    out = stack.predict_multi({t: X for t in tenants})
+    assert np.all(np.isnan(np.asarray(out["t-b"])))
+    np.testing.assert_array_equal(np.asarray(out["t-a"]), healthy["t-a"])
+    np.testing.assert_array_equal(np.asarray(out["t-c"]), healthy["t-c"])
+
+
+# --- prefix-bounded listings (the op-budget contract) ------------------------
+
+
+def test_tenant_listing_is_prefix_bounded():
+    """One tenant's registry listing costs O(records-for-that-tenant)
+    backend work: the tenant-qualified prefix goes DOWN to the backend
+    (one bounded list_keys), never 'list everything and filter'."""
+    backend = make_counting_store(make_memory_store())
+    a = scoped_store(backend, "acme")
+    b = scoped_store(backend, "bravo")
+    for i in range(3):
+        a.put_bytes(f"{REGISTRY_RECORDS_PREFIX}2026-01-0{i + 1}.json", b"{}")
+    for i in range(7):
+        b.put_bytes(f"{REGISTRY_RECORDS_PREFIX}2026-01-0{i + 1}.json", b"{}")
+
+    backend.reset_counts()
+    hist = a.history(REGISTRY_RECORDS_PREFIX)
+    assert len(hist) == 3  # acme's records only, never bravo's
+    assert backend.ops == {"list_keys": 1}
+    assert backend.by_key == {
+        ("list_keys", f"tenants/acme/{REGISTRY_RECORDS_PREFIX}"): 1
+    }
+
+
+# --- fsck recursion into tenant subtrees -------------------------------------
+
+
+def test_fsck_scrubs_tenant_subtrees(tmp_path):
+    """Root fsck recurses into every tenant's namespace with a scoped
+    view: a truncated model inside ``tenants/acme/`` surfaces as a
+    rebased finding; repair stays per-tenant (root scrub reports only)."""
+    from bodywork_tpu.audit.fsck import run_fsck
+    from bodywork_tpu.store import FilesystemStore
+
+    backend = FilesystemStore(tmp_path / "s")
+    acme = scoped_store(backend, "acme")
+    acme.put_bytes("models/regressor-2026-01-01.joblib", b"truncated")
+    report = run_fsck(backend)
+    rebased = [
+        f for f in report["findings"]
+        if f["key"].startswith("tenants/acme/models/")
+    ]
+    assert rebased, report["findings"]
+    assert all(f["prefix"] == TENANTS_PREFIX for f in rebased)
+    assert all("[tenant acme]" in f["detail"] for f in rebased)
+    # the SAME fault found in-scope carries its normal key and prefix
+    scoped_report = run_fsck(acme)
+    assert any(
+        f["key"] == "models/regressor-2026-01-01.joblib"
+        for f in scoped_report["findings"]
+    )
+    # a subtree whose id segment cannot have come from scoped_store
+    backend.put_bytes(f"{TENANTS_PREFIX}Bad_Id/x.txt", b"1")
+    report2 = run_fsck(backend)
+    assert any(
+        f["problem"] == "invalid_tenant_id" for f in report2["findings"]
+    )
+
+
+# --- the fleet sim -----------------------------------------------------------
+
+
+def _fast_zoo(n, days_samples=64):
+    return tuple(
+        TenantSpec(
+            tenant_id=f"tenant-{i:02d}",
+            scenario=SCENARIOS[i % 3],  # skip label-delay: keep days equal
+            base_seed=11,
+            n_samples=days_samples,
+        )
+        for i in range(n)
+    )
+
+
+def test_fleet_sim_byte_identical_to_solo_twins(tmp_path):
+    """Two tenants interleaved in ONE shared store match their dedicated
+    solo-store twins byte for byte — no leak through shared caches,
+    scheduler order, or key scoping."""
+    from bodywork_tpu.tenancy.fleet import run_fleet_sim
+
+    summary = run_fleet_sim(
+        tmp_path, _d(2026, 3, 2), days=2, specs=_fast_zoo(2),
+    )
+    assert summary["ok"], summary
+    assert set(summary["comparisons"]) == {"tenant-00", "tenant-01"}
+    for c in summary["comparisons"].values():
+        assert c["ok"] and not c["mismatched"]
+
+
+def test_fleet_sim_sabotage_zero_blast_radius(tmp_path):
+    """ISSUE 17 acceptance: NaN-poison one tenant's final training day —
+    its registry gate must REJECT the candidate and hold production on
+    the prior healthy model, while every OTHER tenant stays
+    byte-identical to its solo twin."""
+    from bodywork_tpu.tenancy.fleet import run_fleet_sim
+
+    summary = run_fleet_sim(
+        tmp_path, _d(2026, 3, 2), days=2, specs=_fast_zoo(3),
+        sabotage_tenant="tenant-01",
+    )
+    assert summary["gate_rejected"] is True
+    assert summary["production_held"] is True
+    assert set(summary["comparisons"]) == {"tenant-00", "tenant-02"}
+    for c in summary["comparisons"].values():
+        assert c["ok"], c
+    assert summary["ok"], summary
+
+
+def test_fleet_sim_refuses_unknown_sabotage_and_dirty_store(tmp_path):
+    from bodywork_tpu.tenancy.fleet import run_fleet_sim
+
+    with pytest.raises(ValueError, match="not in the fleet"):
+        run_fleet_sim(
+            tmp_path, _d(2026, 3, 2), 1, _fast_zoo(1),
+            sabotage_tenant="ghost",
+        )
+    (tmp_path / "fleet").mkdir()
+    (tmp_path / "fleet" / "stale.txt").write_text("x")
+    with pytest.raises(ValueError, match="already holds artefacts"):
+        run_fleet_sim(tmp_path, _d(2026, 3, 2), 1, _fast_zoo(1))
+
+
+def _d(y, m, d):
+    from datetime import date
+
+    return date(y, m, d)
+
+
+# --- cli wiring --------------------------------------------------------------
+
+
+def test_cli_store_scopes_by_flag_and_env(tmp_path, monkeypatch):
+    from types import SimpleNamespace
+
+    from bodywork_tpu.cli import _store
+
+    args = SimpleNamespace(store=str(tmp_path / "s"), tenant="acme")
+    view = _store(args)
+    assert isinstance(view, TenantStore) and view.tenant_id == "acme"
+    # flag wins over env; env is the soft default; default = unwrapped
+    monkeypatch.setenv(TENANT_ENV, "bravo")
+    assert _store(args).tenant_id == "acme"
+    args.tenant = None
+    assert _store(args).tenant_id == "bravo"
+    monkeypatch.delenv(TENANT_ENV)
+    assert not isinstance(_store(args), TenantStore)
+
+
+def test_cli_fleet_sim_smoke(tmp_path, capsys):
+    """The operator surface end to end: ``fleet-sim`` runs the zoo fleet
+    + twins and exits 0 with a per-tenant verdict table."""
+    from bodywork_tpu.cli import main
+
+    rc = main([
+        "fleet-sim", "--store", str(tmp_path / "zoo"),
+        "--date", "2026-03-02", "--days", "1", "--tenants", "2",
+        "--samples-per-day", "48", "--json",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    doc = json.loads(out)
+    assert doc["ok"] is True
+    assert doc["tenants"] == ["tenant-00", "tenant-01"]
