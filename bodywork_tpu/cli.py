@@ -194,6 +194,55 @@ def _env_number(name: str, cast, minimum):
     return value
 
 
+def _serve_dispatcher_role(args, transport: str, watch, batch_window) -> int:
+    """``serve --role dispatcher``: the device-owning half of the
+    cross-host split, standalone — serves the socket row-queue instead
+    of HTTP (the k8s dispatcher Deployment's entrypoint). No supervisor
+    wraps it here; the Deployment's restartPolicy is the respawn loop,
+    and the front-ends' reconnect backoff is the heal path."""
+    from bodywork_tpu.serve.dispatch import dispatcher_main
+    from bodywork_tpu.serve.netqueue import (
+        DEFAULT_DISPATCHER_PORT,
+        parse_dispatcher_addr,
+    )
+
+    if transport == "shm":
+        log.error("--role dispatcher needs --transport tcp or unix "
+                  "(remote front-ends cannot attach to this process's "
+                  "shared memory)")
+        return 1
+    addr = args.dispatcher_addr
+    if not addr:
+        if transport == "unix":
+            log.error("--role dispatcher with --transport unix needs "
+                      "--dispatcher-addr (the socket path to bind)")
+            return 1
+        # tcp default: every interface on the well-known port, which is
+        # what the dispatcher k8s Service targets
+        addr = f"0.0.0.0:{DEFAULT_DISPATCHER_PORT}"
+    try:
+        parsed = parse_dispatcher_addr(transport, addr)
+    except ValueError as exc:
+        log.error(str(exc))
+        return 1
+    # dispatcher_main installs its own SIGTERM -> clean-exit handler
+    # (it is the same entrypoint the fleet supervisor spawns), so no
+    # graceful_sigterm wrapper here
+    dispatcher_main(
+        args.store, None, None,
+        engine=args.engine,
+        watch_interval_s=watch,
+        buckets=args.buckets,
+        batch_window_ms=batch_window,
+        batch_max_rows=args.batch_max_rows,
+        dtype=args.dtype,
+        tuned_config=args.tuned_config,
+        transport=transport,
+        dispatcher_addr=parsed,
+    )
+    return 0
+
+
 def cmd_serve(args) -> int:
     from bodywork_tpu.utils.shutdown import (
         SIGTERM_EXIT,
@@ -218,12 +267,33 @@ def cmd_serve(args) -> int:
             "request coalescing stays OFF"
         )
     frontends = getattr(args, "frontends", None)
+    transport = getattr(args, "transport", "shm")
+    role = getattr(args, "role", "auto")
     if frontends is not None and frontends >= 1 and args.workers > 1:
         # two incompatible scale-out topologies: replicas each own a
         # model; front-ends share the one dispatcher's
         log.error("--frontends and --workers are mutually exclusive "
                   "scale-out modes; pick one")
         return 1
+    if transport != "shm" and role == "auto" and not frontends:
+        # the socket transports carry the front-end -> dispatcher
+        # handoff; --workers replicas have no such handoff to move
+        log.error("--transport tcp/unix requires --frontends N "
+                  "(or a split --role)")
+        return 1
+    if role == "dispatcher":
+        return _serve_dispatcher_role(args, transport, watch, batch_window)
+    if role == "frontend":
+        if transport == "shm":
+            log.error("--role frontend needs --transport tcp or unix "
+                      "(a remote dispatcher is not reachable over "
+                      "shared memory)")
+            return 1
+        if not args.dispatcher_addr:
+            log.error("--role frontend needs --dispatcher-addr "
+                      "(the dispatcher Service/host to connect to)")
+            return 1
+        frontends = frontends or 1
     if (args.workers and args.workers > 1) or (
         frontends is not None and frontends >= 1
     ):
@@ -253,6 +323,9 @@ def cmd_serve(args) -> int:
             dtype=args.dtype,
             tuned_config=args.tuned_config,
             frontends=frontends,
+            transport=transport,
+            dispatcher_addr=getattr(args, "dispatcher_addr", None),
+            external_dispatcher=(role == "frontend"),
         ).start()
         if svc.metrics_url:
             log.info(f"aggregated metrics at {svc.metrics_url}")
@@ -454,6 +527,7 @@ def cmd_traffic_run(args) -> int:
             args.url, requests, timeout_s=args.timeout,
             results_log=args.results_out,
             transport_kind=getattr(args, "transport", "json"),
+            shards=getattr(args, "shards", 1),
         )
         print(format_report(report))
         return 0
@@ -1668,6 +1742,53 @@ def build_parser() -> argparse.ArgumentParser:
              "serve Deployment materialises (docs/PERF.md §config 14)",
     )
     p.add_argument(
+        # choices hardcoded to keep parser construction import-light;
+        # pinned == serve.netqueue.SERVE_TRANSPORTS (and the stages
+        # env-knob parser) by tests/test_netqueue.py
+        "--transport", default=_env_choice(
+            "BODYWORK_TPU_SERVE_TRANSPORT", ("shm", "tcp", "unix"), "shm"
+        ),
+        choices=["shm", "tcp", "unix"],
+        help="row-queue transport between the front-ends and the "
+             "dispatcher (--frontends mode only): 'shm' (default — "
+             "shared memory, one host; env BODYWORK_TPU_SERVE_TRANSPORT "
+             "overrides), 'unix' (domain socket, one host), or 'tcp' "
+             "(cross-host: the split k8s Deployments' transport). "
+             "Admission/shed semantics and response bytes are identical "
+             "across all three (docs/PERF.md §config 16)",
+    )
+    p.add_argument(
+        "--dispatcher-addr", default=(
+            os.environ.get(
+                "BODYWORK_TPU_DISPATCHER_ADDR", ""
+            ).strip() or None
+        ), metavar="ADDR",
+        help="where the dispatcher's row-queue listener lives for the "
+             "socket transports: host:port for tcp (the dispatcher "
+             "k8s Service), a filesystem path for unix (env "
+             "BODYWORK_TPU_DISPATCHER_ADDR overrides). Default: "
+             "auto-picked on loopback / a temp path when both halves "
+             "run under this process (--role auto); REQUIRED for the "
+             "split roles",
+    )
+    p.add_argument(
+        # choices hardcoded like --transport; pinned ==
+        # serve.netqueue.SERVE_ROLES by tests/test_netqueue.py
+        "--role", default=_env_choice(
+            "BODYWORK_TPU_SERVE_ROLE",
+            ("auto", "frontend", "dispatcher"), "auto",
+        ),
+        choices=["auto", "frontend", "dispatcher"],
+        help="which half of the disaggregated split this process runs "
+             "(env BODYWORK_TPU_SERVE_ROLE overrides): 'auto' (default) "
+             "runs both halves locally; 'frontend' runs only the "
+             "parse/admission fleet against a remote dispatcher at "
+             "--dispatcher-addr; 'dispatcher' runs only the "
+             "device-owning scorer, serving the socket row-queue "
+             "instead of HTTP — the two halves the split k8s "
+             "Deployments run (docs/RESILIENCE.md §14)",
+    )
+    p.add_argument(
         "--buckets", default=None, metavar="N[,N...]", type=_bucket_list,
         help="comma-separated request-size buckets to compile and warm "
              "(positive integers; narrows startup cost when request "
@@ -2245,6 +2366,14 @@ def build_parser() -> argparse.ArgumentParser:
              "(application/x-bodywork-rows) both serving engines "
              "accept — a json-vs-binary pair isolates JSON "
              "parse/format cost from everything else",
+    )
+    p.add_argument(
+        "--shards", type=_positive_int, default=1, metavar="N",
+        help="drive through N worker processes, splitting the request "
+             "log round-robin and merging per-shard results into ONE "
+             "report — one driver process tops out around ~1.6k rps "
+             "(docs/PERF.md §config 14 note), so high offered rates "
+             "need N > 1 (default 1)",
     )
     p.add_argument(
         "--arrival", default="poisson", choices=["poisson", "mmpp"],

@@ -53,7 +53,9 @@ METRIC_NAME_RE = re.compile(r"^bodywork_tpu_[a-z0-9_]+$")
 #: closed/half-open/open, serve healthy/degraded/no-model — the value
 #: encoding lives with each metric in docs/RESILIENCE.md); ``_depth``
 #: is a queue-occupancy gauge (requests currently held — the admission
-#: layer's saturation signal, docs/OBSERVABILITY.md).
+#: layer's saturation signal, docs/OBSERVABILITY.md); ``_in_flight`` is
+#: an outstanding-work gauge counted in requests (the socket
+#: row-queue's consumed transport credits).
 UNIT_SUFFIXES = (
     "_total",
     "_seconds",
@@ -66,6 +68,7 @@ UNIT_SUFFIXES = (
     "_loss",
     "_state",
     "_depth",
+    "_in_flight",
 )
 
 #: default histogram buckets, tuned for this service's latency regime:

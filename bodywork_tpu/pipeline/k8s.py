@@ -251,6 +251,14 @@ def _container(
             # the UNION of all front-ends' rows; empty = the flat
             # topology (docs/PERF.md §config 14)
             ("BODYWORK_TPU_FRONTENDS", ""),
+            # cross-host row-queue transport (serve --transport /
+            # --dispatcher-addr / --role, PR 18): "tcp" at generate
+            # time splits the stage into front-end + dispatcher
+            # Deployments; materialised here so `kubectl set env`
+            # can flip a flat pod's knobs without editing manifests
+            ("BODYWORK_TPU_SERVE_TRANSPORT", ""),
+            ("BODYWORK_TPU_DISPATCHER_ADDR", ""),
+            ("BODYWORK_TPU_SERVE_ROLE", ""),
             # coalescer + bucket knobs and the tuned-config pointer
             # (tune/config.py, read by stages._serve_tuned_env_knobs):
             # point BODYWORK_TPU_TUNED_CONFIG at a tuning/ document (or
@@ -526,6 +534,35 @@ def generate_manifests(
                     "spec": job_spec,
                 }
             else:
+                # cross-host disaggregated serving (serve.netqueue,
+                # docs/RESILIENCE.md §14): a service stage that DECLARES
+                # the tcp row-queue transport in its env splits into two
+                # separately scalable Deployments — jax-free front-ends
+                # (this doc, keeping the stage's standard name so the
+                # Service/Ingress/HPA below keep targeting it) and one
+                # device-owning dispatcher reached through its own
+                # Service. Both run `cli serve` directly with an
+                # explicit --role: the in-process run-stage entrypoint
+                # cannot run either half of a process fleet.
+                split = (
+                    str(stage.env.get(
+                        "BODYWORK_TPU_SERVE_TRANSPORT", ""
+                    )).strip() == "tcp"
+                )
+                dispatcher_dns = f"{meta['name']}--dispatcher"
+                if split:
+                    from bodywork_tpu.serve.netqueue import (
+                        DEFAULT_DISPATCHER_PORT,
+                    )
+
+                    command = [
+                        "python", "-m", "bodywork_tpu.cli", "serve",
+                        "--store", store_path,
+                        "--host", "0.0.0.0", "--port", str(stage.port),
+                        "--role", "frontend", "--transport", "tcp",
+                        "--dispatcher-addr",
+                        f"{dispatcher_dns}:{DEFAULT_DISPATCHER_PORT}",
+                    ]
                 docs[f"{i:02d}-{stage.name}-deployment.yaml"] = {
                     "apiVersion": "apps/v1",
                     "kind": "Deployment",
@@ -564,6 +601,93 @@ def generate_manifests(
                         },
                     },
                 }
+                if split:
+                    fe_pod = docs[
+                        f"{i:02d}-{stage.name}-deployment.yaml"
+                    ]["spec"]["template"]["spec"]
+                    fe_container = fe_pod["containers"][0]
+                    # the front-ends are jax-free parse/admission
+                    # processes: the stage's TPU chips belong to the
+                    # dispatcher alone (holding chips a pod never
+                    # touches would starve the scheduler)
+                    fe_container["resources"].pop("limits", None)
+                    fe_pod.pop("nodeSelector", None)
+                    dlabels = {**labels_base, "app": dispatcher_dns}
+                    dmeta = {
+                        "name": dispatcher_dns,
+                        "namespace": namespace,
+                        "labels": dlabels,
+                    }
+                    dispatcher_cmd = [
+                        "python", "-m", "bodywork_tpu.cli", "serve",
+                        "--store", store_path,
+                        "--role", "dispatcher", "--transport", "tcp",
+                        "--dispatcher-addr",
+                        f"0.0.0.0:{DEFAULT_DISPATCHER_PORT}",
+                    ]
+                    dpod = _pod_spec(
+                        spec, stage, store, image, dispatcher_cmd,
+                        "Always",
+                    )
+                    dcontainer = dpod["containers"][0]
+                    dcontainer["name"] = f"{stage.name}-dispatcher"
+                    # the dispatcher serves the socket row-queue, not
+                    # HTTP: readiness is "the listener accepts" (it
+                    # binds only after the model is loaded —
+                    # dispatcher_main arms the listener before ready,
+                    # after load), probed at the TCP layer
+                    dcontainer["ports"] = [{
+                        "containerPort": DEFAULT_DISPATCHER_PORT,
+                        "name": "rowqueue",
+                    }]
+                    dcontainer["readinessProbe"] = {
+                        "tcpSocket": {"port": DEFAULT_DISPATCHER_PORT},
+                        "initialDelaySeconds": 2,
+                        "periodSeconds": 3,
+                        "failureThreshold":
+                            int(stage.max_startup_time_s // 3) or 1,
+                        "timeoutSeconds": 2,
+                    }
+                    docs[f"{i:02d}-{stage.name}-dispatcher-deployment"
+                         ".yaml"] = {
+                        "apiVersion": "apps/v1",
+                        "kind": "Deployment",
+                        "metadata": dmeta,
+                        "spec": {
+                            # exactly ONE device-owning dispatcher: the
+                            # row-queue contract is N front-ends -> one
+                            # scorer (batches coalesce from the union
+                            # of all front-ends' rows); scale
+                            # FRONT-ENDS via the HPA, dispatchers only
+                            # by deploying more services
+                            "replicas": 1,
+                            "selector": {
+                                "matchLabels": {"app": dispatcher_dns},
+                            },
+                            "template": {
+                                "metadata": {"labels": dlabels},
+                                "spec": {
+                                    **dpod,
+                                    "terminationGracePeriodSeconds": 30,
+                                },
+                            },
+                        },
+                    }
+                    docs[f"{i:02d}-{stage.name}-dispatcher-service"
+                         ".yaml"] = {
+                        "apiVersion": "v1",
+                        "kind": "Service",
+                        "metadata": dmeta,
+                        "spec": {
+                            "selector": {"app": dispatcher_dns},
+                            "ports": [{
+                                "port": DEFAULT_DISPATCHER_PORT,
+                                "targetPort": DEFAULT_DISPATCHER_PORT,
+                                "name": "rowqueue",
+                            }],
+                            "type": "ClusterIP",
+                        },
+                    }
                 docs[f"{i:02d}-{stage.name}-service.yaml"] = {
                     "apiVersion": "v1",
                     "kind": "Service",

@@ -493,6 +493,135 @@ def validate_manifest(doc: dict, origin: str = "<manifest>") -> list[str]:
     return errors
 
 
+def validate_split_serving(docs: dict[str, dict]) -> list[str]:
+    """Semantic checks for the cross-host disaggregated serving split
+    (``--dispatcher`` Deployments emitted when a service stage declares
+    the tcp row-queue transport): the generic whitelist/schema layers
+    cannot see that the two Deployments and the dispatcher Service must
+    agree with each other. Checks, per dispatcher Deployment: exactly
+    one replica (the row-queue contract is N front-ends -> ONE scorer),
+    a tcpSocket readiness probe (the dispatcher serves no HTTP), a
+    dispatcher Service targeting the same app label on the probed port,
+    a paired front-end Deployment running ``--role frontend`` with a
+    ``--dispatcher-addr`` naming that Service, the serve env knobs
+    materialised on the front-end container, and every HPA targeting
+    the FRONT-END Deployment — autoscaling the singleton dispatcher
+    would violate the one-scorer contract. Returns error strings."""
+    errors: list[str] = []
+    deployments = {
+        doc["metadata"]["name"]: (filename, doc)
+        for filename, doc in docs.items()
+        if isinstance(doc, dict) and doc.get("kind") == "Deployment"
+    }
+    services = {
+        doc["metadata"]["name"]: doc
+        for filename, doc in docs.items()
+        if isinstance(doc, dict) and doc.get("kind") == "Service"
+    }
+    hpa_targets = [
+        (filename, doc["spec"]["scaleTargetRef"]["name"])
+        for filename, doc in docs.items()
+        if isinstance(doc, dict)
+        and doc.get("kind") == "HorizontalPodAutoscaler"
+    ]
+    for name, (filename, doc) in deployments.items():
+        if not name.endswith("--dispatcher"):
+            continue
+        spec = doc["spec"]
+        if spec.get("replicas") != 1:
+            errors.append(
+                f"{filename}: dispatcher Deployment {name!r} must run "
+                f"exactly 1 replica, got {spec.get('replicas')!r}"
+            )
+        container = spec["template"]["spec"]["containers"][0]
+        probe = container.get("readinessProbe", {})
+        if "tcpSocket" not in probe:
+            errors.append(
+                f"{filename}: dispatcher Deployment {name!r} needs a "
+                "tcpSocket readinessProbe (it serves no HTTP)"
+            )
+        port = probe.get("tcpSocket", {}).get("port")
+        svc = services.get(name)
+        if svc is None:
+            errors.append(
+                f"{filename}: dispatcher Deployment {name!r} has no "
+                "matching Service (front-ends resolve the dispatcher "
+                "through it)"
+            )
+        else:
+            app = doc["metadata"]["labels"].get("app")
+            if svc["spec"].get("selector", {}).get("app") != app:
+                errors.append(
+                    f"{filename}: dispatcher Service {name!r} selector "
+                    f"does not target app={app!r}"
+                )
+            svc_ports = [p.get("port") for p in svc["spec"].get("ports", [])]
+            if port is not None and port not in svc_ports:
+                errors.append(
+                    f"{filename}: dispatcher Service {name!r} ports "
+                    f"{svc_ports} do not include the probed row-queue "
+                    f"port {port}"
+                )
+        for target in ("--role", "dispatcher"):
+            if target not in container.get("command", []):
+                errors.append(
+                    f"{filename}: dispatcher Deployment {name!r} command "
+                    f"must run `cli serve --role dispatcher` "
+                    f"(missing {target!r})"
+                )
+        # the paired front-end Deployment keeps the stage's standard
+        # name (= this name minus the suffix) so Service/Ingress/HPA
+        # keep targeting it
+        fe_name = name[: -len("--dispatcher")]
+        fe = deployments.get(fe_name)
+        if fe is None:
+            errors.append(
+                f"{filename}: dispatcher {name!r} has no paired "
+                f"front-end Deployment {fe_name!r}"
+            )
+        else:
+            fe_filename, fe_doc = fe
+            fe_container = (
+                fe_doc["spec"]["template"]["spec"]["containers"][0]
+            )
+            fe_cmd = fe_container.get("command", [])
+            if "frontend" not in fe_cmd or "--role" not in fe_cmd:
+                errors.append(
+                    f"{fe_filename}: front-end Deployment {fe_name!r} "
+                    "command must run `cli serve --role frontend`"
+                )
+            addr = None
+            for flag, value in zip(fe_cmd, fe_cmd[1:]):
+                if flag == "--dispatcher-addr":
+                    addr = value
+            if addr is None or not addr.startswith(f"{name}:"):
+                errors.append(
+                    f"{fe_filename}: front-end Deployment {fe_name!r} "
+                    f"--dispatcher-addr {addr!r} does not name the "
+                    f"dispatcher Service {name!r}"
+                )
+            env_names = {
+                e.get("name") for e in fe_container.get("env", [])
+            }
+            for knob in ("BODYWORK_TPU_SERVE_TRANSPORT",
+                         "BODYWORK_TPU_SERVER_ENGINE",
+                         "BODYWORK_TPU_FRONTENDS",
+                         "BODYWORK_TPU_MAX_PENDING"):
+                if knob not in env_names:
+                    errors.append(
+                        f"{fe_filename}: front-end Deployment "
+                        f"{fe_name!r} must materialise the {knob} env "
+                        "knob"
+                    )
+        for hpa_filename, target in hpa_targets:
+            if target == name:
+                errors.append(
+                    f"{hpa_filename}: HPA must target the front-end "
+                    f"Deployment, not the singleton dispatcher {name!r}"
+                )
+    return errors
+
+
 def validate_manifests(docs: dict[str, dict]) -> None:
     """Validate every generated manifest; raise :class:`ManifestError`
     listing ALL problems (not just the first) on any failure.
@@ -511,6 +640,7 @@ def validate_manifests(docs: dict[str, dict]) -> None:
         errors.extend(validate_manifest(doc, filename))
         if isinstance(doc, dict):
             errors.extend(validate_against_k8s_schema(doc, filename))
+    errors.extend(validate_split_serving(docs))
     if errors:
         raise ManifestError(
             "invalid generated manifests:\n  " + "\n  ".join(errors)

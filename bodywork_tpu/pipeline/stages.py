@@ -410,6 +410,44 @@ def _serve_fleet_env_knobs() -> int | None:
     return frontends
 
 
+def _serve_transport_env_knobs() -> tuple[str, str | None, str]:
+    """The deployed cross-host-split knobs (``(transport,
+    dispatcher_addr, role)`` — ``serve.netqueue``: which row-queue
+    transport the front-end -> dispatcher handoff rides, where the
+    dispatcher's listener lives, and which half of the split this pod
+    runs) from the pod environment. Split out like
+    :func:`_serve_fleet_env_knobs`, and consumed the same way:
+    ``cli serve`` builds the topology from them; the IN-PROCESS serve
+    stage cannot (one process, no row-queue), so it surfaces and warns.
+    The transport/role choice sets are pinned ==
+    ``serve.netqueue.SERVE_TRANSPORTS`` / ``SERVE_ROLES`` == the
+    ``cli serve`` parser choices by tests/test_netqueue.py. Same
+    malformed-degrades contract: a typo'd value is a warning and the
+    default, never a crash-looping pod."""
+    import os
+
+    # choice sets hardcoded to keep this import-light (the same reason
+    # the cli parser hardcodes them); the guard test pins all three
+    transports = ("shm", "tcp", "unix")
+    roles = ("auto", "frontend", "dispatcher")
+    transport = os.environ.get("BODYWORK_TPU_SERVE_TRANSPORT", "").strip()
+    if transport and transport not in transports:
+        log.warning(
+            f"ignoring BODYWORK_TPU_SERVE_TRANSPORT={transport!r} "
+            f"(expected one of {transports})"
+        )
+        transport = ""
+    role = os.environ.get("BODYWORK_TPU_SERVE_ROLE", "").strip()
+    if role and role not in roles:
+        log.warning(
+            f"ignoring BODYWORK_TPU_SERVE_ROLE={role!r} "
+            f"(expected one of {roles})"
+        )
+        role = ""
+    addr = os.environ.get("BODYWORK_TPU_DISPATCHER_ADDR", "").strip() or None
+    return transport or "shm", addr, role or "auto"
+
+
 def serve_stage(
     ctx: StageContext,
     host: str = "127.0.0.1",
@@ -539,6 +577,14 @@ def serve_stage(
             f"BODYWORK_TPU_FRONTENDS={env_frontends} selects the "
             "disaggregated process fleet (`cli serve --frontends`); "
             "the in-process serve stage runs one process and ignores it"
+        )
+    env_transport, _env_addr, env_role = _serve_transport_env_knobs()
+    if env_transport != "shm" or env_role != "auto":
+        log.warning(
+            f"BODYWORK_TPU_SERVE_TRANSPORT={env_transport!r} / "
+            f"BODYWORK_TPU_SERVE_ROLE={env_role!r} select the cross-host "
+            "disaggregated split (`cli serve --transport/--role`); the "
+            "in-process serve stage runs one process and ignores them"
         )
     # coalescer/bucket/tuned-config knobs: spec args > per-knob env >
     # tuned document > built-in defaults (tune/config.py)

@@ -61,12 +61,15 @@ class DispatchServer:
     ScoringApp`: poll a submission, score it exactly as the in-process
     engines would, reply with predictions + the answering bundle."""
 
-    def __init__(self, app, queue):
+    def __init__(self, app, queue, server=None):
         from bodywork_tpu.serve.app import PredictionSanityError
         from bodywork_tpu.serve.batcher import CoalescerSaturated
 
         self.app = app
-        self.server = RowQueueServer(queue)
+        # transport-agnostic: any server with the RowQueueServer
+        # poll/reply surface pumps here (serve.netqueue passes the
+        # socket one for the cross-host split)
+        self.server = server if server is not None else RowQueueServer(queue)
         self._sanity_error = PredictionSanityError
         self._saturated = CoalescerSaturated
         self._stopping = False
@@ -177,12 +180,21 @@ def dispatcher_main(store_path: str, queue, ready,
                     batch_max_rows: int | None = None,
                     metrics_dir: str | None = None,
                     dtype: str = "float32",
-                    tuned_config: str | None = None):
+                    tuned_config: str | None = None,
+                    transport: str = "shm",
+                    dispatcher_addr=None):
     """The dispatcher process entrypoint (mirrors ``multiproc._worker_main``
     minus HTTP): load the serving checkpoint, build the predictor, arm
     the dispatcher-side coalescer, pump the row-queue. ``up`` flips to 1
     only once a model is loaded — front-end ``/healthz`` stays 503 until
-    the service can actually score."""
+    the service can actually score.
+
+    ``transport`` selects the queue the dispatcher serves: ``"shm"``
+    pumps the shared-memory ``queue`` (same-host fleet); ``"tcp"`` /
+    ``"unix"`` bind a :class:`~bodywork_tpu.serve.netqueue.NetQueueServer`
+    at ``dispatcher_addr`` instead, and ``queue`` may be ``None`` (the
+    standalone k8s dispatcher Deployment has no shm arena to share).
+    ``ready`` may be ``None`` too when there is no supervising parent."""
     from bodywork_tpu.models.checkpoint import load_model, resolve_serving_key
     from bodywork_tpu.serve.app import create_app
     from bodywork_tpu.serve.batcher import DEFAULT_WINDOW_MS
@@ -254,17 +266,31 @@ def dispatcher_main(store_path: str, queue, ready,
                                      policy=policy_from_env()),
             dtype=dtype,
         ).start()
-    dispatch = DispatchServer(app, queue)
-    queue.up.value = 1
-    ready.put(os.getpid())
+    net_server = None
+    if transport in ("tcp", "unix"):
+        from bodywork_tpu.serve.netqueue import NetQueueServer
+
+        # bind BEFORE signalling ready: a front-end told to connect must
+        # find a listener, not a race
+        net_server = NetQueueServer(dispatcher_addr)
+        dispatch = DispatchServer(app, queue, server=net_server)
+    else:
+        dispatch = DispatchServer(app, queue)
+    if queue is not None:
+        queue.up.value = 1
+    if ready is not None:
+        ready.put(os.getpid())
     log.info(
-        f"dispatcher serving the row-queue (model {served_key}, "
-        f"window={window}ms)"
+        f"dispatcher serving the {transport} row-queue "
+        f"(model {served_key}, window={window}ms)"
     )
     try:
         dispatch.serve_forever()
     finally:  # pragma: no cover - only on signal teardown
-        queue.up.value = 0
+        if queue is not None:
+            queue.up.value = 0
+        if net_server is not None:
+            net_server.close()
         if watcher is not None:
             watcher.stop()
         if flusher is not None:

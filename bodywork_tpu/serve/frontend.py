@@ -266,6 +266,14 @@ class FrontendApp:
             ),
             "admission": admission.state() if admission is not None else None,
             "rowqueue": stats,
+            # which transport the handoff rides and how it's doing:
+            # kind/connected/reconnects/credit window — one schema for
+            # shm and socket clients (both implement transport_state),
+            # the operator's first read in the §14 runbook
+            "transport": (
+                self.client.transport_state()
+                if hasattr(self.client, "transport_state") else None
+            ),
         }
         if stats["dispatcher_up"]:
             return payload, 200, None

@@ -269,19 +269,33 @@ def _frontend_main(queue, host: str, port: int, ready,
                    shared_budget=None,
                    slot_index: int = 0,
                    max_pending: int | None = None,
-                   retry_after_max_s: float | None = None):
+                   retry_after_max_s: float | None = None,
+                   transport: str = "shm",
+                   dispatcher_addr=None):
     """One parse/admission front-end of the disaggregated split: HTTP
     parse + admission + row-queue handoff, NO model. Deliberately
     JAX-free (pinned by a test) — front-end processes must stay cheap to
     spawn and must not touch the accelerator runtime; everything
     device-shaped lives in the single dispatcher
-    (``serve.dispatch.dispatcher_main``)."""
+    (``serve.dispatch.dispatcher_main``).
+
+    ``transport`` selects the queue the handoff rides: ``"shm"`` is the
+    shared-memory ``queue`` (same host as the dispatcher); ``"tcp"`` /
+    ``"unix"`` connect a :class:`~bodywork_tpu.serve.netqueue.
+    NetQueueClient` to ``dispatcher_addr`` instead (``queue`` is then
+    ``None`` — there is no arena to share across hosts)."""
     from bodywork_tpu.serve.admission import SharedBudgetSlot, build_admission
     from bodywork_tpu.serve.frontend import FrontendApp
-    from bodywork_tpu.serve.rowqueue import RowQueueClient
 
     signal.signal(signal.SIGTERM, lambda signum, frame: sys.exit(0))
-    client = RowQueueClient(queue, slot_index).start()
+    if transport in ("tcp", "unix"):
+        from bodywork_tpu.serve.netqueue import NetQueueClient
+
+        client = NetQueueClient(dispatcher_addr, slot_index).start()
+    else:
+        from bodywork_tpu.serve.rowqueue import RowQueueClient
+
+        client = RowQueueClient(queue, slot_index).start()
     # same service-wide admission budget shape as --workers: each
     # front-end holds a slot in the shared array, so max_pending bounds
     # the SERVICE's held work and the supervisor can zero a dead
@@ -353,6 +367,19 @@ class MultiProcessService:
     The same supervisor keeps both roles alive; a dying dispatcher
     flips the queue down (front-ends answer 503 + Retry-After, never
     wedge) and is respawned under the same backoff budget.
+
+    ``transport`` (frontends mode only) moves the handoff off shared
+    memory: ``"tcp"`` / ``"unix"`` run the same split over the socket
+    row-queue (``serve.netqueue``) — locally that buys nothing over shm
+    (it IS the bench-16 overhead comparison), but it is the exact
+    topology the split k8s Deployments run across pods, with
+    ``dispatcher_addr`` naming the dispatcher's listener (auto-picked on
+    loopback / a temp unix path when unset). ``external_dispatcher=True``
+    runs ONLY the front-end half against a dispatcher some other
+    supervisor owns (the k8s front-end Deployment): no local dispatcher
+    is spawned or supervised, and dispatcher death shows up as the
+    clients' connection loss (503 + Retry-After, reconnect backoff) —
+    the remote supervisor owns the respawn.
     """
 
     def __init__(
@@ -375,7 +402,31 @@ class MultiProcessService:
         dtype: str = "float32",
         tuned_config: str | None = None,
         frontends: int | None = None,
+        transport: str = "shm",
+        dispatcher_addr: str | None = None,
+        external_dispatcher: bool = False,
     ):
+        from bodywork_tpu.serve.netqueue import (
+            SERVE_TRANSPORTS,
+            parse_dispatcher_addr,
+        )
+
+        if transport not in SERVE_TRANSPORTS:
+            raise ValueError(
+                f"unknown row-queue transport {transport!r}; "
+                f"expected one of {SERVE_TRANSPORTS}"
+            )
+        if transport != "shm" and frontends is None:
+            raise ValueError(
+                "socket row-queue transports require the disaggregated "
+                "topology (--frontends N); --workers replicas have no "
+                "row-queue to carry"
+            )
+        if external_dispatcher and transport == "shm":
+            raise ValueError(
+                "an external dispatcher cannot be reached over shared "
+                "memory; use --transport tcp or unix"
+            )
         if frontends is not None:
             assert frontends >= 1, "need at least one front-end"
             # role split: `workers` now counts HTTP processes, which in
@@ -463,10 +514,42 @@ class MultiProcessService:
         self._ctx = multiprocessing.get_context("spawn")
         self._queue = None
         self._dispatcher = None
-        if frontends is not None:
+        self.transport = transport
+        self.external_dispatcher = external_dispatcher
+        self.dispatcher_addr = None
+        self._unix_dir = None
+        if frontends is not None and transport == "shm":
             from bodywork_tpu.serve.rowqueue import RowQueue
 
             self._queue = RowQueue(self._ctx, frontends)
+        elif frontends is not None:
+            # socket transports carry no shared arena: the handoff state
+            # lives in the dispatcher's listener, which needs an address
+            # both halves agree on before either spawns
+            if dispatcher_addr is None:
+                if external_dispatcher:
+                    raise ValueError(
+                        "an external dispatcher needs an explicit "
+                        "--dispatcher-addr"
+                    )
+                if transport == "unix":
+                    self._unix_dir = tempfile.mkdtemp(
+                        prefix="bodywork-tpu-netqueue-"
+                    )
+                    dispatcher_addr = os.path.join(
+                        self._unix_dir, "rowqueue.sock"
+                    )
+                else:
+                    # loopback free port, reserved the same racy-but-
+                    # fine way every local test harness picks ports
+                    probe = socket.socket(socket.AF_INET,
+                                          socket.SOCK_STREAM)
+                    probe.bind(("127.0.0.1", 0))
+                    dispatcher_addr = f"127.0.0.1:{probe.getsockname()[1]}"
+                    probe.close()
+            self.dispatcher_addr = parse_dispatcher_addr(
+                transport, dispatcher_addr
+            )
         # ONE service-wide admission budget across the fleet: every
         # worker's controller admits against the sum of this per-slot
         # array, so max_pending bounds the SERVICE's held work (the "N
@@ -529,6 +612,8 @@ class MultiProcessService:
                 metrics_dir=self.metrics_dir,
                 dtype=self.dtype,
                 tuned_config=self.tuned_config,
+                transport=self.transport,
+                dispatcher_addr=self.dispatcher_addr,
             ),
             daemon=True,
         )
@@ -543,7 +628,8 @@ class MultiProcessService:
                 args=(self._queue, self.host, self.port, ready,
                       self.server_engine, self.metrics_dir,
                       self._shared_budget, slot_index,
-                      self.max_pending, self.retry_after_max_s),
+                      self.max_pending, self.retry_after_max_s,
+                      self.transport, self.dispatcher_addr),
                 daemon=True,
             )
             proc.start()
@@ -587,7 +673,7 @@ class MultiProcessService:
             self.metrics_dir = tempfile.mkdtemp(prefix="bodywork-tpu-obs-")
         spawned: list = []
         try:
-            if self.frontends is not None:
+            if self.frontends is not None and not self.external_dispatcher:
                 # dispatcher first: its readiness IS model readiness —
                 # once it arms `queue.up`, the (fast-booting, model-free)
                 # front-ends answer /healthz 200 from their first request
@@ -749,8 +835,12 @@ class MultiProcessService:
         if proc.is_alive() or slot["policy"].exhausted:
             return
         if slot["respawn_at"] is None:
-            self._queue.up.value = 0
-            self._queue.epoch.value += 1
+            if self._queue is not None:
+                self._queue.up.value = 0
+                self._queue.epoch.value += 1
+            # (socket transports need no supervisor-side down-flip: the
+            # dying dispatcher's connections break, and every client
+            # fails its in-flight waits on the connection loss itself)
             alive_s = now - slot["spawned_at"]
             delay = slot["policy"].on_death(alive_s)
             if delay is None:
@@ -828,6 +918,8 @@ class MultiProcessService:
         if self._queue is not None:
             self._queue.close()
         self._reserved.close()
+        if self._unix_dir is not None:
+            shutil.rmtree(self._unix_dir, ignore_errors=True)
         if self.metrics_dir is not None:
             shutil.rmtree(self.metrics_dir, ignore_errors=True)
         log.info("multi-process scoring service stopped")

@@ -534,6 +534,24 @@ class RowQueueClient:
                 "slots_free": int(self.queue.free[0]),
             }
 
+    def transport_state(self) -> dict:
+        """The /healthz transport block — same shape as
+        ``netqueue.NetQueueClient.transport_state`` so operators read one
+        schema whichever transport a front-end rides. The shm transport
+        has no connection to lose (liveness is the supervisor-maintained
+        ``up`` word) and never reconnects; its credit window is the
+        shared slot pool."""
+        with self._lock:
+            in_flight = len(self._pending)
+        return {
+            "kind": "shm",
+            "connected": self.dispatcher_up(),
+            "reconnects": 0,
+            "credit_window": self.queue.slots,
+            "credits_in_flight": in_flight,
+            "address": None,
+        }
+
 
 class _Submission:
     """One dequeued request, dispatcher-side. ``X`` is a ZERO-COPY numpy

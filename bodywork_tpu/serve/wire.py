@@ -28,6 +28,7 @@ import numpy as np
 __all__ = [
     "BINARY_CONTENT_TYPE",
     "MODEL_KEY_HEADER",
+    "WIRE_SCHEMA_VERSION",
     "BatchResponseTemplate",
     "SingleResponseTemplate",
     "batch_score_payload",
@@ -50,6 +51,15 @@ MODEL_KEY_HEADER = "X-Bodywork-Model-Key"
 #: framing removes the client-side float formatting and server-side JSON
 #: parse from the request path, nothing else.
 BINARY_CONTENT_TYPE = "application/x-bodywork-rows"
+
+#: version of the row framing above, negotiated by every transport that
+#: carries it (HTTP via the content type; the socket row-queue transport
+#: — ``serve.netqueue`` — via its HELLO frame). Bump on ANY change to
+#: the header layout or the f32 row encoding: a front-end and a
+#: dispatcher from different builds must refuse to talk rather than
+#: misparse each other's rows. Pinned identical across the shm and
+#: socket paths by a guard test.
+WIRE_SCHEMA_VERSION = 1
 
 #: the binary header: little-endian (n_rows, n_features)
 _BINARY_HEADER = struct.Struct("<II")

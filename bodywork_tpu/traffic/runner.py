@@ -37,12 +37,21 @@ The transport is pluggable (``transport=`` — an async callable taking a
 ``(status, retry_after_s)``): tests substitute a recording/canned
 transport to prove replay determinism without a socket, the CLI and
 bench use the real HTTP transport.
+
+The driver itself has a ceiling: ONE process's event loop tops out
+around ~1.6k rps on one core (the committed config-14 N=4 point was
+truncated there). ``shards=N`` splits the log round-robin across N
+worker processes — round-robin preserves both the aggregate rate and
+the arrival-time distribution of every shard — and merges the
+per-shard results into ONE report, so the offered rate scales with
+driver cores while every measurement rule above still holds.
 """
 from __future__ import annotations
 
 import asyncio
 import dataclasses
 import json
+import multiprocessing
 import urllib.parse
 
 from bodywork_tpu.traffic.generator import Request
@@ -122,6 +131,9 @@ class LoadReport:
     #: X-Bodywork-Model-Key header; "unknown" bucket when absent) — how
     #: a canary sweep attributes per-version behaviour with this harness
     per_model_key: dict = dataclasses.field(default_factory=dict)
+    #: driver worker processes this report aggregates (1 = the classic
+    #: single-process drive, ceiling ~1.6k rps; >1 = the sharded driver)
+    shards: int = 1
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -259,31 +271,17 @@ async def _http_transport(pool: _ConnectionPool, request: Request,
     raise ConnectionResetError("unreachable")  # pragma: no cover
 
 
-def run_open_loop(
+def _drive_once(
     url: str,
     requests_log: list[Request],
-    timeout_s: float = 30.0,
-    transport=None,
-    duration_s: float | None = None,
-    results_log: str | None = None,
-    transport_kind: str = "json",
-) -> LoadReport:
-    """Fire ``requests_log`` at its scheduled arrival times against
-    ``url`` (scheme://host:port — any path component is ignored; each
-    log entry carries its own route) and summarise the outcome.
-
-    ``results_log`` writes one JSONL record per request (scheduled
-    arrival, status, client-observed latency, send lag, answering model
-    key, and the server's returned trace id) — the join table between
-    this harness's client-side latencies and the server-side spans a
-    flight-recorder dump or ``cli trace show`` holds for the same trace
-    id (obs/tracing.py).
-
-    Runs its own event loop, so it is callable from plain synchronous
-    code (the CLI, bench children, tests); do not call it from inside a
-    running loop."""
-    if not requests_log:
-        raise ValueError("empty request log: nothing to drive")
+    timeout_s: float,
+    transport,
+    transport_kind: str,
+):
+    """One process's open-loop drive: fire every request at its
+    scheduled time, return ``(results, timeouts, max_in_flight)``. The
+    single-shard core both :func:`run_open_loop` and each shard worker
+    run."""
     parsed = urllib.parse.urlsplit(url)
     host = parsed.hostname or "127.0.0.1"
     port = parsed.port or 80
@@ -301,10 +299,6 @@ def run_open_loop(
         async def transport(req: Request):
             return await _http_transport(pool, req, kind=transport_kind)
 
-    span = duration_s if duration_s is not None else max(
-        r.t_s for r in requests_log
-    )
-    span = max(span, 1e-6)
     results: list[_Result] = []
     in_flight = 0
     max_in_flight = 0
@@ -358,6 +352,128 @@ def run_open_loop(
                 pool.close()
 
     asyncio.run(_drive())
+    return results, timeouts, max_in_flight
+
+
+def _shard_main(url, requests_log, timeout_s, transport_kind, conn) -> None:
+    """One sharded-driver worker: drive this shard's slice of the log,
+    ship the raw per-request results back over the pipe (``_Result`` is
+    a plain picklable dataclass). Any failure is shipped too — a dead
+    shard must fail the whole run loudly, not silently under-offer."""
+    try:
+        results, timeouts, max_in_flight = _drive_once(
+            url, requests_log, timeout_s, None, transport_kind
+        )
+        conn.send(("ok", results, timeouts, max_in_flight))
+    except BaseException as exc:  # noqa: BLE001 - marshalled to the parent
+        conn.send(("error", repr(exc)))
+    finally:
+        conn.close()
+
+
+def _merged_max_in_flight(results: list[_Result]) -> int:
+    """Exact peak concurrency across every shard, reconstructed from the
+    per-request send/complete intervals (per-shard maxima cannot be
+    summed — shards do not peak at the same instant)."""
+    events = []
+    for r in results:
+        events.append((r.t_s + r.send_lag_s, 1))
+        events.append((r.t_s + r.latency_s, -1))
+    events.sort()
+    peak = current = 0
+    for _t, delta in events:
+        current += delta
+        peak = max(peak, current)
+    return peak
+
+
+def run_open_loop(
+    url: str,
+    requests_log: list[Request],
+    timeout_s: float = 30.0,
+    transport=None,
+    duration_s: float | None = None,
+    results_log: str | None = None,
+    transport_kind: str = "json",
+    shards: int = 1,
+) -> LoadReport:
+    """Fire ``requests_log`` at its scheduled arrival times against
+    ``url`` (scheme://host:port — any path component is ignored; each
+    log entry carries its own route) and summarise the outcome.
+
+    ``results_log`` writes one JSONL record per request (scheduled
+    arrival, status, client-observed latency, send lag, answering model
+    key, and the server's returned trace id) — the join table between
+    this harness's client-side latencies and the server-side spans a
+    flight-recorder dump or ``cli trace show`` holds for the same trace
+    id (obs/tracing.py).
+
+    ``shards=N`` drives through N worker processes, splitting the log
+    round-robin (``requests_log[i::N]`` keeps every shard's rate and
+    arrival distribution proportional) and merging the per-shard
+    results into this one report — the escape from the single-process
+    generator's ~1.6k rps ceiling (docs/PERF.md §config 14 note).
+    Custom ``transport=`` callables cannot cross the process boundary,
+    so sharding requires the real HTTP transport.
+
+    Runs its own event loop, so it is callable from plain synchronous
+    code (the CLI, bench children, tests); do not call it from inside a
+    running loop."""
+    if not requests_log:
+        raise ValueError("empty request log: nothing to drive")
+    if shards < 1:
+        raise ValueError(f"shards must be >= 1, got {shards}")
+    shards = min(shards, len(requests_log))
+    span = duration_s if duration_s is not None else max(
+        r.t_s for r in requests_log
+    )
+    span = max(span, 1e-6)
+    if shards == 1:
+        results, timeouts, max_in_flight = _drive_once(
+            url, requests_log, timeout_s, transport, transport_kind
+        )
+    else:
+        if transport is not None:
+            raise ValueError(
+                "shards > 1 requires the built-in HTTP transport "
+                "(a custom transport callable cannot cross the "
+                "worker-process boundary)"
+            )
+        # spawn, not fork: the driver may be running inside a process
+        # that already holds an event loop / threads (bench, the CLI
+        # after jax import) — the repo-wide child-process convention
+        ctx = multiprocessing.get_context("spawn")
+        workers = []
+        for i in range(shards):
+            parent_conn, child_conn = ctx.Pipe(duplex=False)
+            proc = ctx.Process(
+                target=_shard_main,
+                args=(url, requests_log[i::shards], timeout_s,
+                      transport_kind, child_conn),
+                daemon=True,
+            )
+            proc.start()
+            child_conn.close()
+            workers.append((proc, parent_conn))
+        results = []
+        timeouts = 0
+        errors = []
+        for i, (proc, conn) in enumerate(workers):
+            try:
+                outcome = conn.recv()
+            except EOFError:
+                outcome = ("error", f"shard {i} died without a result")
+            if outcome[0] == "ok":
+                results.extend(outcome[1])
+                timeouts += outcome[2]
+            else:
+                errors.append(f"shard {i}: {outcome[1]}")
+            proc.join(timeout=30)
+        if errors:
+            raise RuntimeError(
+                "sharded open-loop drive failed: " + "; ".join(errors)
+            )
+        max_in_flight = _merged_max_in_flight(results)
 
     if results_log:
         # per-request JSONL, in scheduled-arrival order (the log the
@@ -448,6 +564,7 @@ def run_open_loop(
         max_in_flight=max_in_flight,
         traced_responses=sum(1 for r in results if r.trace_id is not None),
         per_model_key=per_model_key,
+        shards=shards,
     )
     log.info(
         f"open-loop run: offered {report.offered_rps:.0f} rps x "

@@ -1,0 +1,689 @@
+"""Cross-host disaggregated serving (ISSUE 18): the socket transport
+for the row queue (`serve/netqueue.py`), the sharded open-loop driver,
+and the split k8s Deployments.
+
+The contract under test: `NetQueueClient`/`NetQueueServer` present the
+SAME producer/consumer surface as the shm `RowQueueClient`/
+`RowQueueServer` — same shed boundary (credit window == slot budget →
+`SlotsExhausted` → 429), same dispatcher-death semantics (broken
+connection fails every in-flight wait NOW → 503 + Retry-After, heals
+on jittered reconnect), same reply payload (predictions + the
+answering bundle identity) — so `frontend.py`/`aio.py`/`dispatch.py`
+run unchanged over either transport. Plus the three-table knob guards
+(SERVE_TRANSPORTS == cli choices == stages env parse), the wire-schema
+pin across shm and socket paths, the sharded `run_open_loop`, the
+split-manifest round trip, and the tier-1 config-16 bench smoke.
+"""
+import json
+import os
+import sys
+import threading
+import time
+from datetime import date
+from pathlib import Path
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from bodywork_tpu.serve.netqueue import (
+    DEFAULT_DISPATCHER_PORT,
+    KIND_SINGLE,
+    SERVE_ROLES,
+    SERVE_TRANSPORTS,
+    NetQueueClient,
+    NetQueueServer,
+    parse_dispatcher_addr,
+)
+from bodywork_tpu.serve.rowqueue import (
+    DEFAULT_SLOTS,
+    DispatcherUnavailable,
+    SlotsExhausted,
+)
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _bundle(key="mk", info="mi", when="2026-07-01"):
+    return SimpleNamespace(model_key=key, model_info=info, model_date=when)
+
+
+def _wait_for(predicate, timeout_s=8.0, interval_s=0.01):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+@pytest.fixture(params=["tcp", "unix"])
+def net_pair(request, tmp_path):
+    if request.param == "tcp":
+        addr = ("tcp", "127.0.0.1", 0)
+    else:
+        addr = ("unix", str(tmp_path / "rowqueue.sock"))
+    server = NetQueueServer(addr, credit_window=4)
+    client = NetQueueClient(server.address, frontend_id=0).start()
+    assert _wait_for(client.dispatcher_up), "client never connected"
+    yield client, server
+    client.stop()
+    server.close()
+
+
+# -- transport roundtrip -----------------------------------------------------
+
+def test_submit_reply_roundtrip_parity(net_pair):
+    """One submit over the socket arrives dispatcher-side duck-typed to
+    the shm `_Submission` (kind/X/frontend_id/trace_id) and the reply
+    carries predictions + the answering bundle identity — the fields
+    the front-end splices into byte-identical HTTP responses."""
+    client, server = net_pair
+    got = {}
+    X = np.arange(6, dtype=np.float32).reshape(2, 3)
+    client.submit(X, KIND_SINGLE, lambda r: got.setdefault("r", r),
+                  trace_id="t-1")
+    sub = server.poll(timeout_s=5.0)
+    assert sub is not None
+    assert sub.kind == KIND_SINGLE
+    assert sub.frontend_id == 0
+    assert sub.trace_id == "t-1"
+    np.testing.assert_array_equal(sub.X, X)
+    server.reply(sub, 200,
+                 predictions=np.array([1.5, 2.5], dtype=np.float32),
+                 bundle=_bundle())
+    assert _wait_for(lambda: "r" in got)
+    reply = got["r"]
+    assert reply.status == 200
+    assert list(reply.predictions) == [1.5, 2.5]
+    assert (reply.model_key, reply.model_info, reply.model_date) == (
+        "mk", "mi", "2026-07-01"
+    )
+    stats = client.stats()
+    assert stats["requests_submitted"] == 1
+    assert stats["rows_submitted"] == 2
+    assert stats["replies_received"] == 1
+    assert stats["in_flight"] == 0
+
+
+def test_credit_window_is_the_shed_boundary(net_pair):
+    """Submits beyond the HELLO-granted window raise `SlotsExhausted`
+    synchronously — the socket analogue of an empty shm free-list, so
+    429 shedding fires at the same boundary on either transport — and
+    replies return the credits."""
+    client, server = net_pair
+    assert client.credit_window == 4
+    X = np.ones((1, 1), dtype=np.float32)
+    for _ in range(4):
+        client.submit(X, KIND_SINGLE, lambda r: None)
+    with pytest.raises(SlotsExhausted):
+        client.submit(X, KIND_SINGLE, lambda r: None)
+    assert client.transport_state()["credits_in_flight"] == 4
+    for _ in range(4):
+        sub = server.poll(timeout_s=5.0)
+        server.reply(sub, 200,
+                     predictions=np.zeros(1, dtype=np.float32),
+                     bundle=_bundle())
+    assert _wait_for(lambda: client.stats()["in_flight"] == 0)
+    client.submit(X, KIND_SINGLE, lambda r: None)  # credits came back
+
+
+def test_dispatcher_death_fails_waits_now_then_heals(net_pair):
+    """The PR 16 death contract over a socket: a broken connection
+    fails every in-flight wait immediately with `DispatcherUnavailable`
+    (503 + Retry-After at the HTTP layer — never a hung request), new
+    submits shed synchronously, and the jittered reconnect loop heals
+    against a rebound server, counting the reconnect."""
+    client, server = net_pair
+    address = server.address
+    fails = {}
+    X = np.ones((1, 1), dtype=np.float32)
+    client.submit(X, KIND_SINGLE, lambda r: fails.setdefault("r", r))
+    server.close()
+    assert _wait_for(lambda: "r" in fails)
+    assert isinstance(fails["r"], DispatcherUnavailable)
+    assert _wait_for(lambda: not client.dispatcher_up())
+    with pytest.raises(DispatcherUnavailable):
+        client.submit(X, KIND_SINGLE, lambda r: None)
+
+    reborn = NetQueueServer(address, credit_window=4)
+    try:
+        assert _wait_for(client.dispatcher_up, timeout_s=15.0)
+        assert client.reconnects == 1
+        assert client.transport_state()["reconnects"] == 1
+        got = {}
+        client.submit(X, KIND_SINGLE, lambda r: got.setdefault("r", r))
+        sub = reborn.poll(timeout_s=5.0)
+        reborn.reply(sub, 200,
+                     predictions=np.array([9.0], dtype=np.float32),
+                     bundle=_bundle())
+        assert _wait_for(lambda: "r" in got)
+        assert got["r"].status == 200
+    finally:
+        reborn.close()
+
+
+def test_dead_connection_submissions_skipped_and_reclaimed(tmp_path):
+    """The socket analogue of the dead-front-end slot reclaim: a
+    submission whose connection died while it queued is skipped at
+    `poll` (its reply would go nowhere), and a reply packed for a dead
+    connection drops silently instead of raising into the serve loop."""
+    server = NetQueueServer(("tcp", "127.0.0.1", 0), credit_window=4)
+    c1 = NetQueueClient(server.address, frontend_id=0).start()
+    c2 = NetQueueClient(server.address, frontend_id=1).start()
+    try:
+        assert _wait_for(lambda: c1.dispatcher_up() and c2.dispatcher_up())
+        X = np.ones((1, 1), dtype=np.float32)
+        c1.submit(X, KIND_SINGLE, lambda r: None, trace_id="dead")
+        c2.submit(X, KIND_SINGLE, lambda r: None, trace_id="alive")
+        # both queued server-side before either is polled
+        assert _wait_for(lambda: server._subs.qsize() == 2)
+        c1.stop()  # its connection (and in-flight budget) evaporates
+        time.sleep(0.2)
+        seen = []
+        while True:
+            sub = server.poll(timeout_s=1.0)
+            if sub is None:
+                break
+            seen.append(sub.trace_id)
+            server.reply(sub, 200,
+                         predictions=np.zeros(1, dtype=np.float32),
+                         bundle=_bundle())
+        assert seen == ["alive"]
+    finally:
+        c2.stop()
+        server.close()
+
+
+def test_hello_version_fence_refuses_mismatched_peer():
+    """A dispatcher speaking another wire schema version must be
+    refused at handshake — a mixed-version rollout degrades to 503 on
+    the new pods, never to misparsed frames mid-stream."""
+    import socket
+    import struct
+
+    from bodywork_tpu.serve.netqueue import _FRAME_HEADER, _HELLO_BODY
+    from bodywork_tpu.serve.wire import BINARY_CONTENT_TYPE
+
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+
+    def impostor():
+        conn, _ = listener.accept()
+        body = _HELLO_BODY.pack(9999, 4) + BINARY_CONTENT_TYPE.encode()
+        conn.sendall(_FRAME_HEADER.pack(len(body) + 1, 1) + body)
+        time.sleep(1.0)
+        conn.close()
+
+    t = threading.Thread(target=impostor, daemon=True)
+    t.start()
+    client = NetQueueClient(
+        ("tcp",) + listener.getsockname()[:2], frontend_id=0
+    ).start()
+    try:
+        time.sleep(0.8)
+        assert not client.dispatcher_up()
+        with pytest.raises(DispatcherUnavailable):
+            client.submit(np.ones((1, 1), dtype=np.float32),
+                          KIND_SINGLE, lambda r: None)
+    finally:
+        client.stop()
+        listener.close()
+
+
+def test_parse_dispatcher_addr():
+    assert parse_dispatcher_addr("tcp", "host.svc:9091") == (
+        "tcp", "host.svc", 9091
+    )
+    assert parse_dispatcher_addr("tcp", ":9091") == (
+        "tcp", "127.0.0.1", 9091
+    )
+    assert parse_dispatcher_addr("unix", "/tmp/q.sock") == (
+        "unix", "/tmp/q.sock"
+    )
+    with pytest.raises(ValueError):
+        parse_dispatcher_addr("tcp", "no-port")
+    with pytest.raises(ValueError):
+        parse_dispatcher_addr("tcp", None)
+    with pytest.raises(ValueError):
+        parse_dispatcher_addr("unix", None)
+    with pytest.raises(ValueError):
+        parse_dispatcher_addr("carrier-pigeon", "x:1")
+
+
+# -- surface + knob guards ---------------------------------------------------
+
+def test_transport_state_surface_parity():
+    """Both clients answer `transport_state()` with the same keys — the
+    `/healthz` transport block is transport-agnostic by construction."""
+    from bodywork_tpu.serve.rowqueue import RowQueue, RowQueueClient
+    import multiprocessing
+
+    server = NetQueueServer(("tcp", "127.0.0.1", 0), credit_window=4)
+    net = NetQueueClient(server.address, frontend_id=0).start()
+    queue = RowQueue(multiprocessing.get_context("spawn"), frontends=1,
+                     slots=4, slot_floats=8)
+    shm = RowQueueClient(queue, frontend_id=0)
+    try:
+        assert _wait_for(net.dispatcher_up)
+        net_state = net.transport_state()
+        shm_state = shm.transport_state()
+        assert set(net_state) == set(shm_state)
+        assert net_state["kind"] == "tcp"
+        assert shm_state["kind"] == "shm"
+        assert net_state["credit_window"] == 4
+        assert shm_state["credit_window"] == queue.slots
+        # and the stats surface frontend.py reads stays identical too
+        assert set(net.stats()) == set(shm.stats())
+    finally:
+        net.stop()
+        server.close()
+        queue.close()
+
+
+def test_transport_knob_cli_stage_and_module_stay_in_sync(monkeypatch):
+    """The three-table guard (the PR 6/12/14 parser-drift pattern):
+    `SERVE_TRANSPORTS`/`SERVE_ROLES` == the cli `serve` parser's
+    `--transport`/`--role` choices == the choices the pod-boot stage
+    env parse accepts — and malformed env values degrade to the
+    defaults with a warning, never a crash-looping pod."""
+    from bodywork_tpu.cli import build_parser
+    from bodywork_tpu.pipeline.stages import _serve_transport_env_knobs
+
+    parser = build_parser()
+    serve_sp = next(
+        sp for sub in parser._subparsers._group_actions
+        for name, sp in sub.choices.items() if name == "serve"
+    )
+    by_flag = {
+        flag: a for a in serve_sp._actions
+        for flag in a.option_strings
+    }
+    assert tuple(by_flag["--transport"].choices) == SERVE_TRANSPORTS
+    assert tuple(by_flag["--role"].choices) == SERVE_ROLES
+    assert "--dispatcher-addr" in by_flag
+
+    for raw_t, want_t in (
+        ("tcp", "tcp"), ("unix", "unix"), ("shm", "shm"),
+        ("quic", "shm"),  # malformed -> degrade, never a crash
+        ("", "shm"),
+    ):
+        monkeypatch.setenv("BODYWORK_TPU_SERVE_TRANSPORT", raw_t)
+        monkeypatch.delenv("BODYWORK_TPU_DISPATCHER_ADDR", raising=False)
+        monkeypatch.setenv("BODYWORK_TPU_SERVE_ROLE", "nope")
+        transport, addr, role = _serve_transport_env_knobs()
+        assert transport == want_t, raw_t
+        assert role == "auto"  # malformed role degraded
+        assert addr is None
+        args = build_parser().parse_args(["serve", "--store", "s"])
+        assert args.transport == want_t, raw_t
+        assert args.role == "auto"
+
+    monkeypatch.setenv("BODYWORK_TPU_DISPATCHER_ADDR", "disp.svc:9091")
+    monkeypatch.setenv("BODYWORK_TPU_SERVE_ROLE", "frontend")
+    assert _serve_transport_env_knobs()[1:] == ("disp.svc:9091", "frontend")
+
+
+def test_wire_schema_pinned_identical_across_shm_and_socket_paths():
+    """One wire version, one content type — the HELLO negotiates
+    exactly what `serve/wire.py` exports, and the shm HTTP path's
+    binary content type is the same constant the socket frames carry
+    (the byte-identity contract rests on this pin)."""
+    import socket
+
+    from bodywork_tpu.serve import wire
+    from bodywork_tpu.serve.netqueue import _HELLO_BODY, _recv_frame
+
+    assert wire.WIRE_SCHEMA_VERSION == 1
+    assert wire.BINARY_CONTENT_TYPE == "application/x-bodywork-rows"
+
+    server = NetQueueServer(("tcp", "127.0.0.1", 0), credit_window=7)
+    try:
+        raw = socket.create_connection(server.address[1:], timeout=5)
+        try:
+            msg_type, body = _recv_frame(raw)
+            assert msg_type == 1  # HELLO
+            version, credits = _HELLO_BODY.unpack_from(body)
+            assert version == wire.WIRE_SCHEMA_VERSION
+            assert credits == 7
+            assert body[_HELLO_BODY.size:].decode("ascii") == (
+                wire.BINARY_CONTENT_TYPE
+            )
+        finally:
+            raw.close()
+    finally:
+        server.close()
+
+
+def test_multiproc_transport_validation():
+    from bodywork_tpu.serve import MultiProcessService
+
+    with pytest.raises(ValueError, match="unknown row-queue transport"):
+        MultiProcessService("s", transport="quic")
+    with pytest.raises(ValueError, match="frontends"):
+        MultiProcessService("s", transport="tcp")
+    with pytest.raises(ValueError, match="external dispatcher"):
+        MultiProcessService("s", transport="shm", frontends=2,
+                            external_dispatcher=True)
+    with pytest.raises(ValueError, match="dispatcher-addr"):
+        MultiProcessService("s", transport="tcp", frontends=2,
+                            external_dispatcher=True)
+
+
+def test_netqueue_metric_names_pass_the_lint():
+    """The new families respect the obs naming contract (namespace
+    prefix, unit suffix, counter `_total`) — `_in_flight` is a lintable
+    unit suffix, so the credits gauge is legal by rule, not exception."""
+    from bodywork_tpu.obs.registry import validate_metric_name
+
+    validate_metric_name("bodywork_tpu_netqueue_reconnects_total",
+                         "counter")
+    validate_metric_name("bodywork_tpu_netqueue_rtt_seconds", "histogram")
+    validate_metric_name("bodywork_tpu_netqueue_credits_in_flight",
+                         "gauge")
+
+
+def test_frontend_healthz_carries_the_transport_block():
+    """`/healthz` answers the transport block for BOTH client kinds —
+    the k8s split's operator view (kind, connected, reconnects, credit
+    window, credits in flight) — without the front-end knowing which
+    transport it rides."""
+    from bodywork_tpu.serve.frontend import FrontendApp
+    from bodywork_tpu.serve.rowqueue import RowQueue, RowQueueClient
+    import multiprocessing
+
+    server = NetQueueServer(("tcp", "127.0.0.1", 0), credit_window=4)
+    net = NetQueueClient(server.address, frontend_id=0).start()
+    queue = RowQueue(multiprocessing.get_context("spawn"), frontends=1,
+                     slots=4, slot_floats=8)
+    shm = RowQueueClient(queue, frontend_id=0)
+    try:
+        assert _wait_for(net.dispatcher_up)
+        for client, kind, connected in (
+            (net, "tcp", True), (shm, "shm", False),
+        ):
+            payload, _status, _retry = FrontendApp(client).healthz_payload()
+            block = payload["transport"]
+            assert block["kind"] == kind
+            assert block["connected"] is connected
+            assert set(block) >= {
+                "kind", "connected", "reconnects", "credit_window",
+                "credits_in_flight", "address",
+            }
+    finally:
+        net.stop()
+        server.close()
+        queue.close()
+
+
+# -- the sharded open-loop driver --------------------------------------------
+
+class _StubHandler:
+    pass
+
+
+def _stub_server():
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            body = json.dumps({
+                "prediction": 1.0, "model_info": "m", "model_date": "d",
+            }).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
+def test_sharded_driver_merges_per_shard_reports():
+    """`run_open_loop(shards=N)` round-robins the seeded log across N
+    worker processes (rate and arrival distribution preserved per
+    shard) and merges the per-shard results into ONE report whose
+    counts equal the single-process drive of the same log."""
+    from bodywork_tpu.traffic.generator import (
+        TrafficConfig,
+        generate_request_log,
+    )
+    from bodywork_tpu.traffic.runner import run_open_loop
+
+    server = _stub_server()
+    url = f"http://127.0.0.1:{server.server_port}"
+    try:
+        log = generate_request_log(
+            TrafficConfig(rate_rps=120, duration_s=0.8, seed=5)
+        )
+        solo = run_open_loop(url, log, timeout_s=10.0)
+        merged = run_open_loop(url, log, timeout_s=10.0, shards=3)
+        assert solo.shards == 1
+        assert merged.shards == 3
+        assert merged.requests == solo.requests == len(log)
+        assert merged.ok == len(log)
+        assert merged.timeouts == 0
+        assert merged.goodput_rps > 0
+        assert merged.max_in_flight >= 1
+        assert merged.latency["p99_s"] > 0
+    finally:
+        server.shutdown()
+
+
+def test_sharded_driver_refuses_custom_transports_and_bad_counts():
+    """A custom in-process transport cannot cross a process boundary —
+    sharding must refuse it loudly rather than silently serialize."""
+    from bodywork_tpu.traffic.generator import (
+        TrafficConfig,
+        generate_request_log,
+    )
+    from bodywork_tpu.traffic.runner import run_open_loop
+
+    log = generate_request_log(
+        TrafficConfig(rate_rps=50, duration_s=0.2, seed=1)
+    )
+    with pytest.raises(ValueError, match="transport"):
+        run_open_loop("http://x", log, transport=lambda *a: None, shards=2)
+    with pytest.raises(ValueError, match="shards"):
+        run_open_loop("http://x", log, shards=0)
+
+
+def test_cli_traffic_run_exposes_shards():
+    from bodywork_tpu.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["traffic", "run", "--url", "http://x", "--shards", "4"]
+    )
+    assert args.shards == 4
+    assert build_parser().parse_args(
+        ["traffic", "run", "--url", "http://x"]
+    ).shards == 1
+
+
+# -- the k8s split -----------------------------------------------------------
+
+def test_k8s_split_manifests_round_trip():
+    """A serving stage declaring `BODYWORK_TPU_SERVE_TRANSPORT=tcp`
+    splits into a jax-free front-end Deployment (standard stage name —
+    the Service/Ingress/HPA retarget it without edits; TPU limits and
+    nodeSelector stripped) plus a one-replica dispatcher Deployment
+    (keeps the TPU, tcpSocket readiness on 9091) and its ClusterIP
+    Service — and the whole set passes every validation layer."""
+    from bodywork_tpu.pipeline import default_pipeline
+    from bodywork_tpu.pipeline.k8s import generate_manifests
+    from bodywork_tpu.pipeline.k8s_validate import validate_manifests
+
+    spec = default_pipeline()
+    stage = next(s for s in spec.stages.values() if "serve" in s.name)
+    stage.env["BODYWORK_TPU_SERVE_TRANSPORT"] = "tcp"
+    docs = generate_manifests(spec, store_path="/mnt/store")
+    validate_manifests(docs)  # whitelist + schema + split semantics
+
+    deployments = {
+        d["metadata"]["name"]: d for d in docs.values()
+        if isinstance(d, dict) and d.get("kind") == "Deployment"
+    }
+    disp_name = next(n for n in deployments if n.endswith("--dispatcher"))
+    fe_name = disp_name[: -len("--dispatcher")]
+    disp = deployments[disp_name]
+    fe = deployments[fe_name]
+
+    assert disp["spec"]["replicas"] == 1
+    disp_c = disp["spec"]["template"]["spec"]["containers"][0]
+    assert disp_c["readinessProbe"]["tcpSocket"]["port"] == (
+        DEFAULT_DISPATCHER_PORT
+    )
+    assert "dispatcher" in disp_c["command"]
+    assert disp_c["resources"].get("limits", {}).get("google.com/tpu")
+
+    fe_c = fe["spec"]["template"]["spec"]["containers"][0]
+    assert "frontend" in fe_c["command"]
+    addr = fe_c["command"][fe_c["command"].index("--dispatcher-addr") + 1]
+    assert addr == f"{disp_name}:{DEFAULT_DISPATCHER_PORT}"
+    assert "limits" not in fe_c["resources"]
+    assert "nodeSelector" not in fe["spec"]["template"]["spec"]
+    env_names = {e["name"] for e in fe_c["env"]}
+    assert {"BODYWORK_TPU_SERVE_TRANSPORT", "BODYWORK_TPU_DISPATCHER_ADDR",
+            "BODYWORK_TPU_SERVE_ROLE"} <= env_names
+
+    svc = next(
+        d for d in docs.values()
+        if isinstance(d, dict) and d.get("kind") == "Service"
+        and d["metadata"]["name"] == disp_name
+    )
+    assert svc["spec"]["ports"][0]["port"] == DEFAULT_DISPATCHER_PORT
+    hpa_targets = [
+        d["spec"]["scaleTargetRef"]["name"] for d in docs.values()
+        if isinstance(d, dict)
+        and d.get("kind") == "HorizontalPodAutoscaler"
+    ]
+    assert fe_name in hpa_targets
+    assert disp_name not in hpa_targets
+
+    # the default (shm) pipeline emits NO split and still validates
+    plain = generate_manifests(default_pipeline(), store_path="/mnt/store")
+    validate_manifests(plain)
+    assert not any("dispatcher" in name for name in plain)
+
+
+def test_k8s_split_validator_rejects_scaled_dispatcher():
+    """`validate_k8s` refuses a dispatcher Deployment with replicas > 1
+    (two dispatchers = two coalescers each seeing a fraction of the
+    rows) and an HPA aimed at the singleton."""
+    from bodywork_tpu.pipeline import default_pipeline
+    from bodywork_tpu.pipeline.k8s import generate_manifests
+    from bodywork_tpu.pipeline.k8s_validate import validate_split_serving
+
+    spec = default_pipeline()
+    stage = next(s for s in spec.stages.values() if "serve" in s.name)
+    stage.env["BODYWORK_TPU_SERVE_TRANSPORT"] = "tcp"
+    docs = generate_manifests(spec, store_path="/mnt/store")
+    disp = next(
+        d for d in docs.values()
+        if isinstance(d, dict) and d.get("kind") == "Deployment"
+        and d["metadata"]["name"].endswith("--dispatcher")
+    )
+    disp["spec"]["replicas"] = 3
+    errors = validate_split_serving(docs)
+    assert any("exactly 1 replica" in e for e in errors)
+
+    disp["spec"]["replicas"] = 1
+    hpa = next(
+        d for d in docs.values()
+        if isinstance(d, dict)
+        and d.get("kind") == "HorizontalPodAutoscaler"
+    )
+    hpa["spec"]["scaleTargetRef"]["name"] = disp["metadata"]["name"]
+    errors = validate_split_serving(docs)
+    assert any("front-end" in e and "HPA" in e for e in errors)
+
+
+def test_serve_stage_warns_on_socket_knobs_it_cannot_materialise(
+    monkeypatch, caplog
+):
+    """The in-process `serve_stage` cannot run a cross-host fleet; a
+    pod booted with socket-transport knobs must warn and serve anyway
+    (malformed-degrades, the §13 pattern), not crash."""
+    import logging
+
+    from bodywork_tpu.pipeline.stages import _serve_transport_env_knobs
+
+    monkeypatch.setenv("BODYWORK_TPU_SERVE_TRANSPORT", "tcp")
+    monkeypatch.setenv("BODYWORK_TPU_SERVE_ROLE", "frontend")
+    with caplog.at_level(logging.WARNING):
+        transport, addr, role = _serve_transport_env_knobs()
+    assert (transport, role) == ("tcp", "frontend")
+
+
+# -- config 16: tier-1 smoke + full sweep ------------------------------------
+
+@pytest.mark.load
+def test_config16_smoke():
+    """Smoke-scale cross-host-transport bench (loopback sockets,
+    seconds not minutes): byte identity holds across shm/tcp and the
+    single-process server, the handoff scrape resolves, the sharded
+    driver produces the scaling points, and the kill drill sees only
+    503+Retry-After with zero hung requests. The full acceptance sweep
+    is the `slow`-marked test below."""
+    import bench
+
+    record = bench.bench_cross_host_transports(
+        frontend_counts=(1,),
+        transports=("shm", "tcp"),
+        rate_cap_rps=120.0,
+        capacity_window_s=0.4,
+        handoff_rate_rps=50.0,
+        handoff_window_s=0.5,
+        driver_shards=2,
+        compare_frontends=1,
+        kill_rate_rps=50.0,
+        kill_window_s=0.8,
+    )
+    assert record["metric"] == "cross_host_transport_scaling"
+    assert record["byte_identity"]["identical"] is True
+    assert record["transports"]["tcp"]["healthz_transport"]["kind"] == "tcp"
+    assert record["transports"]["tcp"]["mean_handoff_s"] is not None
+    assert record["transports"]["tcp"]["mean_rtt_s"] is not None
+    point = record["scaling"]["points"]["1"]
+    assert point["capacity_rps"] > 0
+    assert record["scaling"]["driver_shards"] == 2
+    drill = record["kill_drill"]
+    assert drill["ran"] and drill["healed"]
+    assert drill["outage_clean"], drill["outage"]
+    assert drill["outage"]["timeouts"] == 0
+    assert drill["byte_identical_after_heal"]
+
+
+@pytest.mark.load
+@pytest.mark.slow
+def test_config16_full_sweep():
+    """The acceptance sweep (minutes): byte identity across every
+    transport, the sharded-driver scaling slope, and the kill drill's
+    10% recovery bar."""
+    import bench
+
+    record = bench.bench_cross_host_transports()
+    assert record["byte_identity"]["identical"] is True
+    drill = record["kill_drill"]
+    assert drill["outage_clean"] and drill["recovered_within_10pct"]
+    for point in record["scaling"]["points"].values():
+        assert point["capacity_rps"] > 0
+
+
+def test_config_registry_includes_16():
+    """The ISSUE-18 satellite: the config tables really grew to 16
+    entries (the generic sync guard can't notice a config that is
+    missing from ALL three tables at once)."""
+    import bench
+
+    assert set(bench.ALL_CONFIGS) == set(range(1, 17))
+    assert 16 in bench.CONFIG_BENCHES
+    assert bench.CONFIG_TIMEOUT_S[16] > 0
