@@ -75,6 +75,7 @@ from bodywork_tpu.store.schema import (
     REGISTRY_PREFIX,
     REGISTRY_RECORDS_PREFIX,
     RUNS_PREFIX,
+    SERVE_PREFIX,
     SNAPSHOTS_PREFIX,
     TENANTS_PREFIX,
     TEST_METRICS_PREFIX,
@@ -877,6 +878,37 @@ def _check_tenants(ctx: FsckContext) -> list[Finding]:
     return out
 
 
+def _check_serve(ctx: FsckContext) -> list[Finding]:
+    """Serving-plane operational state (``serve/leadership.py``): the
+    dispatcher-leader lease document. Purely operational — it names the
+    CURRENT leader, not any artefact — so every defect is rebuildable:
+    the next election's CAS acquire repairs a corrupt document in
+    place, and a deleted one merely forces a fresh election (fence
+    restarts at 1, which clients accept — fences only guard against
+    REGRESSION within a document's history)."""
+    from bodywork_tpu.serve.leadership import LEADER_SCHEMA
+
+    out = []
+    for key in ctx.keys[SERVE_PREFIX]:
+        doc = _json_doc(_get(ctx.store, key) or b"")
+        if doc is None or doc.get("schema") != LEADER_SCHEMA:
+            out.append(Finding(
+                key, SERVE_PREFIX, "unreadable", "rebuildable",
+                detail="serving-plane lease document fails validation; "
+                       "operational state only — the next leadership "
+                       "acquire CAS-repairs it in place (deleting it "
+                       "just forces a fresh election)",
+            ))
+            continue
+        if not isinstance(doc.get("fence"), int) or doc["fence"] < 0:
+            out.append(Finding(
+                key, SERVE_PREFIX, "unreadable", "rebuildable",
+                detail="lease document carries no valid fence; the next "
+                       "acquire rewrites it",
+            ))
+    return out
+
+
 #: prefix -> auditor. Guard-pinned == schema.ALL_PREFIXES == the
 #: docs/RESILIENCE.md §11 integrity table (tests/test_audit.py).
 CHECKERS = {
@@ -892,6 +924,7 @@ CHECKERS = {
     AUDIT_PREFIX: _check_audit,
     QUARANTINE_PREFIX: _check_quarantine,
     FLIGHTREC_PREFIX: _check_flightrec,
+    SERVE_PREFIX: _check_serve,
     TENANTS_PREFIX: _check_tenants,
 }
 

@@ -46,6 +46,7 @@ from bodywork_tpu.store.schema import (
     FLIGHTREC_PREFIX,
     QUARANTINE_PREFIX,
     RUNS_PREFIX,
+    SERVE_PREFIX,
     SNAPSHOTS_PREFIX,
     TEST_METRICS_PREFIX,
 )
@@ -177,6 +178,11 @@ _COMPARE_EXCLUDED = (
     # tracing on: trace ids ride only a response header.
     FLIGHTREC_PREFIX,
     AUDIT_DIGESTS_PREFIX + FLIGHTREC_PREFIX,
+    # the serving-plane leader lease embeds owner host:pid:nonce and
+    # wall-clock expiry — operational state, never artefact data; each
+    # twin elects its own dispatcher so the docs can never match
+    SERVE_PREFIX,
+    AUDIT_DIGESTS_PREFIX + SERVE_PREFIX,
 )
 
 

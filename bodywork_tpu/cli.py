@@ -194,6 +194,27 @@ def _env_number(name: str, cast, minimum):
     return value
 
 
+def _env_flag(name: str) -> bool:
+    """Parser-build-time env default for a boolean flag: malformed
+    values degrade to False with a stderr note (the same contract as
+    :func:`_env_choice` — a typo'd deploy knob must not crash every
+    subcommand, and the stages env parser degrades identically)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return False
+    value = raw.strip().lower()
+    if value in ("1", "true", "yes", "on"):
+        return True
+    if value in ("", "0", "false", "no", "off"):
+        return False
+    print(
+        f"warning: ignoring {name}={raw!r} (expected a boolean like "
+        "1/0/true/false)",
+        file=sys.stderr,
+    )
+    return False
+
+
 def _serve_dispatcher_role(args, transport: str, watch, batch_window) -> int:
     """``serve --role dispatcher``: the device-owning half of the
     cross-host split, standalone — serves the socket row-queue instead
@@ -269,6 +290,7 @@ def cmd_serve(args) -> int:
     frontends = getattr(args, "frontends", None)
     transport = getattr(args, "transport", "shm")
     role = getattr(args, "role", "auto")
+    standby = bool(getattr(args, "standby", False))
     if frontends is not None and frontends >= 1 and args.workers > 1:
         # two incompatible scale-out topologies: replicas each own a
         # model; front-ends share the one dispatcher's
@@ -281,9 +303,36 @@ def cmd_serve(args) -> int:
         log.error("--transport tcp/unix requires --frontends N "
                   "(or a split --role)")
         return 1
+    if standby and transport == "shm":
+        log.error("--standby needs --transport tcp or unix: the shm "
+                  "queue is single-host, where the supervisor respawn "
+                  "is already the takeover path")
+        return 1
     if role == "dispatcher":
-        return _serve_dispatcher_role(args, transport, watch, batch_window)
+        if not standby:
+            return _serve_dispatcher_role(args, transport, watch,
+                                          batch_window)
+        # --role dispatcher --standby: the active/standby PAIR under
+        # one supervisor — no local HTTP, two warm candidates, CAS
+        # lease arbitration (serve.leadership). Falls through to the
+        # MultiProcessService branch with frontends=0.
+        from bodywork_tpu.serve.netqueue import DEFAULT_DISPATCHER_PORT
+
+        if not args.dispatcher_addr:
+            if transport == "unix":
+                log.error("--role dispatcher with --transport unix "
+                          "needs --dispatcher-addr (the socket path "
+                          "to bind)")
+                return 1
+            args.dispatcher_addr = f"0.0.0.0:{DEFAULT_DISPATCHER_PORT}"
+        frontends = 0
     if role == "frontend":
+        if standby:
+            # front-ends need no flag to ride a failover: the standby
+            # pair announces itself through the lease/fence alone
+            log.warning("--standby concerns the dispatcher side; "
+                        "ignoring it for --role frontend")
+            standby = False
         if transport == "shm":
             log.error("--role frontend needs --transport tcp or unix "
                       "(a remote dispatcher is not reachable over "
@@ -295,7 +344,7 @@ def cmd_serve(args) -> int:
             return 1
         frontends = frontends or 1
     if (args.workers and args.workers > 1) or (
-        frontends is not None and frontends >= 1
+        frontends is not None and (frontends >= 1 or standby)
     ):
         # real OS-process replicas on one SO_REUSEPORT port (the local
         # materialisation of the reference's `replicas: 2` Deployment);
@@ -326,6 +375,7 @@ def cmd_serve(args) -> int:
             transport=transport,
             dispatcher_addr=getattr(args, "dispatcher_addr", None),
             external_dispatcher=(role == "frontend"),
+            standby=standby,
         ).start()
         if svc.metrics_url:
             log.info(f"aggregated metrics at {svc.metrics_url}")
@@ -1787,6 +1837,19 @@ def build_parser() -> argparse.ArgumentParser:
              "device-owning scorer, serving the socket row-queue "
              "instead of HTTP — the two halves the split k8s "
              "Deployments run (docs/RESILIENCE.md §14)",
+    )
+    p.add_argument(
+        "--standby", action="store_true",
+        default=_env_flag("BODYWORK_TPU_SERVE_STANDBY"),
+        help="dispatcher high availability (socket transports only): "
+             "run a WARM standby dispatcher next to the active one, "
+             "arbitrated by a CAS lease on the artefact store — the "
+             "standby takes over within the lease TTL of a leader "
+             "death, and front-ends resubmit in-flight rows across the "
+             "takeover instead of shedding (env "
+             "BODYWORK_TPU_SERVE_STANDBY overrides; with --role "
+             "dispatcher this supervises the active/standby PAIR; "
+             "docs/RESILIENCE.md failover runbook)",
     )
     p.add_argument(
         "--buckets", default=None, metavar="N[,N...]", type=_bucket_list,

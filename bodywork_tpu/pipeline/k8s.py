@@ -259,6 +259,10 @@ def _container(
             ("BODYWORK_TPU_SERVE_TRANSPORT", ""),
             ("BODYWORK_TPU_DISPATCHER_ADDR", ""),
             ("BODYWORK_TPU_SERVE_ROLE", ""),
+            # dispatcher high availability (serve/leadership.py): a
+            # truthy value emits an active/standby dispatcher PAIR
+            # (replicas: 2) arbitrated by the CAS lease on the store
+            ("BODYWORK_TPU_SERVE_STANDBY", ""),
             # coalescer + bucket knobs and the tuned-config pointer
             # (tune/config.py, read by stages._serve_tuned_env_knobs):
             # point BODYWORK_TPU_TUNED_CONFIG at a tuning/ document (or
@@ -549,6 +553,9 @@ def generate_manifests(
                         "BODYWORK_TPU_SERVE_TRANSPORT", ""
                     )).strip() == "tcp"
                 )
+                standby = str(stage.env.get(
+                    "BODYWORK_TPU_SERVE_STANDBY", ""
+                )).strip().lower() in ("1", "true", "yes", "on")
                 dispatcher_dns = f"{meta['name']}--dispatcher"
                 if split:
                     from bodywork_tpu.serve.netqueue import (
@@ -625,6 +632,16 @@ def generate_manifests(
                         "--dispatcher-addr",
                         f"0.0.0.0:{DEFAULT_DISPATCHER_PORT}",
                     ]
+                    if standby:
+                        # each pod supervises warm candidates in the
+                        # CAS election (serve/leadership.py); with the
+                        # Deployment scaled to 2, a whole-pod death
+                        # still leaves the OTHER pod's candidates to
+                        # take over within the lease TTL — only the
+                        # global leader binds :9091, so the tcpSocket
+                        # readiness below IS leadership-gated and the
+                        # ClusterIP routes to the leader alone
+                        dispatcher_cmd.append("--standby")
                     dpod = _pod_spec(
                         spec, stage, store, image, dispatcher_cmd,
                         "Always",
@@ -654,13 +671,17 @@ def generate_manifests(
                         "kind": "Deployment",
                         "metadata": dmeta,
                         "spec": {
-                            # exactly ONE device-owning dispatcher: the
+                            # exactly ONE SERVING dispatcher: the
                             # row-queue contract is N front-ends -> one
                             # scorer (batches coalesce from the union
                             # of all front-ends' rows); scale
                             # FRONT-ENDS via the HPA, dispatchers only
-                            # by deploying more services
-                            "replicas": 1,
+                            # by deploying more services. Standby mode
+                            # scales to 2 PODS — warm candidates, CAS
+                            # lease arbitration, one leader serving —
+                            # the only scaled dispatcher shape the
+                            # validator accepts (k8s_validate.py)
+                            "replicas": 2 if standby else 1,
                             "selector": {
                                 "matchLabels": {"app": dispatcher_dns},
                             },

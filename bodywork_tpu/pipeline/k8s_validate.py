@@ -506,7 +506,16 @@ def validate_split_serving(docs: dict[str, dict]) -> list[str]:
     ``--dispatcher-addr`` naming that Service, the serve env knobs
     materialised on the front-end container, and every HPA targeting
     the FRONT-END Deployment — autoscaling the singleton dispatcher
-    would violate the one-scorer contract. Returns error strings."""
+    would violate the one-scorer contract. Returns error strings.
+
+    Replica rule (ISSUE 19): WITHOUT standby mode the dispatcher must
+    run exactly 1 replica (two unfenced dispatchers would both bind
+    and split the coalescer's row union); WITH standby declared
+    (``--standby`` in the command or a truthy
+    ``BODYWORK_TPU_SERVE_STANDBY`` env) up to 2 replicas are accepted —
+    warm candidates arbitrated by the CAS lease, only the leader binds
+    the probed port. More than 2 is refused either way: extra standbys
+    buy no additional fault tolerance for their device cost."""
     errors: list[str] = []
     deployments = {
         doc["metadata"]["name"]: (filename, doc)
@@ -528,12 +537,30 @@ def validate_split_serving(docs: dict[str, dict]) -> list[str]:
         if not name.endswith("--dispatcher"):
             continue
         spec = doc["spec"]
-        if spec.get("replicas") != 1:
+        container = spec["template"]["spec"]["containers"][0]
+        env_values = {
+            e.get("name"): str(e.get("value", ""))
+            for e in container.get("env", [])
+        }
+        standby = "--standby" in container.get("command", []) or (
+            env_values.get("BODYWORK_TPU_SERVE_STANDBY", "")
+            .strip().lower() in ("1", "true", "yes", "on")
+        )
+        replicas = spec.get("replicas")
+        if standby:
+            if replicas not in (1, 2):
+                errors.append(
+                    f"{filename}: standby dispatcher Deployment {name!r} "
+                    f"may run 1 or 2 replicas (the active/standby pair), "
+                    f"got {replicas!r}"
+                )
+        elif replicas != 1:
             errors.append(
                 f"{filename}: dispatcher Deployment {name!r} must run "
-                f"exactly 1 replica, got {spec.get('replicas')!r}"
+                f"exactly 1 replica without standby mode (scale needs "
+                f"--standby: lease-fenced leadership, one serving "
+                f"leader), got {replicas!r}"
             )
-        container = spec["template"]["spec"]["containers"][0]
         probe = container.get("readinessProbe", {})
         if "tcpSocket" not in probe:
             errors.append(

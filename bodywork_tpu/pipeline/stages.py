@@ -410,18 +410,19 @@ def _serve_fleet_env_knobs() -> int | None:
     return frontends
 
 
-def _serve_transport_env_knobs() -> tuple[str, str | None, str]:
+def _serve_transport_env_knobs() -> tuple[str, str | None, str, bool]:
     """The deployed cross-host-split knobs (``(transport,
-    dispatcher_addr, role)`` — ``serve.netqueue``: which row-queue
-    transport the front-end -> dispatcher handoff rides, where the
-    dispatcher's listener lives, and which half of the split this pod
-    runs) from the pod environment. Split out like
+    dispatcher_addr, role, standby)`` — ``serve.netqueue`` /
+    ``serve.leadership``: which row-queue transport the front-end ->
+    dispatcher handoff rides, where the dispatcher's listener lives,
+    which half of the split this pod runs, and whether the dispatcher
+    runs with a warm standby) from the pod environment. Split out like
     :func:`_serve_fleet_env_knobs`, and consumed the same way:
     ``cli serve`` builds the topology from them; the IN-PROCESS serve
     stage cannot (one process, no row-queue), so it surfaces and warns.
-    The transport/role choice sets are pinned ==
-    ``serve.netqueue.SERVE_TRANSPORTS`` / ``SERVE_ROLES`` == the
-    ``cli serve`` parser choices by tests/test_netqueue.py. Same
+    The transport/role choice sets (and the standby boolean parse) are
+    pinned == ``serve.netqueue.SERVE_TRANSPORTS`` / ``SERVE_ROLES`` ==
+    the ``cli serve`` parser choices by tests/test_netqueue.py. Same
     malformed-degrades contract: a typo'd value is a warning and the
     default, never a crash-looping pod."""
     import os
@@ -445,7 +446,18 @@ def _serve_transport_env_knobs() -> tuple[str, str | None, str]:
         )
         role = ""
     addr = os.environ.get("BODYWORK_TPU_DISPATCHER_ADDR", "").strip() or None
-    return transport or "shm", addr, role or "auto"
+    raw_standby = os.environ.get(
+        "BODYWORK_TPU_SERVE_STANDBY", ""
+    ).strip().lower()
+    standby = raw_standby in ("1", "true", "yes", "on")
+    if raw_standby and not standby and raw_standby not in (
+        "0", "false", "no", "off"
+    ):
+        log.warning(
+            f"ignoring BODYWORK_TPU_SERVE_STANDBY={raw_standby!r} "
+            "(expected a boolean like 1/0/true/false)"
+        )
+    return transport or "shm", addr, role or "auto", standby
 
 
 def serve_stage(
@@ -578,7 +590,9 @@ def serve_stage(
             "disaggregated process fleet (`cli serve --frontends`); "
             "the in-process serve stage runs one process and ignores it"
         )
-    env_transport, _env_addr, env_role = _serve_transport_env_knobs()
+    env_transport, _env_addr, env_role, _env_standby = (
+        _serve_transport_env_knobs()
+    )
     if env_transport != "shm" or env_role != "auto":
         log.warning(
             f"BODYWORK_TPU_SERVE_TRANSPORT={env_transport!r} / "

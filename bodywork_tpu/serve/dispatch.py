@@ -182,7 +182,9 @@ def dispatcher_main(store_path: str, queue, ready,
                     dtype: str = "float32",
                     tuned_config: str | None = None,
                     transport: str = "shm",
-                    dispatcher_addr=None):
+                    dispatcher_addr=None,
+                    standby: bool = False,
+                    leader_ttl_s: float | None = None):
     """The dispatcher process entrypoint (mirrors ``multiproc._worker_main``
     minus HTTP): load the serving checkpoint, build the predictor, arm
     the dispatcher-side coalescer, pump the row-queue. ``up`` flips to 1
@@ -194,7 +196,17 @@ def dispatcher_main(store_path: str, queue, ready,
     ``"unix"`` bind a :class:`~bodywork_tpu.serve.netqueue.NetQueueServer`
     at ``dispatcher_addr`` instead, and ``queue`` may be ``None`` (the
     standalone k8s dispatcher Deployment has no shm arena to share).
-    ``ready`` may be ``None`` too when there is no supervising parent."""
+    ``ready`` may be ``None`` too when there is no supervising parent.
+
+    ``standby=True`` (socket transports only) runs this dispatcher as a
+    WARM leadership candidate (``serve.leadership``): load the model,
+    warm the predictor, signal ``ready`` — then block campaigning for
+    the CAS lease on the artefact store and only bind the listen
+    address after WINNING it, announcing the lease fence in every
+    HELLO. Takeover therefore costs zero compiles: the standby's only
+    cold step is the bind. A lost lease (renew fails past TTL) stops
+    the serve loop so the process exits and respawns as a fresh
+    candidate rather than serving as a zombie."""
     from bodywork_tpu.models.checkpoint import load_model, resolve_serving_key
     from bodywork_tpu.serve.app import create_app
     from bodywork_tpu.serve.batcher import DEFAULT_WINDOW_MS
@@ -267,28 +279,73 @@ def dispatcher_main(store_path: str, queue, ready,
             dtype=dtype,
         ).start()
     net_server = None
-    if transport in ("tcp", "unix"):
-        from bodywork_tpu.serve.netqueue import NetQueueServer
-
-        # bind BEFORE signalling ready: a front-end told to connect must
-        # find a listener, not a race
-        net_server = NetQueueServer(dispatcher_addr)
-        dispatch = DispatchServer(app, queue, server=net_server)
-    else:
-        dispatch = DispatchServer(app, queue)
-    if queue is not None:
-        queue.up.value = 1
-    if ready is not None:
-        ready.put(os.getpid())
-    log.info(
-        f"dispatcher serving the {transport} row-queue "
-        f"(model {served_key}, window={window}ms)"
-    )
+    election = None
     try:
+        if standby:
+            if transport not in ("tcp", "unix"):
+                raise ValueError(
+                    "standby leadership needs a socket transport "
+                    "(tcp/unix) — the shm queue is single-host, its "
+                    "supervisor respawn is already the takeover"
+                )
+            from bodywork_tpu.serve.leadership import LeaderElection
+
+            # WARM standby: everything above (model, predictor, AOT
+            # warmup, coalescer) is already paid. Signal ready BEFORE
+            # campaigning — the losing candidate parks here and must
+            # not trip the supervisor's startup timeout.
+            if ready is not None:
+                ready.put(os.getpid())
+            addr_str = (
+                dispatcher_addr[1] if dispatcher_addr[0] == "unix"
+                else f"{dispatcher_addr[1]}:{dispatcher_addr[2]}"
+            )
+            election = LeaderElection(
+                store, ttl_s=leader_ttl_s, address=addr_str,
+            )
+            log.info(
+                "dispatcher warm, campaigning for the serve lease "
+                f"(owner {election.lease.owner})"
+            )
+            election.campaign()
+            from bodywork_tpu.serve.netqueue import NetQueueServer
+
+            # bind only AFTER winning: the listen address itself is the
+            # readiness signal (k8s tcpSocket probe routes to the
+            # leader), and the HELLO fence refuses zombie ex-leaders
+            net_server = NetQueueServer(
+                dispatcher_addr, fence=election.fence
+            )
+            dispatch = DispatchServer(app, queue, server=net_server)
+            # a lost lease stops the serve loop: exit and re-candidate
+            # beats serving split-brain
+            election.on_lost = dispatch.stop
+            election.start_renewer()
+        elif transport in ("tcp", "unix"):
+            from bodywork_tpu.serve.netqueue import NetQueueServer
+
+            # bind BEFORE signalling ready: a front-end told to connect
+            # must find a listener, not a race
+            net_server = NetQueueServer(dispatcher_addr)
+            dispatch = DispatchServer(app, queue, server=net_server)
+        else:
+            dispatch = DispatchServer(app, queue)
+        if queue is not None:
+            queue.up.value = 1
+        if ready is not None and not standby:
+            ready.put(os.getpid())
+        log.info(
+            f"dispatcher serving the {transport} row-queue "
+            f"(model {served_key}, window={window}ms"
+            + (f", fence {election.fence}" if election else "")
+            + ")"
+        )
         dispatch.serve_forever()
     finally:  # pragma: no cover - only on signal teardown
         if queue is not None:
             queue.up.value = 0
+        if election is not None:
+            election.stop()
         if net_server is not None:
             net_server.close()
         if watcher is not None:

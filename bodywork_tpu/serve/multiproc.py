@@ -380,6 +380,18 @@ class MultiProcessService:
     is spawned or supervised, and dispatcher death shows up as the
     clients' connection loss (503 + Retry-After, reconnect backoff) —
     the remote supervisor owns the respawn.
+
+    ``standby=True`` (socket transports only) runs an ACTIVE/STANDBY
+    dispatcher pair under this supervisor instead of a singleton: both
+    candidates warm fully (model, predictor, AOT buckets), one wins the
+    CAS lease (``serve.leadership``) and binds the listener; the other
+    parks campaigning. A dead candidate's lease is CAS-expired at the
+    supervisor's FIRST death observation (local fast failover — the
+    k8s pair relies on TTL expiry instead), the standby takes over by
+    bumping the fence, and the dead process respawns as a fresh
+    candidate. ``frontends=0`` with ``standby=True`` is the
+    ``cli serve --role dispatcher --standby`` pair: no local HTTP, two
+    supervised candidates serving remote front-ends.
     """
 
     def __init__(
@@ -405,6 +417,8 @@ class MultiProcessService:
         transport: str = "shm",
         dispatcher_addr: str | None = None,
         external_dispatcher: bool = False,
+        standby: bool = False,
+        leader_ttl_s: float | None = None,
     ):
         from bodywork_tpu.serve.netqueue import (
             SERVE_TRANSPORTS,
@@ -427,12 +441,31 @@ class MultiProcessService:
                 "an external dispatcher cannot be reached over shared "
                 "memory; use --transport tcp or unix"
             )
+        if standby and transport == "shm":
+            raise ValueError(
+                "standby leadership needs a socket transport (tcp/unix): "
+                "the shm queue is single-host, where the supervisor "
+                "respawn is already the takeover path"
+            )
+        if standby and external_dispatcher:
+            raise ValueError(
+                "an external dispatcher is supervised elsewhere; its "
+                "standby (if any) belongs to that supervisor"
+            )
         if frontends is not None:
-            assert frontends >= 1, "need at least one front-end"
+            assert frontends >= 0, "front-end count cannot be negative"
+            if frontends == 0 and not standby:
+                raise ValueError(
+                    "a dispatcher-only service (--frontends 0) is the "
+                    "standby pair topology; it needs --standby"
+                )
             # role split: `workers` now counts HTTP processes, which in
-            # this topology are the front-ends (the dispatcher is extra)
+            # this topology are the front-ends (the dispatcher is extra).
+            # 0 is the `serve --role dispatcher --standby` pair: one
+            # supervisor, two dispatcher candidates, no local HTTP.
             workers = frontends
-        assert workers >= 1, "need at least one replica"
+        else:
+            assert workers >= 1, "need at least one replica"
         from bodywork_tpu.serve.predictor import SERVE_DTYPES
         from bodywork_tpu.serve.server import SERVER_ENGINES
 
@@ -513,7 +546,13 @@ class MultiProcessService:
         self.startup_timeout_s = startup_timeout_s
         self._ctx = multiprocessing.get_context("spawn")
         self._queue = None
-        self._dispatcher = None
+        #: live dispatcher processes: [] (workers / external mode), one
+        #: (the PR 16 singleton), or an active/standby PAIR (standby
+        #: mode — which one leads is the lease's call, not an index's)
+        self._dispatchers: list = []
+        self.standby = standby
+        self.leader_ttl_s = leader_ttl_s
+        self._lease_reader = None
         self.transport = transport
         self.external_dispatcher = external_dispatcher
         self.dispatcher_addr = None
@@ -589,12 +628,45 @@ class MultiProcessService:
     def worker_pids(self) -> list[int]:
         return [p.pid for p in self._procs if p.is_alive()]
 
+    def _lease(self):
+        """A read/expire handle on the dispatcher-leader lease (standby
+        mode), lazily opened — the supervisor thread resolves the active
+        leader and fast-expires the lease of a dispatcher it watched
+        die."""
+        if self._lease_reader is None:
+            from bodywork_tpu.serve.leadership import DispatcherLease
+            from bodywork_tpu.store import open_scoped_store
+
+            self._lease_reader = DispatcherLease(
+                open_scoped_store(self.store_path),
+                ttl_s=self.leader_ttl_s,
+            )
+        return self._lease_reader
+
     @property
     def dispatcher_pid(self) -> int | None:
-        """PID of the device-owning dispatcher (frontends mode only)."""
-        if self._dispatcher is None or not self._dispatcher.is_alive():
+        """PID of the ACTIVE device-owning dispatcher (frontends mode
+        only). In standby mode the lease document says which candidate
+        leads; a local alive pid matching its owner wins, else the
+        first live candidate (e.g. mid-election)."""
+        alive = [p.pid for p in self._dispatchers if p.is_alive()]
+        if not alive:
             return None
-        return self._dispatcher.pid
+        if self.standby and len(alive) > 1:
+            try:
+                doc = self._lease().peek()
+            except Exception:
+                doc = None
+            owner = (doc or {}).get("owner") or ""
+            parts = owner.rsplit(":", 2)
+            if len(parts) == 3 and parts[0] == socket.gethostname():
+                try:
+                    pid = int(parts[1])
+                except ValueError:
+                    pid = None
+                if pid in alive:
+                    return pid
+        return alive[0]
 
     def _spawn_dispatcher(self):
         from bodywork_tpu.serve.dispatch import dispatcher_main
@@ -614,6 +686,8 @@ class MultiProcessService:
                 tuned_config=self.tuned_config,
                 transport=self.transport,
                 dispatcher_addr=self.dispatcher_addr,
+                standby=self.standby,
+                leader_ttl_s=self.leader_ttl_s,
             ),
             daemon=True,
         )
@@ -676,9 +750,14 @@ class MultiProcessService:
             if self.frontends is not None and not self.external_dispatcher:
                 # dispatcher first: its readiness IS model readiness —
                 # once it arms `queue.up`, the (fast-booting, model-free)
-                # front-ends answer /healthz 200 from their first request
-                self._dispatcher, dready = self._spawn_dispatcher()
-                self._wait_ready(dready, self._dispatcher)
+                # front-ends answer /healthz 200 from their first request.
+                # In standby mode TWO candidates spawn; each signals
+                # ready once WARM (model loaded), before the election —
+                # the loser parks campaigning, so both waits return.
+                for _ in range(2 if self.standby else 1):
+                    proc, dready = self._spawn_dispatcher()
+                    self._dispatchers.append(proc)
+                    self._wait_ready(dready, proc)
             for i in range(self.workers):
                 spawned.append(self._spawn_one(i))
             for proc, ready in spawned:
@@ -688,9 +767,8 @@ class MultiProcessService:
             # without stop() ever running — don't leak the snapshot dir
             # (or the already-spawned siblings). Join before rmtree so a
             # terminating worker's final flush cannot race the removal.
-            if self._dispatcher is not None:
-                spawned.append((self._dispatcher, None))
-                self._dispatcher = None
+            spawned.extend((p, None) for p in self._dispatchers)
+            self._dispatchers = []
             for proc, _ready in spawned:
                 if proc.is_alive():
                     proc.terminate()
@@ -722,8 +800,10 @@ class MultiProcessService:
             f"{self.workers} {role} process(es) listening on "
             f"{self.url} (SO_REUSEPORT, pids {self.worker_pids})"
             + (
-                f"; dispatcher pid {self._dispatcher.pid}"
-                if self._dispatcher is not None else ""
+                f"; dispatcher pid(s) "
+                f"{[p.pid for p in self._dispatchers]}"
+                + (" (active/standby pair)" if self.standby else "")
+                if self._dispatchers else ""
             )
         )
         return self
@@ -737,12 +817,15 @@ class MultiProcessService:
              "respawn_at": None}
             for _ in self._procs
         ]
-        dslot = {"policy": RespawnPolicy(), "spawned_at": time.monotonic(),
-                 "respawn_at": None}
+        dslots = [
+            {"policy": RespawnPolicy(), "spawned_at": time.monotonic(),
+             "respawn_at": None}
+            for _ in self._dispatchers
+        ]
         while not self._stopping.wait(0.5):
             now = time.monotonic()
-            if self._dispatcher is not None:
-                self._supervise_dispatcher(dslot, now)
+            for d, dslot in enumerate(dslots):
+                self._supervise_dispatcher(d, dslot, now)
             for i, proc in enumerate(self._procs):
                 if self._stopping.is_set():
                     break
@@ -824,14 +907,17 @@ class MultiProcessService:
                 slot["spawned_at"] = time.monotonic()
                 log.info(f"replica respawned as pid {new_proc.pid}")
 
-    def _supervise_dispatcher(self, slot, now: float) -> None:
-        """One supervision tick for the singleton dispatcher (frontends
+    def _supervise_dispatcher(self, d: int, slot, now: float) -> None:
+        """One supervision tick for dispatcher slot ``d`` (frontends
         mode). Same budget/backoff as a replica slot, plus the liveness
         contract the front-ends depend on: the FIRST observation of a
         death downs the queue and bumps its epoch, failing every
         in-flight front-end wait into 503 + Retry-After immediately —
-        waiters must not ride out the whole backoff window."""
-        proc = self._dispatcher
+        waiters must not ride out the whole backoff window. In standby
+        mode the first observation also CAS-expires the dead leader's
+        lease, so the warm standby takes over on its next poll instead
+        of waiting out the TTL."""
+        proc = self._dispatchers[d]
         if proc.is_alive() or slot["policy"].exhausted:
             return
         if slot["respawn_at"] is None:
@@ -839,8 +925,22 @@ class MultiProcessService:
                 self._queue.up.value = 0
                 self._queue.epoch.value += 1
             # (socket transports need no supervisor-side down-flip: the
-            # dying dispatcher's connections break, and every client
-            # fails its in-flight waits on the connection loss itself)
+            # dying dispatcher's connections break, and the clients HOLD
+            # their in-flight waits for failover resubmission)
+            if self.standby:
+                # reclaim the dead candidate's leadership slot at the
+                # first death observation: safe — this is evidence of a
+                # dead process on THIS host, never a partition guess. A
+                # dead STANDBY simply does not own the lease (no-op).
+                try:
+                    self._lease().expire_dead_owner(
+                        socket.gethostname(), proc.pid
+                    )
+                except Exception as exc:
+                    log.warning(
+                        f"could not fast-expire the dead dispatcher's "
+                        f"lease (TTL expiry will cover it): {exc!r}"
+                    )
             alive_s = now - slot["spawned_at"]
             delay = slot["policy"].on_death(alive_s)
             if delay is None:
@@ -872,14 +972,16 @@ class MultiProcessService:
         _count_dispatcher_restart(self._sup_registry)
         try:
             # the respawned dispatcher re-arms `queue.up` itself, only
-            # after its model is loaded — serving resumes atomically
+            # after its model is loaded — serving resumes atomically.
+            # (Standby mode: the respawn is a fresh WARM candidate; it
+            # signals ready at warm and parks campaigning.)
             self._wait_ready(ready, new_proc)
         except Exception as exc:
             log.error(f"dispatcher respawn failed: {exc!r}")
-            self._dispatcher = new_proc  # dead; next tick backs off
+            self._dispatchers[d] = new_proc  # dead; next tick backs off
             slot["spawned_at"] = time.monotonic()
             return
-        self._dispatcher = new_proc
+        self._dispatchers[d] = new_proc
         slot["spawned_at"] = time.monotonic()
         log.info(f"dispatcher respawned as pid {new_proc.pid}")
 
@@ -902,9 +1004,7 @@ class MultiProcessService:
 
     def stop(self) -> None:
         self._stopping.set()
-        procs = list(self._procs)
-        if self._dispatcher is not None:
-            procs.append(self._dispatcher)
+        procs = list(self._procs) + list(self._dispatchers)
         for proc in procs:
             if proc.is_alive():
                 proc.terminate()

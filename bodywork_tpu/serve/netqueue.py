@@ -42,15 +42,37 @@ pinned at 0, connection healthy — scale the dispatcher); a partition or
 death breaks the connection (credits irrelevant, ``connected`` false —
 reconnect/respawn), see docs/RESILIENCE.md §14.
 
-**Failure semantics match the shm transport's** (PR 16): a dispatcher
-death fails every in-flight wait into
-:class:`~bodywork_tpu.serve.rowqueue.DispatcherUnavailable` — the
-front-end answers 503 + Retry-After, never wedges — and the client
-reconnects with jittered exponential backoff, healing without a restart.
-A dropped front-end connection reclaims its in-flight budget
+**Failure semantics extend the shm transport's** (PR 16) with safe
+in-flight RESUBMISSION (ISSUE 19): a broken dispatcher connection no
+longer fails in-flight waits immediately — the client HOLDS each
+pending request's encoded SUBMIT frame, reconnects with the shared
+full-jitter backoff (``utils.retry.full_jitter_delay``), and resends
+the held frames verbatim over the new connection. Scoring is a pure
+function of the rows, so duplicate dispatch is safe: if the old
+dispatcher also replied, the late reply demuxes to an already-popped
+request id and is inert; the response the waiter sees is byte-identical
+either way. Only past ``failover_deadline_s`` of continuous disconnect
+do the waits fail into
+:class:`~bodywork_tpu.serve.rowqueue.DispatcherUnavailable` (503 +
+Retry-After at the HTTP layer) — a dispatcher FAILOVER (warm standby
+takes over within the lease TTL, ``serve.leadership``) heals under the
+deadline and the client never sheds at all. NEW submissions while
+disconnected still shed synchronously, as before.
+
+**The leadership fence rides the HELLO** (``u64`` after the credit
+window): clients track the highest fence ever seen and refuse — at the
+handshake, before any row could be misparsed — a dispatcher offering a
+LOWER fence: that is a zombie ex-leader that has not yet noticed its
+lost lease. A fence of 0 means no election is running (the PR 16/18
+topologies), and the check never fires.
+
+A dropped front-end connection still reclaims its in-flight budget
 server-side (the socket analogue of the dead-front-end slot reclaim):
 queued submissions from the dead connection are skipped at poll, and
-replies to it are dropped instead of erroring the dispatcher.
+replies to it are dropped instead of erroring the dispatcher. Resubmits
+stay within the credit window by construction (the client never held
+more than the window), provided the standby serves the same window —
+both sides default to ``DEFAULT_SLOTS``.
 
 Dependency note: this module is deliberately jax-free (numpy + stdlib
 sockets) — it rides the front-end processes, which must never pay the
@@ -61,7 +83,6 @@ from __future__ import annotations
 import json
 import os
 import queue as queue_mod
-import random
 import socket
 import struct
 import threading
@@ -83,11 +104,13 @@ from bodywork_tpu.serve.wire import (
     parse_binary_rows,
 )
 from bodywork_tpu.utils.logging import get_logger
+from bodywork_tpu.utils.retry import full_jitter_delay
 
 log = get_logger("serve.netqueue")
 
 __all__ = [
     "DEFAULT_DISPATCHER_PORT",
+    "DEFAULT_FAILOVER_DEADLINE_S",
     "SERVE_ROLES",
     "SERVE_TRANSPORTS",
     "NetQueueClient",
@@ -110,14 +133,24 @@ SERVE_ROLES = ("auto", "frontend", "dispatcher")
 #: the dispatcher Service port the k8s split wires front-ends at
 DEFAULT_DISPATCHER_PORT = 9091
 
-#: reconnect backoff (client side): exponential with full jitter, so N
-#: front-ends orphaned by one dispatcher death do not reconnect in
+#: reconnect backoff (client side): exponential with full jitter —
+#: drawn through utils.retry.full_jitter_delay, the ONE backoff policy
+#: every transport/store loop shares (guard: tests/test_chaos.py) — so
+#: N front-ends orphaned by one dispatcher death do not reconnect in
 #: lockstep (the reconnect-storm runbook, docs/RESILIENCE.md §14)
 RECONNECT_BASE_S = 0.2
 RECONNECT_MAX_S = 5.0
 
+#: how long a disconnected client HOLDS in-flight requests for
+#: resubmission before failing them into 503s: sized above the default
+#: leadership TTL + one maximal reconnect backoff, so a warm-standby
+#: failover completes under it, and WELL below the front-end's 60 s
+#: rendezvous timeout, so nothing ever wedges
+DEFAULT_FAILOVER_DEADLINE_S = 15.0
+
 _FRAME_HEADER = struct.Struct("<IB")   # length, msg type
-_HELLO_BODY = struct.Struct("<HI")     # wire schema version, credits
+#: wire schema version, credits, leadership fence (0 = no election)
+_HELLO_BODY = struct.Struct("<HIQ")
 _SUBMIT_HEADER = struct.Struct("<QBH")  # req id, kind, trace length
 _REPLY_HEADER = struct.Struct("<QHI")  # req id, status, n predictions
 
@@ -211,35 +244,63 @@ def _shutdown_close(sock) -> None:
         pass
 
 
+class _PendingEntry:
+    """One in-flight request: its completion callback, submit clock,
+    and — for failover resubmission — the encoded SUBMIT frame (resent
+    VERBATIM over a re-established connection, so the standby scores
+    the exact bytes the dead leader held) and its row count."""
+
+    __slots__ = ("on_done", "submitted_at", "frame", "n_rows")
+
+    def __init__(self, on_done, submitted_at, frame, n_rows):
+        self.on_done = on_done
+        self.submitted_at = submitted_at
+        self.frame = frame
+        self.n_rows = n_rows
+
+
 class NetQueueClient:
     """The front-end side of the socket row queue — the same surface as
     :class:`~bodywork_tpu.serve.rowqueue.RowQueueClient` (``submit`` /
     ``start`` / ``stop`` / ``stats`` / ``dispatcher_up``), so
     ``frontend.py`` and ``serve.aio`` run unchanged over either
     transport. One persistent connection, a reader thread demuxing
-    replies by request id, and a jittered-backoff reconnect loop."""
+    replies by request id, a jittered-backoff reconnect loop, and
+    failover resubmission of held in-flight frames (module docstring)."""
 
     def __init__(self, address, frontend_id: int = 0,
                  connect_timeout_s: float = 5.0,
                  reconnect_base_s: float = RECONNECT_BASE_S,
-                 reconnect_max_s: float = RECONNECT_MAX_S):
+                 reconnect_max_s: float = RECONNECT_MAX_S,
+                 failover_deadline_s: float = DEFAULT_FAILOVER_DEADLINE_S):
         self.address = address
         self.frontend_id = frontend_id
         self.connect_timeout_s = connect_timeout_s
         self.reconnect_base_s = reconnect_base_s
         self.reconnect_max_s = reconnect_max_s
+        self.failover_deadline_s = failover_deadline_s
         self._lock = threading.Lock()
         self._wlock = threading.Lock()
         self._sock: socket.socket | None = None
         self._connected = False
         self._stopped = False
         self._next_id = 0
-        #: req_id -> (on_done, submitted_at monotonic)
-        self._pending: dict[int, tuple[object, float]] = {}
+        #: req_id -> _PendingEntry (held across disconnects until the
+        #: failover deadline — the resubmission set)
+        self._pending: dict[int, _PendingEntry] = {}
+        #: monotonic instant the connection carrying in-flight requests
+        #: broke; None while connected (or nothing is held)
+        self._disconnected_at: float | None = None
         #: per-connection credit window granted by the server's HELLO;
         #: 0 until connected (every submit then sheds as unavailable)
         self.credit_window = 0
         self.reconnects = 0
+        #: highest leadership fence any HELLO carried; a dispatcher
+        #: offering less is a zombie ex-leader, refused at handshake
+        self.fence_seen = 0
+        #: fence INCREASES observed (each one is a completed failover)
+        self.takeovers_observed = 0
+        self._leader_since: float | None = None
         # same accounting surface as RowQueueClient (healthz reads it)
         self.rows_submitted = 0
         self.requests_submitted = 0
@@ -268,6 +329,13 @@ class NetQueueClient:
             "transport (the cross-host analogue of the shm handoff "
             "histogram; includes dispatcher service time)",
             buckets=(0.0001, 0.0005, 0.001, 0.005, 0.025, 0.1, 0.5, 2.0),
+        )
+        self._m_resubmitted = reg.counter(
+            "bodywork_tpu_netqueue_resubmitted_rows_total",
+            "In-flight feature rows resent verbatim over a "
+            "re-established row-queue connection after a dispatcher "
+            "failover (scoring is pure, so duplicate dispatch is safe "
+            "and replies stay byte-identical)",
         )
         self._m_credits = reg.gauge(
             "bodywork_tpu_netqueue_credits_in_flight",
@@ -327,7 +395,13 @@ class NetQueueClient:
                 raise SlotsExhausted("no free row-queue transport credit")
             req_id = self._next_id
             self._next_id += 1
-            self._pending[req_id] = (on_done, time.monotonic())
+            payload = (
+                _SUBMIT_HEADER.pack(req_id, kind, len(trace)) + trace + rows
+            )
+            frame = _frame(_MSG_SUBMIT, payload)
+            self._pending[req_id] = _PendingEntry(
+                on_done, time.monotonic(), frame, n_rows
+            )
             self.requests_submitted += 1
             self.rows_submitted += n_rows
             self._m_credits.set(float(len(self._pending)))
@@ -335,13 +409,12 @@ class NetQueueClient:
                 self._m_occupancy.set(
                     len(self._pending) / self.credit_window
                 )
-        payload = _SUBMIT_HEADER.pack(req_id, kind, len(trace)) + trace + rows
         try:
             with self._wlock:
                 sock = self._sock
                 if sock is None:
                     raise ConnectionError("not connected")
-                sock.sendall(_frame(_MSG_SUBMIT, payload))
+                sock.sendall(frame)
         except (OSError, ConnectionError) as exc:
             # nothing (whole) reached the dispatcher: unwind the credit
             # and raise synchronously, exactly as a failed enqueue would
@@ -361,6 +434,7 @@ class NetQueueClient:
         streak = 0
         first = True
         while not self._stopped:
+            self._expire_held()
             try:
                 sock = _connect(self.address, self.connect_timeout_s)
             except OSError:
@@ -384,6 +458,19 @@ class NetQueueClient:
                 )
             first = False
             streak = 0
+            try:
+                # resend the held in-flight frames BEFORE the submit
+                # path can see the connection: the new dispatcher scores
+                # the exact bytes the dead one held (pure function -> a
+                # duplicate reply racing in is popped-empty and inert)
+                self._resubmit_held(sock)
+            except (OSError, ConnectionError) as exc:
+                if not self._stopped:
+                    log.warning(f"netqueue resubmission failed: {exc}")
+                _shutdown_close(sock)
+                streak += 1
+                self._backoff(streak)
+                continue
             self._sock = sock
             self._connected = True
             try:
@@ -393,31 +480,70 @@ class NetQueueClient:
                     log.warning(f"netqueue connection lost: {exc}")
             finally:
                 self._teardown_socket()
-                # every in-flight wait fails NOW (503 + Retry-After at
-                # the HTTP layer) instead of hanging into a timeout —
-                # the PR 16 dispatcher-death contract
-                self._fail_pending(
-                    DispatcherUnavailable("scoring dispatcher died")
-                )
+                # in-flight waits are NOT failed here (the pre-ISSUE-19
+                # contract): they are HELD for resubmission — a standby
+                # takeover heals them under the failover deadline, and
+                # only _expire_held turns them into 503s
+                with self._lock:
+                    if self._pending and self._disconnected_at is None:
+                        self._disconnected_at = time.monotonic()
             streak += 1
             self._backoff(streak)
 
     def _backoff(self, streak: int) -> None:
         if self._stopped:
             return
-        cap = min(
-            self.reconnect_base_s * (2 ** max(0, streak - 1)),
-            self.reconnect_max_s,
+        # full jitter via the ONE shared policy (utils.retry): N
+        # orphaned front-ends spread over [0, cap] rather than
+        # stampeding the respawned/elected dispatcher in lockstep
+        time.sleep(full_jitter_delay(
+            max(0, streak - 1), self.reconnect_base_s, self.reconnect_max_s
+        ))
+
+    def _expire_held(self) -> None:
+        """Fail the held in-flight requests once a disconnect has
+        outlived the failover deadline — the ONLY place (besides stop)
+        that turns a disconnect into DispatcherUnavailable waits."""
+        with self._lock:
+            expired = (
+                self._disconnected_at is not None
+                and time.monotonic() - self._disconnected_at
+                >= self.failover_deadline_s
+            )
+            if expired:
+                self._disconnected_at = None
+        if expired:
+            self._fail_pending(DispatcherUnavailable(
+                f"scoring dispatcher did not fail over within "
+                f"{self.failover_deadline_s:.1f}s"
+            ))
+
+    def _resubmit_held(self, sock) -> None:
+        """Resend every held frame, in submit order, over the fresh
+        connection. Raises the connection errors to the caller, which
+        treats them exactly like a lost connection."""
+        with self._lock:
+            entries = [e for _id, e in sorted(self._pending.items())]
+            self._disconnected_at = None
+        if not entries:
+            return
+        rows = 0
+        for entry in entries:
+            sock.sendall(entry.frame)
+            rows += entry.n_rows
+        self._m_resubmitted.inc(rows)
+        log.info(
+            f"resubmitted {len(entries)} in-flight request(s) "
+            f"({rows} rows) over the re-established connection"
         )
-        # full jitter: N orphaned front-ends spread over [0, cap] rather
-        # than stampeding the respawned dispatcher in lockstep
-        time.sleep(random.uniform(0, cap) if cap > 0 else 0)
 
     def _handshake(self, sock) -> None:
         msg_type, body = _recv_frame(sock)
         if msg_type != _MSG_HELLO:
             raise ValueError(f"expected HELLO, got frame type {msg_type}")
-        version, credits = _HELLO_BODY.unpack_from(body)
+        if len(body) < _HELLO_BODY.size:
+            raise ValueError(f"short HELLO body ({len(body)} bytes)")
+        version, credits, fence = _HELLO_BODY.unpack_from(body)
         content_type = body[_HELLO_BODY.size:].decode("ascii")
         if version != WIRE_SCHEMA_VERSION or (
             content_type != BINARY_CONTENT_TYPE
@@ -428,6 +554,22 @@ class NetQueueClient:
                 f"({content_type!r}), this build v{WIRE_SCHEMA_VERSION} "
                 f"({BINARY_CONTENT_TYPE!r})"
             )
+        if fence < self.fence_seen:
+            # a zombie ex-leader still listening after losing its
+            # lease: refuse at the handshake, never misparse mid-stream
+            raise ValueError(
+                f"stale dispatcher fence {fence} < {self.fence_seen} "
+                "already seen (zombie ex-leader refused)"
+            )
+        if fence > self.fence_seen:
+            if self.fence_seen:
+                # a fence INCREASE is a completed failover we lived
+                # through (the first fence is just discovery)
+                self.takeovers_observed += 1
+            self.fence_seen = int(fence)
+            self._leader_since = time.monotonic()
+        elif self._leader_since is None:
+            self._leader_since = time.monotonic()
         self.credit_window = int(credits)
 
     def _read_replies(self, sock) -> None:
@@ -458,13 +600,12 @@ class NetQueueClient:
                         len(self._pending) / self.credit_window
                     )
             if entry is None:
-                continue  # reply raced a reconnect's fail_pending: inert
-            on_done, submitted_at = entry
-            rtt = time.monotonic() - submitted_at
+                continue  # duplicate/late reply after a failover: inert
+            rtt = time.monotonic() - entry.submitted_at
             self._m_wait.observe(rtt)
             self._m_rtt.observe(rtt)
             self._complete(
-                on_done,
+                entry.on_done,
                 _Reply(status, predictions, model_key, model_info,
                        model_date),
             )
@@ -483,8 +624,8 @@ class NetQueueClient:
             self.failures += len(failed)
             self._m_credits.set(0.0)
             self._m_occupancy.set(0.0)
-        for on_done, _t0 in failed:
-            self._complete(on_done, exc)
+        for entry in failed:
+            self._complete(entry.on_done, exc)
 
     @staticmethod
     def _complete(on_done, outcome) -> None:
@@ -523,6 +664,18 @@ class NetQueueClient:
                 self.address[1] if self.address[0] == "unix"
                 else f"{self.address[1]}:{self.address[2]}"
             ),
+            # the ISSUE 19 /healthz leadership section, from the
+            # CLIENT's vantage point: what fence it is pinned to and
+            # how many completed failovers it has lived through
+            "leadership": {
+                "role": "active" if self._connected else "unknown",
+                "fence": self.fence_seen,
+                "lease_age_s": (
+                    round(time.monotonic() - self._leader_since, 3)
+                    if self._leader_since is not None else None
+                ),
+                "takeovers_observed": self.takeovers_observed,
+            },
         }
 
 
@@ -576,8 +729,12 @@ class NetQueueServer:
     dropped, never raised."""
 
     def __init__(self, address, credit_window: int = DEFAULT_SLOTS,
-                 backlog: int = 64):
+                 backlog: int = 64, fence: int = 0):
         self.credit_window = int(credit_window)
+        #: leadership fence announced in every HELLO; 0 = no election
+        #: (clients then never refuse on fence). An elected dispatcher
+        #: passes its lease fence so zombie ex-leaders are refused.
+        self.fence = int(fence)
         self._unix_path = None
         if address[0] == "unix":
             self._unix_path = address[1]
@@ -646,7 +803,7 @@ class NetQueueServer:
                 self._conns[conn.conn_id] = conn
             try:
                 hello = _HELLO_BODY.pack(
-                    WIRE_SCHEMA_VERSION, self.credit_window
+                    WIRE_SCHEMA_VERSION, self.credit_window, self.fence
                 ) + BINARY_CONTENT_TYPE.encode("ascii")
                 sock.sendall(_frame(_MSG_HELLO, hello))
             except OSError:

@@ -550,6 +550,16 @@ class RowQueueClient:
             "credit_window": self.queue.slots,
             "credits_in_flight": in_flight,
             "address": None,
+            # the /healthz leadership section, shm analogue: no CAS
+            # election runs on one host — the supervisor's respawn IS
+            # the takeover, and the queue epoch (bumped once per
+            # dispatcher death) plays the fence's monotonic role
+            "leadership": {
+                "role": "active" if self.dispatcher_up() else "down",
+                "fence": int(self.queue.epoch.value),
+                "lease_age_s": None,
+                "takeovers_observed": int(self.queue.epoch.value),
+            },
         }
 
 

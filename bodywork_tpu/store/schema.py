@@ -145,6 +145,10 @@ QUARANTINE_PREFIX = "quarantine/"
 #: flight-recorder dumps (obs/tracing.py) — diagnostic evidence; see
 #: the module docstring's delete-safety note
 FLIGHTREC_PREFIX = "obs/flightrec/"
+#: serving-plane operational state (serve/leadership.py): the dispatcher
+#: leader lease document. Like runs/ journals it is coordination state,
+#: not a result — deleting it only forces a fresh election
+SERVE_PREFIX = "serve/"
 #: multi-tenant namespace root (bodywork_tpu/tenancy/): tenants/<id>/
 #: mirrors the whole schema for one tenant; see the module docstring's
 #: delete-safety note (deleting a subtree is offboarding that tenant)
@@ -207,10 +211,19 @@ ALL_PREFIXES = (
     AUDIT_PREFIX,
     QUARANTINE_PREFIX,
     FLIGHTREC_PREFIX,
+    SERVE_PREFIX,
     #: last on purpose: each tenant subtree is audited AFTER the root
     #: namespace, with a tenant-scoped recursion over the prefixes above
     TENANTS_PREFIX,
 )
+
+
+def dispatcher_leader_key() -> str:
+    """The dispatcher leadership lease document
+    (``serve/leadership.py``): one ``(owner, expires_at, fence)`` doc
+    per namespace, mutated exclusively through CAS — the journal-lease
+    discipline applied to the serving plane."""
+    return f"{SERVE_PREFIX}dispatcher-leader.json"
 
 
 def dataset_key(d: date) -> str:

@@ -123,6 +123,13 @@ class LoadReport:
     retry_after: dict      # {responses, mean_s, max_s} where the header appeared
     send_lag_p99_s: float | None
     max_in_flight: int
+    #: longest service blackout observed by the driver: the maximum
+    #: time-span (in scheduled-arrival time) over any run of consecutive
+    #: scheduled arrivals that produced zero 200s, measured from the
+    #: first failed arrival to the next successful one. THE failover
+    #: headline — bench config 17 asserts this stays under the lease
+    #: TTL plus one reconnect backoff when the active dispatcher dies.
+    max_blackout_s: float = 0.0
     #: responses carrying an X-Bodywork-Trace-Id header — nonzero means
     #: the service ran tracing-on and the results log (when written)
     #: joins to server-side spans
@@ -562,6 +569,7 @@ def run_open_loop(
         },
         send_lag_p99_s=_round6(_percentile(lags, 99)),
         max_in_flight=max_in_flight,
+        max_blackout_s=_max_blackout_s(results),
         traced_responses=sum(1 for r in results if r.trace_id is not None),
         per_model_key=per_model_key,
         shards=shards,
@@ -577,6 +585,30 @@ def run_open_loop(
 
 def _round6(value: float | None) -> float | None:
     return round(value, 6) if value is not None else None
+
+
+def _max_blackout_s(results: list) -> float:
+    """Longest run of consecutive scheduled arrivals with zero 200s,
+    as a time-span: from the first failed arrival's scheduled time to
+    the scheduled time of the next 200 (or of the last arrival when the
+    run never recovers). A lone failure between two successes scores
+    the gap to the next success — a blackout is measured by how long
+    the service was dark, not by how many arrivals fell into the hole.
+    Returns 0.0 when every scheduled arrival got a 200.
+    """
+    worst = 0.0
+    run_start: float | None = None
+    for r in sorted(results, key=lambda x: x.t_s):
+        if r.status == 200:
+            if run_start is not None:
+                worst = max(worst, r.t_s - run_start)
+                run_start = None
+        elif run_start is None:
+            run_start = r.t_s
+        last_t = r.t_s
+    if run_start is not None:
+        worst = max(worst, last_t - run_start)
+    return round(worst, 6)
 
 
 def format_report(report: LoadReport) -> str:

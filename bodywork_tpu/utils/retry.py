@@ -44,6 +44,7 @@ __all__ = [
     "classify_error",
     "RetryPolicy",
     "call_with_retry",
+    "full_jitter_delay",
     "CircuitBreaker",
     "CircuitOpenError",
 ]
@@ -160,6 +161,22 @@ class RetryPolicy:
 _JITTER_RNG = random.Random()
 
 
+def full_jitter_delay(attempt: int, base_s: float, max_s: float,
+                      rng=None) -> float:
+    """THE backoff delay: full jitter over an exponential cap,
+    ``U(0, min(base·2^attempt, max))`` (attempt 0 = first retry).
+    Every retry loop in the tree — :func:`call_with_retry`, the
+    netqueue reconnect/leader-re-resolve loop, the standby election
+    poll — draws its sleep from this one function, so decorrelation is
+    a property of the codebase, not of whichever module remembered to
+    jitter (tests/test_chaos.py guards that no store or serve module
+    re-grows a private ``delay *=`` loop)."""
+    cap = min(base_s * (2 ** max(0, attempt)), max_s)
+    if cap <= 0:
+        return 0.0
+    return (rng or _JITTER_RNG).uniform(0.0, cap)
+
+
 def call_with_retry(
     fn,
     policy: RetryPolicy = RetryPolicy(),
@@ -196,8 +213,9 @@ def call_with_retry(
             remaining = policy.deadline_s - (clock() - start)
             if remaining <= 0:
                 raise
-            cap = min(policy.base_delay_s * (2 ** attempt), policy.max_delay_s)
-            delay = rng.uniform(0.0, cap)
+            delay = full_jitter_delay(
+                attempt, policy.base_delay_s, policy.max_delay_s, rng=rng
+            )
             floor = getattr(exc, "retry_after_s", None)
             if floor:
                 delay = max(delay, min(float(floor), policy.max_delay_s))

@@ -314,6 +314,22 @@ def test_no_private_backoff_loops_in_store_modules():
     # the GCS backend's retry entrypoint IS the shared one
     assert gcs.call_with_retry is retry.call_with_retry
 
+    # PR 19 extends the guard to the serving plane: the netqueue
+    # reconnect loop and the leadership election poll must share the
+    # ONE full-jitter schedule — no serve module may grow its own
+    # geometric backoff (sleeping is allowed there: reconnect/election
+    # loops legitimately wait, but the DELAY always comes from
+    # utils/retry.full_jitter_delay)
+    import bodywork_tpu.serve as serve_pkg
+    from bodywork_tpu.serve import leadership, netqueue
+
+    serve_dir = pathlib.Path(serve_pkg.__file__).parent
+    for path in sorted(serve_dir.glob("*.py")):
+        source = path.read_text()
+        assert "delay *=" not in source, f"{path.name} grows its own backoff"
+    assert netqueue.full_jitter_delay is retry.full_jitter_delay
+    assert leadership.full_jitter_delay is retry.full_jitter_delay
+
 
 def test_breaker_state_machine():
     t = [0.0]

@@ -1,0 +1,408 @@
+"""Dispatcher high availability (ISSUE 19): CAS-leased leadership
+(`serve/leadership.py`), fenced handshakes, and warm-standby failover
+with in-flight resubmission.
+
+Three layers, cheapest first:
+
+- the lease PROTOCOL over an in-memory store with an injected clock —
+  acquisition reasons, renewal, release-keeps-fence, dead-owner expiry,
+  corrupt-doc repair, and the CountingStore steady-state budget (one
+  CAS renew per interval, ZERO raw puts);
+- the TRANSPORT smoke, in-process and jax-free: a `NetQueueClient`
+  holds in-flight rows across the active server's death, resubmits
+  them to a higher-fenced standby on the same address, the replies are
+  byte-identical (scoring is pure), and a lower-fenced zombie is
+  refused at the HELLO;
+- the slow-marked SUBPROCESS drill: `MultiProcessService(standby=True)`
+  takes a SIGKILL of the active dispatcher and heals inside the
+  TTL + reconnect bound, with the takeover visible on /healthz.
+"""
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from bodywork_tpu.serve.leadership import (
+    DEFAULT_LEADER_TTL_S,
+    LEADER_SCHEMA,
+    DispatcherLease,
+    LeaderElection,
+    LeadershipLost,
+    leader_owner,
+)
+from bodywork_tpu.serve.netqueue import (
+    KIND_SINGLE,
+    NetQueueClient,
+    NetQueueServer,
+)
+from bodywork_tpu.serve.rowqueue import DispatcherUnavailable
+from bodywork_tpu.store.schema import dispatcher_leader_key
+from tests.helpers import make_counting_store, make_memory_store
+
+
+def _wait_for(predicate, timeout_s=8.0, interval_s=0.01):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+def _bundle():
+    return SimpleNamespace(model_key="mk", model_info="mi",
+                           model_date="2026-07-01")
+
+
+# -- the lease protocol (fake clock, no threads) ------------------------------
+
+def _lease(store, owner, clock, ttl_s=5.0):
+    return DispatcherLease(store, owner=owner, ttl_s=ttl_s, clock=clock)
+
+
+def test_acquire_renew_and_fenced_takeover_on_expiry():
+    """The core failover argument: a live lease blocks challengers; an
+    expired one is taken over with a FENCE BUMP; the fenced-out
+    ex-leader's next renew raises `LeadershipLost`."""
+    store = make_memory_store()
+    t = [1000.0]
+    a = _lease(store, "hostA:11:aa", lambda: t[0])
+    b = _lease(store, "hostA:22:bb", lambda: t[0])
+
+    assert a.try_acquire() == 1  # reason: fresh
+    assert b.try_acquire() is None  # live foreign lease blocks
+    t[0] += 2.0
+    a.renew()  # extends expires_at from now
+    t[0] += 4.0  # 6.0 past the renew? no: 4.0 past it, lease ttl 5.0
+    assert b.try_acquire() is None  # renewal kept it alive
+    t[0] += 1.1  # now 5.1 past the renew: expired
+    assert b.try_acquire() == 2  # reason: expired, fence bumped
+    with pytest.raises(LeadershipLost):
+        a.renew()  # the zombie learns it was fenced out
+
+
+def test_release_keeps_the_fence_and_the_next_leader_bumps_past_it():
+    store = make_memory_store()
+    t = [0.0]
+    a = _lease(store, "h:1:aa", lambda: t[0])
+    b = _lease(store, "h:2:bb", lambda: t[0])
+    assert a.try_acquire() == 1
+    a.release()
+    doc = b.peek()
+    assert doc["owner"] is None and doc["fence"] == 1
+    assert b.try_acquire() == 2  # reason: released — fence still bumps
+
+
+def test_expire_dead_owner_requires_matching_host_and_pid():
+    """The supervisor's fast-failover hook only fires against the exact
+    owner it OBSERVED die — never a partition guess."""
+    store = make_memory_store()
+    t = [0.0]
+    a = _lease(store, "hostA:123:aa", lambda: t[0], ttl_s=600.0)
+    b = _lease(store, "hostA:999:bb", lambda: t[0], ttl_s=600.0)
+    assert a.try_acquire() == 1
+    assert b.expire_dead_owner("hostB", 123) is False
+    assert b.expire_dead_owner("hostA", 124) is False
+    assert b.try_acquire() is None  # still blocked: nothing expired
+    assert b.expire_dead_owner("hostA", 123) is True
+    assert b.try_acquire() == 2  # immediate takeover, no TTL wait
+
+
+def test_corrupt_lease_doc_is_cas_repaired_by_the_next_acquire():
+    store = make_memory_store()
+    store.put_bytes(dispatcher_leader_key(), b"not json {{{")
+    t = [0.0]
+    lease = _lease(store, "h:1:aa", lambda: t[0])
+    assert lease.peek() is None  # corrupt reads as absent
+    assert lease.try_acquire() == 1  # repaired in place via CAS
+    doc = lease.peek()
+    assert doc["schema"] == LEADER_SCHEMA and doc["owner"] == "h:1:aa"
+
+
+def test_leader_owner_shape_round_trips_through_rsplit():
+    host, pid, nonce = leader_owner().rsplit(":", 2)
+    assert int(pid) > 0 and len(nonce) == 8
+
+
+def test_steady_state_leadership_is_one_cas_per_interval_zero_raw_puts():
+    """The CountingStore pin the module docstring promises: holding
+    leadership costs exactly ONE `put_bytes_if_match` per renew
+    interval and the store NEVER sees an unconditional put."""
+    store = make_counting_store(make_memory_store())
+    t = [0.0]
+    elec = LeaderElection(
+        store, owner="h:1:aa", ttl_s=9.0,  # renew interval = 3.0
+        clock=lambda: t[0], sleep=lambda s: None,
+    )
+    assert elec.campaign() == 1
+    store.reset_counts()
+    for _ in range(30):  # 15 s of heartbeat ticks at 0.5 s
+        t[0] += 0.5
+        elec.maybe_renew(now=t[0])
+    assert store.ops.get("put_bytes", 0) == 0
+    assert store.ops.get("put_bytes_if_match", 0) == 5  # 15 s / 3 s
+    assert store.by_key.get(
+        ("put_bytes", dispatcher_leader_key()), 0
+    ) == 0
+
+
+def test_election_campaign_blocks_then_wins_on_release():
+    """A WARM standby's campaign parks on the full-jitter poll and wins
+    the moment the active releases — with the fence bumped and the
+    takeover counted."""
+    store = make_memory_store()
+    active = LeaderElection(store, owner="h:1:aa", ttl_s=60.0)
+    assert active.campaign() == 1
+    assert active.leading and active.state()["role"] == "active"
+
+    standby = LeaderElection(store, owner="h:2:bb", ttl_s=60.0)
+    won = {}
+    t = threading.Thread(
+        target=lambda: won.setdefault("fence", standby.campaign()),
+        daemon=True,
+    )
+    t.start()
+    time.sleep(0.3)
+    assert not won  # still parked: the active lease is live
+    assert standby.state()["role"] == "standby"
+    active.stop()  # release — the standby's next poll wins
+    t.join(timeout=10)
+    assert won.get("fence") == 2
+    assert standby.leading
+    standby.stop()
+
+
+def test_renewer_thread_fires_on_lost_once_when_fenced_out():
+    store = make_memory_store()
+    lost = []
+    a = LeaderElection(store, owner="h:1:aa", ttl_s=0.4,
+                       on_lost=lambda: lost.append(True))
+    assert a.campaign() == 1
+    a.start_renewer()
+    # a challenger steals the document outright (simulates expiry +
+    # takeover racing ahead of the renewer)
+    b = _lease(store, "h:2:bb", time.time, ttl_s=60.0)
+    b._load()
+    store.put_bytes(dispatcher_leader_key(), b._block(2))
+    assert _wait_for(lambda: lost == [True], timeout_s=10.0)
+    assert not a.leading
+    a.stop()
+
+
+# -- transport failover smoke (in-process, jax-free) --------------------------
+
+def _pump(server, stop_evt):
+    """Echo dispatcher: deterministic pure scoring (row sums), so reply
+    bytes are a function of the submitted rows alone — the byte-identity
+    predicate duplicate dispatch must preserve."""
+    while not stop_evt.is_set():
+        try:
+            sub = server.poll(timeout_s=0.1)
+        except Exception:
+            return
+        if sub is None:
+            continue
+        preds = np.asarray(sub.X, dtype=np.float32).sum(axis=1)
+        server.reply(sub, 200, predictions=preds, bundle=_bundle())
+
+
+def test_failover_resubmits_held_rows_to_the_fenced_standby():
+    """The tentpole smoke: kill the active dispatcher with a request in
+    flight; the client HOLDS the row, reconnects to the standby on the
+    same address (fence bumped), resubmits, and the reply is
+    byte-identical to the pre-kill answer. A zombie ex-leader offering
+    the OLD fence is refused at the handshake."""
+    active = NetQueueServer(("tcp", "127.0.0.1", 0), credit_window=8,
+                            fence=1)
+    address = active.address
+    stop1 = threading.Event()
+    pump1 = threading.Thread(target=_pump, args=(active, stop1),
+                             daemon=True)
+    pump1.start()
+    client = NetQueueClient(address, frontend_id=0,
+                            reconnect_base_s=0.05, reconnect_max_s=0.2,
+                            failover_deadline_s=15.0).start()
+    try:
+        assert _wait_for(client.dispatcher_up)
+        assert client.fence_seen == 1
+        X = np.arange(4, dtype=np.float32).reshape(2, 2)
+        baseline = {}
+        client.submit(X, KIND_SINGLE,
+                      lambda r: baseline.setdefault("r", r))
+        assert _wait_for(lambda: "r" in baseline)
+        assert baseline["r"].status == 200
+
+        # stop answering, then submit: the row is in flight when the
+        # active dies — the exact bytes the standby must score
+        stop1.set()
+        pump1.join(timeout=5)
+        held = {}
+        client.submit(X, KIND_SINGLE, lambda r: held.setdefault("r", r))
+        active.close()
+        assert _wait_for(lambda: not client.dispatcher_up())
+        assert "r" not in held  # HELD, not failed: resubmission window
+
+        standby = NetQueueServer(address, credit_window=8, fence=2)
+        stop2 = threading.Event()
+        pump2 = threading.Thread(target=_pump, args=(standby, stop2),
+                                 daemon=True)
+        pump2.start()
+        try:
+            assert _wait_for(lambda: "r" in held, timeout_s=15.0)
+            reply = held["r"]
+            assert reply.status == 200
+            assert list(reply.predictions) == list(
+                baseline["r"].predictions
+            )
+            assert (reply.model_key, reply.model_info, reply.model_date) \
+                == (baseline["r"].model_key, baseline["r"].model_info,
+                    baseline["r"].model_date)
+            assert client.fence_seen == 2  # monotonic across the kill
+            assert client.takeovers_observed == 1
+            lead = client.transport_state()["leadership"]
+            assert lead["role"] == "active" and lead["fence"] == 2
+            assert lead["takeovers_observed"] == 1
+        finally:
+            stop2.set()
+            standby.close()
+
+        # the zombie drill: an ex-leader (old fence) rebinds the address
+        assert _wait_for(lambda: not client.dispatcher_up())
+        zombie = NetQueueServer(address, credit_window=8, fence=1)
+        try:
+            time.sleep(0.8)  # several reconnect attempts' worth
+            assert not client.dispatcher_up()  # refused at HELLO
+            with pytest.raises(DispatcherUnavailable):
+                client.submit(X, KIND_SINGLE, lambda r: None)
+        finally:
+            zombie.close()
+    finally:
+        client.stop()
+
+
+def test_resubmitted_rows_metric_counts_the_replay():
+    """`bodywork_tpu_netqueue_resubmitted_rows_total` moves by exactly
+    the held row count when the connection heals."""
+    from bodywork_tpu.obs import get_registry
+
+    server = NetQueueServer(("tcp", "127.0.0.1", 0), credit_window=8,
+                            fence=1)
+    address = server.address
+    client = NetQueueClient(address, frontend_id=0,
+                            reconnect_base_s=0.05, reconnect_max_s=0.2,
+                            failover_deadline_s=15.0).start()
+    counter = get_registry().counter(
+        "bodywork_tpu_netqueue_resubmitted_rows_total", ""
+    )
+    before = counter.value()
+    try:
+        assert _wait_for(client.dispatcher_up)
+        client.submit(np.ones((3, 2), dtype=np.float32), KIND_SINGLE,
+                      lambda r: None)
+        server.close()
+        assert _wait_for(lambda: not client.dispatcher_up())
+        reborn = NetQueueServer(address, credit_window=8, fence=2)
+        try:
+            assert _wait_for(client.dispatcher_up, timeout_s=15.0)
+            assert counter.value() == before + 3  # 3 rows replayed
+        finally:
+            reborn.close()
+    finally:
+        client.stop()
+
+
+def test_leadership_metric_names_pass_the_lint():
+    from bodywork_tpu.obs.registry import validate_metric_name
+
+    validate_metric_name("bodywork_tpu_serve_leader_state", "gauge")
+    validate_metric_name("bodywork_tpu_serve_leader_takeovers_total",
+                         "counter")
+    validate_metric_name("bodywork_tpu_netqueue_resubmitted_rows_total",
+                         "counter")
+
+
+def test_default_ttl_and_env_override():
+    from bodywork_tpu.serve.leadership import leader_ttl_from_env
+
+    assert DEFAULT_LEADER_TTL_S == 5.0
+    assert leader_ttl_from_env() == 5.0
+
+
+# -- the subprocess SIGKILL drill (slow) --------------------------------------
+
+@pytest.mark.slow
+def test_standby_pair_survives_sigkill_of_the_active(tmp_path):
+    """The full drill bench config 17 measures, at smoke scale: an
+    active/standby pair under one supervisor takes SIGKILL of the
+    ACTIVE dispatcher; scoring heals to byte-identical answers without
+    a cold start, the supervised slot respawns, and /healthz shows the
+    bumped fence."""
+    from datetime import date
+
+    import requests
+
+    from bodywork_tpu.models import LinearRegressor
+    from bodywork_tpu.models.checkpoint import save_model
+    from bodywork_tpu.serve import MultiProcessService
+    from bodywork_tpu.store import FilesystemStore
+    from tests.helpers import hermetic_env
+
+    root = tmp_path / "store"
+    store = FilesystemStore(root)
+    rng = np.random.default_rng(3)
+    X = rng.uniform(0, 100, 200).astype(np.float32)
+    save_model(store, LinearRegressor().fit(X, (1.0 + 0.5 * X)),
+               date(2026, 7, 1))
+
+    with hermetic_env():
+        svc = MultiProcessService(
+            str(root), frontends=1, engine="xla", server_engine="aio",
+            transport="tcp", standby=True, leader_ttl_s=1.0,
+        ).start()
+        try:
+            base_url = svc.url.replace("/score/v1", "")
+            baseline = requests.post(svc.url, json={"X": [50.0]},
+                                     timeout=30)
+            assert baseline.status_code == 200
+            old_pid = svc.dispatcher_pid
+            assert old_pid is not None
+            svc.kill_dispatcher()
+
+            healed = None
+            deadline = time.monotonic() + 45.0
+            while time.monotonic() < deadline:
+                try:
+                    r = requests.post(svc.url, json={"X": [50.0]},
+                                      timeout=10)
+                except requests.RequestException:
+                    time.sleep(0.1)
+                    continue
+                if r.status_code == 200:
+                    healed = r
+                    break
+                time.sleep(0.1)
+            assert healed is not None, "service never healed"
+            assert healed.content == baseline.content  # pure scoring
+
+            def takeover_visible():
+                try:
+                    h = requests.get(base_url + "/healthz",
+                                     timeout=10).json()
+                except requests.RequestException:
+                    return False
+                lead = (h.get("transport") or {}).get("leadership") or {}
+                return (
+                    int(lead.get("fence") or 0) >= 2
+                    and int(lead.get("takeovers_observed") or 0) >= 1
+                )
+
+            assert _wait_for(takeover_visible, timeout_s=20.0)
+            # the dead candidate's slot respawns as a fresh standby
+            assert _wait_for(
+                lambda: svc.dispatcher_pid not in (None, old_pid),
+                timeout_s=30.0,
+            )
+        finally:
+            svc.stop()

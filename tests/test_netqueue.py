@@ -6,8 +6,9 @@ The contract under test: `NetQueueClient`/`NetQueueServer` present the
 SAME producer/consumer surface as the shm `RowQueueClient`/
 `RowQueueServer` — same shed boundary (credit window == slot budget →
 `SlotsExhausted` → 429), same dispatcher-death semantics (broken
-connection fails every in-flight wait NOW → 503 + Retry-After, heals
-on jittered reconnect), same reply payload (predictions + the
+connection HOLDS in-flight waits for failover resubmission, fails
+them 503 + Retry-After only past the failover deadline, heals on
+jittered reconnect), same reply payload (predictions + the
 answering bundle identity) — so `frontend.py`/`aio.py`/`dispatch.py`
 run unchanged over either transport. Plus the three-table knob guards
 (SERVE_TRANSPORTS == cli choices == stages env parse), the wire-schema
@@ -128,39 +129,50 @@ def test_credit_window_is_the_shed_boundary(net_pair):
     client.submit(X, KIND_SINGLE, lambda r: None)  # credits came back
 
 
-def test_dispatcher_death_fails_waits_now_then_heals(net_pair):
-    """The PR 16 death contract over a socket: a broken connection
-    fails every in-flight wait immediately with `DispatcherUnavailable`
-    (503 + Retry-After at the HTTP layer — never a hung request), new
-    submits shed synchronously, and the jittered reconnect loop heals
-    against a rebound server, counting the reconnect."""
-    client, server = net_pair
+def test_dispatcher_death_fails_waits_at_deadline_then_heals():
+    """The PR 16 death contract, ISSUE-19-amended: a broken connection
+    HOLDS in-flight waits for failover resubmission; only a disconnect
+    that outlives the failover deadline fails them with
+    `DispatcherUnavailable` (503 + Retry-After at the HTTP layer —
+    never a hung request). New submits still shed synchronously while
+    down, and the jittered reconnect loop heals against a rebound
+    server, counting the reconnect."""
+    server = NetQueueServer(("tcp", "127.0.0.1", 0), credit_window=4)
+    client = NetQueueClient(server.address, frontend_id=0,
+                            reconnect_base_s=0.05, reconnect_max_s=0.2,
+                            failover_deadline_s=0.4).start()
+    assert _wait_for(client.dispatcher_up), "client never connected"
     address = server.address
     fails = {}
     X = np.ones((1, 1), dtype=np.float32)
-    client.submit(X, KIND_SINGLE, lambda r: fails.setdefault("r", r))
-    server.close()
-    assert _wait_for(lambda: "r" in fails)
-    assert isinstance(fails["r"], DispatcherUnavailable)
-    assert _wait_for(lambda: not client.dispatcher_up())
-    with pytest.raises(DispatcherUnavailable):
-        client.submit(X, KIND_SINGLE, lambda r: None)
-
-    reborn = NetQueueServer(address, credit_window=4)
     try:
-        assert _wait_for(client.dispatcher_up, timeout_s=15.0)
-        assert client.reconnects == 1
-        assert client.transport_state()["reconnects"] == 1
-        got = {}
-        client.submit(X, KIND_SINGLE, lambda r: got.setdefault("r", r))
-        sub = reborn.poll(timeout_s=5.0)
-        reborn.reply(sub, 200,
-                     predictions=np.array([9.0], dtype=np.float32),
-                     bundle=_bundle())
-        assert _wait_for(lambda: "r" in got)
-        assert got["r"].status == 200
+        client.submit(X, KIND_SINGLE, lambda r: fails.setdefault("r", r))
+        server.close()
+        assert _wait_for(lambda: not client.dispatcher_up())
+        with pytest.raises(DispatcherUnavailable):
+            client.submit(X, KIND_SINGLE, lambda r: None)
+        # no standby appears: the held wait fails once the deadline runs
+        # out — bounded, never hung
+        assert _wait_for(lambda: "r" in fails, timeout_s=10.0)
+        assert isinstance(fails["r"], DispatcherUnavailable)
+
+        reborn = NetQueueServer(address, credit_window=4)
+        try:
+            assert _wait_for(client.dispatcher_up, timeout_s=15.0)
+            assert client.reconnects == 1
+            assert client.transport_state()["reconnects"] == 1
+            got = {}
+            client.submit(X, KIND_SINGLE, lambda r: got.setdefault("r", r))
+            sub = reborn.poll(timeout_s=5.0)
+            reborn.reply(sub, 200,
+                         predictions=np.array([9.0], dtype=np.float32),
+                         bundle=_bundle())
+            assert _wait_for(lambda: "r" in got)
+            assert got["r"].status == 200
+        finally:
+            reborn.close()
     finally:
-        reborn.close()
+        client.stop()
 
 
 def test_dead_connection_submissions_skipped_and_reclaimed(tmp_path):
@@ -211,7 +223,7 @@ def test_hello_version_fence_refuses_mismatched_peer():
 
     def impostor():
         conn, _ = listener.accept()
-        body = _HELLO_BODY.pack(9999, 4) + BINARY_CONTENT_TYPE.encode()
+        body = _HELLO_BODY.pack(9999, 4, 0) + BINARY_CONTENT_TYPE.encode()
         conn.sendall(_FRAME_HEADER.pack(len(body) + 1, 1) + body)
         time.sleep(1.0)
         conn.close()
@@ -312,17 +324,25 @@ def test_transport_knob_cli_stage_and_module_stay_in_sync(monkeypatch):
         monkeypatch.setenv("BODYWORK_TPU_SERVE_TRANSPORT", raw_t)
         monkeypatch.delenv("BODYWORK_TPU_DISPATCHER_ADDR", raising=False)
         monkeypatch.setenv("BODYWORK_TPU_SERVE_ROLE", "nope")
-        transport, addr, role = _serve_transport_env_knobs()
+        monkeypatch.setenv("BODYWORK_TPU_SERVE_STANDBY", "perhaps")
+        transport, addr, role, standby = _serve_transport_env_knobs()
         assert transport == want_t, raw_t
         assert role == "auto"  # malformed role degraded
         assert addr is None
+        assert standby is False  # malformed standby degraded
         args = build_parser().parse_args(["serve", "--store", "s"])
         assert args.transport == want_t, raw_t
         assert args.role == "auto"
+        assert args.standby is False
 
     monkeypatch.setenv("BODYWORK_TPU_DISPATCHER_ADDR", "disp.svc:9091")
     monkeypatch.setenv("BODYWORK_TPU_SERVE_ROLE", "frontend")
-    assert _serve_transport_env_knobs()[1:] == ("disp.svc:9091", "frontend")
+    monkeypatch.setenv("BODYWORK_TPU_SERVE_STANDBY", "1")
+    assert _serve_transport_env_knobs()[1:] == (
+        "disp.svc:9091", "frontend", True
+    )
+    args = build_parser().parse_args(["serve", "--store", "s"])
+    assert args.standby is True  # env default feeds the flag too
 
 
 def test_wire_schema_pinned_identical_across_shm_and_socket_paths():
@@ -344,9 +364,10 @@ def test_wire_schema_pinned_identical_across_shm_and_socket_paths():
         try:
             msg_type, body = _recv_frame(raw)
             assert msg_type == 1  # HELLO
-            version, credits = _HELLO_BODY.unpack_from(body)
+            version, credits, fence = _HELLO_BODY.unpack_from(body)
             assert version == wire.WIRE_SCHEMA_VERSION
             assert credits == 7
+            assert fence == 0  # no election ran for this bare server
             assert body[_HELLO_BODY.size:].decode("ascii") == (
                 wire.BINARY_CONTENT_TYPE
             )
@@ -369,6 +390,33 @@ def test_multiproc_transport_validation():
     with pytest.raises(ValueError, match="dispatcher-addr"):
         MultiProcessService("s", transport="tcp", frontends=2,
                             external_dispatcher=True)
+
+
+def test_multiproc_standby_validation():
+    """ISSUE 19's topology rules: standby leadership needs a socket
+    transport (shm is single-host, where respawn IS the takeover), an
+    external dispatcher's standby is not ours to run, and a
+    dispatcher-only fleet (frontends=0) exists ONLY as the standby
+    pair."""
+    from bodywork_tpu.serve import MultiProcessService
+
+    with pytest.raises(ValueError, match="socket transport"):
+        MultiProcessService("s", transport="shm", frontends=2,
+                            standby=True)
+    with pytest.raises(ValueError, match="supervised elsewhere"):
+        MultiProcessService("s", transport="tcp", frontends=2,
+                            dispatcher_addr="h:9091",
+                            external_dispatcher=True, standby=True)
+    with pytest.raises(ValueError, match="--standby"):
+        MultiProcessService("s", transport="tcp", frontends=0)
+    # the legal shapes construct (no processes started)
+    for svc in (
+        MultiProcessService("s", transport="tcp", frontends=0,
+                            standby=True),
+        MultiProcessService("s", transport="tcp", frontends=2,
+                            standby=True, leader_ttl_s=2.0),
+    ):
+        svc._reserved.close()
 
 
 def test_netqueue_metric_names_pass_the_lint():
@@ -606,6 +654,62 @@ def test_k8s_split_validator_rejects_scaled_dispatcher():
     assert any("front-end" in e and "HPA" in e for e in errors)
 
 
+def test_k8s_standby_materialises_the_pair_and_validates_both_ways():
+    """The standby knob rides the env contract end to end: a truthy
+    `BODYWORK_TPU_SERVE_STANDBY` on the serving stage emits a
+    dispatcher Deployment with `--standby` in its command and
+    `replicas: 2`, which the validator ACCEPTS — while the validator's
+    replica rule still refuses >2 with standby and >1 without (ISSUE
+    19: scale without standby mode splits the coalescer; scale WITH it
+    is the lease-arbitrated pair)."""
+    from bodywork_tpu.pipeline import default_pipeline
+    from bodywork_tpu.pipeline.k8s import generate_manifests
+    from bodywork_tpu.pipeline.k8s_validate import (
+        validate_manifests,
+        validate_split_serving,
+    )
+
+    spec = default_pipeline()
+    stage = next(s for s in spec.stages.values() if "serve" in s.name)
+    stage.env["BODYWORK_TPU_SERVE_TRANSPORT"] = "tcp"
+    stage.env["BODYWORK_TPU_SERVE_STANDBY"] = "1"
+    docs = generate_manifests(spec, store_path="/mnt/store")
+    validate_manifests(docs)  # the emitted pair passes every layer
+    disp = next(
+        d for d in docs.values()
+        if isinstance(d, dict) and d.get("kind") == "Deployment"
+        and d["metadata"]["name"].endswith("--dispatcher")
+    )
+    container = disp["spec"]["template"]["spec"]["containers"][0]
+    assert "--standby" in container["command"]
+    assert disp["spec"]["replicas"] == 2
+    assert not validate_split_serving(docs)
+
+    disp["spec"]["replicas"] = 3  # extra standbys only stretch elections
+    errors = validate_split_serving(docs)
+    assert any("1 or 2 replicas" in e for e in errors)
+    disp["spec"]["replicas"] = 1  # a pair scaled down is still legal
+    assert not validate_split_serving(docs)
+
+    # and WITHOUT the knob the PR 18 singleton rule still holds
+    spec2 = default_pipeline()
+    stage2 = next(s for s in spec2.stages.values() if "serve" in s.name)
+    stage2.env["BODYWORK_TPU_SERVE_TRANSPORT"] = "tcp"
+    docs2 = generate_manifests(spec2, store_path="/mnt/store")
+    disp2 = next(
+        d for d in docs2.values()
+        if isinstance(d, dict) and d.get("kind") == "Deployment"
+        and d["metadata"]["name"].endswith("--dispatcher")
+    )
+    assert "--standby" not in (
+        disp2["spec"]["template"]["spec"]["containers"][0]["command"]
+    )
+    assert disp2["spec"]["replicas"] == 1
+    disp2["spec"]["replicas"] = 2
+    errors = validate_split_serving(docs2)
+    assert any("exactly 1 replica" in e for e in errors)
+
+
 def test_serve_stage_warns_on_socket_knobs_it_cannot_materialise(
     monkeypatch, caplog
 ):
@@ -619,8 +723,8 @@ def test_serve_stage_warns_on_socket_knobs_it_cannot_materialise(
     monkeypatch.setenv("BODYWORK_TPU_SERVE_TRANSPORT", "tcp")
     monkeypatch.setenv("BODYWORK_TPU_SERVE_ROLE", "frontend")
     with caplog.at_level(logging.WARNING):
-        transport, addr, role = _serve_transport_env_knobs()
-    assert (transport, role) == ("tcp", "frontend")
+        transport, addr, role, standby = _serve_transport_env_knobs()
+    assert (transport, role, standby) == ("tcp", "frontend", False)
 
 
 # -- config 16: tier-1 smoke + full sweep ------------------------------------
@@ -630,9 +734,12 @@ def test_config16_smoke():
     """Smoke-scale cross-host-transport bench (loopback sockets,
     seconds not minutes): byte identity holds across shm/tcp and the
     single-process server, the handoff scrape resolves, the sharded
-    driver produces the scaling points, and the kill drill sees only
-    503+Retry-After with zero hung requests. The full acceptance sweep
-    is the `slow`-marked test below."""
+    driver produces the scaling points, and the kill drill heals with
+    zero hung requests — since PR 19 an in-flight row caught by the
+    kill is HELD and replayed over the re-established connection (a
+    late 200), so a sequential prober may see no 503 at all; any 503
+    that does surface must carry Retry-After. The full acceptance
+    sweep is the `slow`-marked test below."""
     import bench
 
     record = bench.bench_cross_host_transports(
@@ -659,6 +766,7 @@ def test_config16_smoke():
     assert drill["ran"] and drill["healed"]
     assert drill["outage_clean"], drill["outage"]
     assert drill["outage"]["timeouts"] == 0
+    assert drill["frontend_reconnects"] >= 1  # the outage was real
     assert drill["byte_identical_after_heal"]
 
 
@@ -673,17 +781,20 @@ def test_config16_full_sweep():
     record = bench.bench_cross_host_transports()
     assert record["byte_identity"]["identical"] is True
     drill = record["kill_drill"]
+    assert drill["healed"] and drill["frontend_reconnects"] >= 1
     assert drill["outage_clean"] and drill["recovered_within_10pct"]
     for point in record["scaling"]["points"].values():
         assert point["capacity_rps"] > 0
 
 
 def test_config_registry_includes_16():
-    """The ISSUE-18 satellite: the config tables really grew to 16
-    entries (the generic sync guard can't notice a config that is
-    missing from ALL three tables at once)."""
+    """The ISSUE-18 satellite (grown by ISSUE 19): the config tables
+    really carry configs 16 and 17 (the generic sync guard can't notice
+    a config that is missing from ALL three tables at once)."""
     import bench
 
-    assert set(bench.ALL_CONFIGS) == set(range(1, 17))
+    assert set(bench.ALL_CONFIGS) == set(range(1, 18))
     assert 16 in bench.CONFIG_BENCHES
     assert bench.CONFIG_TIMEOUT_S[16] > 0
+    assert 17 in bench.CONFIG_BENCHES
+    assert bench.CONFIG_TIMEOUT_S[17] > 0

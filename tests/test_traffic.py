@@ -325,3 +325,36 @@ def test_wheel_packages_include_every_subpackage():
         f"subpackages missing from pyproject packages: "
         f"{sorted(on_disk - declared)}"
     )
+
+
+def test_max_blackout_measures_the_dark_span():
+    """ISSUE 19 satellite: `max_blackout_s` is the longest time-span of
+    consecutive scheduled arrivals with zero 200s — measured from the
+    first failed arrival to the next success (or the last arrival when
+    the run never recovers), order-independent, 0.0 on a clean run. The
+    failover bench (config 17) asserts this against the lease TTL +
+    one-reconnect bound."""
+    from types import SimpleNamespace
+
+    from bodywork_tpu.traffic.runner import LoadReport, _max_blackout_s
+
+    def r(t, status):
+        return SimpleNamespace(t_s=t, status=status)
+
+    assert _max_blackout_s([]) == 0.0
+    assert _max_blackout_s([r(0.0, 200), r(1.0, 200)]) == 0.0
+    # hole from the 1.0 failure to the 3.0 recovery
+    assert _max_blackout_s(
+        [r(0.0, 200), r(1.0, 503), r(2.0, 0), r(3.0, 200)]
+    ) == 2.0
+    # never recovered: dark through the last scheduled arrival
+    assert _max_blackout_s([r(0.0, 200), r(1.0, 503), r(4.0, 503)]) == 3.0
+    # input order must not matter (sharded results merge unsorted)
+    assert _max_blackout_s(
+        [r(3.0, 200), r(1.0, 503), r(0.0, 200), r(2.0, 0)]
+    ) == 2.0
+    # two holes: the WIDER one wins, not the one with more failures
+    assert _max_blackout_s(
+        [r(0.0, 503), r(0.1, 503), r(0.2, 200), r(1.0, 429), r(4.0, 200)]
+    ) == 3.0
+    assert "max_blackout_s" in LoadReport.__dataclass_fields__
