@@ -103,7 +103,7 @@ from datetime import date
 BASELINE_DAY_S = 1317 * 0.00822  # reference stage-4 scoring loop, see above
 BASELINE_REQUEST_S = 0.00822  # reference per-request scoring latency
 
-ALL_CONFIGS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17)
+ALL_CONFIGS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17, 18)
 HEADLINE_CONFIG = 2  # the north-star day loop
 
 #: config 11's padded-bucket sweep — pinned == serve.predictor.
@@ -4617,6 +4617,464 @@ def bench_dispatcher_failover(
     }
 
 
+# -- config 18: the online tuning control plane ------------------------------
+
+
+def bench_online_tuning(
+    phase_a_s: float = 5.0,
+    phase_b_s: float = 5.0,
+    phase_a_rate_rps: float = 60.0,
+    # 3x phase A — far past the 0.5 drift threshold, but comfortably
+    # under this box's CPU service rate: the refit's OWN guard compares
+    # post-apply p99 against the pre-apply window, so a phase-B rate
+    # that saturates the box reverts the (correct) refit for latency
+    # the traffic caused, not the knobs
+    phase_b_rate_rps: float = 180.0,
+    poll_interval_s: float = 0.25,
+    min_window_requests: int = 60,
+    min_verdict_requests: int = 15,
+    verdict_polls: int = 40,
+    cooldown_polls: int = 2,
+    revert_p99_ratio: float = 12.0,
+    sabotage_window_ms: float = 900.0,
+    calibration_s: float = 2.5,
+    calibration_rate_rps: float = 40.0,
+    sabotage_drive_s: float = 4.0,
+    sabotage_rate_rps: float = 40.0,
+    cost_holdout_bound: float = 0.5,
+    probe_reps: int = 3,
+    cost_budget_s: float = 4.0,
+    mlp_kwargs: dict | None = None,
+    wait_slack_s: float = 20.0,
+) -> dict:
+    """Config 18: the online tuning control plane (``tune/online.py``,
+    ``tune/costmodel.py``, ``registry/configlog.py`` — ROADMAP 5b/5d).
+    One seeded in-process serving run proves the three tentpole claims
+    end to end:
+
+    1. **Learned cost model**: the dispatch-cost probe's curve trains
+       the ridge regressor; its held-out relative error is recorded
+       and must sit inside the stated bound — the evidence behind
+       pricing unprobed ladder rungs during the online refit (and the
+       admission layer's cost-priced shed, armed here with a generous
+       budget so the pricing path runs without shedding healthy load).
+    2. **Mid-flight refit, zero compiles, zero dropped requests**: a
+       live drive shifts traffic shape mid-flight (uniform trickle ->
+       ~4x arrival rate, appended to the controller's watch log while
+       requests are in flight); the controller detects the drift,
+       refits against the cost-model-priced window, and applies the
+       knobs to the LIVE service. Every possible fitted ladder rung is
+       a power of two <= 512, and serving boots with exactly that
+       ladder AOT-warmed — so the executable-cache miss counter must
+       not move after boot (the zero-compile claim, measured), every
+       request across both phases must answer 200, and a fixed probe
+       request must return byte-identical bodies before and after the
+       refit.
+    3. **Config-as-canary auto-revert**: a deliberately sabotaged
+       config (an absurd-but-valid coalescer window — the valid-but-
+       terrible case knob validation cannot catch) is injected through
+       ``apply_tuned`` — the SAME machinery the refit uses. The guard
+       window catches the p99 regression within its poll budget and
+       auto-reverts in exactly ONE config-log CAS (counted at the
+       store boundary), restoring the graduated config's knobs, with
+       the flight-recorder dump key carried in the revert event.
+
+    CPU-safe: every mechanism (drift arithmetic, AOT cache, CAS
+    discipline, guard verdicts) is host-side or cached-executable
+    work; the record carries cpu_count and backend."""
+    import threading
+
+    import requests as rq
+
+    from bodywork_tpu.data import Dataset, generate_day, persist_dataset
+    from bodywork_tpu.obs.tracing import configured_tracing
+    from bodywork_tpu.ops.slo import _sum_counter
+    from bodywork_tpu.registry.configlog import read_config_log
+    from bodywork_tpu.serve import serve_latest_model
+    from bodywork_tpu.store import FilesystemStore
+    from bodywork_tpu.store.base import DelegatingStore
+    from bodywork_tpu.store.schema import CONFIG_LOG_KEY
+    from bodywork_tpu.traffic import run_open_loop, write_request_log
+    from bodywork_tpu.traffic.generator import (
+        TrafficConfig,
+        generate_request_log,
+    )
+    from bodywork_tpu.train import train_on_history
+    from bodywork_tpu.tune.collect import probe_dispatch_costs
+    from bodywork_tpu.tune.config import write_tuned_config
+    from bodywork_tpu.tune.costmodel import (
+        fit_cost_model,
+        samples_from_probe,
+        write_cost_model,
+    )
+
+    class _CasCountingStore(DelegatingStore):
+        """Counts ``put_bytes_if_match`` calls per key at the store
+        boundary — the exactly-one-CAS budget is asserted on what hit
+        the backend, not on what the ledger code intended."""
+
+        def __init__(self, inner):
+            super().__init__(inner)
+            self.cas_calls: dict = {}
+
+        def put_bytes_if_match(self, key, data, expected_token=None):
+            self.cas_calls[key] = self.cas_calls.get(key, 0) + 1
+            return self._inner.put_bytes_if_match(key, data, expected_token)
+
+    def _wait_for(predicate, timeout_s: float, tick_s: float = 0.02):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            value = predicate()
+            if value:
+                return value
+            time.sleep(tick_s)
+        return predicate()
+
+    def _drive_summary(report: dict) -> dict:
+        return {
+            "requests": report["requests"],
+            "ok": report["ok"],
+            "shed": report["shed"],
+            "unavailable": report["unavailable"],
+            "client_error": report["client_error"],
+            "server_error": report["server_error"],
+            "transport_errors": report["transport_errors"],
+            "timeouts": report["timeouts"],
+            "p99_s": (report.get("latency") or {}).get("p99_s"),
+        }
+
+    def _zero_errors(report: dict) -> bool:
+        return report["ok"] == report["requests"]
+
+    # every ladder rung the fitter can choose is a power of two <= 512
+    # (row-quantile covers <= the 512-clamped max_rows flush cover) —
+    # booting with ALL of them warmed is what makes the zero-compile
+    # assertion global instead of "trust me, it was the watcher thread"
+    serve_buckets = tuple(2 ** i for i in range(10))  # 1 .. 512
+
+    store_path = tempfile.mkdtemp(prefix="bench-onlinetune-")
+    store = _CasCountingStore(FilesystemStore(store_path))
+    d0 = date(2026, 1, 1)
+    X, y = generate_day(d0)
+    persist_dataset(store, Dataset(X, y, d0))
+    train_on_history(
+        store, "mlp",
+        model_kwargs=mlp_kwargs or {"hidden": [32, 32], "n_steps": 40},
+    )
+
+    # -- 1. the learned cost model, from the dispatch probe ------------------
+    curve = probe_dispatch_costs(store, serve_buckets, reps=probe_reps)
+    from bodywork_tpu.models.checkpoint import load_model, resolve_serving_key
+
+    serving_key, _src = resolve_serving_key(store)
+    model, _day = load_model(store, serving_key)
+    samples = samples_from_probe(curve, n_features=model.n_features or 1)
+    cm_doc = fit_cost_model(samples, seed=18)
+    cm_key, cm_digest = write_cost_model(store, cm_doc, d0)
+    holdout = cm_doc["holdout"]
+    cost_model = {
+        "key": cm_key,
+        "digest": cm_digest,
+        "n_samples": len(samples),
+        "holdout": holdout,
+        "rel_err_bound": cost_holdout_bound,
+        "within_bound": (
+            holdout["mean_rel_err"] is not None
+            and holdout["mean_rel_err"] <= cost_holdout_bound
+        ),
+    }
+
+    # -- controller policy, through the deployed env channel -----------------
+    tune_env = {
+        "BODYWORK_TPU_TUNE_MIN_WINDOW_REQUESTS": str(min_window_requests),
+        "BODYWORK_TPU_TUNE_DRIFT_THRESHOLD": "0.5",
+        "BODYWORK_TPU_TUNE_COOLDOWN_POLLS": str(cooldown_polls),
+        "BODYWORK_TPU_TUNE_VERDICT_POLLS": str(verdict_polls),
+        "BODYWORK_TPU_TUNE_MIN_VERDICT_REQUESTS": str(min_verdict_requests),
+        "BODYWORK_TPU_TUNE_REVERT_P99_RATIO": str(revert_p99_ratio),
+    }
+    saved_env = {k: os.environ.get(k) for k in tune_env}
+    os.environ.update(tune_env)
+
+    scratch = tempfile.mkdtemp(prefix="bench-onlinetune-logs-")
+    watch_log = os.path.join(scratch, "live.requests.jsonl")
+    counters = {
+        "refits": lambda: _sum_counter(
+            "bodywork_tpu_tune_online_refits_total", outcome="applied"
+        ),
+        "reverts": lambda: _sum_counter(
+            "bodywork_tpu_tune_online_reverts_total"
+        ),
+        "cache_misses": lambda: _sum_counter(
+            "bodywork_tpu_serve_executable_cache_misses_total"
+        ),
+        "cache_hits": lambda: _sum_counter(
+            "bodywork_tpu_serve_executable_cache_hits_total"
+        ),
+        "ingest_bytes": lambda: _sum_counter(
+            "bodywork_tpu_tune_ingest_bytes_total", kind="request_log"
+        ),
+    }
+    base = {name: fn() for name, fn in counters.items()}
+
+    handle = None
+    try:
+        with configured_tracing(1.0, seed=18):
+            handle = serve_latest_model(
+                store, host="127.0.0.1", port=0, block=False,
+                server_engine="aio", watch_interval_s=poll_interval_s,
+                buckets=serve_buckets, max_pending=512,
+                batch_window_ms=2.0, batch_max_rows=64,
+                online_tune=True, tune_request_logs=(watch_log,),
+                cost_budget_s=cost_budget_s,
+            )
+            app = handle.app
+            controller = app.tune_controller
+            base_url = handle.url.replace("/score/v1", "")
+            misses_at_boot = counters["cache_misses"]()
+            cost_shed_armed = (app.admission.state() or {}).get("cost_shed")
+            probe_payload = {"X": [50.0]}
+            body_boot = rq.post(
+                handle.url, json=probe_payload, timeout=10
+            ).content
+
+            # -- 2a. phase A: the shape the reference pins to ----------------
+            cfg_a = TrafficConfig(
+                rate_rps=phase_a_rate_rps, duration_s=phase_a_s, seed=181,
+            )
+            requests_a = generate_request_log(cfg_a)
+            write_request_log(watch_log, cfg_a, requests_a)
+            report_a = run_open_loop(
+                handle.url, requests_a, timeout_s=15.0,
+                duration_s=phase_a_s,
+            ).to_dict()
+            reference = _wait_for(
+                lambda: controller._reference, wait_slack_s
+            )
+
+            # -- 2b. phase B: shape shift appended MID-DRIVE -----------------
+            requests_b = generate_request_log(TrafficConfig(
+                rate_rps=phase_b_rate_rps, duration_s=phase_b_s, seed=182,
+            ))
+
+            def _append_phase_b():
+                offset = phase_a_s + 0.2 * phase_b_s
+                with open(watch_log, "a") as f:
+                    for r in requests_b:
+                        f.write(json.dumps({
+                            "t_s": round(r.t_s + offset, 9),
+                            "route": r.route, "rows": r.rows,
+                            "x": list(r.x),
+                        }) + "\n")
+
+            cas_before_refit = store.cas_calls.get(CONFIG_LOG_KEY, 0)
+            appender = threading.Timer(0.2 * phase_b_s, _append_phase_b)
+            appender.start()
+            try:
+                report_b = run_open_loop(
+                    handle.url, requests_b, timeout_s=15.0,
+                    duration_s=phase_b_s,
+                ).to_dict()
+            finally:
+                appender.join()
+            refit_applied = _wait_for(
+                lambda: counters["refits"]() - base["refits"] >= 1,
+                wait_slack_s,
+            )
+            # the guard window closes by graduating (healthy) — a revert
+            # here means the refit regressed its own service
+            _wait_for(
+                lambda: controller._guard is None,
+                verdict_polls * poll_interval_s + wait_slack_s,
+            )
+            graduated = (
+                controller._guard is None
+                and counters["reverts"]() - base["reverts"] == 0
+            )
+            cas_refit = (
+                store.cas_calls.get(CONFIG_LOG_KEY, 0) - cas_before_refit
+            )
+            refit_log = read_config_log(store)
+            refit_entry = (refit_log or {}).get("active") or {}
+            body_after_refit = rq.post(
+                handle.url, json=probe_payload, timeout=10
+            ).content
+            healthz_after_refit = rq.get(
+                base_url + "/healthz", timeout=10
+            ).json()
+
+            # -- 3. sabotage: absurd-but-valid window, same machinery --------
+            calibration = generate_request_log(TrafficConfig(
+                rate_rps=calibration_rate_rps, duration_s=calibration_s,
+                seed=183,
+            ))
+            report_cal = run_open_loop(
+                handle.url, calibration, timeout_s=15.0,
+                duration_s=calibration_s,
+            ).to_dict()
+            sab_knobs = {"batch_window_ms": float(sabotage_window_ms)}
+            sab_key, sab_digest = write_tuned_config(
+                store,
+                {"knobs": sab_knobs, "decisions": [], "note": (
+                    "bench-18 sabotage: validly-shaped config with an "
+                    "absurd coalescer window — the guard, not the "
+                    "validator, must catch it"
+                )},
+                day=date(2026, 9, 18),
+            )
+            cas_before_sab = store.cas_calls.get(CONFIG_LOG_KEY, 0)
+            sab_applied = controller.apply_tuned(
+                sab_knobs, sab_key, sab_digest,
+                reason=f"bench_sabotage(window_ms={sabotage_window_ms})",
+            )
+            cas_sab_apply = (
+                store.cas_calls.get(CONFIG_LOG_KEY, 0) - cas_before_sab
+            )
+            sabotage_drive = generate_request_log(TrafficConfig(
+                rate_rps=sabotage_rate_rps, duration_s=sabotage_drive_s,
+                seed=184,
+            ))
+            report_sab = run_open_loop(
+                handle.url, sabotage_drive,
+                timeout_s=max(15.0, 6.0 * sabotage_window_ms / 1e3),
+                duration_s=sabotage_drive_s,
+            ).to_dict()
+            reverted = _wait_for(
+                lambda: counters["reverts"]() - base["reverts"] >= 1,
+                verdict_polls * poll_interval_s + wait_slack_s,
+            )
+            cas_revert = (
+                store.cas_calls.get(CONFIG_LOG_KEY, 0)
+                - cas_before_sab - cas_sab_apply
+            )
+            final_log = read_config_log(store)
+            revert_events = [
+                e for e in (final_log or {}).get("history", [])
+                if e["event"] == "reverted"
+            ]
+            revert_event = revert_events[-1] if revert_events else {}
+            flight_record_key = revert_event.get("flight_record")
+            body_after_revert = rq.post(
+                handle.url, json=probe_payload, timeout=10
+            ).content
+            effective_after_revert = app.effective_config()
+            misses_final = counters["cache_misses"]()
+    finally:
+        if handle is not None:
+            handle.stop()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    miss_delta = misses_final - misses_at_boot
+    restored_digest = ((final_log or {}).get("active") or {}).get("digest")
+    refit = {
+        "applied": bool(refit_applied),
+        "graduated": graduated,
+        "config_log_cas_writes": cas_refit,
+        "tuned_config_key": refit_entry.get("key"),
+        "tuned_config_digest": refit_entry.get("digest"),
+        "knobs": refit_entry.get("knobs"),
+        "reference_shape": reference,
+        "healthz_tuning": healthz_after_refit.get("tuning"),
+        "executable_cache_miss_delta_after_boot": miss_delta,
+        "executable_cache_hits_delta": (
+            counters["cache_hits"]() - base["cache_hits"]
+        ),
+        "byte_identical_across_refit": body_after_refit == body_boot,
+        "phase_a": _drive_summary(report_a),
+        "phase_b": _drive_summary(report_b),
+    }
+    sabotage = {
+        "key": sab_key,
+        "digest": sab_digest,
+        "knobs": sab_knobs,
+        "apply_outcome": sab_applied,
+        "config_log_cas_writes_apply": cas_sab_apply,
+        "config_log_cas_writes_revert": cas_revert,
+        "reverted": bool(reverted),
+        "revert_event": revert_event,
+        "flight_record_key": flight_record_key,
+        "flight_record_exists": bool(
+            flight_record_key and store.exists(flight_record_key)
+        ),
+        "restored_digest": restored_digest,
+        "restored_is_graduated_config": (
+            restored_digest is not None
+            and restored_digest == refit_entry.get("digest")
+        ),
+        "effective_window_after_revert": (
+            effective_after_revert.get("batch_window_ms")
+        ),
+        "byte_identical_after_revert": body_after_revert == body_boot,
+        "calibration": _drive_summary(report_cal),
+        "drive": _drive_summary(report_sab),
+    }
+    zero_errors = all(
+        _zero_errors(r) for r in (report_a, report_b, report_cal, report_sab)
+    )
+    passed = (
+        cost_model["within_bound"]
+        and refit["applied"] and refit["graduated"]
+        and cas_refit == 1
+        and miss_delta == 0
+        and refit["byte_identical_across_refit"]
+        and zero_errors
+        and sabotage["reverted"]
+        and cas_sab_apply == 1 and cas_revert == 1
+        and sabotage["flight_record_exists"]
+        and sabotage["restored_is_graduated_config"]
+    )
+    return {
+        "metric": "online_tuning_zero_compile_refit",
+        "unit": "executable-cache misses after boot warmup",
+        "value": miss_delta,
+        "vs_baseline": None,
+        "baseline_note": (
+            "no external baseline applies: the claims are invariants "
+            "(zero misses, zero dropped requests, one CAS per "
+            "lifecycle transition) measured against this run's own "
+            "counters and store"
+        ),
+        "cpu_count": os.cpu_count(),
+        "cost_model": cost_model,
+        "refit": refit,
+        "sabotage": sabotage,
+        "zero_request_errors": zero_errors,
+        "ingest_bytes": counters["ingest_bytes"]() - base["ingest_bytes"],
+        "acceptance": {
+            "required": (
+                "cost-model held-out mean relative error within "
+                f"{cost_holdout_bound}; a mid-drive traffic-shape shift "
+                "triggers an online refit applied live with zero "
+                "executable-cache misses after boot, zero non-200 "
+                "responses, and byte-identical probe bodies; the "
+                "sabotaged config auto-reverts within "
+                f"{verdict_polls} polls in exactly one config-log CAS "
+                "with the flight-record key in the revert event"
+            ),
+            "passed": passed,
+        },
+        "protocol": (
+            "one MLP checkpoint; dispatch probe over the full pow2 "
+            "ladder trains the ridge cost model (held-out error "
+            "in-record); an aio server boots with every pow2 rung <= "
+            "512 AOT-warmed, the online controller watching a live "
+            f"request log at {poll_interval_s}s polls; phase A "
+            f"({phase_a_rate_rps:.0f} rps x {phase_a_s:.0f}s) pins the "
+            f"reference shape, phase B ({phase_b_rate_rps:.0f} rps) is "
+            "appended to the watch log MID-DRIVE, the drift refit "
+            "applies live under guard and graduates; then an absurd "
+            f"{sabotage_window_ms:.0f} ms coalescer window is injected "
+            "through apply_tuned and the guard auto-reverts it on the "
+            "p99 verdict, flight record dumped, one CAS per transition "
+            "counted at the store boundary"
+        ),
+    }
+
+
 #: CONFIG_TIMEOUT_S budget and appear in ALL_CONFIGS — pinned by
 #: tests/test_bench.py::test_config_registry_sync so a new config can
 #: never silently miss one of the three tables (config 7 was once wired
@@ -4641,6 +5099,7 @@ CONFIG_BENCHES = {
     15: lambda: bench_multitenant_stacked(),
     16: lambda: bench_cross_host_transports(),
     17: lambda: bench_dispatcher_failover(),
+    18: lambda: bench_online_tuning(),
 }
 
 
@@ -4725,10 +5184,14 @@ RESUME_MAX_AGE_S = 6 * 3600
 #: init each: 3 transports + 3 tcp fleet sizes + the single-process
 #: baseline) plus the in-process kill-drill fleet, around sharded
 #: capacity ramps and fixed-rate handoff windows — generously sized
+#: config 18 is one in-process aio server (JAX init + ~10 small AOT
+#: compiles from the probe, reused by boot warmup) around ~17 s of
+#: timed drives plus the guard windows' poll budgets (~10 s each for
+#: graduation and the sabotage verdict) — generously sized
 CONFIG_TIMEOUT_S = {
     1: 300, 2: 300, 3: 600, 4: 600, 5: 450, 6: 1200, 7: 600, 8: 300,
     9: 600, 10: 1800, 11: 1200, 12: 1200, 13: 900, 14: 900, 15: 600,
-    16: 1200, 17: 900,
+    16: 1200, 17: 900, 18: 900,
 }
 
 

@@ -736,7 +736,9 @@ def _check_tuning(ctx: FsckContext) -> list[Finding]:
     any validation failure, so dropping the corrupt document converges
     the store to the same state serving sees (re-running ``cli tune``
     re-fits it)."""
+    from bodywork_tpu.registry.configlog import CONFIG_LOG_SCHEMA
     from bodywork_tpu.tune.config import TUNED_CONFIG_SCHEMA
+    from bodywork_tpu.tune.costmodel import COST_MODEL_SCHEMA
 
     out = []
     for key in ctx.keys[TUNING_PREFIX]:
@@ -745,8 +747,29 @@ def _check_tuning(ctx: FsckContext) -> list[Finding]:
             continue
         sidecar_doc, status = ctx.sidecar(key)
         doc = _json_doc(data)
+        # the tuning/ prefix holds three document kinds, dispatched by
+        # basename: the config-lifecycle log (a live CAS pointer), the
+        # learned cost model, and the tuned configs themselves —
+        # validating a cost model against the tuned-config schema would
+        # quarantine every healthy one
+        basename = key.rsplit("/", 1)[-1]
+        if basename == "config-log.json":
+            expected_schema = CONFIG_LOG_SCHEMA
+            shape_ok = doc is not None and isinstance(
+                doc.get("history"), list
+            )
+        elif basename.startswith("cost-model-"):
+            expected_schema = COST_MODEL_SCHEMA
+            shape_ok = doc is not None and isinstance(
+                doc.get("weights"), list
+            )
+        else:
+            expected_schema = TUNED_CONFIG_SCHEMA
+            shape_ok = doc is not None and (
+                doc.get("knobs") is None or isinstance(doc["knobs"], dict)
+            )
         # validity deliberately MATCHES the serving loader's integrity
-        # checks (schema tag + doc_digest + knobs-field shape), NOT its
+        # checks (schema tag + doc_digest + top-level shape), NOT its
         # per-knob value validation: a digest-valid document holding a
         # knob this version rejects (or none at all) was WRITTEN that
         # way — e.g. an evidence-poor fit or a newer schema — and the
@@ -754,9 +777,9 @@ def _check_tuning(ctx: FsckContext) -> list[Finding]:
         # (replica == primary) or quarantine a healthy document
         valid = (
             doc is not None
-            and doc.get("schema") == TUNED_CONFIG_SCHEMA
+            and doc.get("schema") == expected_schema
             and verify_doc(doc) is not False
-            and (doc.get("knobs") is None or isinstance(doc["knobs"], dict))
+            and shape_ok
         )
         digest_ok = (
             status != "ok" or sidecar_doc["sha256"] == artefact_sha256(data)

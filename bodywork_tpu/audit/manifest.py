@@ -87,10 +87,12 @@ PUT_SIDECAR_PREFIXES = (
 
 #: CAS-mutated classes that also get a sidecar, written after each
 #: successful ``put_bytes_if_match`` (registry records + the alias
-#: document; journals are deliberately excluded — their bytes embed
+#: document, plus the tuning config-lifecycle log — a live CAS pointer
+#: exactly like the alias doc, so the same stale-by-one-write rules
+#: apply; journals are deliberately excluded — their bytes embed
 #: lease wall-clocks, so sidecars would break the chaos twin
 #: comparison, and they already embed a ``doc_digest``)
-CAS_SIDECAR_PREFIXES = (REGISTRY_PREFIX,)
+CAS_SIDECAR_PREFIXES = (REGISTRY_PREFIX, TUNING_PREFIX)
 
 #: subset whose sidecars embed a compressed replica (small artefacts
 #: with no other redundancy; datasets restore from snapshots instead)

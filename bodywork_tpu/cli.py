@@ -194,6 +194,16 @@ def _env_number(name: str, cast, minimum):
     return value
 
 
+def _env_paths(name: str) -> list:
+    """Parser-build-time env default for a repeatable path flag: a
+    colon-separated list (the PATH convention — the k8s Deployment
+    cannot repeat a flag through one env var). Empty segments drop."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return []
+    return [p for p in raw.split(":") if p.strip()]
+
+
 def _env_flag(name: str) -> bool:
     """Parser-build-time env default for a boolean flag: malformed
     values degrade to False with a stderr note (the same contract as
@@ -422,10 +432,108 @@ def cmd_serve(args) -> int:
                 retry_after_max_s=args.retry_after_max_s,
                 dtype=args.dtype,
                 tuned_config=args.tuned_config,
+                online_tune=bool(getattr(args, "online_tune", False)),
+                tune_request_logs=tuple(
+                    getattr(args, "tune_request_log", None) or ()
+                ),
+                tune_results_logs=tuple(
+                    getattr(args, "tune_results_log", None) or ()
+                ),
+                cost_budget_s=getattr(args, "cost_budget_s", None),
             )
         except ShutdownRequested:
             log.warning("SIGTERM during service startup; exiting")
     return SIGTERM_EXIT if sigterm_fired.is_set() else 0
+
+
+def _tune_status(store) -> int:
+    """``cli tune status``: the active tuned config — digest, the
+    observation window it was fitted from, per-knob source (tuned /
+    default / env-override) — plus the applied/reverted lifecycle
+    history from the config log. Exit 1 on a CORRUPT document (config
+    log or named tuned config): status is the operator's audit read,
+    and "corrupt" must never render as "defaults"."""
+    import json
+    import os
+
+    from bodywork_tpu.registry.configlog import (
+        ConfigLogCorrupt,
+        read_config_log,
+    )
+    from bodywork_tpu.tune.config import (
+        KNOB_DEFAULTS,
+        TUNED_KNOB_ENV,
+        _resolve_ref,
+        load_tuned_config,
+    )
+
+    try:
+        log_doc = read_config_log(store)
+    except ConfigLogCorrupt as exc:
+        log.error(str(exc))
+        return 1
+    # the active document: the config log's say when one exists (the
+    # online controller's apply/revert ledger), else the newest tuned
+    # document (what a `--tuned-config latest` boot would serve)
+    active_key = None
+    if log_doc is not None and log_doc.get("active"):
+        active_key = log_doc["active"]["key"]
+    else:
+        active_key = _resolve_ref(store, "latest")
+    knobs = digest = doc = None
+    if active_key is not None:
+        knobs, digest, doc = load_tuned_config(store, active_key)
+        if doc is None:
+            # the key EXISTS as the active config but does not load:
+            # that is corruption (load_tuned_config already warned with
+            # the specific failure), not "no config"
+            log.error(
+                f"active tuned config {active_key!r} is unreadable or "
+                "fails validation"
+            )
+            return 1
+    per_knob = {}
+    for knob, env_name in TUNED_KNOB_ENV.items():
+        if os.environ.get(env_name, "").strip():
+            per_knob[knob] = {
+                "source": "env-override",
+                "value": os.environ[env_name].strip(),
+            }
+        elif knobs is not None and knob in knobs:
+            per_knob[knob] = {"source": "tuned", "value": knobs[knob]}
+        else:
+            default = KNOB_DEFAULTS.get(knob)
+            per_knob[knob] = {
+                "source": "default",
+                "value": list(default) if isinstance(default, tuple)
+                else default,
+            }
+    out = {
+        "active": (
+            {
+                "key": active_key,
+                "digest": digest,
+                "observations": doc.get("observations"),
+                "cost_model": doc.get("cost_model"),
+            }
+            if doc is not None else None
+        ),
+        "knobs": per_knob,
+        "config_log": (
+            {
+                "rev": log_doc.get("rev"),
+                "last_op": log_doc.get("last_op"),
+                "previous": (
+                    log_doc["previous"]["digest"]
+                    if log_doc.get("previous") else None
+                ),
+                "history": log_doc.get("history"),
+            }
+            if log_doc is not None else None
+        ),
+    }
+    print(json.dumps(out, indent=2))
+    return 0
 
 
 def cmd_tune(args) -> int:
@@ -436,7 +544,11 @@ def cmd_tune(args) -> int:
     model, and persist the tuned config under ``tuning/`` — the
     document ``serve --tuned-config latest`` (or the deployed
     BODYWORK_TPU_TUNED_CONFIG env knob) consumes. stdout is exactly ONE
-    JSON document (key, digest, knobs, decision trace)."""
+    JSON document (key, digest, knobs, decision trace).
+
+    ``cli tune status`` instead reports the ACTIVE config: digest,
+    fitted observation window, per-knob source, and the online
+    controller's apply/revert history (:func:`_tune_status`)."""
     from bodywork_tpu.obs.spans import SpanRecorder, write_chrome_trace
     from bodywork_tpu.tune.collect import (
         ObservationTable,
@@ -453,6 +565,8 @@ def cmd_tune(args) -> int:
     import json
 
     store = _store(args)
+    if getattr(args, "action", "fit") == "status":
+        return _tune_status(store)
     table = ObservationTable()
     try:
         for path in args.traffic_log or ():
@@ -481,6 +595,41 @@ def cmd_tune(args) -> int:
             # the probe is one evidence source, not a precondition
             log.warning(f"dispatch-cost probe unavailable ({exc!r}); "
                         "fitting from passive traces only")
+    cost_model_out = None
+    if table.dispatch_cost_s and not args.dry_run:
+        # a measured cost curve is also training data for the LEARNED
+        # cost model (tune/costmodel.py): fit + persist it alongside
+        # the tuned config so the online controller and the cost-priced
+        # shed can price what the probe never measured. Best-effort —
+        # a thin curve (< MIN_SAMPLES rungs) just skips.
+        try:
+            from bodywork_tpu.models.checkpoint import (
+                load_model,
+                resolve_serving_key,
+            )
+            from bodywork_tpu.tune.costmodel import (
+                fit_cost_model,
+                samples_from_probe,
+                write_cost_model,
+            )
+
+            serving_key, _src = resolve_serving_key(store)
+            model, _day = load_model(store, serving_key)
+            samples = samples_from_probe(
+                table.dispatch_cost_s, model.n_features or 1
+            )
+            cm_doc = fit_cost_model(samples)
+            cm_key, cm_digest = write_cost_model(store, cm_doc, _date(args))
+            cost_model_out = {
+                "key": cm_key, "digest": cm_digest,
+                "holdout": cm_doc["holdout"],
+            }
+            log.info(
+                f"cost model -> {cm_key} (holdout mean rel err "
+                f"{cm_doc['holdout']['mean_rel_err']:.3f})"
+            )
+        except Exception as exc:
+            log.warning(f"cost-model fit skipped ({exc!r})")
     if not table.sources:
         log.error(
             "nothing to tune from: no traces ingested and no probe — "
@@ -502,6 +651,7 @@ def cmd_tune(args) -> int:
         },
         "decisions": doc["decisions"],
         "observations": doc["observations"],
+        "cost_model": cost_model_out,
     }
     if args.dry_run:
         out["key"] = None
@@ -1928,11 +2078,55 @@ def build_parser() -> argparse.ArgumentParser:
              "missing or malformed document degrades to the built-in "
              "defaults with a warning, never a failed boot",
     )
+    p.add_argument(
+        "--online-tune", action="store_true",
+        default=_env_flag("BODYWORK_TPU_TUNE_ONLINE"),
+        help="arm the online re-tune controller (tune/online.py) on "
+             "the reload-watcher loop: incremental drift detection "
+             "over the --tune-*-log files, mid-flight knob refits "
+             "under a config-canary guard that auto-reverts a "
+             "regressing config in one CAS. Requires --reload-interval "
+             "> 0 (env BODYWORK_TPU_TUNE_ONLINE=1 overrides); "
+             "single-process serving only",
+    )
+    p.add_argument(
+        "--tune-request-log", action="append", metavar="FILE",
+        default=_env_paths("BODYWORK_TPU_TUNE_REQUEST_LOGS"),
+        help="a growing `traffic run` request log the online "
+             "controller watches incrementally (arrival process + row "
+             "shapes); repeatable (env BODYWORK_TPU_TUNE_REQUEST_LOGS "
+             "colon-separated)",
+    )
+    p.add_argument(
+        "--tune-results-log", action="append", metavar="FILE",
+        default=_env_paths("BODYWORK_TPU_TUNE_RESULTS_LOGS"),
+        help="a growing `traffic run --results-out` outcome log the "
+             "online controller watches incrementally; repeatable "
+             "(env BODYWORK_TPU_TUNE_RESULTS_LOGS colon-separated)",
+    )
+    p.add_argument(
+        "--cost-budget-s", type=float, metavar="S",
+        default=_env_number("BODYWORK_TPU_COST_BUDGET_S", float, 0.0),
+        help="arm the admission layer's cost-priced shed: bound the "
+             "ESTIMATED dispatch-seconds of admitted-and-unfinished "
+             "work, priced per request by the latest learned cost "
+             "model under tuning/ (env BODYWORK_TPU_COST_BUDGET_S "
+             "overrides; requires admission to be armed)",
+    )
 
     p = add(
         "tune", cmd_tune,
         help="fit the serving knobs from observed traces (docs/PERF.md "
-             "§config 13)",
+             "§config 13); `tune status` reports the active config + "
+             "apply/revert history",
+    )
+    p.add_argument(
+        "action", nargs="?", default="fit", choices=["fit", "status"],
+        help="fit (default): ingest traces and write a tuned config; "
+             "status: print the ACTIVE tuned config (digest, source "
+             "window, per-knob source incl. env overrides) and the "
+             "online controller's applied/reverted history from the "
+             "config log — exit 1 on a corrupt document",
     )
     p.add_argument("--store", **common_store)
     p.add_argument("--date", default=None,

@@ -73,10 +73,14 @@ from bodywork_tpu.utils.logging import get_logger
 log = get_logger("ops.slo")
 
 __all__ = [
+    "SERVICE_LATENCY_METRIC",
+    "SERVICE_REQUESTS_METRIC",
     "SloPolicy",
     "SloWatchdog",
     "histogram_quantile",
     "policy_from_env",
+    "serve_window_snapshot",
+    "serve_window_delta",
 ]
 
 #: bodywork_tpu_slo_watchdog_state encoding
@@ -88,6 +92,14 @@ REQUESTS_METRIC = "bodywork_tpu_serve_model_requests_total"
 ERRORS_METRIC = "bodywork_tpu_serve_model_errors_total"
 LATENCY_METRIC = "bodywork_tpu_serve_model_latency_seconds"
 VIOLATIONS_METRIC = "bodywork_tpu_serve_sanity_violations_total"
+
+#: families the CONFIG guard reads (:func:`serve_window_snapshot`).
+#: Deliberately NOT the per-stream families above: those are observed
+#: only while a model canary is live (zero hot-path cost otherwise),
+#: but a config change affects every request, always — so its guard
+#: reads the always-on whole-service counters
+SERVICE_REQUESTS_METRIC = "bodywork_tpu_http_requests_total"
+SERVICE_LATENCY_METRIC = "bodywork_tpu_scoring_latency_seconds"
 
 
 @dataclasses.dataclass
@@ -279,6 +291,67 @@ def _hist_buckets(name: str, **labels):
                 counts[i] += n
             total += sample["count"]
     return bounds, counts, total
+
+
+def serve_window_snapshot() -> dict:
+    """Cumulative WHOLE-SERVICE serving counters: scoring requests,
+    errors, and the success-latency histogram. The watchdog above
+    judges one canary STREAM against another through the stream
+    families (which only flow while a canary is live); a config change
+    (tuned knobs going live, :mod:`bodywork_tpu.tune.online`) affects
+    every request, always, so its guard reads the always-on families:
+    ``bodywork_tpu_http_requests_total`` over the scoring routes and
+    ``bodywork_tpu_scoring_latency_seconds``. An error here is a 5xx
+    OR a 429 — a config that sheds traffic the previous config served
+    (an absurd ``max_pending``) is exactly as reverted as one that
+    crashes requests, and it leaves no latency samples to catch it
+    otherwise."""
+    from bodywork_tpu.obs import get_registry
+
+    requests = errors = 0.0
+    metric = get_registry().get(SERVICE_REQUESTS_METRIC)
+    if metric is not None:
+        for sample in metric.snapshot_samples():
+            labels = sample["labels"]
+            if not labels.get("route", "").startswith("/score"):
+                continue
+            requests += sample["value"]
+            status = labels.get("status", "")
+            if status == "429" or status.startswith("5"):
+                errors += sample["value"]
+    bounds, buckets, count = _hist_buckets(SERVICE_LATENCY_METRIC)
+    return {
+        "requests": requests,
+        "errors": errors,
+        "bounds": bounds,
+        "buckets": buckets,
+        "count": count,
+    }
+
+
+def serve_window_delta(base: dict, now: dict) -> dict:
+    """The service-wide window between two :func:`serve_window_snapshot`
+    calls — a pure function of the two snapshots (no clocks, no RNG),
+    the same determinism contract as :meth:`SloPolicy.verdict`. Returns
+    ``requests``, ``errors``, ``error_rate``, ``p99_s`` (None on an
+    empty window), and ``latency_samples``."""
+    if len(base.get("buckets", [])) == len(now["buckets"]):
+        delta_buckets = [
+            b - a for a, b in zip(base["buckets"], now["buckets"])
+        ]
+    else:
+        # the histogram family first appeared mid-window: the base has
+        # no buckets to subtract, the cumulative counts ARE the delta
+        delta_buckets = list(now["buckets"])
+    requests = int(now["requests"] - base.get("requests", 0))
+    errors = int(now["errors"] - base.get("errors", 0))
+    return {
+        "requests": requests,
+        "errors": errors,
+        "error_rate": errors / max(requests, 1),
+        "p99_s": histogram_quantile(now["bounds"], delta_buckets, 0.99),
+        "latency_samples": int(now["count"] - base.get("count", 0)),
+    }
 
 
 class SloWatchdog:

@@ -284,6 +284,25 @@ def _container(
             ("BODYWORK_TPU_SLO_MAX_P99_RATIO", ""),
             ("BODYWORK_TPU_SLO_MAX_SANITY_VIOLATIONS", ""),
             ("BODYWORK_TPU_SLO_PROMOTE_AFTER_REQUESTS", ""),
+            # online tuning control plane (tune/online.py
+            # policy_from_env + cli serve): arm the drift-refit
+            # controller and retune its drift/revert thresholds with
+            # `kubectl set env` — empty = off / the coded defaults,
+            # and a malformed value degrades per-field, never a
+            # crash-looping pod
+            ("BODYWORK_TPU_TUNE_ONLINE", ""),
+            ("BODYWORK_TPU_TUNE_REQUEST_LOGS", ""),
+            ("BODYWORK_TPU_TUNE_RESULTS_LOGS", ""),
+            ("BODYWORK_TPU_TUNE_MIN_WINDOW_REQUESTS", ""),
+            ("BODYWORK_TPU_TUNE_DRIFT_THRESHOLD", ""),
+            ("BODYWORK_TPU_TUNE_COOLDOWN_POLLS", ""),
+            ("BODYWORK_TPU_TUNE_VERDICT_POLLS", ""),
+            ("BODYWORK_TPU_TUNE_MIN_VERDICT_REQUESTS", ""),
+            ("BODYWORK_TPU_TUNE_REVERT_ERROR_RATE", ""),
+            ("BODYWORK_TPU_TUNE_REVERT_P99_RATIO", ""),
+            # cost-priced admission shed (tune/costmodel.py +
+            # serve/admission.py): estimated dispatch-seconds budget
+            ("BODYWORK_TPU_COST_BUDGET_S", ""),
         ):
             if name not in declared:
                 env.append({"name": name, "value": value})

@@ -57,6 +57,7 @@ event-loop hot path.
 """
 from __future__ import annotations
 
+import collections
 import math
 import threading
 
@@ -69,6 +70,7 @@ __all__ = [
     "DEFAULT_MAX_PENDING",
     "SHED_TOTAL_METRIC",
     "QUEUE_DEPTH_METRIC",
+    "PENDING_COST_METRIC",
     "AdmissionController",
     "SharedBudgetSlot",
     "build_admission",
@@ -89,6 +91,9 @@ SHED_TOTAL_METRIC = "bodywork_tpu_serve_shed_total"
 #: admitted-and-unfinished scoring requests; gauge aggregate ``sum`` so
 #: the multiproc merge reports the service-wide queue
 QUEUE_DEPTH_METRIC = "bodywork_tpu_serve_queue_depth"
+#: estimated dispatch-seconds of admitted-and-unfinished work when the
+#: cost-priced shed is armed (:meth:`AdmissionController.configure_cost_shed`)
+PENDING_COST_METRIC = "bodywork_tpu_serve_pending_cost_seconds"
 
 
 def count_shed(reason: str) -> None:
@@ -205,7 +210,29 @@ class AdmissionController:
         self._ewma_delay_s: float | None = None
         self._shed_count = 0
         self._admitted_count = 0
+        #: cost-priced shed (:meth:`configure_cost_shed`): when armed,
+        #: each request is priced in estimated dispatch-seconds BEFORE
+        #: any parse-side queueing, and shed (reason="cost") while the
+        #: estimated cost of admitted-and-unfinished work exceeds the
+        #: budget. Off by default — the count budget alone preserves
+        #: historical behaviour.
+        self._cost_pricer = None
+        self._cost_budget_s: float | None = None
+        self._pending_cost_s = 0.0
+        #: per-admit estimates, consumed one per release. Releases can
+        #: complete out of order, so an individual pop may misattribute
+        #: WHICH estimate it retires — but every admit pushes exactly
+        #: once and every release pops exactly once, so the pending SUM
+        #: conserves (drains to zero when the queue does).
+        self._cost_fifo: collections.deque = collections.deque()
         reg = get_registry()
+        self._g_cost = reg.gauge(
+            PENDING_COST_METRIC,
+            "Estimated dispatch-seconds of admitted-and-unfinished work "
+            "(cost-priced shed; 0 when unarmed)",
+            aggregate="sum",
+        )
+        self._g_cost.set(0.0)
         self._g_depth = reg.gauge(
             QUEUE_DEPTH_METRIC,
             "Admitted-and-unfinished scoring requests (per worker; the "
@@ -244,19 +271,77 @@ class AdmissionController:
     def draining(self) -> bool:
         return self._draining
 
-    def try_admit(self) -> bool:
+    def configure_cost_shed(self, pricer, budget_s: float | None) -> None:
+        """Arm (or, with ``pricer=None``, disarm) the cost-priced shed:
+        ``pricer(rows)`` returns the estimated dispatch-seconds of a
+        request (``tune.costmodel.cost_pricer`` builds one from the
+        learned cost model), and admission sheds (reason ``"cost"``)
+        while the estimated cost of admitted-and-unfinished work would
+        exceed ``budget_s``. A count budget bounds HOW MANY requests are
+        held; the cost budget bounds how much device TIME they represent
+        — under a mixed-row-count workload the two disagree, and the
+        cost budget is the one that tracks the latency bound."""
+        if pricer is not None and (budget_s is None or budget_s <= 0.0):
+            raise ValueError(f"cost budget_s must be > 0, got {budget_s}")
+        with self._lock:
+            self._cost_pricer = pricer
+            self._cost_budget_s = float(budget_s) if pricer is not None else None
+            if pricer is None:
+                self._pending_cost_s = 0.0
+                self._cost_fifo.clear()
+        self._g_cost.set(self._pending_cost_s)
+
+    def _price(self, rows: int) -> float | None:
+        """Estimated dispatch-seconds for a ``rows``-row request, or
+        None when unarmed/broken (a broken pricer must never break
+        admission — same contract as the depth probe)."""
+        pricer = self._cost_pricer
+        if pricer is None:
+            return None
+        try:
+            est = float(pricer(rows))
+        except Exception:
+            return None
+        return est if est >= 0.0 and math.isfinite(est) else None
+
+    def try_admit(self, rows: int = 1) -> bool:
         """Admit one request against the pending budget. Returns False —
         and counts the shed — when the budget is exhausted, either by
         admitted-and-unfinished requests or by upstream backlog (the
         depth probe; ``>`` not ``>=`` because the probing request's own
         connection is part of that count), or when the controller is
         draining for shutdown. O(1), no allocation: this runs before
-        any per-request work."""
+        any per-request work.
+
+        ``rows`` (advisory, from the transport's cheap pre-parse hint)
+        feeds the cost-priced shed when armed: the request's estimated
+        dispatch cost is priced BEFORE parse-side queueing, and admission
+        refuses (reason ``"cost"``) while pending estimated cost would
+        exceed the configured budget. Callers that cannot know the row
+        count pass the default 1 — the estimate degrades toward the
+        count budget, it never blocks."""
         if self._draining:
             with self._lock:
                 self._shed_count += 1
             count_shed("drain")
             return False
+        est = self._price(rows)
+        if est is not None:
+            with self._lock:
+                budget = self._cost_budget_s
+                over = (
+                    budget is not None
+                    and self._pending_cost_s + est > budget
+                    # never shed an EMPTY service on price alone: one
+                    # oversized request must still make progress, else a
+                    # budget below one request's cost is a full outage
+                    and self._pending_cost_s > 0.0
+                )
+                if over:
+                    self._shed_count += 1
+            if over:
+                count_shed("cost")
+                return False
         external = self._external_depth()
         shared = self._shared
         if shared is not None:
@@ -277,10 +362,14 @@ class AdmissionController:
                     self._admitted_count += 1
                     if shared_total > self.max_observed_pending:
                         self.max_observed_pending = shared_total
+                    cost = self._cost_admit_locked(est)
                 else:
                     self._shed_count += 1
+                    cost = None
                 depth = self._pending
             self._g_depth.set(float(depth))
+            if cost is not None:
+                self._g_cost.set(cost)
             if not admitted:
                 count_shed("admission")
                 return False
@@ -292,19 +381,33 @@ class AdmissionController:
             ):
                 self._shed_count += 1
                 shed = True
+                cost = None
                 depth = max(self._pending, external)
             else:
                 self._pending += 1
                 self._admitted_count += 1
                 if self._pending > self.max_observed_pending:
                     self.max_observed_pending = self._pending
+                cost = self._cost_admit_locked(est)
                 depth = max(self._pending, external)
                 shed = False
         self._g_depth.set(float(depth))
+        if cost is not None:
+            self._g_cost.set(cost)
         if shed:
             count_shed("admission")
             return False
         return True
+
+    def _cost_admit_locked(self, est: float | None) -> float | None:
+        """Record one admitted request's cost estimate (caller holds
+        ``_lock``); returns the new pending cost, or None when the cost
+        shed is unarmed / this request was unpriced."""
+        if est is None:
+            return None
+        self._pending_cost_s += est
+        self._cost_fifo.append(est)
+        return self._pending_cost_s
 
     def release(self, observed_delay_s: float | None = None) -> None:
         """Return one unit of budget; ``observed_delay_s`` (admission ->
@@ -320,10 +423,19 @@ class AdmissionController:
                 self._pending -= 1
                 if shared is not None:
                     shared.release()
+                if self._cost_fifo:
+                    # retire one admit's estimate; clamp so a mid-flight
+                    # configure_cost_shed can only UNDER-count pending
+                    # cost (degrade toward the count budget, never shed
+                    # on phantom cost)
+                    self._pending_cost_s = max(
+                        0.0, self._pending_cost_s - self._cost_fifo.popleft()
+                    )
             depth = (
                 self._pending if shared is not None
                 else max(self._pending, external)
             )
+            cost = self._pending_cost_s
             if observed_delay_s is not None and observed_delay_s >= 0.0:
                 if self._ewma_delay_s is None:
                     self._ewma_delay_s = float(observed_delay_s)
@@ -334,6 +446,8 @@ class AdmissionController:
                         + (1.0 - a) * self._ewma_delay_s
                     )
         self._g_depth.set(float(depth))
+        if self._cost_pricer is not None:
+            self._g_cost.set(cost)
 
     # -- signals ------------------------------------------------------------
     @property
@@ -386,6 +500,9 @@ class AdmissionController:
             ewma = self._ewma_delay_s
             shed = self._shed_count
             admitted = self._admitted_count
+            cost_armed = self._cost_pricer is not None
+            pending_cost = self._pending_cost_s
+            cost_budget = self._cost_budget_s
         budget_used = shared_total if shared_total is not None else pending
         depth = max(budget_used, external)
         return {
@@ -408,6 +525,15 @@ class AdmissionController:
             "ewma_queue_delay_s": round(ewma, 6) if ewma is not None else None,
             "admitted_total": admitted,
             "shed_total": shed,
+            # cost-priced shed (learned dispatch-cost model): None until
+            # configure_cost_shed arms it
+            "cost_shed": (
+                {
+                    "pending_cost_s": round(pending_cost, 6),
+                    "budget_s": cost_budget,
+                }
+                if cost_armed else None
+            ),
         }
 
 

@@ -277,6 +277,10 @@ class ScoringApp:
         #: it); rides /healthz so probes and the traffic harness can see
         #: the release loop's state without scraping /metrics
         self.slo_state: dict | None = None
+        #: the online tune controller's latest state (tune/online.py
+        #: publishes it every poll); rides /healthz next to the
+        #: watchdog block for the same reason
+        self.tune_state: dict | None = None
         self._plan_getter = None  # lazy chaos-plan resolver (canary latency)
         # opt-in request coalescer (serve.batcher.RequestCoalescer);
         # None = every request dispatches its own padded device call
@@ -1127,6 +1131,7 @@ class ScoringApp:
                         self._canary_fraction if canary is not None else None
                     ),
                     "watchdog": self.slo_state,
+                    "tuning": self.tune_state,
                     "queue_depth": queue_depth,
                     "admission": admission_state,
                     # live knob values (coalescer/admission exist even
@@ -1172,6 +1177,9 @@ class ScoringApp:
                 self._canary_fraction if canary is not None else None
             ),
             "watchdog": self.slo_state,
+            # the config-release channel (tune/online.py): drift /
+            # guard / revert state, same rationale as the watchdog block
+            "tuning": self.tune_state,
             "degraded": reason is not None,
             # saturation channel (serve.admission): current depth plus —
             # when admission is on — budget, shedding state, and the

@@ -206,6 +206,41 @@ class RequestCoalescer:
         )
         return self
 
+    def reconfigure(self, window_ms: float | None = None,
+                    max_rows: int | None = None) -> dict:
+        """Mutate the live coalescing policy in place (the online tuning
+        controller's apply path): the dispatcher reads ``window_s`` /
+        ``max_rows`` fresh on every loop iteration under ``_cond``, so a
+        change here takes effect on the NEXT batch boundary — no drain,
+        no dropped submissions, in-flight batches finish under the
+        policy they started with. Validation matches the constructor
+        (``window_ms`` must stay > 0: coalescing on/off is an app-level
+        topology decision — a dispatcher thread cannot un-exist — so
+        the 0=off transition is deliberately NOT live-mutable and the
+        controller pins that in its mutable-live contract). Returns the
+        applied values."""
+        if window_ms is not None and window_ms <= 0:
+            raise ValueError(f"window_ms must be > 0, got {window_ms}")
+        if max_rows is not None and max_rows < 1:
+            raise ValueError(f"max_rows must be >= 1, got {max_rows}")
+        with self._cond:
+            if window_ms is not None:
+                self.window_s = window_ms / 1000.0
+            if max_rows is not None:
+                self.max_rows = int(max_rows)
+            # wake the dispatcher so a SHORTENED window re-arms its
+            # deadline now instead of after the old (longer) wait
+            self._cond.notify_all()
+            applied = {
+                "window_ms": round(self.window_s * 1e3, 3),
+                "max_rows": self.max_rows,
+            }
+        log.info(
+            f"coalescer reconfigured live: window="
+            f"{applied['window_ms']}ms max_rows={applied['max_rows']}"
+        )
+        return applied
+
     def stop(self) -> None:
         """Flush everything already enqueued, then stop the dispatcher.
         Late ``submit()`` calls raise :class:`CoalescerSaturated` (the

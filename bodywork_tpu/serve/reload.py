@@ -57,6 +57,7 @@ class CheckpointWatcher:
         slo_watchdog=None,
         dtype: str = "float32",
         mesh_model: int = 1,
+        tune_controller=None,
     ):
         # one watcher drives every replica app: replicas share read-only
         # model state by design, so one load+warm serves them all
@@ -113,6 +114,13 @@ class CheckpointWatcher:
         #: optional SLO watchdog (ops/slo.py), driven once per poll —
         #: the loop that makes canary abort/promote automatic
         self.slo_watchdog = slo_watchdog
+        #: optional online tune controller (tune/online.py), driven once
+        #: per poll right after the watchdog — model releases and config
+        #: releases share one cadence. Wiring here (not in the
+        #: controller) gives it the ladder-apply path below.
+        self.tune_controller = tune_controller
+        if tune_controller is not None and tune_controller.apply_buckets is None:
+            tune_controller.apply_buckets = self.apply_bucket_ladder
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="checkpoint-watcher", daemon=True
@@ -136,6 +144,7 @@ class CheckpointWatcher:
             )
         except ArtefactNotFound:
             self._poll_watchdog()
+            self._poll_tuner()
             return False
         except Exception as exc:
             # e.g. registry.records.RegistryCorrupt: falling back to
@@ -175,6 +184,7 @@ class CheckpointWatcher:
                     )
                 self._sync_canary(canary_state, canary_dangling)
                 self._poll_watchdog()
+                self._poll_tuner()
                 return False
             # swap_model is an atomic bundle swap; for apps with a request
             # coalescer it ALSO drains the batch queue before returning.
@@ -193,6 +203,7 @@ class CheckpointWatcher:
             swapped = True
         self._sync_canary(canary_state, canary_dangling)
         self._poll_watchdog()
+        self._poll_tuner()
         return swapped
 
     def _build_swap_predictor(self, model):
@@ -318,6 +329,46 @@ class CheckpointWatcher:
                 bounds=state.get("bounds"),
             )
         self._current_canary = desired
+
+    def apply_bucket_ladder(self, buckets: tuple) -> None:
+        """Swap the SERVED predictor onto a new bucket ladder without
+        changing the model — the online tune controller's ladder-apply
+        path. The current checkpoint is re-loaded and a predictor over
+        ``buckets`` is built + warmed on the calling (watcher) thread
+        before the atomic swap, exactly like a model reload: with the
+        process-wide AOT executable cache, a ladder whose rungs were
+        ever compiled for this architecture swaps in with ZERO compile
+        work, and a genuinely new rung pays its compile here, never on
+        a scoring request. The explicit ladder is pinned as this
+        watcher's bucket policy so later model swaps keep it."""
+        key = self.apps[0].model_key
+        if key is None:
+            raise RuntimeError("no model is served; cannot apply a ladder")
+        model, model_date = load_model(self.store, key)
+        self.buckets = tuple(buckets)
+        predictor = self._build_swap_predictor(model)
+        bounds = self._record_bounds(key)
+        source = self.apps[0].model_source
+        # identity-preserving swap: same model, same key/date/source ->
+        # same response templates, so bodies stay byte-identical across
+        # the ladder change (the mid-flight apply test pins this)
+        for app in self.apps:
+            app.swap_model(model, model_date, predictor,
+                           model_key=key, model_source=source,
+                           model_bounds=bounds)
+        self._current = (key, self.store.version_token(key))
+        log.info(f"bucket ladder applied live: {tuple(buckets)}")
+
+    def _poll_tuner(self) -> None:
+        """Drive the online tune controller once per poll. Sibling of
+        :meth:`_poll_watchdog`; a controller error must never kill
+        model reloads."""
+        if self.tune_controller is None:
+            return
+        try:
+            self.tune_controller.poll()
+        except Exception as exc:
+            log.error(f"online tune poll failed: {exc!r}")
 
     def _poll_watchdog(self) -> None:
         """Drive the SLO watchdog once per poll. A promote re-anchors

@@ -492,8 +492,23 @@ def serve_latest_model(
     dtype: str = "float32",
     mesh_model: int = 1,
     tuned_config: str | None = None,
+    online_tune: bool = False,
+    tune_request_logs: tuple = (),
+    tune_results_logs: tuple = (),
+    cost_budget_s: float | None = None,
 ):
     """Load latest model -> HBM, warm up, serve (reference ``stage_2`` main).
+
+    ``online_tune`` (env ``BODYWORK_TPU_TUNE_ONLINE`` via ``cli serve
+    --online-tune``) arms the online re-tune controller
+    (``tune/online.py``) on the reload-watcher loop — it requires
+    ``watch_interval_s`` (the controller IS a watcher passenger) and
+    watches ``tune_request_logs`` / ``tune_results_logs`` (growing
+    ``traffic run`` JSONL files) incrementally for traffic-shape drift,
+    refitting and applying knobs mid-flight under the config-canary
+    guard. ``cost_budget_s`` additionally arms the admission layer's
+    cost-priced shed from the latest learned cost model, bounding the
+    estimated dispatch-seconds of admitted work.
 
     ``tuned_config`` names a tuned serving-config document (a
     ``tuning/`` store key, or ``"latest"`` — ``cli tune``'s output,
@@ -611,6 +626,29 @@ def serve_latest_model(
         admission=admission, model_bounds=model_bounds,
     )
     app.tuned_config_digest = tuned_digest
+    if cost_budget_s and admission is not None and model is not None:
+        # cost-priced shed: price each request's estimated dispatch
+        # cost from the learned cost model BEFORE parse-side queueing.
+        # Degrades armlessly when no model document exists yet.
+        from bodywork_tpu.tune.costmodel import cost_pricer, load_cost_model
+
+        cm_doc, cm_digest = load_cost_model(store, "latest")
+        if cm_doc is not None:
+            admission.configure_cost_shed(
+                cost_pricer(
+                    cm_doc, model.n_features or 1, buckets=buckets,
+                ),
+                cost_budget_s,
+            )
+            log.info(
+                f"cost-priced shed armed (model {cm_digest[:23]}..., "
+                f"budget {cost_budget_s}s)"
+            )
+        else:
+            log.warning(
+                "cost-priced shed requested but no cost model is "
+                "readable under tuning/; admission stays count-only"
+            )
     if server_engine == "aio":
         from bodywork_tpu.serve.aio import AioServiceHandle
 
@@ -628,6 +666,21 @@ def serve_latest_model(
         # all poll on the same cadence as checkpoint swaps. Idle cost
         # with no canary live: one attribute read per poll.
         watchdog = SloWatchdog(store, [app], policy=policy_from_env())
+        tune_controller = None
+        if online_tune:
+            from bodywork_tpu.tune.online import (
+                OnlineTuneController,
+                policy_from_env as tune_policy_from_env,
+            )
+
+            tune_controller = OnlineTuneController(
+                store, app, policy=tune_policy_from_env(),
+                request_logs=tune_request_logs,
+                results_logs=tune_results_logs,
+            )
+            # reachable from handle.app for operational drills (the
+            # sabotage path injects through apply_tuned, not a fork)
+            app.tune_controller = tune_controller
         watcher = CheckpointWatcher(
             app, store, poll_interval_s=watch_interval_s,
             mesh_data=mesh_data, mesh_model=mesh_model, engine=engine,
@@ -638,9 +691,16 @@ def serve_latest_model(
             buckets=buckets,
             slo_watchdog=watchdog,
             dtype=dtype,
+            tune_controller=tune_controller,
         )
         watcher.start()
         handle.add_cleanup(watcher.stop)
+    elif online_tune:
+        log.warning(
+            "--online-tune requested without a watch interval; the "
+            "controller rides the reload-watcher loop — set "
+            "watch_interval_s to arm it"
+        )
     if block:
         try:
             handle.serve_forever()

@@ -284,6 +284,25 @@ def tuned_config_key(d: date) -> str:
     return f"{TUNING_PREFIX}tuned-config-{d}.json"
 
 
+def cost_model_key(d: date) -> str:
+    """The learned dispatch-cost model fitted on day ``d``
+    (``bodywork_tpu/tune/costmodel.py``). Lives under ``tuning/`` with
+    the tuned config (same derived-artefact delete-safety, same audit
+    coverage); its distinct basename keeps tuned-config ``latest``
+    resolution (which filters on basename) and the fsck checker's
+    per-kind validation unambiguous."""
+    return f"{TUNING_PREFIX}cost-model-{d}.json"
+
+
+#: the config-lifecycle log (``registry/configlog.py``): which tuned
+#: config is ACTIVE in the serving plane, which one preceded it, and
+#: the applied/reverted event history. Like the registry alias document
+#: it is a live CAS-mutated pointer — no embedded date, invisible to
+#: the ``history``/``latest`` protocol by design, written ONLY via
+#: ``put_bytes_if_match``.
+CONFIG_LOG_KEY = f"{TUNING_PREFIX}config-log.json"
+
+
 def audit_digest_key(key: str) -> str:
     """The write-time digest sidecar for artefact ``key``
     (``bodywork_tpu/audit/manifest.py``): the primary key path mirrored

@@ -17,9 +17,22 @@ Three layers:
   (:func:`~bodywork_tpu.tune.config.resolve_serving_knobs`).
 
 ``cli tune`` drives all three; bench config 13 proves tuned >= hand-set
-on seeded traffic profiles. This ``__init__`` re-exports only the
-jax-free config layer — the collector's probe (which needs the real
-predictor) imports lazily, so fsck and the CLI parser stay light.
+on seeded traffic profiles. Two online layers close the loop against
+LIVE traffic (bench config 18):
+
+- :mod:`bodywork_tpu.tune.costmodel` — the learned dispatch-cost model:
+  a seeded closed-form ridge over probe samples, persisted under
+  ``tuning/``, pricing unprobed ladder rungs for the fitter and
+  per-request cost for the admission layer's cost-priced shed.
+- :mod:`bodywork_tpu.tune.online` — the online re-tune controller
+  (reload-watcher sibling of the SLO watchdog): incremental log
+  ingestion, drift detection, mid-flight knob application, and the
+  config-canary guard that auto-reverts a regressing config in one
+  CAS (``registry/configlog.py``).
+
+This ``__init__`` re-exports only the jax-free config layer — the
+collector's probe (which needs the real predictor) imports lazily, so
+fsck and the CLI parser stay light.
 """
 from bodywork_tpu.tune.config import (
     KNOB_DEFAULTS,

@@ -51,11 +51,14 @@ from bodywork_tpu.utils.logging import get_logger
 log = get_logger("tune.collect")
 
 __all__ = [
+    "IngestCursor",
     "ObservationTable",
     "ingest_day_report",
     "ingest_obs_snapshot",
     "ingest_request_log",
+    "ingest_request_log_incremental",
     "ingest_results_log",
+    "ingest_results_log_incremental",
     "probe_dispatch_costs",
 ]
 
@@ -270,6 +273,158 @@ def ingest_results_log(table: ObservationTable, path: str | Path) -> int:
         )
     table.sources.append(f"results_log:{path.name}")
     return n
+
+
+# -- incremental ingestion (the online controller's O(new entries) path) ---
+
+
+@dataclasses.dataclass
+class IngestCursor:
+    """Byte-offset resume state for ONE growing log file.
+
+    The offline ``cli tune`` flow reads each file once, so the whole-
+    file ingestors above are fine there — but the online controller
+    (``tune/online.py``) re-ingests its watch files EVERY poll, and a
+    whole-file re-read per poll makes a long-lived controller O(file)
+    instead of O(new entries). The cursor carries everything a resumed
+    parse needs: the byte offset of the first unconsumed line, the last
+    scheduled arrival (inter-arrival gaps must bridge poll boundaries),
+    and the running outcome counts the results-log saturation heuristic
+    is defined over (it is a whole-drive rate, not a tail rate).
+
+    Only COMPLETE lines are ever consumed — a partially-written tail
+    line (the live writer mid-append) stays un-offset for the next
+    poll, so a torn JSON line can never poison the table."""
+
+    offset: int = 0
+    last_t: float | None = None
+    entries: int = 0
+    ok: int = 0
+    shed: int = 0
+    span_t: float = 0.0
+
+
+def _count_ingest(kind: str, entries: int, n_bytes: int) -> None:
+    from bodywork_tpu.obs import get_registry
+
+    reg = get_registry()
+    reg.counter(
+        "bodywork_tpu_tune_ingest_entries_total",
+        "Log entries folded into tuning observation tables by the "
+        "incremental ingestors, by log kind",
+    ).inc(entries, kind=kind)
+    reg.counter(
+        "bodywork_tpu_tune_ingest_bytes_total",
+        "Bytes consumed by the incremental tuning-log ingestors, by "
+        "log kind — per-poll deltas prove the controller stays "
+        "O(new entries), not O(file)",
+    ).inc(n_bytes, kind=kind)
+
+
+def _new_complete_lines(path: Path, offset: int):
+    """``(lines, new_offset, bytes_consumed)`` for every complete line
+    appended since ``offset``."""
+    with path.open("rb") as f:
+        f.seek(offset)
+        chunk = f.read()
+    end = chunk.rfind(b"\n")
+    if end < 0:
+        return [], offset, 0
+    consumed = end + 1
+    return (
+        chunk[:consumed].decode("utf-8").splitlines(),
+        offset + consumed,
+        consumed,
+    )
+
+
+def ingest_request_log_incremental(
+    table: ObservationTable, path: str | Path,
+    cursor: IngestCursor | None = None,
+) -> IngestCursor:
+    """Fold every request-log entry appended since ``cursor`` into the
+    table and return the advanced cursor (a fresh one reads from the
+    top, validating the header exactly like :func:`ingest_request_log`).
+    Entry semantics are identical to the whole-file ingestor; only the
+    I/O pattern differs."""
+    path = Path(path)
+    cursor = cursor or IngestCursor()
+    lines, new_offset, n_bytes = _new_complete_lines(path, cursor.offset)
+    start = 0
+    if cursor.offset == 0 and lines:
+        header = json.loads(lines[0])
+        if header.get("schema") != "bodywork_tpu.request_log/1":
+            raise ValueError(
+                f"{path}: not a request log "
+                f"(schema {header.get('schema')!r})"
+            )
+        start = 1
+    n = 0
+    for line in lines[start:]:
+        if not line.strip():
+            continue
+        entry = json.loads(line)
+        t = float(entry["t_s"])
+        if cursor.last_t is not None and t >= cursor.last_t:
+            table.interarrival_s.append(t - cursor.last_t)
+        cursor.last_t = t
+        table.row_counts.append(_request_rows(entry))
+        n += 1
+    cursor.offset = new_offset
+    cursor.entries += n
+    if n:
+        table.sources.append(f"request_log:{path.name}[+{n}]")
+    _count_ingest("request_log", n, n_bytes)
+    return cursor
+
+
+def ingest_results_log_incremental(
+    table: ObservationTable, path: str | Path,
+    cursor: IngestCursor | None = None,
+) -> IngestCursor:
+    """Incremental sibling of :func:`ingest_results_log`. The
+    saturation heuristic runs over the cursor's RUNNING totals (ok /
+    shed / span) — saturation is a whole-drive property, and judging it
+    from one poll's tail alone would flap."""
+    path = Path(path)
+    cursor = cursor or IngestCursor()
+    lines, new_offset, n_bytes = _new_complete_lines(path, cursor.offset)
+    n = 0
+    for line in lines:
+        if not line.strip():
+            continue
+        entry = json.loads(line)
+        t = float(entry["t_s"])
+        cursor.span_t = max(cursor.span_t, t)
+        if cursor.last_t is not None and t >= cursor.last_t:
+            table.interarrival_s.append(t - cursor.last_t)
+        cursor.last_t = t
+        if "rows" in entry:
+            table.row_counts.append(_request_rows(entry))
+        status = entry.get("status")
+        if status == 200:
+            cursor.ok += 1
+            if entry.get("latency_s") is not None:
+                table.latency_s.append(float(entry["latency_s"]))
+        elif status == 429:
+            cursor.shed += 1
+        if entry.get("retry_after_s") is not None:
+            table.queue_delay_s.append(float(entry["retry_after_s"]))
+        n += 1
+    cursor.offset = new_offset
+    cursor.entries += n
+    if cursor.entries and cursor.ok:
+        span = max(cursor.span_t, 1e-6)
+        offered = cursor.entries / span
+        goodput = cursor.ok / span
+        if cursor.shed / cursor.entries > 0.02 or offered > 1.3 * goodput:
+            table.saturated_goodput_rps = max(
+                table.saturated_goodput_rps or 0.0, goodput
+            )
+    if n:
+        table.sources.append(f"results_log:{path.name}[+{n}]")
+    _count_ingest("results_log", n, n_bytes)
+    return cursor
 
 
 def _histogram_moments(entry: dict) -> tuple[float, int]:

@@ -229,12 +229,20 @@ def write_tuned_config(store: ArtefactStore, doc: dict,
 
 def _resolve_ref(store: ArtefactStore, ref: str) -> str | None:
     """A tuned-config reference -> a concrete store key: ``latest``
-    resolves through the standard date-key protocol; anything else is
-    taken as the key itself."""
+    resolves through the standard date-key protocol, restricted to
+    ``tuned-config-*`` basenames — ``tuning/`` also holds the learned
+    cost model (date-keyed) and the config-lifecycle log, and a cost
+    model fitted AFTER the newest tuned config must not shadow it.
+    Anything else is taken as the key itself."""
     if ref == "latest":
         try:
-            key, _d = store.latest(TUNING_PREFIX)
-            return key
+            hist = [
+                (key, d) for key, d in store.history(TUNING_PREFIX)
+                if key.rsplit("/", 1)[-1].startswith("tuned-config-")
+            ]
+            if not hist:
+                return None
+            return hist[-1][0]
         except ArtefactNotFound:
             return None
     return ref

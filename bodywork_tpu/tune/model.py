@@ -270,10 +270,58 @@ def _decide_max_pending(table: ObservationTable, default: int) -> dict:
     }
 
 
+def _price_unprobed_rungs(table: ObservationTable, cost_model: dict):
+    """Augment the measured dispatch-cost curve with LEARNED prices for
+    every candidate power-of-two rung the probe never timed
+    (``tune/costmodel.py``) — measured entries always win. Returns
+    ``(augmented_table, provenance)`` without mutating the caller's
+    table; provenance (model digest + priced rungs + the model's own
+    held-out error bound) rides the tuned document so an audit can see
+    exactly which decisions leaned on extrapolation."""
+    import dataclasses as _dc
+
+    from bodywork_tpu.tune.costmodel import predict_cost
+
+    samples = cost_model.get("samples") or []
+    if not samples:
+        return table, None
+
+    def _majority(field, default):
+        counts: dict = {}
+        for s in samples:
+            v = s.get(field, default)
+            counts[v] = counts.get(v, 0) + 1
+        return max(counts.items(), key=lambda kv: (kv[1], str(kv[0])))[0]
+
+    n_features = int(_majority("n_features", 1))
+    dtype = str(_majority("dtype", "float32"))
+    mesh = int(_majority("mesh_devices", 1))
+    candidates = [2 ** i for i in range(int(math.log2(_MAX_BUCKET)) + 1)]
+    priced = {
+        b: predict_cost(cost_model, b, n_features, dtype, mesh)
+        for b in candidates if b not in table.dispatch_cost_s
+    }
+    provenance = {
+        "digest": cost_model.get("doc_digest"),
+        "priced_buckets": sorted(priced),
+        "measured_buckets": sorted(table.dispatch_cost_s),
+        "holdout": cost_model.get("holdout"),
+    }
+    if not priced:
+        return table, provenance
+    augmented = _dc.replace(
+        table,
+        dispatch_cost_s={**priced, **table.dispatch_cost_s},
+        sources=list(table.sources) + ["cost_model"],
+    )
+    return augmented, provenance
+
+
 def fit_tuned_config(
     table: ObservationTable,
     defaults: dict | None = None,
     recorder=None,
+    cost_model: dict | None = None,
 ) -> dict:
     """Fit every knob from ``table``; returns the tuned-config document
     body (knobs + decision trace + observation summary — the writer
@@ -283,8 +331,20 @@ def fit_tuned_config(
 
     ``recorder`` (an ``obs.spans.SpanRecorder``) gets one span per knob
     with chosen-vs-default meta — the decision trace ``cli tune
-    --trace-out`` renders through the existing Chrome emitter."""
+    --trace-out`` renders through the existing Chrome emitter.
+
+    ``cost_model`` (a loaded ``tune.costmodel`` document) prices the
+    candidate ladder rungs the probe never measured, so the knee and
+    window decisions see the FULL power-of-two curve instead of
+    degrading wherever the probe was thin; the document records which
+    rungs were priced vs measured (still a pure function — of the table
+    AND the model document)."""
     defaults = {**KNOB_DEFAULTS, **(defaults or {})}
+    cost_model_provenance = None
+    if cost_model is not None:
+        table, cost_model_provenance = _price_unprobed_rungs(
+            table, cost_model
+        )
     max_rows_decision = _decide_max_rows(table, defaults["batch_max_rows"])
     max_rows = max_rows_decision["chosen"]
     window_decision = _decide_window(
@@ -340,7 +400,7 @@ def fit_tuned_config(
             if d["source"] == "fitted"
         )
     )
-    return {
+    doc = {
         "knobs": {
             k: (list(v) if isinstance(v, tuple) else v)
             for k, v in accepted.items()
@@ -352,3 +412,6 @@ def fit_tuned_config(
             for k, v in defaults.items()
         },
     }
+    if cost_model_provenance is not None:
+        doc["cost_model"] = cost_model_provenance
+    return doc

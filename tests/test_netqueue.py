@@ -788,13 +788,15 @@ def test_config16_full_sweep():
 
 
 def test_config_registry_includes_16():
-    """The ISSUE-18 satellite (grown by ISSUE 19): the config tables
-    really carry configs 16 and 17 (the generic sync guard can't notice
-    a config that is missing from ALL three tables at once)."""
+    """The ISSUE-18 satellite (grown by ISSUEs 19 and 20): the config
+    tables really carry configs 16-18 (the generic sync guard can't
+    notice a config that is missing from ALL three tables at once)."""
     import bench
 
-    assert set(bench.ALL_CONFIGS) == set(range(1, 18))
+    assert set(bench.ALL_CONFIGS) == set(range(1, 19))
     assert 16 in bench.CONFIG_BENCHES
     assert bench.CONFIG_TIMEOUT_S[16] > 0
     assert 17 in bench.CONFIG_BENCHES
     assert bench.CONFIG_TIMEOUT_S[17] > 0
+    assert 18 in bench.CONFIG_BENCHES
+    assert bench.CONFIG_TIMEOUT_S[18] > 0

@@ -946,3 +946,23 @@ def test_bench_config13_full_acceptance():
             profile["tuned_config_digest"]
         )
     assert record["value"] >= 1, record["profiles"]
+
+
+def test_knob_universe_is_pinned_four_ways():
+    """The four places a knob name lives must agree EXACTLY: what the
+    fitter decides, what the validator accepts, what the env channel
+    deploys, and what the online controller may mutate live. A knob
+    added to one surface but not the others silently never ships (or
+    worse: ships but can never be reverted)."""
+    from bodywork_tpu.tune.config import TUNED_KNOB_ENV, _VALIDATORS
+    from bodywork_tpu.tune.online import MUTABLE_LIVE_KNOBS
+
+    t = ObservationTable()
+    t.interarrival_s = [0.002] * 400
+    t.row_counts = [1] * 300 + [300] * 100
+    t.dispatch_cost_s = dict(_CURVE)
+    t.sources = ["synthetic"]
+    decided = {d["knob"] for d in fit_tuned_config(t)["decisions"]}
+    assert decided == set(_VALIDATORS)
+    assert decided == set(TUNED_KNOB_ENV)
+    assert decided == set(MUTABLE_LIVE_KNOBS)
